@@ -1,0 +1,74 @@
+"""Benchmark: training throughput of the flagship model on the available
+chip(s).  Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline anchor: the reference's headline "ZeRO-3 Offload sustains up to
+50 TFLOPs/GPU" (BASELINE.md, docs/_posts/2021-03-08-zero3-offload.md:65);
+``vs_baseline`` = our achieved model TFLOPs/chip ÷ 50.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = TransformerConfig.gpt2_125m(remat=True)
+        batch, seq, steps = 8, 1024, 20
+    else:  # CI smoke
+        cfg = TransformerConfig.tiny()
+        batch, seq, steps = 4, 128, 3
+
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+
+    ds_config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.0}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+    }
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=ds_config)
+
+    rng = np.random.default_rng(0)
+    def make_batch():
+        return {"input_ids": rng.integers(0, cfg.vocab_size, (batch, seq))}
+
+    # warmup/compile
+    engine.train_batch(batch=make_batch())
+    jax.block_until_ready(engine.state)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=make_batch())
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    # 6ND flops per token for fwd+bwd
+    n_params = cfg.num_params()
+    tflops = 6.0 * n_params * tokens_per_sec / 1e12
+    n_chips = max(1, len(jax.devices()))
+    result = {
+        "metric": f"train_tokens_per_sec_per_chip_gpt2_125m_bf16_seq{seq}",
+        "value": round(tokens_per_sec / n_chips, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tflops / n_chips / 50.0, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capabilities of DeepSpeed (reference: FreyaRao/DeepSpeed 0.8.3), built on
+JAX/XLA/Pallas.
+
+Top-level API parity: reference ``deepspeed/__init__.py`` (``initialize:52``,
+``init_inference:233``, ``init_distributed``, ``add_config_arguments``).
+"""
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator  # noqa: F401
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               tp_rules=None,
+               collate_fn=None,
+               config=None,
+               config_params=None):
+    """Initialise the training engine.
+
+    Parity: reference ``deepspeed/__init__.py:52``.  Differences forced by the
+    functional paradigm:
+
+    * ``model`` is a callable ``loss_fn(params, batch, rng) -> loss`` (or an
+      object with ``.loss``), not an ``nn.Module``;
+    * ``model_parameters`` is the params *pytree* (it is required);
+    * ``optimizer`` (optional) is an optax ``GradientTransformation``;
+    * ``mesh``/``tp_rules`` configure the device mesh and tensor-parallel
+      sharding rules (the reference takes an ``mpu`` object for this).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    assert model is not None, "deepspeed_tpu.initialize: model is required"
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config") \
+            and args.deepspeed_config is not None:
+        config = args.deepspeed_config
+    assert config is not None, \
+        "DeepSpeed requires --deepspeed_config or the config= argument"
+
+    if not isinstance(config, DeepSpeedConfig):
+        config = DeepSpeedConfig(config)
+
+    engine = DeepSpeedEngine(
+        model=model,
+        config=config,
+        params=model_parameters,
+        optimizer=optimizer,
+        lr_scheduler=lr_scheduler,
+        mesh=mesh,
+        tp_rules=tp_rules,
+        collate_fn=collate_fn,
+        training_data=training_data)
+
+    return engine, engine.tx, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
+    """Parity: reference ``deepspeed/__init__.py:233``.  Config kwargs
+    (``mp_size=2`` etc.) merge into ``config`` like the reference; ``params``
+    is the weights pytree (functional-paradigm addition)."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg_dict = dict(config or {})
+    cfg_dict.update(kwargs)
+    cfg = DeepSpeedInferenceConfig(cfg_dict)
+    return InferenceEngine(model, cfg, params=params, mesh=mesh)
+
+
+def add_config_arguments(parser):
+    """Parity: reference ``deepspeed/__init__.py add_config_arguments``."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, parity)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true")
+    group.add_argument("--deepscale_config", default=None, type=str)
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true")
+    return parser

@@ -1,0 +1,222 @@
+"""Accelerator abstraction.
+
+TPU-native re-design of the reference's ``accelerator/abstract_accelerator.py:7``
+(``DeepSpeedAccelerator`` ABC, ~40 abstract methods).  The surface keeps the
+same *roles* — device enumeration, RNG, streams/events, memory stats, dtype
+support, op-builder lookup, communication backend name — but maps them onto
+JAX semantics:
+
+* "device" is a ``jax.Device``; the index is the position in ``jax.local_devices()``.
+* Streams/events do not exist in XLA's programming model: dispatch is async
+  and ordering is handled by the runtime.  We keep the API (reference
+  ``abstract_accelerator.py:73,90``) as no-op context objects so engine code
+  written against the reference surface still runs.
+* RNG state is functional (``jax.random.key``); the accelerator tracks a seed
+  counter to mirror ``manual_seed``/``initial_seed``.
+* Memory stats come from ``jax.Device.memory_stats()``.
+"""
+
+import abc
+from abc import ABC
+
+
+class DeepSpeedAccelerator(ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ------------------------------------------------------------------
+    # Device APIs (reference abstract_accelerator.py:15-70)
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def is_synchronized_device(self):
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # ------------------------------------------------------------------
+    # RNG APIs
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def random(self):
+        ...
+
+    @abc.abstractmethod
+    def set_rng_state(self, new_state, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def get_rng_state(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    @abc.abstractmethod
+    def manual_seed_all(self, seed):
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self):
+        ...
+
+    @abc.abstractmethod
+    def default_generator(self, device_index):
+        ...
+
+    # ------------------------------------------------------------------
+    # Streams/Events (no-ops on XLA; reference :73-:100)
+    # ------------------------------------------------------------------
+    @property
+    @abc.abstractmethod
+    def Stream(self):
+        ...
+
+    @abc.abstractmethod
+    def stream(self, stream):
+        ...
+
+    @abc.abstractmethod
+    def current_stream(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def default_stream(self, device_index=None):
+        ...
+
+    @property
+    @abc.abstractmethod
+    def Event(self):
+        ...
+
+    # ------------------------------------------------------------------
+    # Memory management (reference :103-:168)
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def empty_cache(self):
+        ...
+
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def reset_max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def reset_peak_memory_stats(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def memory_reserved(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_reserved(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    # ------------------------------------------------------------------
+    # Dtype / capability probes (reference :171-:210)
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # ------------------------------------------------------------------
+    # Misc
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        ...
+
+    @abc.abstractmethod
+    def is_available(self):
+        ...
+
+    @abc.abstractmethod
+    def range_push(self, msg):
+        ...
+
+    @abc.abstractmethod
+    def range_pop(self):
+        ...
+
+    @abc.abstractmethod
+    def lazy_call(self, callback):
+        ...
+
+    @abc.abstractmethod
+    def pin_memory(self, tensor):
+        ...
+
+    @abc.abstractmethod
+    def on_accelerator(self, tensor):
+        ...
+
+    # ------------------------------------------------------------------
+    # Op-builder plugin seam (reference :221-:240)
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def op_builder_dir(self):
+        ...
+
+    @abc.abstractmethod
+    def create_op_builder(self, class_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name):
+        ...
+
+    @abc.abstractmethod
+    def build_extension(self):
+        ...

@@ -1,0 +1,45 @@
+"""CPU (XLA-on-host) accelerator — used for CI and tests.
+
+The reference ships a CUDA accelerator plus an optional XPU plugin
+(``accelerator/real_accelerator.py:39-54``); our second backend is the XLA CPU
+platform, which shares every code path with TPU because JAX abstracts the
+device.  Only capability probes differ.
+"""
+
+from .tpu_accelerator import TPU_Accelerator
+
+
+class CPU_Accelerator(TPU_Accelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "cpu"
+        return f"cpu:{device_index}"
+
+    def current_device_name(self):
+        return f"cpu:{self._current_device_index}"
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return False  # XLA:CPU fp16 matmul support is emulated/slow
+
+    def on_accelerator(self, tensor):
+        try:
+            import jax
+            return isinstance(tensor, jax.Array)
+        except Exception:
+            return False
+
+    def total_memory(self, device_index=None):
+        try:
+            import psutil
+            return psutil.virtual_memory().total
+        except Exception:
+            return 0

@@ -1,0 +1,48 @@
+"""Accelerator selection singleton.
+
+Parity: reference ``accelerator/real_accelerator.py:39,57``
+(``get_accelerator``/``set_accelerator``).  Selection honours the
+``DSTPU_ACCELERATOR`` env var ("tpu" | "cpu"); default is TPU when a TPU
+backend is importable, else the CPU (XLA-on-host) accelerator — which is the
+same class pointed at CPU devices, since JAX abstracts both.
+"""
+
+import os
+
+ds_accelerator = None
+
+
+def _validate_accelerator(accel_obj):
+    from .abstract_accelerator import DeepSpeedAccelerator
+    assert isinstance(accel_obj, DeepSpeedAccelerator), \
+        f"{accel_obj.__class__.__name__} is not a DeepSpeedAccelerator"
+    return accel_obj
+
+
+def get_accelerator():
+    global ds_accelerator
+    if ds_accelerator is not None:
+        return ds_accelerator
+
+    accelerator_name = os.environ.get("DSTPU_ACCELERATOR", None)
+    if accelerator_name is None:
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+        accelerator_name = "cpu" if platform == "cpu" else "tpu"
+
+    if accelerator_name == "cpu":
+        from .cpu_accelerator import CPU_Accelerator
+        ds_accelerator = CPU_Accelerator()
+    else:
+        from .tpu_accelerator import TPU_Accelerator
+        ds_accelerator = TPU_Accelerator()
+    return _validate_accelerator(ds_accelerator)
+
+
+def set_accelerator(accel_obj):
+    global ds_accelerator
+    ds_accelerator = _validate_accelerator(accel_obj)
+    return ds_accelerator

@@ -1,0 +1,280 @@
+"""TPU implementation of the accelerator abstraction.
+
+Counterpart of the reference's ``accelerator/cuda_accelerator.py`` — but built
+on JAX/XLA: devices come from ``jax.devices()``, memory stats from
+``Device.memory_stats()``, RNG from functional ``jax.random`` keys, and
+streams/events are no-op shims (XLA orders work itself).
+"""
+
+import os
+
+import numpy as np
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class _NoOpStream:
+    """XLA has no user-visible streams; keep the API shape (reference
+    ``abstract_accelerator.py:73``) as a context-manager no-op."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def synchronize(self):
+        import jax
+        jax.effects_barrier()
+
+    def wait_stream(self, other):
+        pass
+
+
+class _NoOpEvent:
+    """Event shim (reference ``abstract_accelerator.py:90``).  ``record`` takes
+    a host-side timestamp so ``elapsed_time`` still returns something useful
+    for coarse profiling."""
+
+    def __init__(self, enable_timing=False, **kwargs):
+        self.enable_timing = enable_timing
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        import jax
+        jax.effects_barrier()
+        self._t = time.time()
+
+    def synchronize(self):
+        import jax
+        jax.effects_barrier()
+
+    def query(self):
+        return True
+
+    def elapsed_time(self, end_event):
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        # All cross-device traffic is XLA-compiled collectives over ICI/DCN.
+        self._communication_backend_name = "xla"
+        self._current_device_index = 0
+        self._seed = 0
+
+    def _jax(self):
+        import jax
+        return jax
+
+    # --------------------------------------------------------------
+    # Device APIs
+    # --------------------------------------------------------------
+    def is_synchronized_device(self):
+        # Dispatch is async (like CUDA), so False: callers must synchronize
+        # before wall-clock timing.
+        return False
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        jax = self._jax()
+        devices = jax.local_devices()
+        idx = self._current_device_index if device_index is None else device_index
+        return devices[idx % len(devices)]
+
+    def set_device(self, device_index):
+        self._current_device_index = device_index
+
+    def current_device(self):
+        return self._current_device_index
+
+    def current_device_name(self):
+        return f"tpu:{self._current_device_index}"
+
+    def device_count(self):
+        return len(self._jax().local_devices())
+
+    def global_device_count(self):
+        return len(self._jax().devices())
+
+    def synchronize(self, device_index=None):
+        self._jax().effects_barrier()
+
+    # --------------------------------------------------------------
+    # RNG — functional keys; a seed counter emulates stateful torch RNG
+    # --------------------------------------------------------------
+    def random(self):
+        import jax
+        return jax.random
+
+    def set_rng_state(self, new_state, device_index=None):
+        self._seed = int(np.asarray(new_state).ravel()[0])
+
+    def get_rng_state(self, device_index=None):
+        return np.asarray([self._seed], dtype=np.uint32)
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+
+    def manual_seed_all(self, seed):
+        self._seed = int(seed)
+
+    def initial_seed(self):
+        return self._seed
+
+    def default_generator(self, device_index):
+        import jax
+        return jax.random.key(self._seed)
+
+    # --------------------------------------------------------------
+    # Streams / Events
+    # --------------------------------------------------------------
+    @property
+    def Stream(self):
+        return _NoOpStream
+
+    def stream(self, stream):
+        return _NoOpStream()
+
+    def current_stream(self, device_index=None):
+        return _NoOpStream()
+
+    def default_stream(self, device_index=None):
+        return _NoOpStream()
+
+    @property
+    def Event(self):
+        return _NoOpEvent
+
+    # --------------------------------------------------------------
+    # Memory
+    # --------------------------------------------------------------
+    def empty_cache(self):
+        pass
+
+    def _stats(self, device_index=None):
+        try:
+            d = self.device(device_index)
+            return d.memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        s = self._stats(device_index)
+        return s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))
+
+    def reset_max_memory_allocated(self, device_index=None):
+        pass
+
+    def memory_stats(self, device_index=None):
+        return self._stats(device_index)
+
+    def reset_peak_memory_stats(self, device_index=None):
+        pass
+
+    def memory_reserved(self, device_index=None):
+        return self._stats(device_index).get("bytes_reserved", self.memory_allocated(device_index))
+
+    def max_memory_reserved(self, device_index=None):
+        return self.memory_reserved(device_index)
+
+    def total_memory(self, device_index=None):
+        s = self._stats(device_index)
+        return s.get("bytes_limit", 0)
+
+    # --------------------------------------------------------------
+    # Dtypes
+    # --------------------------------------------------------------
+    def is_bf16_supported(self):
+        return True  # bf16 is the TPU-native matmul dtype
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+        return jnp.bfloat16
+
+    # --------------------------------------------------------------
+    # Misc
+    # --------------------------------------------------------------
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def is_available(self):
+        try:
+            import jax
+            return len(jax.devices()) > 0
+        except Exception:
+            return False
+
+    def range_push(self, msg):
+        try:
+            import jax.profiler
+            tc = jax.profiler.TraceAnnotation(msg)
+            tc.__enter__()
+            self._ranges = getattr(self, "_ranges", [])
+            self._ranges.append(tc)
+        except Exception:
+            pass
+
+    def range_pop(self):
+        ranges = getattr(self, "_ranges", [])
+        if ranges:
+            ranges.pop().__exit__(None, None, None)
+
+    def lazy_call(self, callback):
+        callback()
+
+    def pin_memory(self, tensor):
+        # Host arrays feeding the TPU are staged by the runtime; nothing to pin.
+        return tensor
+
+    def on_accelerator(self, tensor):
+        try:
+            import jax
+            return isinstance(tensor, jax.Array) and \
+                list(tensor.devices())[0].platform != "cpu"
+        except Exception:
+            return False
+
+    # --------------------------------------------------------------
+    # Op-builder seam
+    # --------------------------------------------------------------
+    def op_builder_dir(self):
+        return "deepspeed_tpu.ops.op_builder"
+
+    def create_op_builder(self, class_name):
+        builder_class = self.get_op_builder(class_name)
+        if builder_class is not None:
+            return builder_class()
+        return None
+
+    def get_op_builder(self, class_name):
+        from deepspeed_tpu.ops import op_builder
+        return getattr(op_builder, class_name, None)
+
+    def build_extension(self):
+        # Native (C++) extensions use setuptools/ctypes; see ops/native.
+        from deepspeed_tpu.ops.native import build_extension
+        return build_extension

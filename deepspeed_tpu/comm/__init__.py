@@ -1,0 +1,9 @@
+from deepspeed_tpu.comm.backend import ReduceOp
+from deepspeed_tpu.comm.comm import *  # noqa: F401,F403
+from deepspeed_tpu.comm.comm import (
+    all_gather, all_gather_base, all_reduce, all_to_all_single, barrier,
+    broadcast, configure, destroy_process_group, get_local_rank, get_rank,
+    get_world_size, init_distributed, is_initialized, log_summary,
+    ppermute_shift, recv, reduce, reduce_scatter, reduce_scatter_base, scatter,
+    send,
+)

@@ -1,0 +1,64 @@
+"""Communication backend base.
+
+Parity: reference ``deepspeed/comm/backend.py:22`` (``Backend`` base class for
+pluggable comm implementations).  Our default/only backend is XLA collectives
+(``XlaBackend``): every verb lowers to a ``jax.lax`` collective over a named
+mesh axis, compiled onto ICI/DCN by the SPMD partitioner.  The class exists so
+alternative backends (e.g. a host-side gloo-like backend for control-plane
+traffic) can be slotted in like the reference planned for NCCL/MPI.
+"""
+
+
+class ReduceOp:
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "prod"
+
+
+class Backend:
+
+    def __init__(self, name="backend", rank=0, size=1):
+        self.name = name
+        self.initialized = False
+
+    def is_initialized(self):
+        return self.initialized
+
+    def init_process_group(self):
+        self.initialized = True
+
+    def destroy_process_group(self):
+        self.initialized = False
+
+
+class XlaBackend(Backend):
+    """Collectives are free functions in ``deepspeed_tpu.comm.comm`` (they must
+    trace inside jit/shard_map); this object only tracks process-level
+    lifecycle, mirroring ``TorchBackend`` (reference ``comm/torch.py:11``)."""
+
+    def __init__(self):
+        super().__init__(name="xla")
+
+    def init_process_group(self):
+        import jax
+        # Multi-host rendezvous: jax.distributed.initialize() discovers the
+        # coordinator from env (JAX_COORDINATOR_ADDRESS etc.) — analogous to
+        # the reference's NCCL TCP rendezvous in TorchBackend.init_process_group.
+        if jax.process_count() == 1:
+            import os
+            if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS"):
+                try:
+                    jax.distributed.initialize()
+                except Exception:
+                    pass
+        self.initialized = True
+
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    def size(self):
+        import jax
+        return jax.process_count()

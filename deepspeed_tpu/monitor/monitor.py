@@ -1,0 +1,119 @@
+"""Experiment monitoring fan-out.
+
+Parity: reference ``monitor/monitor.py:10,25`` (``Monitor`` ABC +
+``MonitorMaster`` dispatching to TensorBoard/W&B/CSV writers).  Events are
+``(tag, value, step)`` tuples, written only from process 0.
+"""
+
+import csv
+import os
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor(ABC):
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    @abstractmethod
+    def write_events(self, event_list):
+        ...
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                log_dir = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning(f"tensorboard disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        if self.enabled:
+            try:
+                import wandb
+                wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb disabled: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: value}, step=step)
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = cfg.enabled
+        self.output_path = cfg.output_path or "./csv_monitor"
+        self.job_name = cfg.job_name
+        self.filenames = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name),
+                        exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            new = not os.path.exists(path)
+            with open(path, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        import jax
+        rank = jax.process_index()
+        self.tb_monitor = None
+        self.wandb_monitor = None
+        self.csv_monitor = None
+        if rank == 0 and monitor_config:
+            if monitor_config["tensorboard"].enabled:
+                self.tb_monitor = TensorBoardMonitor(monitor_config["tensorboard"])
+            if monitor_config["wandb"].enabled:
+                self.wandb_monitor = WandbMonitor(monitor_config["wandb"])
+            if monitor_config["csv_monitor"].enabled:
+                self.csv_monitor = csvMonitor(monitor_config["csv_monitor"])
+        self.enabled = any([self.tb_monitor, self.wandb_monitor, self.csv_monitor])
+
+    def write_events(self, event_list):
+        if not event_list:
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m is not None:
+                m.write_events(event_list)

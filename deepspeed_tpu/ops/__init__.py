@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops import op_builder  # noqa: F401

@@ -1,0 +1,74 @@
+"""Attention ops: reference implementation + Pallas flash attention.
+
+Parity role: reference ``csrc/transformer`` fused training attention
+(``ds_transformer_cuda.cpp``) and ``deepspeed/ops/sparse_attention`` — the
+compute-bound inner loop of the transformer.  TPU design: a Pallas
+flash-attention kernel (tiled online-softmax over VMEM blocks feeding the MXU)
+with a jnp reference implementation that is also the CPU/CI fallback and the
+test oracle.
+
+``attention()`` is the public entry: picks Pallas on TPU, jnp elsewhere.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v, causal=True, bias=None, segment_ids=None,
+                        softmax_scale: Optional[float] = None):
+    """Plain softmax attention.
+
+    q: [B, S, H, D]; k/v: [B, S, Hkv, D] (Hkv divides H → GQA).
+    Softmax in fp32 regardless of input dtype (reference kernels do the same).
+    """
+    orig_dtype = q.dtype
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    Sk = k.shape[1]
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        qi = jnp.arange(Sq)[:, None] + (Sk - Sq)
+        ki = jnp.arange(Sk)[None, :]
+        mask = qi >= ki
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(orig_dtype)
+
+
+# jnp reference doubles as the fallback; the Pallas kernel lives in
+# ops/pallas/flash_attention.py and is substituted when running on TPU.
+reference_impl = reference_attention
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "softmax_scale", "impl"))
+def attention(q, k, v, causal=True, softmax_scale=None, impl="auto"):
+    """Dispatching attention entry point."""
+    use_pallas = False
+    if impl == "pallas":
+        use_pallas = True
+    elif impl == "auto":
+        use_pallas = jax.default_backend() not in ("cpu",)
+    if use_pallas:
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+        except Exception:
+            pass
+    return reference_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale)

@@ -1,0 +1,108 @@
+"""Host-side (CPU) Adam for offloaded optimizer state.
+
+Parity: reference ``csrc/adam/cpu_adam.cpp`` (AVX256/512 + OpenMP
+``adam_update``, the ZeRO-Offload optimizer) and ``csrc/adagrad/cpu_adagrad.cpp``.
+
+TPU design: optimizer state lives in host RAM (numpy), gradients stream
+device→host, the update runs on the TPU-VM host cores, and updated params
+stream back.  The hot loop is C++ (OpenMP + auto-vectorised; built lazily via
+``ops/native.py``) with a numpy fallback — numpy's vectorised ops already use
+SIMD, the C++ path mainly wins by fusing the five passes into one.
+"""
+
+import ctypes
+import os
+from typing import NamedTuple
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_lib = None
+_lib_tried = False
+
+_CPP_SRC = os.path.join(os.path.dirname(__file__), "csrc", "cpu_adam.cpp")
+
+
+def _load_native():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        from deepspeed_tpu.ops.native import load_extension
+        lib = load_extension("cpu_adam", [_CPP_SRC])
+        lib.adam_update.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int]
+        _lib = lib
+    except Exception as e:
+        logger.warning(f"cpu_adam native build unavailable, numpy fallback: {e}")
+        _lib = None
+    return _lib
+
+
+class CPUAdamState(NamedTuple):
+    m: np.ndarray
+    v: np.ndarray
+    step: int
+
+
+def init_state(numel) -> CPUAdamState:
+    return CPUAdamState(m=np.zeros(numel, np.float32),
+                        v=np.zeros(numel, np.float32), step=0)
+
+
+def adam_update(params: np.ndarray, grads: np.ndarray, state: CPUAdamState,
+                lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
+                adamw_mode=True, bias_correction=True) -> CPUAdamState:
+    """In-place fused AdamW on host fp32 buffers.  Mirrors
+    ``cpu_adam.cpp Adam_Optimizer::Step`` semantics."""
+    assert params.dtype == np.float32 and grads.dtype == np.float32
+    step = state.step + 1
+    lib = _load_native()
+    if lib is not None:
+        bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
+        bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
+        fp = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))  # noqa: E731
+        lib.adam_update(fp(params), fp(grads), fp(state.m), fp(state.v),
+                        ctypes.c_long(params.size), ctypes.c_float(lr),
+                        ctypes.c_float(beta1), ctypes.c_float(beta2),
+                        ctypes.c_float(eps), ctypes.c_float(weight_decay),
+                        ctypes.c_float(bc1), ctypes.c_float(bc2),
+                        ctypes.c_int(1 if adamw_mode else 0))
+        return CPUAdamState(m=state.m, v=state.v, step=step)
+
+    # numpy fallback
+    g = grads
+    if not adamw_mode and weight_decay:
+        g = g + weight_decay * params
+    np.multiply(state.m, beta1, out=state.m)
+    state.m += (1.0 - beta1) * g
+    np.multiply(state.v, beta2, out=state.v)
+    state.v += (1.0 - beta2) * np.square(g)
+    if bias_correction:
+        m_hat = state.m / (1.0 - beta1 ** step)
+        v_hat = state.v / (1.0 - beta2 ** step)
+    else:
+        m_hat, v_hat = state.m, state.v
+    update = m_hat / (np.sqrt(v_hat) + eps)
+    if adamw_mode and weight_decay:
+        update += weight_decay * params
+    params -= lr * update
+    return CPUAdamState(m=state.m, v=state.v, step=step)
+
+
+def adagrad_update(params, grads, sq_accum, lr=1e-2, eps=1e-10,
+                   weight_decay=0.0):
+    """Host Adagrad (reference cpu_adagrad.cpp)."""
+    g = grads + weight_decay * params if weight_decay else grads
+    sq_accum += np.square(g)
+    params -= lr * g / (np.sqrt(sq_accum) + eps)
+    return sq_accum
+
+
+reference_impl = adam_update

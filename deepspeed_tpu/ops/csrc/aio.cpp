@@ -1,0 +1,64 @@
+// Threaded pread/pwrite core for the NVMe swap engine.
+//
+// Role parity: reference csrc/aio/common + py_lib (libaio O_DIRECT engine).
+// Design: POSIX pread/pwrite in chunks from a caller-managed thread pool
+// (Python side schedules; each call here is one blocking transfer).  O_DIRECT
+// is attempted when the buffer and size are 4k-aligned, falling back to
+// buffered I/O otherwise — same behaviour the reference gets from its
+// _do_io fallback.
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+constexpr long kAlign = 4096;
+
+bool aligned(const void* p, long n, long off) {
+    return ((reinterpret_cast<uintptr_t>(p) % kAlign) == 0) &&
+           (n % kAlign == 0) && (off % kAlign == 0);
+}
+}  // namespace
+
+extern "C" {
+
+long ds_pread(const char* filename, void* buffer, long nbytes, long offset,
+              int use_direct) {
+    int flags = O_RDONLY;
+    if (use_direct && aligned(buffer, nbytes, offset)) flags |= O_DIRECT;
+    int fd = open(filename, flags);
+    if (fd < 0 && (flags & O_DIRECT)) fd = open(filename, O_RDONLY);
+    if (fd < 0) return -1;
+    long done = 0;
+    char* p = static_cast<char*>(buffer);
+    while (done < nbytes) {
+        ssize_t r = pread(fd, p + done, nbytes - done, offset + done);
+        if (r <= 0) break;
+        done += r;
+    }
+    close(fd);
+    return done;
+}
+
+long ds_pwrite(const char* filename, const void* buffer, long nbytes,
+               long offset, int use_direct) {
+    int flags = O_WRONLY | O_CREAT;
+    if (use_direct && aligned(buffer, nbytes, offset)) flags |= O_DIRECT;
+    int fd = open(filename, flags, 0644);
+    if (fd < 0 && (flags & O_DIRECT)) fd = open(filename, O_WRONLY | O_CREAT, 0644);
+    if (fd < 0) return -1;
+    long done = 0;
+    const char* p = static_cast<const char*>(buffer);
+    while (done < nbytes) {
+        ssize_t w = pwrite(fd, p + done, nbytes - done, offset + done);
+        if (w <= 0) break;
+        done += w;
+    }
+    close(fd);
+    return done;
+}
+
+}  // extern "C"

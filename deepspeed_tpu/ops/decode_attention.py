@@ -1,0 +1,65 @@
+"""Decode (inference) attention with KV cache.
+
+Parity: reference ``csrc/transformer/inference`` ``softmax_context_fp16`` —
+the fused attention-with-KV-cache kernel behind ``DeepSpeedTransformerInference``.
+
+TPU design: the cache is a static-shape ring buffer [B, max_seq, Hkv, D]
+updated with ``lax.dynamic_update_slice`` (static shapes keep XLA happy in a
+decode loop); attention masks positions ≥ cur_len.  A Pallas paged/ragged
+variant can replace the inner product for long-context serving (see
+PAPERS.md ragged paged attention).
+"""
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [B, S_max, Hkv, D]
+    v: jnp.ndarray  # [B, S_max, Hkv, D]
+    length: jnp.ndarray  # i32 scalar: valid prefix length
+
+
+def init_cache(batch, max_seq, n_kv_heads, head_dim, dtype=jnp.bfloat16):
+    shape = (batch, max_seq, n_kv_heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def update_cache(cache: KVCache, k_new, v_new) -> KVCache:
+    """Append [B, T, Hkv, D] at position cache.length."""
+    start = cache.length
+    k = jax.lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype),
+                                     (0, start, 0, 0))
+    v = jax.lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype),
+                                     (0, start, 0, 0))
+    return KVCache(k=k, v=v, length=start + k_new.shape[1])
+
+
+def decode_attention(q, cache: KVCache, softmax_scale=None):
+    """q: [B, T, H, D] (T=1 decode or T=prompt prefill, already appended to
+    cache); attends over cache[:length].  fp32 softmax."""
+    B, T, H, D = q.shape
+    Hkv = cache.k.shape[2]
+    k, v = cache.k, cache.v
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    S = cache.k.shape[1]
+    kpos = jnp.arange(S)[None, :]
+    qpos = cache.length - T + jnp.arange(T)[:, None]
+    mask = kpos <= qpos  # causal within the valid prefix
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+softmax_context = decode_attention  # parity alias
+reference_impl = decode_attention

@@ -1,0 +1,33 @@
+"""Tensor flatten/unflatten.
+
+Parity: reference ``csrc/utils/flatten_unflatten.cpp`` (apex-style
+``flatten``/``unflatten`` used by the engine and ZeRO for contiguous comm
+buffers).  On TPU this is ``jax.flatten_util.ravel_pytree`` — XLA keeps the
+layout fusion-friendly, so no custom kernel is needed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def flatten(tensors):
+    """Pytree/list of arrays → one flat fp-preserving 1-D buffer."""
+    flat, _ = ravel_pytree(tensors)
+    return flat
+
+
+def unflatten(flat, like):
+    """Inverse of flatten given a template pytree ``like``."""
+    _, unravel = ravel_pytree(like)
+    return unravel(flat)
+
+
+def flatten_dense_tensors_aligned(tensors, alignment):
+    """Flatten with padding to ``alignment`` elements (reference
+    ``stage_1_and_2.py flatten_dense_tensors_aligned``)."""
+    flat = flatten(tensors)
+    remainder = flat.size % alignment
+    if remainder:
+        flat = jnp.pad(flat, (0, alignment - remainder))
+    return flat

@@ -1,0 +1,42 @@
+"""Native (C/C++) extension build support.
+
+Parity role: reference ``op_builder/builder.py`` JIT-compile path (torch
+cpp_extension + ninja).  Here: a tiny g++ shared-object builder + ctypes
+loader used by the host-side ops (cpu_adam SIMD, async NVMe I/O).  Built
+lazily on first use, cached under ``~/.cache/deepspeed_tpu``.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+from deepspeed_tpu.utils.logging import logger
+
+CACHE_DIR = os.path.expanduser(os.environ.get(
+    "DSTPU_CACHE_DIR", "~/.cache/deepspeed_tpu"))
+
+
+def build_extension(name, sources, extra_cflags=None, extra_ldflags=None,
+                    verbose=False):
+    """Compile ``sources`` (C++ files) into a cached .so; returns the path."""
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    src_blob = "".join(open(s).read() for s in sources)
+    tag = hashlib.sha1(
+        (src_blob + str(extra_cflags) + str(extra_ldflags)).encode()
+    ).hexdigest()[:12]
+    so_path = os.path.join(CACHE_DIR, f"{name}-{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cflags = ["-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
+              "-march=native"] + (extra_cflags or [])
+    cmd = ["g++"] + cflags + list(sources) + ["-o", so_path] + (extra_ldflags or [])
+    if verbose:
+        logger.info(" ".join(cmd))
+    subprocess.check_call(cmd)
+    return so_path
+
+
+def load_extension(name, sources, **kwargs):
+    so_path = build_extension(name, sources, **kwargs)
+    return ctypes.CDLL(so_path)

@@ -1,0 +1,97 @@
+"""Groupwise quantization ops.
+
+Parity: reference ``csrc/quantization/`` (``ds_quantize_fp16/32``,
+``ds_sr_quantize(_asym)_*`` — groupwise symmetric/asymmetric int8/int4
+quantize/dequantize with optional stochastic rounding, used by MoQ and
+inference).  jnp implementation (XLA fuses it); a Pallas variant for the
+inference weight-dequant hot path can slot in via the same API.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    values: jnp.ndarray   # int8 codes; asymmetric codes are offset by
+    #                       -2^(bits-1) so the [0, 2^bits-1] range fits int8
+    scale: jnp.ndarray    # fp32 per group
+    zero_point: jnp.ndarray  # fp32 per group (0 for symmetric)
+    num_bits: int
+    group_shape: Tuple[int, ...]
+    symmetric: bool = True
+
+
+def _grouped(x, groups):
+    n = x.size
+    assert n % groups == 0, f"size {n} not divisible into {groups} groups"
+    return x.reshape(groups, n // groups)
+
+
+def quantize(x, groups=1, num_bits=8, symmetric=True, stochastic=False,
+             rng=None):
+    """Groupwise quantize; returns QuantizedTensor.
+
+    symmetric: scale = max|x| / qmax, zero_point 0 (``ds_quantize``)
+    asymmetric: scale = (max-min)/(2^bits-1), zero = min (``_asym`` variants)
+    stochastic: stochastic rounding (``ds_sr_quantize``)
+    """
+    orig_shape = x.shape
+    g = _grouped(x.astype(jnp.float32), groups)
+    qmax = 2.0 ** (num_bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = jnp.zeros_like(scale)
+        q = g / scale
+        lo, hi = -qmax - 1, qmax
+    else:
+        mn = jnp.min(g, axis=1, keepdims=True)
+        mx = jnp.max(g, axis=1, keepdims=True)
+        scale = (mx - mn) / (2.0 ** num_bits - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = mn
+        q = (g - zero) / scale
+        lo, hi = 0, 2 ** num_bits - 1
+    if stochastic:
+        if rng is None:
+            rng = jax.random.key(0)
+        noise = jax.random.uniform(rng, q.shape) - 0.5
+        q = jnp.floor(q + 0.5 + noise)
+    else:
+        q = jnp.round(q)
+    q = jnp.clip(q, lo, hi)
+    if not symmetric:
+        q = q - 2.0 ** (num_bits - 1)  # recentre into signed int8 range
+    q = q.astype(jnp.int8)
+    return QuantizedTensor(values=q.reshape(orig_shape),
+                           scale=scale[:, 0], zero_point=zero[:, 0],
+                           num_bits=num_bits, group_shape=orig_shape,
+                           symmetric=symmetric)
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.float32):
+    groups = qt.scale.shape[0]
+    g = _grouped(qt.values.astype(jnp.float32), groups)
+    if not qt.symmetric:
+        g = g + 2.0 ** (qt.num_bits - 1)
+    out = g * qt.scale[:, None] + qt.zero_point[:, None]
+    return out.reshape(qt.group_shape).astype(dtype)
+
+
+def fake_quantize(x, groups=1, num_bits=8, symmetric=True, stochastic=False,
+                  rng=None):
+    """quantize→dequantize in one go (reference ``fake_quantizer.cu``, the
+    MoQ training path; straight-through estimator applied by caller)."""
+    return dequantize(quantize(x, groups, num_bits, symmetric, stochastic, rng),
+                      dtype=x.dtype)
+
+
+reference_impl = fake_quantize
+
+# parity aliases (reference pt_binding.cpp exported names)
+ds_quantize = quantize
+ds_dequantize = dequantize
+ds_sr_quantize = lambda x, groups=1, num_bits=8, rng=None: quantize(  # noqa: E731
+    x, groups, num_bits, symmetric=True, stochastic=True, rng=rng)

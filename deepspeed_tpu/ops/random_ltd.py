@@ -1,0 +1,53 @@
+"""Random layer-token-drop (random-LTD) ops.
+
+Parity: reference ``csrc/random_ltd/`` (``token_sort_``, ``token_gather``,
+``token_scatter_``, ``mask_gather_bert/gpt``) backing the random-LTD data
+efficiency feature.  On TPU these are gather/scatter index ops that XLA
+compiles well; the kernel-worthy part (sorting sampled indices) is
+``jnp.sort`` on a small index vector.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng, seq_len, keep, batch=None):
+    """Sample ``keep`` sorted token indices per sequence (reference
+    token_sort_: sampled indices must stay sorted to preserve order)."""
+    if batch is None:
+        idx = jax.random.permutation(rng, seq_len)[:keep]
+        return jnp.sort(idx)
+    keys = jax.random.split(rng, batch)
+    idx = jax.vmap(lambda k: jnp.sort(jax.random.permutation(k, seq_len)[:keep]))(keys)
+    return idx
+
+
+def token_gather(x, indices):
+    """x: [B, S, ...]; indices: [B, K] → [B, K, ...]."""
+    return jnp.take_along_axis(
+        x, indices.reshape(indices.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def token_scatter(full, part, indices):
+    """Inverse of token_gather: write part back into full at indices."""
+    idx = indices.reshape(indices.shape + (1,) * (full.ndim - 2))
+    idx = jnp.broadcast_to(idx, part.shape[:2] + full.shape[2:])
+    return jnp.put_along_axis(full, idx, part, axis=1, inplace=False)
+
+
+def mask_gather_gpt(attention_mask, keep):
+    """Causal (GPT) masks are positional; dropping tokens keeps causality, so
+    the gathered mask is just the leading [keep, keep] block (reference
+    slice_attn_masks.cu mask_gather_gpt)."""
+    return attention_mask[..., :keep, :keep]
+
+
+def mask_gather_bert(attention_mask, indices):
+    """Bidirectional (BERT) mask: gather rows+cols at sampled indices."""
+    m = jnp.take_along_axis(attention_mask,
+                            indices[:, None, :, None], axis=2)
+    m = jnp.take_along_axis(m, indices[:, None, None, :], axis=3)
+    return m
+
+
+reference_impl = token_gather

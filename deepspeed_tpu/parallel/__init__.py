@@ -1,0 +1,4 @@
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import (
+    DP_AXIS, FSDP_AXIS, MESH_AXES, PP_AXIS, SP_AXIS, TP_AXIS,
+    ProcessTopology, PipeDataParallelTopology, TopologyConfig, build_mesh)

@@ -1,0 +1,112 @@
+"""Global parallel-group state.
+
+Parity: reference ``deepspeed/utils/groups.py`` (``_create_expert_and_data_parallel``
+:109, ``_get_data_parallel_group`` etc.).  Where the reference stores NCCL
+``ProcessGroup`` handles, we store the active ``jax.sharding.Mesh`` and answer
+the same questions (world size / rank along each parallel dimension) from mesh
+axis sizes and ``jax.process_index``.
+"""
+
+import threading
+from typing import Optional
+
+from deepspeed_tpu.parallel.topology import (
+    BATCH_AXES, DP_AXIS, FSDP_AXIS, MESH_AXES, PP_AXIS, SP_AXIS, TP_AXIS,
+    TopologyConfig, build_mesh,
+)
+
+_lock = threading.Lock()
+_mesh = None
+_topology_config: Optional[TopologyConfig] = None
+_expert_parallel_size = 1
+
+
+def initialize_mesh(topo: Optional[TopologyConfig] = None, devices=None, mesh=None):
+    """Install the process-wide mesh.  Called from ``initialize()``; tests may
+    install their own mesh directly."""
+    global _mesh, _topology_config, _expert_parallel_size
+    with _lock:
+        if mesh is not None:
+            _mesh = mesh
+        else:
+            _mesh = build_mesh(topo, devices=devices)
+        _topology_config = topo or TopologyConfig()
+        _expert_parallel_size = getattr(_topology_config, "ep", 1)
+    return _mesh
+
+
+def get_mesh():
+    global _mesh
+    if _mesh is None:
+        initialize_mesh()
+    return _mesh
+
+
+def mesh_is_initialized():
+    return _mesh is not None
+
+
+def reset_mesh():
+    global _mesh, _topology_config, _expert_parallel_size
+    with _lock:
+        _mesh = None
+        _topology_config = None
+        _expert_parallel_size = 1
+
+
+def _axis_size(axis) -> int:
+    mesh = get_mesh()
+    if isinstance(axis, (tuple, list)):
+        n = 1
+        for a in axis:
+            n *= _axis_size(a)
+        return n
+    return mesh.shape.get(axis, 1)
+
+
+# ------------------------------------------------------------------
+# Parity accessors (reference groups.py names, minus torch groups)
+# ------------------------------------------------------------------
+def get_data_parallel_world_size() -> int:
+    """Effective DP degree = product of every axis a batch is sharded over."""
+    return _axis_size(list(BATCH_AXES))
+
+
+def get_partition_world_size() -> int:
+    """ZeRO partition degree (the fsdp axis)."""
+    return _axis_size(FSDP_AXIS)
+
+
+def get_model_parallel_world_size() -> int:
+    return _axis_size(TP_AXIS)
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _axis_size(PP_AXIS)
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _axis_size(SP_AXIS)
+
+
+def get_expert_parallel_world_size() -> int:
+    return _expert_parallel_size
+
+
+def set_expert_parallel_world_size(ep_size: int):
+    global _expert_parallel_size
+    cap = get_partition_world_size() * get_sequence_parallel_world_size() * \
+        get_model_parallel_world_size()
+    assert cap % ep_size == 0 or ep_size % cap == 0 or ep_size <= cap, \
+        f"ep_size {ep_size} incompatible with mesh ({cap} non-dp devices)"
+    _expert_parallel_size = ep_size
+
+
+def get_world_size() -> int:
+    mesh = get_mesh()
+    return mesh.devices.size
+
+
+def get_data_parallel_rank() -> int:
+    import jax
+    return jax.process_index()

@@ -1,0 +1,189 @@
+"""Process/device topology — named mesh axes over ICI/DCN.
+
+TPU-native re-design of the reference's ``runtime/pipe/topology.py:9``
+(``ProcessTopology``/``PipeDataParallelTopology``) and the process-group
+bookkeeping in ``deepspeed/utils/groups.py``.  Where the reference builds
+NCCL process groups per parallel dimension, we build ONE
+``jax.sharding.Mesh`` whose named axes are the parallel dimensions; XLA
+lowers collectives over an axis to ICI (intra-slice) or DCN (inter-slice)
+automatically when the mesh is constructed from
+``mesh_utils.create_device_mesh`` / ``create_hybrid_device_mesh``.
+
+Canonical axis order (outermost → innermost, slowest → fastest wire):
+
+    pp   pipeline stages        (point-to-point ppermute traffic)
+    dp   pure data parallel     (gradient all-reduce; rides DCN across slices)
+    fsdp ZeRO partition axis    (all-gather / reduce-scatter; wants ICI)
+    sp   sequence/context       (all-to-all / ring ppermute)
+    tp   tensor parallel        (all-reduce per layer; innermost = fastest ICI)
+
+Expert parallelism reuses ``fsdp×sp×tp`` subsets via ``ep_size`` (the
+reference overlays EP on DP the same way — ``groups.py:109``).
+"""
+
+import collections
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+
+# The order matters: innermost axes get the fastest ICI links when the mesh
+# comes from mesh_utils.create_device_mesh.
+MESH_AXES = (PP_AXIS, DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS)
+
+# Axes over which a data batch is sharded (each contributes to the
+# effective data-parallel world size).
+BATCH_AXES = (DP_AXIS, FSDP_AXIS)
+
+
+@dataclass
+class TopologyConfig:
+    """Degrees of each parallel dimension.  -1 for fsdp means "absorb all
+    remaining devices" (the common ZeRO default: DP world == partition world).
+    """
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = -1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1  # expert parallel degree; must divide fsdp*sp*tp
+
+    def resolve(self, n_devices: int) -> "TopologyConfig":
+        known = self.pp * self.dp * self.sp * self.tp
+        fsdp = self.fsdp
+        if fsdp == -1:
+            assert n_devices % known == 0, \
+                f"device count {n_devices} not divisible by pp*dp*sp*tp={known}"
+            fsdp = n_devices // known
+        total = known * fsdp
+        assert total == n_devices, \
+            f"topology {self} needs {total} devices, have {n_devices}"
+        return TopologyConfig(pp=self.pp, dp=self.dp, fsdp=fsdp,
+                              sp=self.sp, tp=self.tp, ep=self.ep)
+
+
+class ProcessTopology:
+    """Cartesian coordinate math over named axes.
+
+    API parity with reference ``topology.py:9`` (``get_rank``, ``get_coord``,
+    ``get_axis_comm_lists``, ``filter_match``) so grid-walking code ports
+    directly; the difference is that ranks index *devices in the mesh*, not
+    OS processes.
+    """
+
+    def __init__(self, axes: List[str], dims: List[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = collections.namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in dims]
+        import itertools
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = dict(zip(axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("dp", "pp"), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis`` (all other coords
+        equal).  Parity: reference ``topology.py`` same-named method."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        import itertools
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for other in itertools.product(*ranges):
+            fixed = dict(zip(other_axes, other))
+            ranks = [self.get_rank(**{axis: i, **fixed})
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return sorted(idx for coord, idx in self.mapping.items() if _match(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return int(np.prod(self.dims))
+
+    def __str__(self):
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Parity shim for reference ``topology.py`` 3D grid (pipe × data × model)."""
+
+    def __init__(self, num_pp, num_dp, num_mp=1):
+        if num_mp > 1:
+            super().__init__(axes=["pipe", "data", "model"],
+                             dims=[num_pp, num_dp, num_mp])
+        else:
+            super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+def build_mesh(topo: Optional[TopologyConfig] = None, devices=None):
+    """Create a ``jax.sharding.Mesh`` with the canonical named axes.
+
+    Uses ``mesh_utils.create_device_mesh`` so axis order maps onto physical
+    ICI topology (innermost axis ↔ nearest neighbours); falls back to a plain
+    reshape for virtual/CPU device sets where topology discovery fails.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    topo = (topo or TopologyConfig()).resolve(len(devices))
+    shape = (topo.pp, topo.dp, topo.fsdp, topo.sp, topo.tp)
+    try:
+        from jax.experimental import mesh_utils
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+def single_device_mesh(device=None):
+    import jax
+    from jax.sharding import Mesh
+    if device is None:
+        device = jax.devices()[0]
+    return Mesh(np.asarray([device]).reshape((1, 1, 1, 1, 1)), MESH_AXES)

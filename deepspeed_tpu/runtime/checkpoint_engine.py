@@ -1,0 +1,127 @@
+"""Checkpoint engines.
+
+Parity: reference ``runtime/checkpoint_engine/checkpoint_engine.py:6``
+(``CheckpointEngine`` ABC: create/save/load/commit) with a Torch engine and an
+async Nebula engine.  TPU design: the default engine is **Orbax** — sharded,
+multi-host-safe, tensorstore-backed — which natively covers what the reference
+builds by hand:
+
+* per-rank ZeRO shard files (``*_optim_states.pt``) → orbax writes each
+  host's shards of the sharded arrays;
+* elastic DP-degree rescaling of ZeRO-1/2 checkpoints → restore with *target*
+  shardings: orbax reshards on load;
+* ``_zero3_consolidated_16bit_state_dict`` → restore replicated;
+* Nebula-style async snapshotting → ``AsyncCheckpointer``.
+"""
+
+import json
+import os
+from abc import ABC, abstractmethod
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class CheckpointEngine(ABC):
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        log_dist(f"checkpoint tag {tag}", ranks=[0])
+
+    @abstractmethod
+    def save(self, state, save_dir, tag, client_state=None):
+        ...
+
+    @abstractmethod
+    def load(self, template_state, load_dir, tag, mesh,
+             load_optimizer_states=True, load_module_only=False):
+        ...
+
+    def commit(self, tag):
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+
+    def __init__(self, config_params=None, use_async=False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.use_async = use_async
+        self._async_ckptr = None
+
+    def _path(self, save_dir, tag):
+        return os.path.join(os.path.abspath(save_dir), tag)
+
+    def save(self, state, save_dir, tag, client_state=None):
+        ocp = self._ocp
+        path = self._path(save_dir, tag)
+        os.makedirs(path, exist_ok=True)
+        if self.use_async:
+            if self._async_ckptr is None:
+                self._async_ckptr = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler())
+            ckptr = self._async_ckptr
+        else:
+            ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, "state"), state, force=True)
+        if jax.process_index() == 0 and client_state is not None:
+            with open(os.path.join(path, "client_state.json"), "w") as f:
+                json.dump(client_state, f, default=str)
+        if not self.use_async:
+            ckptr.wait_until_finished() if hasattr(ckptr, "wait_until_finished") else None
+        return True
+
+    def load(self, template_state, load_dir, tag, mesh,
+             load_optimizer_states=True, load_module_only=False):
+        ocp = self._ocp
+        path = self._path(load_dir, tag)
+        # Restore with the *current* shardings as target: orbax reshards,
+        # giving elastic ZeRO checkpoints (save at dp=8, load at dp=2) for free.
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array) else x,
+            template_state)
+        ckptr = ocp.StandardCheckpointer()
+        restored = ckptr.restore(os.path.join(path, "state"), abstract)
+        if load_module_only or not load_optimizer_states:
+            restored = template_state.replace(params=restored.params)
+        client_state = {}
+        cs_path = os.path.join(path, "client_state.json")
+        if os.path.exists(cs_path):
+            with open(cs_path) as f:
+                client_state = json.load(f)
+        return restored, client_state
+
+    def commit(self, tag):
+        if self._async_ckptr is not None:
+            self._async_ckptr.wait_until_finished()
+        return True
+
+
+class NebulaCheckpointEngine(OrbaxCheckpointEngine):
+    """Async-snapshot engine (reference ``NebulaCheckpointEngine``): orbax
+    AsyncCheckpointer does the background write + atomic commit."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params, use_async=True)
+
+
+TorchCheckpointEngine = OrbaxCheckpointEngine  # parity alias
+
+_engine = None
+
+
+def get_checkpoint_engine(config_params=None):
+    global _engine
+    if _engine is None:
+        _engine = OrbaxCheckpointEngine(config_params)
+    return _engine
+
+
+def set_checkpoint_engine(engine):
+    global _engine
+    _engine = engine

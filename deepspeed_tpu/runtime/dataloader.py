@@ -1,0 +1,93 @@
+"""Data loading.
+
+Parity: reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader`` wrapping a
+torch ``DataLoader`` + ``DistributedSampler``).  TPU design: one process may
+feed many chips, so the loader yields **global** batches of numpy arrays and
+the engine shards them onto the mesh with ``device_put`` (the device transfer
+is where "distribution" happens — there is no per-rank sampler state to keep
+in sync).  For multi-host, each process yields its process-local slice
+(``process_index``-strided), matching ``DistributedSampler`` semantics.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+
+
+class RepeatingLoader:
+    """Parity: reference ``runtime/dataloader.py RepeatingLoader`` — wraps an
+    iterator, restarting it at StopIteration."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into numpy pytrees.
+
+    dataset: a sequence of samples; each sample is an array or a pytree of
+    arrays (dicts/tuples).  ``collate_fn`` overrides the default np.stack.
+    """
+
+    def __init__(self, dataset, batch_size, collate_fn=None, seed=0,
+                 shuffle=True, drop_last=True, num_processes=None,
+                 process_index=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or self._default_collate
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.num_processes = (num_processes if num_processes is not None
+                              else jax.process_count())
+        self.process_index = (process_index if process_index is not None
+                              else jax.process_index())
+        self.epoch = 0
+        assert batch_size % self.num_processes == 0, \
+            "global batch must divide across processes"
+        self.local_batch = batch_size // self.num_processes
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    @staticmethod
+    def _default_collate(samples):
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *samples)
+
+    def __len__(self):
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        # process-strided shard of each global batch (DistributedSampler-style)
+        for start in range(0, n - self.batch_size + 1 if self.drop_last else n,
+                           self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                break
+            local = idx[self.process_index::self.num_processes]
+            yield self.collate_fn([self.dataset[int(i)] for i in local])
+        self.epoch += 1

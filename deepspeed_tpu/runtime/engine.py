@@ -1,0 +1,661 @@
+"""DeepSpeedEngine — the training engine.
+
+Parity: reference ``runtime/engine.py:189`` (``DeepSpeedEngine``:
+``forward:1780``, ``backward:1931``, ``step:2142``, ``_take_model_step:2074``,
+``_configure_optimizer:1260``, ``save_checkpoint:3084``, ``load_checkpoint:2724``).
+
+TPU-first redesign
+------------------
+The reference engine is an imperative coordinator: it wraps ``nn.Module``,
+installs gradient hooks, manages buckets/streams, and mutates optimizer state
+in place.  Here the whole training step — forward, backward, gradient
+accumulation (``lax.scan``), ZeRO collectives, loss-scale automaton, optimizer
+update — is ONE jitted SPMD program over the device mesh.  ZeRO placement is
+declared by ``ZeroShardingPlan`` and the XLA partitioner materialises the
+same all-gather/reduce-scatter schedule the reference hand-codes.
+
+The user-visible API keeps DeepSpeed shape:
+
+    engine, tx, dataloader, lr_sched = deepspeed_tpu.initialize(
+        model=loss_fn, model_parameters=params, config=cfg)
+    loss = engine(batch)          # forward (computes grads too — functional)
+    engine.backward(loss)         # accumulates
+    engine.step()                 # applies at gradient-accumulation boundary
+
+or the fused fast path:  ``loss = engine.train_batch(data_iter)``.
+
+The model contract is functional: ``model`` is a callable
+``loss_fn(params, batch, rng) -> scalar loss`` (or an object with a
+``.loss`` method of the same signature, e.g. our model zoo classes).
+"""
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.loss_scaler import (LossScaleState,
+                                               dynamic_loss_scale_state,
+                                               has_inf_or_nan,
+                                               static_loss_scale_state,
+                                               update_scale)
+from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
+from deepspeed_tpu.runtime.optimizers import (COMPRESSED_COMM_OPTIMIZERS,
+                                              build_optimizer)
+from deepspeed_tpu.runtime.zero.stage_plan import ZeroShardingPlan, constrain
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER,
+                                       FORWARD_GLOBAL_TIMER,
+                                       STEP_GLOBAL_TIMER,
+                                       SynchronizedWallClockTimer,
+                                       ThroughputTimer)
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
+
+
+@struct.dataclass
+class TrainState:
+    """The entire mutable training state as one pytree, so a step is a pure
+    ``state -> state`` function (the reference spreads this across engine,
+    optimizer and scaler objects)."""
+    params: Any              # fp32 master params (sharded per plan)
+    opt_state: Any           # optax state (sharded per plan)
+    loss_scale: LossScaleState
+    global_step: jnp.ndarray     # i32
+    skipped_steps: jnp.ndarray   # i32
+    rng: jax.Array
+
+
+@struct.dataclass
+class StepMetrics:
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    lr: jnp.ndarray
+    loss_scale: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+class DeepSpeedEngine:
+
+    def __init__(self,
+                 model: Callable,
+                 config: DeepSpeedConfig,
+                 params: Any = None,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 lr_scheduler=None,
+                 mesh=None,
+                 tp_rules=None,
+                 dont_change_device=False,
+                 collate_fn=None,
+                 training_data=None):
+        self.module = model
+        self.loss_fn = self._resolve_loss_fn(model)
+        self._config = config
+        self.accelerator = get_accelerator()
+
+        dist.init_distributed()
+        dist.configure(config)
+
+        # ---- mesh / topology -----------------------------------------
+        if mesh is None:
+            mesh = groups.initialize_mesh(config.mesh_config)
+        else:
+            groups.initialize_mesh(mesh=mesh)
+        self.mesh = mesh
+
+        # ---- precision ----------------------------------------------
+        if config.bfloat16_enabled:
+            self.compute_dtype = jnp.bfloat16
+        elif config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        else:
+            self.compute_dtype = jnp.float32
+
+        # ---- ZeRO plan ----------------------------------------------
+        zc = config.zero_config
+        self.zero_stage = zc.stage
+        self.plan = ZeroShardingPlan(
+            mesh, stage=zc.stage, tp_rules=tp_rules,
+            param_persistence_threshold=(zc.param_persistence_threshold
+                                         if zc.stage >= 3 else 0),
+            offload_optimizer=zc.offload_optimizer_device != "none",
+            offload_param=zc.offload_param_device != "none")
+
+        # ---- optimizer ----------------------------------------------
+        self.client_optimizer = optimizer
+        self.optimizer_name_ = (config.optimizer_config.type.lower()
+                                if config.optimizer_config and config.optimizer_config.type
+                                else None)
+        self.tx, self._base_lr, self._schedule_fn = self._configure_optimizer(
+            optimizer, lr_scheduler)
+        self.lr_scheduler = (lr_scheduler if not callable(self._schedule_fn) or
+                             isinstance(lr_scheduler, LRScheduler) else None)
+        if self.lr_scheduler is None and self._schedule_fn is not None:
+            self.lr_scheduler = LRScheduler(self._schedule_fn)
+
+        # ---- state init / placement ---------------------------------
+        if params is None:
+            raise ValueError("model_parameters (a params pytree) is required")
+        self.state = self._init_state(params)
+
+        # ---- host-side bookkeeping ----------------------------------
+        self.micro_steps = 0
+        self.global_steps = int(self.state.global_step)
+        self.skipped_steps = 0
+        self.gradient_accumulation_steps_ = config.gradient_accumulation_steps
+        self._cached = None  # (loss, grads, overflow) from forward
+        self._accum_grads = None
+        self._accum_count = 0
+        self._step_applied = False
+        self._global_grad_norm = 0.0
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size,
+            steps_per_output=config.steps_per_print)
+        self.monitor = MonitorMaster(config.monitor_config)
+
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(
+                training_data, collate_fn=collate_fn)
+
+        self._compiled_train_step = None
+        self._compiled_fwd_bwd = None
+        self._compiled_apply = None
+        self._batch_ndim = None
+
+        log_dist(
+            f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
+            f"dtype={self.compute_dtype.__name__} mesh={dict(self.mesh.shape)} "
+            f"micro_batch={config.train_micro_batch_size_per_gpu} "
+            f"gas={config.gradient_accumulation_steps} "
+            f"train_batch={config.train_batch_size}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # setup helpers
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_loss_fn(model):
+        if hasattr(model, "loss") and callable(model.loss):
+            return model.loss
+        if callable(model):
+            return model
+        raise TypeError(
+            "model must be callable loss_fn(params, batch, rng) or expose "
+            "a .loss method")
+
+    def _configure_optimizer(self, client_optimizer, client_scheduler):
+        """Parity: reference ``_configure_optimizer:1260`` /
+        ``_configure_basic_optimizer:1321`` — config-named optimizer takes
+        precedence; a client optax transform is used as-is."""
+        cfg = self._config
+        schedule_fn = None
+        base_lr = 0.0
+        if cfg.scheduler_config and cfg.scheduler_config.type:
+            schedule_fn = build_schedule(cfg.scheduler_config.type,
+                                         cfg.scheduler_config.params)
+        elif isinstance(client_scheduler, LRScheduler):
+            schedule_fn = client_scheduler.schedule_fn
+        elif callable(client_scheduler):
+            schedule_fn = client_scheduler
+
+        config_opt_name = (cfg.optimizer_config.type
+                           if cfg.optimizer_config else None)
+        if config_opt_name:
+            opt_params = dict(cfg.optimizer_config.params)
+            base_lr = opt_params.get("lr", 1e-3)
+            if schedule_fn is not None:
+                opt_params["lr"] = schedule_fn
+            try:
+                tx = build_optimizer(config_opt_name, opt_params)
+            except ValueError:
+                if client_optimizer is None:
+                    raise
+                logger.warning(
+                    f"optimizer '{config_opt_name}' is not built in; using "
+                    "the client-supplied optax transform instead")
+                tx = client_optimizer
+        elif client_optimizer is not None:
+            tx = client_optimizer
+            if schedule_fn is not None and cfg.scheduler_config:
+                logger.warning("scheduler config ignored: client optimizer "
+                               "owns its learning rate")
+        else:
+            # reference requires an optimizer for training; default AdamW so
+            # inference-ish uses of the engine still construct
+            tx = optax.adamw(1e-3)
+            base_lr = 1e-3
+
+        if self._config.gradient_clipping and self._config.gradient_clipping > 0:
+            tx = optax.chain(
+                optax.clip_by_global_norm(self._config.gradient_clipping), tx)
+        if schedule_fn is None:
+            schedule_fn = lambda step: jnp.asarray(base_lr, jnp.float32)  # noqa: E731
+        return tx, base_lr, schedule_fn
+
+    def _init_state(self, params) -> TrainState:
+        cfg = self._config
+        # master params in fp32 (reference: fp16/bf16 optimizers keep fp32
+        # master copies; we ONLY store the master and cast per-step)
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.float32), params)
+
+        if cfg.fp16_enabled:
+            if cfg.dynamic_loss_scale:
+                ls = dynamic_loss_scale_state(
+                    cfg.fp16_config.initial_scale_power,
+                    hysteresis=cfg.fp16_config.hysteresis)
+            else:
+                ls = static_loss_scale_state(cfg.loss_scale)
+        else:
+            ls = static_loss_scale_state(1.0)
+
+        param_sh = self.plan._to_sharding(self.plan.master_param_specs(params))
+        with self.mesh:
+            params = jax.device_put(params, param_sh)
+            opt_state = jax.jit(
+                self.tx.init,
+                out_shardings=self.plan.opt_state_shardings(self.tx, params),
+            )(params)
+        rng = jax.random.key(cfg.seed)
+        repl = self.plan.replicated_sharding()
+        ls = jax.device_put(ls, repl)
+        return TrainState(
+            params=params, opt_state=opt_state, loss_scale=ls,
+            global_step=jax.device_put(jnp.asarray(0, jnp.int32), repl),
+            skipped_steps=jax.device_put(jnp.asarray(0, jnp.int32), repl),
+            rng=jax.device_put(rng, repl))
+
+    # ------------------------------------------------------------------
+    # the compiled step
+    # ------------------------------------------------------------------
+    def _loss_and_grads(self, params, loss_scale, batch, rng):
+        """value_and_grad of the (possibly loss-scaled) compute-dtype loss."""
+        def scaled_loss(p):
+            p_c = jax.tree_util.tree_map(
+                lambda x: x.astype(self.compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+            loss = self.loss_fn(p_c, batch, rng)
+            return (loss * loss_scale).astype(jnp.float32), loss
+
+        (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        # unscale in fp32
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / loss_scale, grads)
+        return loss, grads
+
+    def _apply_update(self, state: TrainState, grads, overflow):
+        """Shared optimizer-update tail: clip (inside tx), skip-on-overflow,
+        re-constrain placements, loss-scale automaton.  Used by both the fused
+        train step and the 3-call ``step()`` so the semantics cannot diverge.
+        (Reference analogue: ``_take_model_step:2074`` +
+        ``_overflow_check_and_loss_scale_update:1840``.)"""
+        cfg = self._config
+        grad_norm = optax.global_norm(grads)
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        def pick(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+        new_params = pick(new_params, state.params)
+        new_opt = pick(new_opt, state.opt_state)
+        new_params = constrain(new_params,
+                               self.plan.master_param_specs(state.params),
+                               self.mesh)
+        new_ls = update_scale(
+            state.loss_scale, overflow,
+            dynamic=cfg.fp16_enabled and cfg.dynamic_loss_scale,
+            scale_window=cfg.fp16_config.loss_scale_window,
+            min_scale=cfg.fp16_config.min_loss_scale,
+            hysteresis=cfg.fp16_config.hysteresis)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, loss_scale=new_ls,
+            global_step=state.global_step + 1,
+            skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
+            rng=state.rng)
+        return new_state, grad_norm
+
+    def _build_train_step(self, gas: int):
+        cfg = self._config
+        fp16 = cfg.fp16_enabled
+
+        def train_step(state: TrainState, batch):
+            params = state.params
+            scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
+            rng, step_rng = jax.random.split(state.rng)
+
+            if gas > 1:
+                def micro(carry, inp):
+                    idx, mb = inp
+                    acc, rloss = carry
+                    mb_rng = jax.random.fold_in(step_rng, idx)
+                    loss, grads = self._loss_and_grads(params, scale, mb, mb_rng)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return (acc, rloss + loss), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params)
+                (gsum, lsum), _ = jax.lax.scan(
+                    micro, (zeros, jnp.float32(0.0)),
+                    (jnp.arange(gas), batch))
+                grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
+                loss = lsum / gas
+            else:
+                loss, grads = self._loss_and_grads(params, scale, batch, step_rng)
+
+            # ZeRO grad placement: stage>=2 spec is fsdp-sharded → XLA lowers
+            # the DP reduction as reduce-scatter (reference average_tensor /
+            # __reduce_and_partition_ipg_grads)
+            grads = constrain(grads, self.plan.grad_specs(params), self.mesh)
+            overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
+
+            new_state, grad_norm = self._apply_update(
+                state.replace(rng=rng), grads, overflow)
+            metrics = StepMetrics(
+                loss=loss.astype(jnp.float32),
+                grad_norm=grad_norm.astype(jnp.float32),
+                lr=jnp.asarray(self._schedule_fn(state.global_step), jnp.float32),
+                loss_scale=new_state.loss_scale.cur_scale,
+                overflow=overflow)
+            return new_state, metrics
+
+        return train_step
+
+    def _get_compiled_train_step(self, gas: int):
+        if self._compiled_train_step is None:
+            step = self._build_train_step(gas)
+            self._compiled_train_step = jax.jit(step, donate_argnums=(0,))
+        return self._compiled_train_step
+
+    # ------------------------------------------------------------------
+    # DeepSpeed-parity 3-call API
+    # ------------------------------------------------------------------
+    def forward(self, batch, rng=None):
+        """Computes loss (and, functionally, gradients — cached for
+        ``backward``).  Returns the unscaled loss."""
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self._compiled_fwd_bwd is None:
+            def fwd_bwd(state, batch):
+                scale = (state.loss_scale.cur_scale
+                         if self._config.fp16_enabled else jnp.float32(1.0))
+                rng, step_rng = jax.random.split(state.rng)
+                loss, grads = self._loss_and_grads(state.params, scale, batch,
+                                                   step_rng)
+                grads = constrain(grads, self.plan.grad_specs(state.params),
+                                  self.mesh)
+                overflow = (has_inf_or_nan(grads)
+                            if self._config.fp16_enabled else jnp.asarray(False))
+                return loss, grads, overflow, rng
+            self._compiled_fwd_bwd = jax.jit(fwd_bwd)
+        batch = self._shard_batch(batch)
+        with self.mesh:
+            loss, grads, overflow, rng = self._compiled_fwd_bwd(self.state, batch)
+        self.state = self.state.replace(rng=rng)
+        self._cached = (loss, grads, overflow)
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
+        """Accumulates the gradients computed by the latest ``forward``.
+        Parity: reference ``backward:1931`` (scaling by 1/GAS happens here)."""
+        assert self._cached is not None, "backward() called before forward()"
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        _, grads, overflow = self._cached
+        gas = self.gradient_accumulation_steps_
+        scaled = jax.tree_util.tree_map(lambda g: g / gas, grads)
+        if self._accum_grads is None:
+            self._accum_grads = scaled
+            self._accum_overflow = overflow
+        else:
+            self._accum_grads = jax.tree_util.tree_map(
+                jnp.add, self._accum_grads, scaled)
+            self._accum_overflow = jnp.logical_or(self._accum_overflow, overflow)
+        self._accum_count += 1
+        self.micro_steps += 1
+        self._cached = None
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        return self._accum_count >= self.gradient_accumulation_steps_
+
+    def step(self):
+        """Applies the optimizer update at the GAS boundary.
+        Parity: reference ``step:2142`` → ``_take_model_step:2074``."""
+        self._step_applied = False
+        if not self.is_gradient_accumulation_boundary():
+            return
+        self.timers(STEP_GLOBAL_TIMER).start()
+        if self._compiled_apply is None:
+            self._compiled_apply = jax.jit(self._apply_update,
+                                           donate_argnums=(0, 1))
+
+        with self.mesh:
+            self.state, grad_norm = self._compiled_apply(
+                self.state, self._accum_grads, self._accum_overflow)
+        self._global_grad_norm = float(grad_norm)
+        self._accum_grads = None
+        self._accum_count = 0
+        self._step_applied = True
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._write_monitor()
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        if self._config.wall_clock_breakdown and \
+                self.global_steps % self._config.steps_per_print == 0:
+            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                             STEP_GLOBAL_TIMER])
+
+    # ------------------------------------------------------------------
+    # fused fast path
+    # ------------------------------------------------------------------
+    def train_batch(self, data_iter=None, batch=None):
+        """One full training step (GAS microbatches) as a single compiled
+        program.  Parity with ``PipelineEngine.train_batch`` semantics: returns
+        the mean loss over the global batch."""
+        gas = self.gradient_accumulation_steps_
+        if batch is None:
+            if data_iter is None:
+                assert self.training_dataloader is not None, \
+                    "train_batch needs data_iter, batch=, or training_data"
+                data_iter = iter(self.training_dataloader)
+            micro_batches = [next(data_iter) for _ in range(gas)]
+            if gas > 1:
+                batch = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *micro_batches)
+            else:
+                batch = micro_batches[0]
+        self.tput_timer.start()
+        batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
+        step_fn = self._get_compiled_train_step(gas)
+        with self.mesh:
+            self.state, metrics = step_fn(self.state, batch)
+        self.global_steps += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._last_metrics = metrics
+        self._global_grad_norm = metrics.grad_norm
+        self.tput_timer.stop(global_step=True)
+        self._write_monitor(metrics)
+        return metrics.loss
+
+    def eval_batch(self, batch, rng=None):
+        if not hasattr(self, "_compiled_eval"):
+            def ev(state, batch):
+                p_c = jax.tree_util.tree_map(
+                    lambda x: x.astype(self.compute_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                    state.params)
+                return self.loss_fn(p_c, batch, state.rng)
+            self._compiled_eval = jax.jit(ev)
+        batch = self._shard_batch(batch)
+        with self.mesh:
+            return self._compiled_eval(self.state, batch)
+
+    # ------------------------------------------------------------------
+    def _shard_batch(self, batch, leading_gas_dim=False):
+        multihost = jax.process_count() > 1
+
+        def put(x):
+            x = np.asarray(x) if not isinstance(x, jax.Array) else x
+            ndim = x.ndim
+            if leading_gas_dim:
+                spec = self.plan.batch_spec(ndim - 1)
+                spec = P(*([None] + list(spec)))
+            else:
+                spec = self.plan.batch_spec(ndim)
+            sharding = NamedSharding(self.mesh, spec)
+            if multihost:
+                # each process holds its local slice of the global batch
+                # (dataloader is process-strided); assemble the global array
+                return jax.make_array_from_process_local_data(sharding, x)
+            return jax.device_put(x, sharding)
+        return jax.tree_util.tree_map(put, batch)
+
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None,
+                     num_local_io_workers=None, data_sampler=None,
+                     route=None):
+        """Parity: reference ``deepspeed_io:1678`` — builds the distributed
+        dataloader (global batches; sharding happens at device_put)."""
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        if batch_size is None:
+            batch_size = (self.train_micro_batch_size_per_gpu() *
+                          groups.get_data_parallel_world_size())
+        return DeepSpeedDataLoader(dataset, batch_size=batch_size,
+                                   collate_fn=collate_fn,
+                                   seed=self._config.seed)
+
+    # ------------------------------------------------------------------
+    # monitor / introspection parity accessors
+    # ------------------------------------------------------------------
+    def _write_monitor(self, metrics=None):
+        if not self.monitor.enabled:
+            return
+        events = []
+        if metrics is not None:
+            events = [
+                ("Train/Samples/train_loss", float(metrics.loss),
+                 self.global_samples()),
+                ("Train/Samples/lr", float(metrics.lr), self.global_samples()),
+            ]
+            if self._config.fp16_enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(metrics.loss_scale), self.global_samples()))
+        self.monitor.write_events(events)
+
+    def global_samples(self):
+        return self.global_steps * self._config.train_batch_size
+
+    def get_global_grad_norm(self):
+        return float(self._global_grad_norm)
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler.get_lr()
+        return [self._base_lr]
+
+    def get_loss_scale(self):
+        return float(self.state.loss_scale.cur_scale)
+
+    @property
+    def cur_scale(self):
+        return self.get_loss_scale()
+
+    def was_step_applied(self):
+        return self._step_applied
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self):
+        return self.zero_stage
+
+    def zero_optimization(self):
+        return self.zero_stage > 0
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self._config.bfloat16_enabled
+
+    def get_params(self):
+        return self.state.params
+
+    def module_state_dict(self):
+        """Full (un-sharded, host) params — reference ``module_state_dict`` /
+        ``_zero3_consolidated_16bit_state_dict:3432`` rolled into one: orbax
+        handles gather-on-save, so consolidation is just a replicated
+        device_get."""
+        repl = self.plan.replicated_sharding()
+        gathered = jax.device_get(jax.device_put(self.state.params, repl))
+        return gathered
+
+    # ------------------------------------------------------------------
+    # checkpointing (parity: save_checkpoint:3084 / load_checkpoint:2724)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        from deepspeed_tpu.runtime.checkpoint_engine import get_checkpoint_engine
+        eng = get_checkpoint_engine()
+        tag = tag or f"global_step{self.global_steps}"
+        client_state = dict(client_state or {})
+        client_state.update({
+            "global_steps": self.global_steps,
+            "skipped_steps": int(self.state.skipped_steps),
+            "micro_steps": self.micro_steps,
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler else None),
+        })
+        eng.save(self.state, save_dir, tag, client_state=client_state)
+        if save_latest and jax.process_index() == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+        dist.barrier()
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True,
+                        load_module_strict=True, load_module_only=False):
+        from deepspeed_tpu.runtime.checkpoint_engine import get_checkpoint_engine
+        eng = get_checkpoint_engine()
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                logger.warning(f"no 'latest' file at {load_dir}")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        state, client_state = eng.load(
+            self.state, load_dir, tag, self.mesh,
+            load_optimizer_states=load_optimizer_states,
+            load_module_only=load_module_only)
+        self.state = state
+        self.global_steps = client_state.get("global_steps", 0)
+        self.micro_steps = client_state.get("micro_steps", 0)
+        if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                client_state.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        return load_dir, client_state

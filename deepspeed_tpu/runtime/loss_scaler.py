@@ -1,0 +1,75 @@
+"""Loss scaling for fp16 training.
+
+Parity: reference ``runtime/fp16/loss_scaler.py`` (``LossScaler``,
+``DynamicLossScaler``).  Jit-friendly redesign: the scaler state is a small
+pytree carried through the compiled train step, and scale updates are
+``jnp.where`` branches — no Python control flow on device values, so the whole
+overflow check/skip-step/rescale dance compiles into the step program (the
+reference does this eagerly on the host, reference ``stage3.py:1840``).
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray       # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iteration: jnp.ndarray       # i32 scalar
+
+
+def static_loss_scale_state(scale: float, hysteresis: int = 0) -> LossScaleState:
+    return LossScaleState(
+        cur_scale=jnp.asarray(scale, jnp.float32),
+        cur_hysteresis=jnp.asarray(hysteresis, jnp.int32),
+        last_overflow_iter=jnp.asarray(-1, jnp.int32),
+        iteration=jnp.asarray(0, jnp.int32),
+    )
+
+
+def dynamic_loss_scale_state(initial_scale_power=16,
+                             hysteresis: int = 2) -> LossScaleState:
+    # start with the full hysteresis budget (reference DynamicLossScaler
+    # initializes cur_hysteresis = delayed_shift)
+    return static_loss_scale_state(2.0 ** initial_scale_power,
+                                   hysteresis=hysteresis)
+
+
+def has_inf_or_nan(tree) -> jnp.ndarray:
+    """True if any leaf contains inf/nan (reference ``check_overflow``)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(tree)
+    bad = jnp.asarray(False)
+    for leaf in leaves:
+        bad = bad | ~jnp.isfinite(jnp.asarray(leaf, jnp.float32)).all()
+    return bad
+
+
+def update_scale(state: LossScaleState, overflow: jnp.ndarray, *,
+                 dynamic: bool, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 hysteresis: int = 2) -> LossScaleState:
+    """One step of the dynamic loss-scale automaton, as pure array math.
+
+    overflow → scale/2 (after hysteresis consumed); ``scale_window`` clean
+    steps → scale*2.  Mirrors reference ``DynamicLossScaler.update_scale``.
+    """
+    it = state.iteration
+    if not dynamic:
+        return state._replace(iteration=it + 1)
+
+    hyst = jnp.where(overflow, jnp.maximum(state.cur_hysteresis - 1, 0),
+                     state.cur_hysteresis)
+    shrink = overflow & (state.cur_hysteresis <= 1)
+    grown_due = (~overflow) & (((it - state.last_overflow_iter) % scale_window) == scale_window - 1)
+
+    new_scale = jnp.where(
+        shrink,
+        jnp.maximum(state.cur_scale / scale_factor, min_scale),
+        jnp.where(grown_due, state.cur_scale * scale_factor, state.cur_scale))
+    new_hyst = jnp.where(shrink, jnp.asarray(hysteresis, jnp.int32), hyst)
+    new_last = jnp.where(overflow, it, state.last_overflow_iter)
+    return LossScaleState(cur_scale=new_scale, cur_hysteresis=new_hyst,
+                          last_overflow_iter=new_last, iteration=it + 1)

@@ -1,0 +1,141 @@
+"""LR schedules.
+
+Parity: reference ``runtime/lr_schedules.py`` (LRRangeTest:308, OneCycle:415,
+WarmupLR:704, WarmupDecayLR:800) with the same config ``params`` keys.
+
+TPU design: a schedule is a pure function ``step -> lr`` (optax convention) so
+it can live *inside* the jitted train step — the reference mutates
+``param_group['lr']`` on the host every step, which would force a retrace
+here.  ``build_schedule`` returns the callable; the engine threads the step
+counter through the compiled update.  Stateful wrapper objects with the
+reference's ``.step()``/``get_lr()`` API are provided for user loops that
+drive schedules manually.
+"""
+
+import math
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+
+def lr_range_test(params: Dict[str, Any]) -> Callable:
+    min_lr = params.get("lr_range_test_min_lr", 1e-3)
+    step_size = params.get("lr_range_test_step_size", 2000)
+    step_rate = params.get("lr_range_test_step_rate", 1.0)
+    staircase = params.get("lr_range_test_staircase", False)
+
+    def schedule(step):
+        interval = jnp.asarray(step, jnp.float32) / step_size
+        if staircase:
+            interval = jnp.floor(interval)
+        return min_lr * (1.0 + interval * step_rate)
+    return schedule
+
+
+def one_cycle(params: Dict[str, Any]) -> Callable:
+    cycle_min_lr = params.get("cycle_min_lr", 1e-3)
+    cycle_max_lr = params.get("cycle_max_lr", 1e-2)
+    decay_lr_rate = params.get("decay_lr_rate", 0.0)
+    cycle_first_step_size = params.get("cycle_first_step_size", 2000)
+    cycle_second_step_size = params.get("cycle_second_step_size",
+                                        cycle_first_step_size)
+    decay_step_size = params.get("decay_step_size", 0)
+    total_cycle = cycle_first_step_size + cycle_second_step_size
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((step - cycle_first_step_size) / cycle_second_step_size,
+                        0.0, 1.0)
+        in_cycle_lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (up - down)
+        past = jnp.maximum(step - total_cycle, 0.0)
+        if decay_step_size > 0:
+            decay_intervals = past / decay_step_size
+        else:
+            decay_intervals = past
+        decayed = cycle_min_lr / (1.0 + decay_lr_rate * decay_intervals)
+        return jnp.where(step <= total_cycle, in_cycle_lr, decayed)
+    return schedule
+
+
+def warmup_lr(params: Dict[str, Any]) -> Callable:
+    warmup_min_lr = params.get("warmup_min_lr", 0.0)
+    warmup_max_lr = params.get("warmup_max_lr", 0.001)
+    warmup_num_steps = max(1, params.get("warmup_num_steps", 1000))
+    warmup_type = params.get("warmup_type", "log")
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            # log(1+step)/log(1+N): reference's default warmup curve
+            gamma = jnp.log1p(step) / math.log(1 + warmup_num_steps)
+            gamma = jnp.clip(gamma, 0.0, 1.0)
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+    return schedule
+
+
+def warmup_decay_lr(params: Dict[str, Any]) -> Callable:
+    total_num_steps = params.get("total_num_steps", 10000)
+    warmup_num_steps = max(1, params.get("warmup_num_steps", 1000))
+    base = warmup_lr(params)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        w = base(step)
+        decay = jnp.clip(
+            (total_num_steps - step) /
+            max(1.0, float(total_num_steps - warmup_num_steps)),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, w, w * decay)
+    return schedule
+
+
+SCHEDULE_REGISTRY = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+}
+
+
+def build_schedule(name: str, params: Dict[str, Any]) -> Callable:
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(
+            f"Unknown scheduler '{name}'. Valid: {VALID_LR_SCHEDULES}")
+    return SCHEDULE_REGISTRY[name](params)
+
+
+class LRScheduler:
+    """Stateful wrapper with the reference's torch-style API
+    (``step``/``get_lr``/``state_dict``/``load_state_dict``)."""
+
+    def __init__(self, schedule_fn: Callable, last_batch_iteration: int = -1):
+        self.schedule_fn = schedule_fn
+        self.last_batch_iteration = last_batch_iteration
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self):
+        return [float(self.schedule_fn(max(0, self.last_batch_iteration)))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]

@@ -1,0 +1,97 @@
+"""ZeRO config.
+
+Parity: reference ``deepspeed/runtime/zero/config.py:79``
+(``DeepSpeedZeroConfig``) + ``offload_config.py`` (``OffloadDeviceEnum``).
+Keys keep reference spellings.  Keys that configured CUDA-side bucketing
+mechanics (bucket sizes, overlap_comm) are accepted and recorded but are
+advisory on TPU: XLA schedules and overlaps the collectives itself; we keep
+them because autotuning and user configs set them.
+"""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    device = OffloadDeviceEnum.none
+    nvme_path = None
+    buffer_count = 5
+    buffer_size = 100_000_000
+    max_in_cpu = 1_000_000_000
+    pin_memory = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device = OffloadDeviceEnum.none
+    nvme_path = None
+    buffer_count = 4
+    pin_memory = False
+    pipeline_read = False
+    pipeline_write = False
+    fast_init = False
+    ratio = 1.0
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    stage = 0
+    contiguous_gradients = True
+    reduce_scatter = True
+    reduce_bucket_size = 500_000_000
+    allgather_partitions = True
+    allgather_bucket_size = 500_000_000
+    overlap_comm = None
+    load_from_fp32_weights = True
+    elastic_checkpoint = False
+    offload_param = None
+    offload_optimizer = None
+    sub_group_size = 1_000_000_000
+    cpu_offload_param = None
+    cpu_offload_use_pin_memory = None
+    cpu_offload = None
+    prefetch_bucket_size = 50_000_000
+    param_persistence_threshold = 100_000
+    model_persistence_threshold = 2 ** 63 - 1
+    max_live_parameters = 1_000_000_000
+    max_reuse_distance = 1_000_000_000
+    gather_16bit_weights_on_model_save = False
+    ignore_unused_parameters = True
+    legacy_stage1 = False
+    round_robin_gradients = False
+
+    _deprecated_ = {
+        "stage3_prefetch_bucket_size": "prefetch_bucket_size",
+        "stage3_param_persistence_threshold": "param_persistence_threshold",
+        "stage3_model_persistence_threshold": "model_persistence_threshold",
+        "stage3_max_live_parameters": "max_live_parameters",
+        "stage3_max_reuse_distance": "max_reuse_distance",
+        "stage3_gather_16bit_weights_on_model_save": "gather_16bit_weights_on_model_save",
+        "stage3_gather_fp16_weights_on_model_save": "gather_16bit_weights_on_model_save",
+    }
+
+    def _validate(self):
+        assert self.stage in (0, 1, 2, 3), f"invalid ZeRO stage {self.stage}"
+        # legacy bool cpu_offload -> offload_optimizer dict
+        if self.cpu_offload:
+            self.offload_optimizer = self.offload_optimizer or {"device": "cpu"}
+        if isinstance(self.offload_param, dict):
+            self.offload_param = DeepSpeedZeroOffloadParamConfig(self.offload_param)
+        if isinstance(self.offload_optimizer, dict):
+            self.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(
+                self.offload_optimizer)
+
+    @property
+    def offload_optimizer_device(self):
+        if self.offload_optimizer is None:
+            return OffloadDeviceEnum.none
+        return self.offload_optimizer.device
+
+    @property
+    def offload_param_device(self):
+        if self.offload_param is None:
+            return OffloadDeviceEnum.none
+        return self.offload_param.device

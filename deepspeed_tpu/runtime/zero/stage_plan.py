@@ -1,0 +1,245 @@
+"""ZeRO stages as sharding plans — the heart of the TPU redesign.
+
+The reference implements ZeRO with imperative machinery: flattened partition
+buffers, per-param grad hooks, bucketed reduce-scatter, prefetch hooks
+(``stage_1_and_2.py:102``, ``stage3.py:65``, ``partitioned_param_coordinator.py:44``).
+On TPU none of that machinery is needed: ZeRO is *a placement policy*, and the
+XLA SPMD partitioner materialises the identical communication schedule from
+sharding annotations:
+
+========  =================  ==================  ==================
+stage     params             gradients           optimizer state
+========  =================  ==================  ==================
+0 (DDP)   replicated         all-reduce          replicated
+1         replicated         all-reduce          fsdp-sharded
+2         replicated         reduce-scatter      fsdp-sharded
+3 (FSDP)  fsdp-sharded       reduce-scatter      fsdp-sharded
+========  =================  ==================  ==================
+
+* "fsdp-sharded": each leaf is sharded on its largest eligible dim over the
+  ``fsdp`` mesh axis (flattened-buffer partitioning in the reference; per-dim
+  sharding here so XLA can fuse the collectives with compute).
+* stage-2 reduce-scatter falls out of constraining grads to the sharded spec:
+  the partitioner rewrites all-reduce → reduce-scatter + (lazy) all-gather.
+* stage-3 all-gather-on-demand + prefetch (reference param coordinator trace
+  machinery) falls out of XLA's latency-hiding scheduler when the forward is a
+  ``lax.scan`` over layers: the gather of layer *i+1* overlaps layer *i*'s
+  compute.
+* ``param_persistence_threshold`` (reference ``zero/config.py``) maps to "keep
+  small leaves replicated" — same memory/latency trade.
+
+TP composes: the model provides per-leaf ``PartitionSpec`` rules over the
+``tp``/``sp`` axes; the plan adds ``fsdp`` on a free dim.
+"""
+
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (DP_AXIS, FSDP_AXIS, SP_AXIS,
+                                             TP_AXIS)
+
+
+def _spec_get(spec: Optional[P], ndim: int):
+    """Normalise a PartitionSpec to a per-dim tuple of axis names."""
+    if spec is None:
+        return [None] * ndim
+    entries = list(spec) + [None] * (ndim - len(spec))
+    return entries[:ndim]
+
+
+def _axes_in(entry):
+    if entry is None:
+        return []
+    if isinstance(entry, (tuple, list)):
+        return list(entry)
+    return [entry]
+
+
+def add_axis_to_spec(spec: Optional[P], shape, axis_name: str, axis_size: int,
+                     mesh_shape=None, prefer_dim: Optional[int] = None) -> P:
+    """Return ``spec`` with ``axis_name`` added on the largest eligible dim.
+
+    A dim is eligible when the global extent is divisible by ``axis_size``
+    times the product of mesh axes already sharding it.  Falls back to the
+    original spec (replicated over ``axis_name``) when nothing divides —
+    matching the reference behaviour of leaving un-partitionable tensors whole
+    on every rank.
+    """
+    if axis_size <= 1 or len(shape) == 0:
+        return spec if spec is not None else P()
+    mesh_shape = mesh_shape or {}
+    entries = _spec_get(spec, len(shape))
+    candidates = []
+    for d, (dim, entry) in enumerate(zip(shape, entries)):
+        used = _axes_in(entry)
+        if axis_name in used:
+            return spec
+        existing = 1
+        for a in used:
+            existing *= mesh_shape.get(a, 1)
+        candidates.append((d, dim, existing))
+    order = sorted(candidates, key=lambda t: -t[1])
+    if prefer_dim is not None:
+        order = sorted(order, key=lambda t: (t[0] != prefer_dim, -t[1]))
+    for d, dim, existing in order:
+        if dim % (axis_size * existing) == 0:
+            entry = entries[d]
+            if entry is None:
+                entries[d] = axis_name
+            else:
+                entries[d] = tuple(_axes_in(entry) + [axis_name])
+            return P(*entries)
+    return spec if spec is not None else P()
+
+
+def _leaf_size(leaf) -> int:
+    shape = getattr(leaf, "shape", ())
+    return int(np.prod(shape)) if shape else 1
+
+
+class ZeroShardingPlan:
+    """Produces NamedShardings for params / grads / optimizer state / batch.
+
+    ``tp_rules``: optional list of ``(path_regex, PartitionSpec)`` supplying
+    tensor/sequence-parallel specs per parameter (the model's sharding map).
+    """
+
+    def __init__(self, mesh, stage: int = 0,
+                 tp_rules=None,
+                 param_persistence_threshold: int = 0,
+                 offload_optimizer: bool = False,
+                 offload_param: bool = False):
+        assert stage in (0, 1, 2, 3)
+        self.mesh = mesh
+        self.stage = stage
+        self.tp_rules = [(re.compile(pat), spec) for pat, spec in (tp_rules or [])]
+        self.param_persistence_threshold = param_persistence_threshold
+        self.offload_optimizer = offload_optimizer
+        self.offload_param = offload_param
+        self.fsdp_size = mesh.shape.get(FSDP_AXIS, 1)
+
+    # ------------------------------------------------------------------
+    def _tp_spec_for(self, path: str, leaf) -> Optional[P]:
+        for pat, spec in self.tp_rules:
+            if pat.search(path):
+                return spec
+        return None
+
+    def _fsdp_spec(self, path: str, leaf) -> P:
+        """Full stage-3 spec: tp spec + fsdp on a free dim."""
+        base = self._tp_spec_for(path, leaf)
+        if self._leaf_persists(leaf):
+            return base if base is not None else P()
+        return add_axis_to_spec(base, getattr(leaf, "shape", ()),
+                                FSDP_AXIS, self.fsdp_size,
+                                mesh_shape=dict(self.mesh.shape))
+
+    def _replicated_spec(self, path: str, leaf) -> P:
+        base = self._tp_spec_for(path, leaf)
+        return base if base is not None else P()
+
+    def _leaf_persists(self, leaf) -> bool:
+        # small tensors stay replicated (reference param_persistence_threshold)
+        return _leaf_size(leaf) < self.param_persistence_threshold
+
+    # ------------------------------------------------------------------
+    # Public: spec pytrees (for with_sharding_constraint) and sharding
+    # pytrees (for jit in/out shardings + device_put)
+    # ------------------------------------------------------------------
+    def param_specs(self, params) -> Any:
+        fn = self._fsdp_spec if self.stage >= 3 else self._replicated_spec
+        return self._map_with_path(fn, params)
+
+    def grad_specs(self, params) -> Any:
+        fn = self._fsdp_spec if self.stage >= 2 else self._replicated_spec
+        return self._map_with_path(fn, params)
+
+    def master_param_specs(self, params) -> Any:
+        """fp32 master copies partition like optimizer state from stage 1 up
+        (reference: stage-1 partitions the fp32 flat buffer)."""
+        fn = self._fsdp_spec if self.stage >= 1 else self._replicated_spec
+        return self._map_with_path(fn, params)
+
+    def opt_state_specs(self, tx, params) -> Any:
+        """Optimizer-state specs aligned leaf-for-leaf with params via
+        ``optax.tree_map_params``; non-param leaves (step counts) replicate."""
+        import optax
+        opt_shape = jax.eval_shape(tx.init, params)
+        pspecs = self.master_param_specs(params)
+        return optax.tree_map_params(
+            tx, lambda _, spec: spec, opt_shape, pspecs,
+            transform_non_params=lambda _: P())
+
+    def batch_spec(self, ndim: int = 2, sequence_dim: Optional[int] = None) -> P:
+        """Batch dim sharded over every data axis; optional sequence dim over
+        ``sp`` (Ulysses-style sequence parallelism input layout)."""
+        entries = [None] * ndim
+        entries[0] = (DP_AXIS, FSDP_AXIS)
+        sp = self.mesh.shape.get(SP_AXIS, 1)
+        if sequence_dim is not None and sp > 1:
+            entries[sequence_dim] = SP_AXIS
+        return P(*entries)
+
+    # sharding (NamedSharding) versions --------------------------------
+    def _to_sharding(self, spec_tree):
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec), spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def param_shardings(self, params):
+        return self._to_sharding(self.param_specs(params))
+
+    def grad_shardings(self, params):
+        return self._to_sharding(self.grad_specs(params))
+
+    def opt_state_shardings(self, tx, params):
+        return self._to_sharding(self.opt_state_specs(tx, params))
+
+    def batch_sharding(self, ndim=2, sequence_dim=None):
+        return NamedSharding(self.mesh, self.batch_spec(ndim, sequence_dim))
+
+    def replicated_sharding(self):
+        return NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _map_with_path(fn, tree):
+        def wrap(path, leaf):
+            return fn(jax.tree_util.keystr(path), leaf)
+        return jax.tree_util.tree_map_with_path(wrap, tree)
+
+
+def active_mesh():
+    """The ambient mesh installed by ``with mesh:`` — None outside."""
+    try:
+        from jax.interpreters import pxla
+        m = pxla.thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:
+        return None
+
+
+def maybe_constrain(x, spec: P):
+    """with_sharding_constraint that is a no-op outside a mesh context (so
+    model code runs unsharded in plain tests/inference)."""
+    mesh = active_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def constrain(tree, spec_tree, mesh):
+    """with_sharding_constraint over a pytree of PartitionSpecs.
+
+    Uses flatten_up_to so it is robust to PartitionSpec's own pytree
+    registration (P must be treated as a leaf of ``spec_tree``).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    spec_leaves = treedef.flatten_up_to(spec_tree)
+    out = [jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s))
+           for x, s in zip(leaves, spec_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)

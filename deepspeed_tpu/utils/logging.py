@@ -1,0 +1,68 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``
+(``log_dist``, ``logger``).  On TPU multi-host (one process per host), the
+"rank" is ``jax.process_index()``; inside a single process all devices are
+driven by one Python thread, so per-device filtering is meaningless and we
+filter per *process* instead.
+"""
+
+import logging
+import os
+import sys
+import functools
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _LoggerFactory:
+    @staticmethod
+    def create_logger(name="deepspeed_tpu", level=logging.INFO):
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(formatter)
+            logger_.addHandler(handler)
+        return logger_
+
+
+level = LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO)
+logger = _LoggerFactory.create_logger(level=level)
+
+
+@functools.lru_cache(None)
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process ranks (None/[-1] = all).
+
+    Parity: reference ``utils/logging.py log_dist``.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message):
+    _warn_cache(message)
+
+
+@functools.lru_cache(None)
+def _warn_cache(message):
+    logger.warning(message)

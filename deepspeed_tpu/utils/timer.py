@@ -1,0 +1,183 @@
+"""Wall-clock and throughput timers.
+
+Parity with the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer``, ``ThroughputTimer``).  The TPU twist:
+JAX dispatch is async, so a meaningful stop() must block on the device —
+we call ``jax.block_until_ready`` on a sync token (or simply
+``jax.effects_barrier``) instead of ``cuda.synchronize``.
+"""
+
+import time
+
+from deepspeed_tpu.utils.logging import log_dist
+
+try:
+    import numpy as _np
+except Exception:  # pragma: no cover
+    _np = None
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _device_sync():
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timers; start/stop pairs may repeat and accumulate.
+
+    Mirrors reference ``utils/timer.py:SynchronizedWallClockTimer``.
+    """
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = 0.0
+            self.records = []
+
+        def start(self, sync=True):
+            assert not self.started_, f"timer {self.name_} already started"
+            if sync:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False, record=True, sync=True):
+            assert self.started_, f"timer {self.name_} not started"
+            if sync:
+                _device_sync()
+            elapsed = time.time() - self.start_time
+            if reset:
+                self.elapsed_ = elapsed
+            else:
+                self.elapsed_ += elapsed
+            if record:
+                self.records.append(elapsed)
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.records = []
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop(record=False)
+            elapsed = self.elapsed_
+            if reset:
+                self.elapsed_ = 0.0
+            if started:
+                self.start()
+            return elapsed
+
+        def mean(self):
+            if not self.records:
+                return 0.0
+            return float(sum(self.records) / len(self.records))
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    def log(self, names, normalizer=1.0, reset=True, ranks=None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names, normalizer=1.0):
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs estimation across steps.
+
+    Mirrors reference ``utils/timer.py:ThroughputTimer``.
+    """
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=None,
+                 monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+            if global_step:
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / max(self.total_elapsed_time, 1e-12)
+        return float("nan")

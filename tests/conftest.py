@@ -1,0 +1,41 @@
+"""Test harness: 8 virtual CPU devices (the TPU translation of the
+reference's ``tests/unit/common.py DistributedExec`` fork-N-procs fixture —
+see SURVEY.md §4: single-process multi-device JAX with device-count fakery).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    yield
+    groups.reset_mesh()
+
+
+@pytest.fixture
+def mesh_1d():
+    """All 8 devices on the fsdp axis (pure ZeRO topology)."""
+    from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+    return build_mesh(TopologyConfig())
+
+
+@pytest.fixture
+def mesh_2d():
+    """4-way fsdp × 2-way tp."""
+    from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+    return build_mesh(TopologyConfig(tp=2))

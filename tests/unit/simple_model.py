@@ -1,0 +1,64 @@
+"""Tiny models for unit tests.
+
+Parity: reference ``tests/unit/simple_model.py`` (SimpleModel — a stack of
+linears trained on random data).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """MLP regression: loss = mse(linear stack(x), y)."""
+
+    def __init__(self, hidden_dim=16, n_layers=2):
+        self.hidden_dim = hidden_dim
+        self.n_layers = n_layers
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.n_layers)
+        return {
+            f"layer_{i}": {
+                "w": jax.random.normal(keys[i], (self.hidden_dim, self.hidden_dim)) * 0.1,
+                "b": jnp.zeros((self.hidden_dim,)),
+            }
+            for i in range(self.n_layers)
+        }
+
+    def apply(self, params, x):
+        for i in range(self.n_layers):
+            layer = params[f"layer_{i}"]
+            x = x @ layer["w"] + layer["b"]
+            if i < self.n_layers - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    def loss(self, params, batch, rng=None):
+        x, y = batch["x"], batch["y"]
+        pred = self.apply(params, x)
+        return jnp.mean(jnp.square(pred - y))
+
+
+def random_dataset(n_samples, hidden_dim, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n_samples, hidden_dim)).astype(np.float32)
+    ys = np.roll(xs, 1, axis=-1) * 0.5
+    return [{"x": xs[i], "y": ys[i]} for i in range(n_samples)]
+
+
+def random_batch(batch_size, hidden_dim, seed=0, gas=None):
+    rng = np.random.default_rng(seed)
+    shape = (batch_size, hidden_dim) if gas is None else (gas, batch_size, hidden_dim)
+    x = rng.normal(size=shape).astype(np.float32)
+    return {"x": x, "y": np.roll(x, 1, axis=-1) * 0.5}
+
+
+def base_config(stage=0, **overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2, "weight_decay": 0.0}},
+        "zero_optimization": {"stage": stage},
+    }
+    cfg.update(overrides)
+    return cfg

@@ -1,0 +1,87 @@
+"""Checkpoint round-trip tests (parity model: reference ``unit/checkpoint/*``:
+save/load, optimizer state, elastic reshard)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from unit.simple_model import SimpleModel, base_config, random_batch
+
+HIDDEN = 16
+
+
+def _engine(stage=0, **overrides):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(stage, **overrides))
+    return engine
+
+
+@pytest.mark.parametrize("stage", [0, 1, 3])
+def test_save_load_roundtrip(tmp_path, stage):
+    engine = _engine(stage)
+    for i in range(3):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=i))
+    engine.save_checkpoint(str(tmp_path), tag="ckpt")
+    ref_params = jax.device_get(engine.module_state_dict())
+
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    engine2 = _engine(stage)
+    engine2.load_checkpoint(str(tmp_path), tag="ckpt")
+    loaded = jax.device_get(engine2.module_state_dict())
+    for k in ref_params:
+        np.testing.assert_array_equal(ref_params[k]["w"], loaded[k]["w"])
+    assert engine2.global_steps == 3
+
+    # resumed training matches
+    b = random_batch(32, HIDDEN, seed=99)
+    l1 = float(engine.train_batch(batch=b))
+    l2 = float(engine2.train_batch(batch=b))
+    np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_latest_tag(tmp_path):
+    engine = _engine(0)
+    engine.train_batch(batch=random_batch(32, HIDDEN))
+    engine.save_checkpoint(str(tmp_path))
+    assert (tmp_path / "latest").exists()
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    engine2 = _engine(0)
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert engine2.global_steps == 1
+
+
+def test_elastic_reshard_stage3_to_stage0(tmp_path):
+    """Save ZeRO-3 (sharded), load into stage-0 (replicated) — the reference's
+    elastic-checkpoint / zero_to_fp32 consolidation path."""
+    engine = _engine(3)
+    engine.train_batch(batch=random_batch(32, HIDDEN))
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    ref = jax.device_get(engine.module_state_dict())
+
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    engine2 = _engine(0)
+    engine2.load_checkpoint(str(tmp_path), tag="t")
+    loaded = jax.device_get(engine2.module_state_dict())
+    np.testing.assert_array_equal(ref["layer_0"]["w"], loaded["layer_0"]["w"])
+
+
+def test_load_module_only(tmp_path):
+    engine = _engine(1)
+    engine.train_batch(batch=random_batch(32, HIDDEN))
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    engine2 = _engine(1)
+    engine2.load_checkpoint(str(tmp_path), tag="t", load_module_only=True)
+    # params match, optimizer state fresh (zeros)
+    ref = jax.device_get(engine.module_state_dict())
+    loaded = jax.device_get(engine2.module_state_dict())
+    np.testing.assert_array_equal(ref["layer_0"]["w"], loaded["layer_0"]["w"])

@@ -1,0 +1,133 @@
+"""Config system tests.
+
+Parity model: reference ``tests/unit/runtime/test_ds_config_dict.py`` and the
+batch-triangle assertions in ``runtime/config.py:956``.
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_triangle_all_given():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=8)
+    assert cfg.train_batch_size == 64
+    assert cfg.data_parallel_size == 8
+
+
+def test_triangle_infer_gas():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 4,
+    }, world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_triangle_infer_train():
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=8)
+    assert cfg.train_batch_size == 64
+
+
+def test_triangle_infer_micro():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 64,
+        "gradient_accumulation_steps": 2,
+    }, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_triangle_inconsistent_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({
+            "train_batch_size": 64,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 3,
+        }, world_size=8)
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "bf16": {"enabled": True},
+        }, world_size=8)
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=8)
+    assert cfg.zero_config.stage == 0
+    assert not cfg.zero_enabled
+
+
+def test_zero_stage3_deprecated_keys():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 12345,
+        },
+    }, world_size=8)
+    assert cfg.zero_config.param_persistence_threshold == 12345
+
+
+def test_offload_configs():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+            "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme"},
+        },
+    }, world_size=8)
+    assert cfg.zero_config.offload_optimizer_device == "cpu"
+    assert cfg.zero_config.offload_param_device == "nvme"
+
+
+def test_mesh_section():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "mesh": {"tp": 2, "fsdp": 4},
+    }, world_size=8)
+    assert cfg.data_parallel_size == 4  # dp(1) * fsdp(4)
+
+
+def test_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16,
+                             "fp16": {"enabled": True}}))
+    cfg = DeepSpeedConfig(str(p), world_size=8)
+    assert cfg.fp16_enabled
+    assert cfg.dynamic_loss_scale
+    assert cfg.initial_dynamic_scale == 2 ** 16
+
+
+def test_dynamic_vs_static_loss_scale():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "loss_scale": 128},
+    }, world_size=8)
+    assert not cfg.dynamic_loss_scale
+    assert cfg.loss_scale == 128
+
+
+def test_legacy_cpu_offload_bool():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+    }, world_size=8)
+    assert cfg.zero_config.offload_optimizer_device == "cpu"

@@ -1,0 +1,152 @@
+"""Engine behaviour tests (parity model: reference unit/runtime engine+fp16
+tests: GAS boundary semantics, loss-scale skip, clipping)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from unit.simple_model import SimpleModel, base_config, random_batch
+
+HIDDEN = 16
+
+
+def _engine(stage=0, **overrides):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(stage, **overrides))
+    return engine
+
+
+def test_three_call_api_gas_boundary():
+    engine = _engine(gradient_accumulation_steps=2,
+                     train_micro_batch_size_per_gpu=4)
+    b = random_batch(32, HIDDEN)
+    loss = engine.forward(b)
+    engine.backward(loss)
+    engine.step()
+    assert not engine.was_step_applied()  # not at boundary yet
+    loss = engine.forward(b)
+    engine.backward(loss)
+    engine.step()
+    assert engine.was_step_applied()
+    assert engine.global_steps == 1
+
+
+def test_three_call_matches_train_batch():
+    e1 = _engine(gradient_accumulation_steps=2)
+    e2 = _engine(gradient_accumulation_steps=2)
+    mb1 = random_batch(32, HIDDEN, seed=1)
+    mb2 = random_batch(32, HIDDEN, seed=2)
+    # three-call path
+    for mb in (mb1, mb2):
+        l = e1.forward(mb)
+        e1.backward(l)
+        e1.step()
+    # fused path with the same microbatches stacked
+    stacked = jax.tree_util.tree_map(lambda a, b: np.stack([a, b]), mb1, mb2)
+    e2.train_batch(batch=stacked)
+    p1 = jax.device_get(e1.module_state_dict())
+    p2 = jax.device_get(e2.module_state_dict())
+    for k in p1:
+        np.testing.assert_allclose(p1[k]["w"], p2[k]["w"], rtol=1e-5, atol=1e-6)
+
+
+def test_fp16_overflow_skips_step():
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 4,
+                           "hysteresis": 1})
+    params_before = jax.device_get(engine.module_state_dict())
+    bad = random_batch(32, HIDDEN)
+    bad["x"] = bad["x"] * np.float32(1e38)  # forces non-finite grads
+    engine.train_batch(batch=bad)
+    params_after = jax.device_get(engine.module_state_dict())
+    np.testing.assert_array_equal(params_before["layer_0"]["w"],
+                                  params_after["layer_0"]["w"])
+    assert int(engine.state.skipped_steps) == 1
+    # hysteresis=1 → dynamic scale halves on the first overflow
+    assert engine.get_loss_scale() == 2 ** 4 / 2
+
+
+def test_fp16_hysteresis_delays_shrink():
+    """Reference DynamicLossScaler semantics: with hysteresis=2 the first
+    overflow is absorbed; the second consecutive overflow halves the scale."""
+    engine = _engine(fp16={"enabled": True, "initial_scale_power": 4,
+                           "hysteresis": 2})
+    bad = random_batch(32, HIDDEN)
+    bad["x"] = bad["x"] * np.float32(1e38)
+    engine.train_batch(batch=bad)
+    assert engine.get_loss_scale() == 2 ** 4  # absorbed
+    engine.train_batch(batch=bad)
+    assert engine.get_loss_scale() == 2 ** 4 / 2
+    assert int(engine.state.skipped_steps) == 2
+
+
+def test_fp16_static_loss_scale():
+    engine = _engine(fp16={"enabled": True, "loss_scale": 64})
+    engine.train_batch(batch=random_batch(32, HIDDEN))
+    assert engine.get_loss_scale() == 64
+
+
+def test_gradient_clipping_applied():
+    # SGD(lr=1) so the update norm directly reflects the clipped grad norm
+    engine = _engine(gradient_clipping=1e-3,
+                     optimizer={"type": "SGD", "params": {"lr": 1.0}})
+    before = jax.device_get(engine.module_state_dict())
+    engine.train_batch(batch=random_batch(32, HIDDEN))
+    after = jax.device_get(engine.module_state_dict())
+    sq = 0.0
+    for k in before:
+        sq += np.sum((after[k]["w"] - before[k]["w"]) ** 2)
+        sq += np.sum((after[k]["b"] - before[k]["b"]) ** 2)
+    assert np.sqrt(sq) <= 1e-3 * 1.01
+
+
+def test_lr_scheduler_steps():
+    engine = _engine(scheduler={"type": "WarmupLR",
+                                "params": {"warmup_min_lr": 0.0,
+                                           "warmup_max_lr": 0.01,
+                                           "warmup_num_steps": 10}})
+    lr0 = engine.get_lr()[0]
+    for i in range(5):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=i))
+    assert engine.get_lr()[0] > lr0
+    assert engine.global_steps == 5
+
+
+def test_eval_batch():
+    engine = _engine()
+    loss = engine.eval_batch(random_batch(32, HIDDEN))
+    assert np.isfinite(float(loss))
+
+
+def test_bf16_training():
+    engine = _engine(bf16={"enabled": True})
+    batch = random_batch(32, HIDDEN)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    # master params stay fp32
+    assert engine.state.params["layer_0"]["w"].dtype == jnp.float32
+
+
+def test_monitor_csv(tmp_path):
+    engine = _engine(csv_monitor={"enabled": True,
+                                  "output_path": str(tmp_path),
+                                  "job_name": "job"})
+    engine.train_batch(batch=random_batch(32, HIDDEN))
+    files = list((tmp_path / "job").glob("*.csv"))
+    assert files
+
+
+def test_client_optimizer():
+    import optax
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init(jax.random.key(0))
+    cfg = {"train_micro_batch_size_per_gpu": 4}
+    engine, tx, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg,
+        optimizer=optax.sgd(1e-2))
+    loss0 = float(engine.train_batch(batch=random_batch(32, HIDDEN)))
+    assert np.isfinite(loss0)

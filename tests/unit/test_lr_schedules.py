@@ -1,0 +1,68 @@
+"""LR schedule tests (parity model: reference unit tests of
+``runtime/lr_schedules.py``)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, build_schedule,
+                                                VALID_LR_SCHEDULES)
+
+
+def test_warmup_lr_endpoints():
+    s = build_schedule("WarmupLR", {"warmup_min_lr": 0.0,
+                                    "warmup_max_lr": 0.01,
+                                    "warmup_num_steps": 100})
+    assert float(s(0)) == pytest.approx(0.0, abs=1e-6)
+    assert float(s(100)) == pytest.approx(0.01, rel=1e-3)
+    assert float(s(1000)) == pytest.approx(0.01, rel=1e-3)
+
+
+def test_warmup_monotone():
+    s = build_schedule("WarmupLR", {"warmup_max_lr": 0.01,
+                                    "warmup_num_steps": 50})
+    vals = [float(s(i)) for i in range(0, 60, 5)]
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_decay():
+    s = build_schedule("WarmupDecayLR", {"warmup_max_lr": 0.01,
+                                         "warmup_num_steps": 10,
+                                         "total_num_steps": 100})
+    assert float(s(10)) == pytest.approx(0.01, rel=1e-2)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+    assert float(s(55)) == pytest.approx(0.005, rel=0.01)
+
+
+def test_one_cycle():
+    s = build_schedule("OneCycle", {"cycle_min_lr": 0.001,
+                                    "cycle_max_lr": 0.01,
+                                    "cycle_first_step_size": 10})
+    assert float(s(0)) == pytest.approx(0.001, rel=1e-3)
+    assert float(s(10)) == pytest.approx(0.01, rel=1e-3)
+    assert float(s(20)) == pytest.approx(0.001, rel=1e-3)
+
+
+def test_lr_range_test():
+    s = build_schedule("LRRangeTest", {"lr_range_test_min_lr": 0.001,
+                                       "lr_range_test_step_size": 10,
+                                       "lr_range_test_step_rate": 1.0})
+    assert float(s(0)) == pytest.approx(0.001)
+    assert float(s(10)) == pytest.approx(0.002, rel=1e-3)
+
+
+def test_invalid_name_raises():
+    with pytest.raises(ValueError):
+        build_schedule("NotASchedule", {})
+
+
+def test_stateful_wrapper():
+    s = build_schedule("WarmupLR", {"warmup_max_lr": 0.01,
+                                    "warmup_num_steps": 10})
+    sched = LRScheduler(s)
+    sched.step()
+    sched.step()
+    assert sched.last_batch_iteration == 1
+    sd = sched.state_dict()
+    sched2 = LRScheduler(s)
+    sched2.load_state_dict(sd)
+    assert sched2.get_lr() == sched.get_lr()

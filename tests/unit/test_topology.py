@@ -1,0 +1,54 @@
+"""Topology / mesh tests (parity model: reference tests of
+``runtime/pipe/topology.py``)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology,
+                                             ProcessTopology, TopologyConfig,
+                                             build_mesh)
+
+
+def test_process_topology_ranks():
+    topo = ProcessTopology(axes=["pp", "dp"], dims=[2, 4])
+    assert topo.world_size() == 8
+    assert topo.get_rank(pp=0, dp=0) == 0
+    assert topo.get_rank(pp=1, dp=3) == 7
+    assert topo.get_dim("dp") == 4
+
+
+def test_axis_comm_lists():
+    topo = ProcessTopology(axes=["pp", "dp"], dims=[2, 2])
+    dp_lists = topo.get_axis_comm_lists("dp")
+    assert [sorted(l) for l in dp_lists] == [[0, 1], [2, 3]]
+    pp_lists = topo.get_axis_comm_lists("pp")
+    assert [sorted(l) for l in pp_lists] == [[0, 2], [1, 3]]
+
+
+def test_filter_match():
+    topo = ProcessTopology(axes=["pp", "dp", "tp"], dims=[2, 2, 2])
+    assert topo.filter_match(pp=0, tp=1) == [1, 3]
+
+
+def test_pipe_data_topology():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2, num_mp=2)
+    assert topo.world_size() == 8
+    assert "model" in topo.get_axis_names()
+
+
+def test_resolve_fsdp_remainder():
+    topo = TopologyConfig(tp=2).resolve(8)
+    assert topo.fsdp == 4
+    with pytest.raises(AssertionError):
+        TopologyConfig(tp=3).resolve(8)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(TopologyConfig(tp=2))
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["fsdp"] == 4
+    assert mesh.devices.size == 8
+
+
+def test_rank_repr():
+    topo = ProcessTopology(axes=["pp", "dp", "tp"], dims=[2, 2, 2])
+    assert topo.get_rank_repr(rank=0) == "tp_00"
