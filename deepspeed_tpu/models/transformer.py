@@ -31,7 +31,8 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.ops.attention import attention, reference_attention
 from deepspeed_tpu.ops.decode_attention import (KVCache, decode_attention,
                                                 init_cache, update_cache)
-from deepspeed_tpu.parallel.topology import DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, DP_AXIS, FSDP_AXIS,
+                                             SP_AXIS, TP_AXIS)
 from deepspeed_tpu.runtime.zero.stage_plan import maybe_constrain
 
 
@@ -53,6 +54,19 @@ class TransformerConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"
+    # MoE (0 experts = dense; reference deepspeed/moe):
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.0
+    moe_min_capacity: int = 4
+    moe_layer_freq: int = 1        # every Nth layer is MoE
+    moe_aux_loss_coef: float = 0.01
+    moe_noisy_gate_policy: Optional[str] = None
+    moe_eval_capacity_factor: Optional[float] = None  # None → capacity_factor
+
+    @property
+    def is_moe(self):
+        return self.moe_num_experts > 1
 
     @property
     def kv_heads(self):
@@ -93,6 +107,11 @@ class TransformerConfig:
             vocab_size=50304, hidden_size=1600, n_layers=48, n_heads=25,
             max_seq_len=1024, activation="gelu", use_rmsnorm=False,
             use_rope=False, tie_embeddings=True)
+        return replace(base, **kw)
+
+    @staticmethod
+    def moe_tiny(**kw):
+        base = TransformerConfig.tiny(moe_num_experts=4, moe_top_k=1)
         return replace(base, **kw)
 
     @staticmethod
@@ -158,6 +177,25 @@ class CausalTransformerLM:
 
     def __init__(self, config: TransformerConfig):
         self.config = config
+        self.gate = None
+        if config.is_moe:
+            from deepspeed_tpu.moe.sharded_moe import TopKGate
+            self.gate = TopKGate(
+                config.hidden_size, config.moe_num_experts,
+                k=config.moe_top_k,
+                capacity_factor=config.moe_capacity_factor,
+                eval_capacity_factor=(config.moe_eval_capacity_factor
+                                      if config.moe_eval_capacity_factor
+                                      is not None
+                                      else config.moe_capacity_factor),
+                min_capacity=config.moe_min_capacity,
+                noisy_gate_policy=config.moe_noisy_gate_policy)
+
+    def _is_moe_layer(self, i: int) -> bool:
+        # reference convention: every Nth layer hosts experts (freq=2 →
+        # alternating dense/MoE, MoE on odd layers)
+        c = self.config
+        return c.is_moe and (i % c.moe_layer_freq == c.moe_layer_freq - 1)
 
     # ------------------------------------------------------------------
     def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
@@ -169,6 +207,9 @@ class CausalTransformerLM:
         def dense(key, shape, fan_in):
             return (jax.random.normal(key, shape, jnp.float32) /
                     math.sqrt(fan_in)).astype(dtype)
+
+        if c.is_moe:
+            return self._init_moe(rng, dtype, dense)
 
         layers = {
             "attn_norm": jnp.ones((L, d), dtype),
@@ -193,10 +234,66 @@ class CausalTransformerLM:
             params["lm_head"] = dense(keys[9], (d, v), d)
         return params
 
+    def _init_moe(self, rng, dtype, dense):
+        """MoE variant: ``layers`` is a LIST of per-layer dicts (layers
+        differ in structure, so the forward unrolls instead of scanning —
+        reference MoE models interleave dense/expert layers the same way)."""
+        c = self.config
+        d, f, v = c.hidden_size, c.ffn_dim, c.vocab_size
+        dh, H, Hkv, E = c.head_dim, c.n_heads, c.kv_heads, c.moe_num_experts
+        keys = jax.random.split(rng, c.n_layers + 4)
+
+        def one_layer(key, moe: bool):
+            ks = jax.random.split(key, 8)
+            layer = {
+                "attn_norm": jnp.ones((d,), dtype),
+                "wq": dense(ks[0], (d, H * dh), d),
+                "wk": dense(ks[1], (d, Hkv * dh), d),
+                "wv": dense(ks[2], (d, Hkv * dh), d),
+                "wo": dense(ks[3], (H * dh, d), H * dh),
+                "mlp_norm": jnp.ones((d,), dtype),
+            }
+            if moe:
+                layer["moe"] = {
+                    "wg": dense(ks[4], (d, E), d).astype(jnp.float32),
+                    "w_up": dense(ks[5], (E, d, f), d),
+                    "w_down": dense(ks[6], (E, f, d), f),
+                }
+            else:
+                layer["w_up"] = dense(ks[5], (d, f), d)
+                layer["w_down"] = dense(ks[6], (f, d), f)
+                if c.activation == "silu":
+                    layer["w_gate"] = dense(ks[7], (d, f), d)
+            return layer
+
+        params = {
+            "tok_embed": dense(keys[-1], (v, d), d),
+            "final_norm": jnp.ones((d,), dtype),
+            "layers": [one_layer(keys[i], self._is_moe_layer(i))
+                       for i in range(c.n_layers)],
+        }
+        if not c.use_rope:
+            params["pos_embed"] = dense(keys[-2], (c.max_seq_len, d), d)
+        if not c.tie_embeddings:
+            params["lm_head"] = dense(keys[-3], (d, v), d)
+        return params
+
     # ------------------------------------------------------------------
     def tp_rules(self):
         """Megatron-style split over the ``tp`` axis: column-parallel in,
         row-parallel out (reference auto-TP ``module_inject/auto_tp.py``)."""
+        if self.config.is_moe:
+            from deepspeed_tpu.parallel.topology import EP_AXIS
+            return [
+                # expert weights: expert dim over ep, ffn dim over tp
+                (r"moe.*w_up", P(EP_AXIS, None, TP_AXIS)),
+                (r"moe.*w_down", P(EP_AXIS, TP_AXIS, None)),
+                (r"moe.*wg", P()),
+                # per-layer dense weights are 2-D in the MoE layout
+                (r"wq|wk|wv|w_up|w_gate", P(None, TP_AXIS)),
+                (r"\bwo|w_down", P(TP_AXIS, None)),
+                (r"lm_head", P(None, TP_AXIS)),
+            ]
         return [
             (r"wq|wk|wv|w_up|w_gate", P(None, None, TP_AXIS)),
             (r"wo|w_down", P(None, TP_AXIS, None)),
@@ -204,11 +301,10 @@ class CausalTransformerLM:
         ]
 
     # ------------------------------------------------------------------
-    def _layer(self, x, layer, positions):
+    def _attn_block(self, x, layer, positions):
         c = self.config
         B, S, d = x.shape
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
-
         h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm)
         q = (h @ layer["wq"]).reshape(B, S, H, dh)
         k = (h @ layer["wk"]).reshape(B, S, Hkv, dh)
@@ -217,17 +313,39 @@ class CausalTransformerLM:
             q = _rope(q, positions, c.rope_theta)
             k = _rope(k, positions, c.rope_theta)
         attn = attention(q, k, v, causal=True, impl=c.attn_impl)
-        x = x + attn.reshape(B, S, H * dh) @ layer["wo"]
+        return x + attn.reshape(B, S, H * dh) @ layer["wo"]
 
+    def _mlp_block(self, x, layer, rng=None, train=True):
+        """Dense or MoE FFN; returns (x, aux_loss)."""
+        c = self.config
         h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm)
+        if "moe" in layer:
+            from deepspeed_tpu.moe.sharded_moe import moe_layer_forward
+            act = jax.nn.silu if c.activation == "silu" else jax.nn.gelu
+
+            def expert_fn(ep, dispatched):
+                # gateless 2-layer expert FFN (reference Experts module);
+                # activation follows the model config
+                inner = act(jnp.einsum("ecd,edf->ecf", dispatched,
+                                       ep["w_up"]))
+                return jnp.einsum("ecf,efd->ecd", inner, ep["w_down"])
+
+            moe_out, l_aux, _ = moe_layer_forward(
+                self.gate, {"wg": layer["moe"]["wg"]}, layer["moe"],
+                expert_fn, h, train=train, rng=rng)
+            return x + moe_out, l_aux
         if c.activation == "silu":
             inner = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
         else:
             inner = jax.nn.gelu(h @ layer["w_up"])
-        x = x + inner @ layer["w_down"]
-        return x
+        return x + inner @ layer["w_down"], jnp.float32(0.0)
 
-    def apply(self, params, input_ids, positions=None):
+    def _layer(self, x, layer, positions, rng=None, train=True):
+        x = self._attn_block(x, layer, positions)
+        return self._mlp_block(x, layer, rng=rng, train=train)
+
+    def apply(self, params, input_ids, positions=None, rng=None, train=True,
+              return_aux=False):
         c = self.config
         B, S = input_ids.shape
         if positions is None:
@@ -236,21 +354,38 @@ class CausalTransformerLM:
         x = params["tok_embed"][input_ids]
         if not c.use_rope:
             x = x + params["pos_embed"][positions].astype(x.dtype)
-        # activation layout: batch over dp/fsdp, sequence over sp
-        x = maybe_constrain(x, P((DP_AXIS, FSDP_AXIS), SP_AXIS, None))
+        # activation layout: batch over all data axes, sequence over sp
+        x = maybe_constrain(x, P(tuple(BATCH_AXES), SP_AXIS, None))
 
-        def body(x, layer):
-            return self._layer(x, layer, positions), None
+        aux = jnp.float32(0.0)
+        if isinstance(params["layers"], (list, tuple)):
+            # MoE / heterogeneous stack: unrolled layer loop
+            layer_fn = self._layer
+            if c.remat:
+                policy = getattr(jax.checkpoint_policies, c.remat_policy, None)
+                layer_fn = jax.checkpoint(layer_fn, policy=policy,
+                                          static_argnums=(4,))
+            for i, layer in enumerate(params["layers"]):
+                lrng = jax.random.fold_in(rng, i) if rng is not None else None
+                x, l_aux = layer_fn(x, layer, positions, lrng, train)
+                aux = aux + l_aux
+        else:
+            def body(x, layer):
+                x, l_aux = self._layer(x, layer, positions, train=train)
+                return x, l_aux
 
-        if c.remat:
-            policy = getattr(jax.checkpoint_policies, c.remat_policy, None)
-            body = jax.checkpoint(body, policy=policy)
-        x, _ = jax.lax.scan(body, x, params["layers"])
+            if c.remat:
+                policy = getattr(jax.checkpoint_policies, c.remat_policy, None)
+                body = jax.checkpoint(body, policy=policy)
+            x, l_auxs = jax.lax.scan(body, x, params["layers"])
+            aux = jnp.sum(l_auxs)
 
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm)
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if return_aux:
+            return logits, aux
         return logits
 
     __call__ = apply
@@ -260,8 +395,12 @@ class CausalTransformerLM:
     # ------------------------------------------------------------------
     def init_caches(self, batch, max_seq, dtype=jnp.bfloat16):
         """Stacked per-layer KV caches: leaves have leading n_layers dim so
-        the decode forward stays a single scan."""
+        the decode forward stays a single scan.  (MoE models use a list of
+        caches matching their per-layer params list.)"""
         c = self.config
+        if c.is_moe:
+            return [init_cache(batch, max_seq, c.kv_heads, c.head_dim, dtype)
+                    for _ in range(c.n_layers)]
         one = init_cache(batch, max_seq, c.kv_heads, c.head_dim, dtype)
         return KVCache(
             k=jnp.broadcast_to(one.k[None], (c.n_layers,) + one.k.shape).copy(),
@@ -282,37 +421,46 @@ class CausalTransformerLM:
         cache = update_cache(KVCache(k=cache_k, v=cache_v, length=length), k, v)
         attn = decode_attention(q, cache)
         x = x + attn.reshape(B, T, H * dh) @ layer["wo"]
-        h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm)
-        if c.activation == "silu":
-            inner = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-        else:
-            inner = jax.nn.gelu(h @ layer["w_up"])
-        x = x + inner @ layer["w_down"]
+        x, _ = self._mlp_block(x, layer, train=False)
         return x, cache
 
-    def apply_with_cache(self, params, input_ids, caches: KVCache):
+    def apply_with_cache(self, params, input_ids, caches):
         """Forward for prefill (T=prompt) or decode (T=1), appending to
         ``caches``.  Returns (logits [B,T,V], new caches)."""
         c = self.config
         B, T = input_ids.shape
-        start = caches.length
+        if isinstance(caches, list):
+            start = caches[0].length
+        else:
+            start = caches.length
         positions = start + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = params["tok_embed"][input_ids]
         if not c.use_rope:
             x = x + params["pos_embed"][positions].astype(x.dtype)
 
-        def body(x, inp):
-            layer, ck, cv = inp
-            x, cache = self._layer_cached(x, layer, ck, cv, start, positions)
-            return x, (cache.k, cache.v)
+        if isinstance(caches, list):  # MoE / heterogeneous stack
+            new_caches = []
+            for layer, cache in zip(params["layers"], caches):
+                x, nc = self._layer_cached(x, layer, cache.k, cache.v,
+                                           start, positions)
+                new_caches.append(nc)
+            out_caches = new_caches
+        else:
+            def body(x, inp):
+                layer, ck, cv = inp
+                x, cache = self._layer_cached(x, layer, ck, cv, start,
+                                              positions)
+                return x, (cache.k, cache.v)
 
-        x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["layers"], caches.k, caches.v))
+            x, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], caches.k, caches.v))
+            out_caches = KVCache(k=new_k, v=new_v, length=start + T)
+
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm)
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
-        return logits, KVCache(k=new_k, v=new_v, length=start + T)
+        return logits, out_caches
 
     # ------------------------------------------------------------------
     def loss(self, params, batch, rng=None):
@@ -325,7 +473,7 @@ class CausalTransformerLM:
         else:
             input_ids, labels, loss_mask = batch, None, None
 
-        logits = self.apply(params, input_ids)
+        logits, aux = self.apply(params, input_ids, rng=rng, return_aux=True)
         if labels is None:
             labels = input_ids[:, 1:]
             logits = logits[:, :-1]
@@ -335,5 +483,8 @@ class CausalTransformerLM:
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         if loss_mask is not None:
-            return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
-        return jnp.mean(nll)
+            ce = jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
+        else:
+            ce = jnp.mean(nll)
+        # MoE load-balancing loss (reference engine adds l_aux scaled by coef)
+        return ce + self.config.moe_aux_loss_coef * aux

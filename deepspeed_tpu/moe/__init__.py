@@ -1,0 +1,3 @@
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import (TopKGate, top1gating, top2gating,
+                                           moe_layer_forward)

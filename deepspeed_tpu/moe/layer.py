@@ -1,0 +1,101 @@
+"""MoE layer — user-facing module.
+
+Parity: reference ``deepspeed/moe/layer.py:15`` (``MoE``: wraps an expert
+module with a TopKGate + MOELayer, expert-parallel groups created from
+``ep_size``) and ``moe/experts.py`` (``Experts``: per-rank expert stack).
+
+TPU design: experts are ONE stacked params pytree with leading dim
+``num_experts`` sharded over the ``ep`` mesh axis — the per-rank expert lists
+and process groups of the reference dissolve into that sharding.  The expert
+computation is a vmap/einsum over the expert dim so all experts run in one
+batched matmul (MXU-friendly), instead of a Python loop over expert modules.
+"""
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_forward
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import EP_AXIS, FSDP_AXIS, TP_AXIS
+
+
+class MoE:
+    """Functional MoE FFN: init() → params, __call__(params, x) →
+    (out, l_aux, exp_counts)."""
+
+    def __init__(self, hidden_size, ffn_hidden_size=None, num_experts=1, k=1,
+                 capacity_factor=1.0, eval_capacity_factor=1.0,
+                 min_capacity=4, noisy_gate_policy: Optional[str] = None,
+                 drop_tokens=True, activation="gelu",
+                 use_residual=False):
+        self.hidden_size = hidden_size
+        self.ffn_dim = ffn_hidden_size or 4 * hidden_size
+        self.num_experts = num_experts
+        self.use_residual = use_residual
+        self.activation = activation
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                             eval_capacity_factor, min_capacity,
+                             noisy_gate_policy, drop_tokens)
+
+    # ------------------------------------------------------------------
+    def init(self, rng, dtype=jnp.float32):
+        kg, k1, k2, k3 = jax.random.split(rng, 4)
+        E, D, F = self.num_experts, self.hidden_size, self.ffn_dim
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32) /
+                    math.sqrt(fan_in)).astype(dtype)
+
+        params = {
+            "gate": self.gate.init(kg),
+            "experts": {
+                "w_up": dense(k1, (E, D, F), D),
+                "b_up": jnp.zeros((E, F), dtype),
+                "w_down": dense(k2, (E, F, D), F),
+                "b_down": jnp.zeros((E, D), dtype),
+            },
+        }
+        if self.use_residual:
+            params["residual_mlp"] = {
+                "w_up": dense(k3, (D, F), D),
+                "w_down": dense(jax.random.fold_in(k3, 1), (F, D), F),
+            }
+            params["coefficient"] = jnp.zeros((D, 2), dtype)
+        return params
+
+    # ------------------------------------------------------------------
+    def tp_rules(self):
+        """Sharding for expert weights: expert dim over ep, ffn dim over tp
+        (column/row parallel within each expert)."""
+        return [
+            (r"experts.*w_up", P(EP_AXIS, None, TP_AXIS)),
+            (r"experts.*b_up", P(EP_AXIS, TP_AXIS)),
+            (r"experts.*w_down", P(EP_AXIS, TP_AXIS, None)),
+            (r"experts.*b_down", P(EP_AXIS, None)),
+        ]
+
+    # ------------------------------------------------------------------
+    def _expert_fn(self, expert_params, dispatched):
+        """dispatched: [E, C, D] → [E, C, D]; one batched einsum per matmul
+        so every expert's FFN runs on the MXU together."""
+        act = jax.nn.gelu if self.activation == "gelu" else jax.nn.silu
+        h = jnp.einsum("ecd,edf->ecf", dispatched, expert_params["w_up"])
+        h = act(h + expert_params["b_up"][:, None, :])
+        out = jnp.einsum("ecf,efd->ecd", h, expert_params["w_down"])
+        return out + expert_params["b_down"][:, None, :]
+
+    def __call__(self, params, x, train=True, rng=None):
+        out, l_aux, exp_counts = moe_layer_forward(
+            self.gate, params["gate"], params["experts"], self._expert_fn,
+            x, train=train, rng=rng)
+        if self.use_residual:
+            mlp = params["residual_mlp"]
+            act = jax.nn.gelu if self.activation == "gelu" else jax.nn.silu
+            res = act(x @ mlp["w_up"]) @ mlp["w_down"]
+            coef = jax.nn.softmax(x @ params["coefficient"], axis=-1)
+            out = out * coef[..., 0:1] + res * coef[..., 1:2]
+        return out, l_aux, exp_counts

@@ -1,0 +1,201 @@
+"""Sharded MoE: gating + expert dispatch.
+
+Parity: reference ``deepspeed/moe/sharded_moe.py`` (``top1gating:177``,
+``top2gating:278`` — gumbel noise, capacity, load-balancing aux loss;
+``_AllToAll:89``; ``MOELayer:439``: gate → dispatch all-to-all → experts →
+combine all-to-all).
+
+TPU design: dispatch/combine are einsums with a dispatch mask; sharding
+constraints place tokens over the batch axes and experts over the ``ep``
+axis, and the XLA partitioner materialises the two all-to-alls the reference
+issues explicitly.  Capacity is static (computed from shapes at trace time)
+so the program never retraces.  Everything is fp32 at the gate (reference
+casts gate logits to fp32 too).
+"""
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (DP_AXIS, EP_AXIS, FSDP_AXIS,
+                                             TP_AXIS)
+from deepspeed_tpu.runtime.zero.stage_plan import maybe_constrain
+
+TOKENS_SPEC = P((DP_AXIS, FSDP_AXIS, EP_AXIS), None)        # [tokens, d]
+DISPATCH_SPEC = P(EP_AXIS, None, None)                      # [e, c, d]
+
+
+class GateOutput(NamedTuple):
+    l_aux: jnp.ndarray            # load-balancing loss (scalar)
+    combine_weights: jnp.ndarray  # [tokens, E, C] fp32
+    dispatch_mask: jnp.ndarray    # [tokens, E, C] bool
+    exp_counts: jnp.ndarray       # [E] tokens routed per expert (pre-capacity)
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4,
+               noisy_gate_policy: Optional[str] = None, rng=None,
+               drop_tokens=True, used_token_mask=None) -> GateOutput:
+    """Top-1 gating (Switch). logits: [tokens, E] fp32.
+
+    Mirrors reference ``top1gating``: optional jitter/RSample noise, position
+    within expert via masked cumsum, tokens beyond capacity dropped, aux loss
+    = E * mean(me·ce).
+    """
+    tokens, E = logits.shape
+    C = _capacity(tokens, E, capacity_factor, min_capacity)
+    if not drop_tokens:
+        C = tokens  # worst case: everything to one expert
+
+    logits = logits.astype(jnp.float32)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        noisy = logits + jax.random.gumbel(rng, logits.shape)
+    elif noisy_gate_policy == "Jitter" and rng is not None:
+        noisy = logits * jax.random.uniform(rng, logits.shape, minval=0.98,
+                                            maxval=1.02)
+    else:
+        noisy = logits
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(noisy, axis=-1)                        # [tokens]
+    mask1 = _one_hot(idx, E)                                # [tokens, E]
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+
+    exp_counts = jnp.sum(mask1, axis=0)
+    # aux loss (reference l_aux = E * sum(me*ce))
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # position of each token within its expert queue
+    pos_in_expert = jnp.cumsum(mask1, axis=0) - mask1       # [tokens, E]
+    pos = jnp.sum(pos_in_expert * mask1, axis=-1)           # [tokens]
+    keep = (pos < C)[:, None] * mask1                        # drop overflow
+
+    gate_val = jnp.sum(gates * keep, axis=-1)               # [tokens]
+    loc = _one_hot(pos.astype(jnp.int32), C)                # [tokens, C]
+    combine = gate_val[:, None, None] * keep[:, :, None] * loc[:, None, :]
+    dispatch = combine > 0
+    return GateOutput(l_aux=l_aux, combine_weights=combine,
+                      dispatch_mask=dispatch, exp_counts=exp_counts)
+
+
+def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
+               second_policy="Rsample") -> GateOutput:
+    """Top-2 gating (GShard).  Capacity doubles (2 slots per token)."""
+    tokens, E = logits.shape
+    C = _capacity(tokens, E, capacity_factor * 2.0, min_capacity)
+
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    logits_no1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    if rng is not None and second_policy.lower() == "rsample":
+        logits_no1 = logits_no1 + jax.random.gumbel(rng, logits.shape)
+    idx2 = jnp.argmax(logits_no1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0)[None]
+    p1 = jnp.sum(pos1 * mask1, axis=-1)
+    p2 = jnp.sum(pos2 * mask2, axis=-1)
+    keep1 = (p1 < C)[:, None] * mask1
+    keep2 = (p2 < C)[:, None] * mask2
+
+    g1 = jnp.sum(gates * keep1, axis=-1)
+    g2 = jnp.sum(gates * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = _one_hot(p1.astype(jnp.int32), C)
+    loc2 = _one_hot(p2.astype(jnp.int32), C)
+    combine = (g1[:, None, None] * keep1[:, :, None] * loc1[:, None, :] +
+               g2[:, None, None] * keep2[:, :, None] * loc2[:, None, :])
+    dispatch = combine > 0
+    return GateOutput(l_aux=l_aux, combine_weights=combine,
+                      dispatch_mask=dispatch, exp_counts=exp_counts)
+
+
+class TopKGate:
+    """Parity shim of reference ``TopKGate:351`` as a functional object."""
+
+    def __init__(self, model_dim, num_experts, k=1, capacity_factor=1.0,
+                 eval_capacity_factor=1.0, min_capacity=4,
+                 noisy_gate_policy=None, drop_tokens=True):
+        assert k in (1, 2), "only top-1 and top-2 gating are supported"
+        self.model_dim = model_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+
+    def init(self, rng):
+        scale = 1.0 / math.sqrt(self.model_dim)
+        return {"wg": jax.random.normal(
+            rng, (self.model_dim, self.num_experts), jnp.float32) * scale}
+
+    def __call__(self, gate_params, x, train=True, rng=None) -> GateOutput:
+        logits = x.astype(jnp.float32) @ gate_params["wg"]
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity,
+                              self.noisy_gate_policy if train else None,
+                              rng=rng, drop_tokens=self.drop_tokens)
+        # second-expert sampling noise only during training (eval must be
+        # deterministic, matching the top-1 path)
+        return top2gating(logits, cf, self.min_capacity,
+                          rng=rng if train else None)
+
+
+def moe_layer_forward(gate: TopKGate, gate_params, expert_params, expert_fn,
+                      x, train=True, rng=None):
+    """The MOELayer hot path (reference ``MOELayer.forward:439``).
+
+    x: [B, S, D] → tokens [B*S, D]; expert_params leaves have leading E dim
+    sharded over ``ep``; returns (out [B,S,D], l_aux, exp_counts).
+
+    The two sharding constraints around the einsums reproduce the reference's
+    explicit all-to-alls: tokens are sharded over the batch axes, the
+    dispatched tensor over ``ep`` — the transition is an all-to-all over ICI.
+    """
+    B, S, D = x.shape
+    tokens = x.reshape(B * S, D)
+    tokens = maybe_constrain(tokens, TOKENS_SPEC)
+
+    out = gate(gate_params, tokens, train=train, rng=rng)
+    # dispatch: [tokens, E, C] × [tokens, D] → [E, C, D]  (all-to-all #1)
+    dispatched = jnp.einsum("tec,td->ecd",
+                            out.dispatch_mask.astype(x.dtype), tokens)
+    dispatched = maybe_constrain(dispatched, DISPATCH_SPEC)
+
+    expert_out = expert_fn(expert_params, dispatched)  # [E, C, D]
+    expert_out = maybe_constrain(expert_out, DISPATCH_SPEC)
+
+    # combine: [tokens, E, C] × [E, C, D] → [tokens, D]  (all-to-all #2)
+    combined = jnp.einsum("tec,ecd->td",
+                          out.combine_weights.astype(x.dtype), expert_out)
+    combined = maybe_constrain(combined, TOKENS_SPEC)
+    return combined.reshape(B, S, D), out.l_aux, out.exp_counts

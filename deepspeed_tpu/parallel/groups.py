@@ -11,27 +11,25 @@ import threading
 from typing import Optional
 
 from deepspeed_tpu.parallel.topology import (
-    BATCH_AXES, DP_AXIS, FSDP_AXIS, MESH_AXES, PP_AXIS, SP_AXIS, TP_AXIS,
-    TopologyConfig, build_mesh,
+    BATCH_AXES, DP_AXIS, EP_AXIS, FSDP_AXIS, MESH_AXES, PP_AXIS, SP_AXIS,
+    TP_AXIS, TopologyConfig, build_mesh,
 )
 
 _lock = threading.Lock()
 _mesh = None
 _topology_config: Optional[TopologyConfig] = None
-_expert_parallel_size = 1
 
 
 def initialize_mesh(topo: Optional[TopologyConfig] = None, devices=None, mesh=None):
     """Install the process-wide mesh.  Called from ``initialize()``; tests may
     install their own mesh directly."""
-    global _mesh, _topology_config, _expert_parallel_size
+    global _mesh, _topology_config
     with _lock:
         if mesh is not None:
             _mesh = mesh
         else:
             _mesh = build_mesh(topo, devices=devices)
         _topology_config = topo or TopologyConfig()
-        _expert_parallel_size = getattr(_topology_config, "ep", 1)
     return _mesh
 
 
@@ -47,11 +45,10 @@ def mesh_is_initialized():
 
 
 def reset_mesh():
-    global _mesh, _topology_config, _expert_parallel_size
+    global _mesh, _topology_config
     with _lock:
         _mesh = None
         _topology_config = None
-        _expert_parallel_size = 1
 
 
 def _axis_size(axis) -> int:
@@ -90,16 +87,14 @@ def get_sequence_parallel_world_size() -> int:
 
 
 def get_expert_parallel_world_size() -> int:
-    return _expert_parallel_size
+    return _axis_size(EP_AXIS)
 
 
-def set_expert_parallel_world_size(ep_size: int):
-    global _expert_parallel_size
-    cap = get_partition_world_size() * get_sequence_parallel_world_size() * \
-        get_model_parallel_world_size()
-    assert cap % ep_size == 0 or ep_size % cap == 0 or ep_size <= cap, \
-        f"ep_size {ep_size} incompatible with mesh ({cap} non-dp devices)"
-    _expert_parallel_size = ep_size
+def get_expert_data_parallel_world_size() -> int:
+    """DP degree *within* an expert group (reference
+    ``_create_expert_and_data_parallel``: expert-data-parallel =
+    dp_world / ep_size)."""
+    return _axis_size([DP_AXIS, FSDP_AXIS])
 
 
 def get_world_size() -> int:

@@ -14,11 +14,13 @@ Canonical axis order (outermost → innermost, slowest → fastest wire):
     pp   pipeline stages        (point-to-point ppermute traffic)
     dp   pure data parallel     (gradient all-reduce; rides DCN across slices)
     fsdp ZeRO partition axis    (all-gather / reduce-scatter; wants ICI)
+    ep   expert parallel        (MoE all-to-all dispatch/combine)
     sp   sequence/context       (all-to-all / ring ppermute)
     tp   tensor parallel        (all-reduce per layer; innermost = fastest ICI)
 
-Expert parallelism reuses ``fsdp×sp×tp`` subsets via ``ep_size`` (the
-reference overlays EP on DP the same way — ``groups.py:109``).
+EP overlays DP exactly like the reference (``groups.py:109``: expert-parallel
+ranks are data-parallel ranks): the ``ep`` axis carries batch shards too, so
+``dp_world = dp × fsdp × ep`` and experts are sharded over ``ep``.
 """
 
 import collections
@@ -30,16 +32,17 @@ import numpy as np
 PP_AXIS = "pp"
 DP_AXIS = "dp"
 FSDP_AXIS = "fsdp"
+EP_AXIS = "ep"
 SP_AXIS = "sp"
 TP_AXIS = "tp"
 
 # The order matters: innermost axes get the fastest ICI links when the mesh
 # comes from mesh_utils.create_device_mesh.
-MESH_AXES = (PP_AXIS, DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS)
+MESH_AXES = (PP_AXIS, DP_AXIS, FSDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
 
 # Axes over which a data batch is sharded (each contributes to the
 # effective data-parallel world size).
-BATCH_AXES = (DP_AXIS, FSDP_AXIS)
+BATCH_AXES = (DP_AXIS, FSDP_AXIS, EP_AXIS)
 
 
 @dataclass
@@ -50,22 +53,22 @@ class TopologyConfig:
     pp: int = 1
     dp: int = 1
     fsdp: int = -1
+    ep: int = 1   # expert parallel degree (own mesh axis; overlays DP)
     sp: int = 1
     tp: int = 1
-    ep: int = 1  # expert parallel degree; must divide fsdp*sp*tp
 
     def resolve(self, n_devices: int) -> "TopologyConfig":
-        known = self.pp * self.dp * self.sp * self.tp
+        known = self.pp * self.dp * self.ep * self.sp * self.tp
         fsdp = self.fsdp
         if fsdp == -1:
             assert n_devices % known == 0, \
-                f"device count {n_devices} not divisible by pp*dp*sp*tp={known}"
+                f"device count {n_devices} not divisible by pp*dp*ep*sp*tp={known}"
             fsdp = n_devices // known
         total = known * fsdp
         assert total == n_devices, \
             f"topology {self} needs {total} devices, have {n_devices}"
-        return TopologyConfig(pp=self.pp, dp=self.dp, fsdp=fsdp,
-                              sp=self.sp, tp=self.tp, ep=self.ep)
+        return TopologyConfig(pp=self.pp, dp=self.dp, fsdp=fsdp, ep=self.ep,
+                              sp=self.sp, tp=self.tp)
 
 
 class ProcessTopology:
@@ -172,7 +175,7 @@ def build_mesh(topo: Optional[TopologyConfig] = None, devices=None):
     if devices is None:
         devices = jax.devices()
     topo = (topo or TopologyConfig()).resolve(len(devices))
-    shape = (topo.pp, topo.dp, topo.fsdp, topo.sp, topo.tp)
+    shape = (topo.pp, topo.dp, topo.fsdp, topo.ep, topo.sp, topo.tp)
     try:
         from jax.experimental import mesh_utils
         device_array = mesh_utils.create_device_mesh(shape, devices=devices)
@@ -186,4 +189,4 @@ def single_device_mesh(device=None):
     from jax.sharding import Mesh
     if device is None:
         device = jax.devices()[0]
-    return Mesh(np.asarray([device]).reshape((1, 1, 1, 1, 1)), MESH_AXES)
+    return Mesh(np.asarray([device]).reshape((1,) * len(MESH_AXES)), MESH_AXES)

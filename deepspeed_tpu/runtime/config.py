@@ -146,9 +146,10 @@ class DeepSpeedConfig:
                 world_size = 1
         self.world_size = world_size
 
-        # effective data-parallel degree for the batch triangle
+        # effective data-parallel degree for the batch triangle (EP overlays
+        # DP, so the ep axis carries batch shards too)
         topo = self.mesh_config.resolve(world_size)
-        self.data_parallel_size = topo.dp * topo.fsdp
+        self.data_parallel_size = topo.dp * topo.fsdp * topo.ep
 
         self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE)
         self.train_micro_batch_size_per_gpu = pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)

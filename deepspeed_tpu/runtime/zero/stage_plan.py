@@ -175,10 +175,11 @@ class ZeroShardingPlan:
             transform_non_params=lambda _: P())
 
     def batch_spec(self, ndim: int = 2, sequence_dim: Optional[int] = None) -> P:
-        """Batch dim sharded over every data axis; optional sequence dim over
-        ``sp`` (Ulysses-style sequence parallelism input layout)."""
+        """Batch dim sharded over every data axis (incl. ep — EP overlays DP);
+        optional sequence dim over ``sp`` (Ulysses input layout)."""
+        from deepspeed_tpu.parallel.topology import BATCH_AXES
         entries = [None] * ndim
-        entries[0] = (DP_AXIS, FSDP_AXIS)
+        entries[0] = tuple(BATCH_AXES)
         sp = self.mesh.shape.get(SP_AXIS, 1)
         if sequence_dim is not None and sp > 1:
             entries[sequence_dim] = SP_AXIS
@@ -216,8 +217,8 @@ class ZeroShardingPlan:
 def active_mesh():
     """The ambient mesh installed by ``with mesh:`` — None outside."""
     try:
-        from jax.interpreters import pxla
-        m = pxla.thread_resources.env.physical_mesh
+        from jax._src import mesh as mesh_lib
+        m = mesh_lib.thread_resources.env.physical_mesh
         return None if m.empty else m
     except Exception:
         return None
