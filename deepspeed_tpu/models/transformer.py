@@ -312,7 +312,25 @@ class CausalTransformerLM:
         if c.use_rope:
             q = _rope(q, positions, c.rope_theta)
             k = _rope(k, positions, c.rope_theta)
-        attn = attention(q, k, v, causal=True, impl=c.attn_impl)
+        if c.attn_impl == "ring":
+            from deepspeed_tpu.ops.ring_attention import ring_attention
+            attn = ring_attention(q, k, v, causal=True)
+        elif c.attn_impl == "ulysses":
+            from deepspeed_tpu.ops.ulysses import ulysses_attention, sp_degree
+            sp = sp_degree()
+            # K/V only need a head count divisible by sp for the all-to-all;
+            # the inner attention handles GQA itself
+            if sp > 1 and Hkv % sp != 0:
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
+            attn = ulysses_attention(
+                q, k, v, lambda q, k, v: attention(q, k, v, causal=True))
+        elif c.attn_impl in ("auto", "pallas", "reference"):
+            attn = attention(q, k, v, causal=True, impl=c.attn_impl)
+        else:
+            raise ValueError(
+                f"unknown attn_impl '{c.attn_impl}'; expected one of "
+                "auto/pallas/reference/ring/ulysses")
         return x + attn.reshape(B, S, H * dh) @ layer["wo"]
 
     def _mlp_block(self, x, layer, rng=None, train=True):

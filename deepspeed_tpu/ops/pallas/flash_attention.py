@@ -1,0 +1,195 @@
+"""Pallas flash attention (TPU).
+
+Parity role: the fused attention inside the reference's training transformer
+kernel (``csrc/transformer/ds_transformer_cuda.cpp``) and its
+softmax/dropout/transform sub-kernels — rebuilt as a tiled online-softmax
+kernel that streams K/V blocks through VMEM into the MXU and never
+materialises the [S, S] score matrix.
+
+Forward: Pallas kernel, grid (batch·heads, q_blocks); K/V for the head stay
+in VMEM (fine to S≈8k at D=128); inner ``fori_loop`` over K blocks carries
+(acc, row-max, row-sum) registers.  Causal blocks beyond the diagonal are
+skipped via the loop bound, the diagonal block is masked with iota.
+
+Backward: custom VJP using the saved log-sum-exp — the standard flash
+backward expressed as jnp einsums (XLA tiles them); a full Pallas backward
+kernel can replace it behind the same signature.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is only importable on TPU-capable installs
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BLK_Q, D]
+    d = q.shape[-1]
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # last K block that intersects the causal triangle for this Q block
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                    # [BLK_Q, BLK_K]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        bm = jnp.max(s, axis=-1, keepdims=True)        # [BLK_Q, 1]
+        new_m = jnp.maximum(m, bm)
+        p = jnp.exp(s - new_m)
+        p = jnp.where(new_m <= _NEG / 2, 0.0, p)
+        corr = jnp.exp(m - new_m)
+        corr = jnp.where(m <= _NEG / 2, 0.0, corr)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + p @ v
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False):
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    qr = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    kr = jnp.swapaxes(k, 1, 2).reshape(B * Hkv, S, D)
+    vr = jnp.swapaxes(v, 1, 2).reshape(B * Hkv, S, D)
+
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    grid = (B * H, S // block_q)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=S)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+
+    out = jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+    return out, lse.reshape(B, H, S)
+
+
+def _flash_bwd(scale, causal, res, g):
+    """Flash backward from saved LSE (jnp einsums; fp32)."""
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k_full = jnp.repeat(k, rep, axis=2)
+        v_full = jnp.repeat(v, rep, axis=2)
+    else:
+        k_full, v_full = k, v
+
+    qf = q.astype(jnp.float32)
+    kf = k_full.astype(jnp.float32)
+    vf = v_full.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        qpos = jnp.arange(S)[:, None]
+        kpos = jnp.arange(S)[None, :]
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG)
+    p = jnp.exp(s - lse[..., None])                    # [B,H,S,S]
+
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
+    delta = jnp.sum(gf * of, axis=-1)                  # [B,S,H]
+    ds = p * (dp - jnp.swapaxes(delta, 1, 2)[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+
+    if Hkv != H:
+        rep = H // Hkv
+        dk = dk.reshape(B, S, Hkv, rep, D).sum(axis=3)
+        dv = dv.reshape(B, S, Hkv, rep, D).sum(axis=3)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k,
+                     interpret=False):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k,
+                         interpret=False):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(scale, causal, res, g)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, causal=True, softmax_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    """q: [B, S, H, D]; k/v: [B, S, Hkv, D].  Falls back to the jnp reference
+    when the shape doesn't tile (S not divisible by the block size).
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI)."""
+    B, S, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k or H % k.shape[2]:
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+    return _flash_attention(q, k, v, scale, causal, block_q, block_k,
+                            interpret)

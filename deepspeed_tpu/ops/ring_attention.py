@@ -1,0 +1,173 @@
+"""Ring attention over the ``sp`` axis — exact blockwise attention for
+sequences too long for any single device.
+
+The reference has no context parallelism (SURVEY §2.4); its long-sequence
+story was block-*sparse* attention.  This implements the exact alternative
+(Ring Attention with blockwise online softmax): each device keeps its local
+Q block resident and K/V blocks rotate around the ``sp`` ring via
+``ppermute``; partial results merge with the flash-attention log-sum-exp
+recurrence.  XLA overlaps each hop's transfer with the current block's
+compute.
+
+Memory: the forward materialises only [S/sp, S/sp] scores per step, and the
+backward is a **custom VJP** that re-rotates K/V and recomputes each block
+from the saved log-sum-exp — per-device residuals stay O(S/sp), never the
+full sequence.  K/V stay at their GQA head count through the ring (the query
+group dim is folded into the block einsums), so ppermute traffic is Hkv-sized.
+"""
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import BATCH_AXES, SP_AXIS
+from deepspeed_tpu.runtime.zero.stage_plan import active_mesh
+
+_NEG = -1e30
+
+
+def _rotate(x, axis_name, n):
+    return jax.lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _block_scores(q5, k, scale, mask):
+    """q5: [B, Sq, Hkv, G, D]; k: [B, Sk, Hkv, D] → scores [B, Hkv, G, Sq, Sk]
+    in fp32 (GQA group folded into the einsum — K stays at Hkv heads)."""
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", q5, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, None], s, _NEG)
+    return s
+
+
+def _causal_mask(my_idx, kv_idx, S):
+    pos = jnp.arange(S)
+    qpos = my_idx * S + pos[:, None]
+    kpos = kv_idx * S + pos[None, :]
+    return qpos >= kpos
+
+
+def _ring_fwd_local(q, k, v, axis_name, causal, scale):
+    """Returns (out [B,S,H,D], lse [B,Hkv,G,S])."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    q5 = q.reshape(B, S, Hkv, G, D)
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    o0 = jnp.zeros((B, S, Hkv, G, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, G, S), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, S), jnp.float32)
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        kv_idx = (my_idx - i) % n
+        mask = _causal_mask(my_idx, kv_idx, S) if causal else None
+        s = _block_scores(q5, k_cur, scale, mask)      # [B,Hkv,G,Sq,Sk]
+        bm = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, bm)
+        p = jnp.exp(s - new_m[..., None])
+        p = jnp.where(new_m[..., None] <= _NEG / 2, 0.0, p)
+        corr = jnp.exp(m - new_m)
+        corr = jnp.where(m <= _NEG / 2, 0.0, corr)
+        l = l * corr + jnp.sum(p, axis=-1)
+        bo = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_cur.dtype),
+                        v_cur).astype(jnp.float32)
+        corr_o = jnp.moveaxis(corr, 3, 1)[..., None]   # [B,Sq,Hkv,G,1]
+        o = o * corr_o + bo
+        return o, new_m, l, _rotate(k_cur, axis_name, n), \
+            _rotate(v_cur, axis_name, n)
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = o / jnp.moveaxis(l_safe, 3, 1)[..., None]
+    lse = m + jnp.log(l_safe)
+    return out.reshape(B, S, H, D).astype(q.dtype), lse
+
+
+def _ring_bwd_local(q, k, v, out, lse, g, axis_name, causal, scale):
+    """Recompute-with-rotation backward: dk/dv accumulators travel with the
+    rotating K/V blocks and arrive home after n hops."""
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    q5 = q.reshape(B, S, Hkv, G, D).astype(jnp.float32)
+    g5 = g.reshape(B, S, Hkv, G, D).astype(jnp.float32)
+    o5 = out.reshape(B, S, Hkv, G, D).astype(jnp.float32)
+    delta = jnp.sum(g5 * o5, axis=-1)                  # [B,S,Hkv,G]
+    delta = jnp.moveaxis(delta, 1, 3)                  # [B,Hkv,G,S]
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    dq0 = jnp.zeros_like(q5)
+    dk0 = jnp.zeros((B, S, Hkv, D), jnp.float32)
+    dv0 = jnp.zeros((B, S, Hkv, D), jnp.float32)
+
+    def body(i, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        kv_idx = (my_idx - i) % n
+        mask = _causal_mask(my_idx, kv_idx, S) if causal else None
+        s = _block_scores(q5, k_cur, scale, mask)
+        p = jnp.exp(s - lse[..., None])                # [B,Hkv,G,Sq,Sk]
+        dp = jnp.einsum("bqhgd,bkhd->bhgqk", g5, v_cur.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", ds,
+                             k_cur.astype(jnp.float32))
+        dk_cur = dk_cur + jnp.einsum("bhgqk,bqhgd->bkhd", ds, q5)
+        dv_cur = dv_cur + jnp.einsum("bhgqk,bqhgd->bkhd", p, g5)
+        return (dq, _rotate(k_cur, axis_name, n), _rotate(v_cur, axis_name, n),
+                _rotate(dk_cur, axis_name, n), _rotate(dv_cur, axis_name, n))
+
+    dq, _, _, dk, dv = jax.lax.fori_loop(0, n, body, (dq0, k, v, dk0, dv0))
+    # after n rotations the accumulators are back at the owner of their block
+    return (dq.reshape(B, S, H, D).astype(q.dtype),
+            dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention_local(q, k, v, axis_name=SP_AXIS, causal=True,
+                         softmax_scale=None):
+    """Per-device body (inside shard_map): q/k/v [B, S_loc, H|Hkv, D] are this
+    device's sequence block; returns the local attention output."""
+    scale = softmax_scale if softmax_scale is not None else \
+        1.0 / math.sqrt(q.shape[-1])
+    out, _ = _ring_fwd_local(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_local_fwd(q, k, v, axis_name, causal, softmax_scale):
+    scale = softmax_scale if softmax_scale is not None else \
+        1.0 / math.sqrt(q.shape[-1])
+    out, lse = _ring_fwd_local(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_local_bwd(axis_name, causal, softmax_scale, res, g):
+    q, k, v, out, lse = res
+    scale = softmax_scale if softmax_scale is not None else \
+        1.0 / math.sqrt(q.shape[-1])
+    return _ring_bwd_local(q, k, v, out, lse, g, axis_name, causal, scale)
+
+
+ring_attention_local.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def ring_attention(q, k, v, causal=True, softmax_scale=None, mesh=None):
+    """GSPMD entry: q/k/v global [B, S, H|Hkv, D], sequence-sharded over
+    ``sp``."""
+    mesh = mesh or active_mesh()
+    if mesh is None or mesh.shape.get(SP_AXIS, 1) == 1:
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale)
+    spec = P(tuple(BATCH_AXES), SP_AXIS, None, None)
+    body = jax.shard_map(
+        # positional call: custom_vjp nondiff_argnums are positional
+        lambda q, k, v: ring_attention_local(q, k, v, SP_AXIS, causal,
+                                             softmax_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return body(q, k, v)
