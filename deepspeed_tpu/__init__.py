@@ -43,6 +43,8 @@ def initialize(args=None,
     Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
     """
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
 
     assert model is not None, "deepspeed_tpu.initialize: model is required"
     if config is None and config_params is not None:
@@ -56,7 +58,11 @@ def initialize(args=None,
     if not isinstance(config, DeepSpeedConfig):
         config = DeepSpeedConfig(config)
 
-    engine = DeepSpeedEngine(
+    # PipelineModule models get the pipeline engine — parity:
+    # reference deepspeed/__init__.py:124-148
+    engine_cls = (PipelineEngine if isinstance(model, PipelineModule)
+                  else DeepSpeedEngine)
+    engine = engine_cls(
         model=model,
         config=config,
         params=model_parameters,

@@ -319,10 +319,14 @@ class CausalTransformerLM:
             from deepspeed_tpu.ops.ulysses import ulysses_attention, sp_degree
             sp = sp_degree()
             # K/V only need a head count divisible by sp for the all-to-all;
-            # the inner attention handles GQA itself
+            # the inner attention handles the remaining GQA grouping, so
+            # repeat by the smallest factor that reaches divisibility
             if sp > 1 and Hkv % sp != 0:
-                k = jnp.repeat(k, H // Hkv, axis=2)
-                v = jnp.repeat(v, H // Hkv, axis=2)
+                group = H // Hkv
+                r = next((r for r in range(1, group + 1)
+                          if group % r == 0 and (Hkv * r) % sp == 0), group)
+                k = jnp.repeat(k, r, axis=2)
+                v = jnp.repeat(v, r, axis=2)
             attn = ulysses_attention(
                 q, k, v, lambda q, k, v: attention(q, k, v, causal=True))
         elif c.attn_impl in ("auto", "pallas", "reference"):

@@ -80,15 +80,16 @@ def adam_update(params: np.ndarray, grads: np.ndarray, state: CPUAdamState,
     g = grads
     if not adamw_mode and weight_decay:
         g = g + weight_decay * params
-    np.multiply(state.m, beta1, out=state.m)
-    state.m += (1.0 - beta1) * g
-    np.multiply(state.v, beta2, out=state.v)
-    state.v += (1.0 - beta2) * np.square(g)
+    m, v = state.m, state.v
+    np.multiply(m, beta1, out=m)
+    m += (1.0 - beta1) * g
+    np.multiply(v, beta2, out=v)
+    v += (1.0 - beta2) * np.square(g)
     if bias_correction:
-        m_hat = state.m / (1.0 - beta1 ** step)
-        v_hat = state.v / (1.0 - beta2 ** step)
+        m_hat = m / (1.0 - beta1 ** step)
+        v_hat = v / (1.0 - beta2 ** step)
     else:
-        m_hat, v_hat = state.m, state.v
+        m_hat, v_hat = m, v
     update = m_hat / (np.sqrt(v_hat) + eps)
     if adamw_mode and weight_decay:
         update += weight_decay * params

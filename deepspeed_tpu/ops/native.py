@@ -30,10 +30,18 @@ def build_extension(name, sources, extra_cflags=None, extra_ldflags=None,
         return so_path
     cflags = ["-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
               "-march=native"] + (extra_cflags or [])
-    cmd = ["g++"] + cflags + list(sources) + ["-o", so_path] + (extra_ldflags or [])
+    # build to a tmp path and rename so concurrent builders (pytest-xdist,
+    # multi-process launch) never load a half-written .so
+    tmp_path = f"{so_path}.tmp.{os.getpid()}"
+    cmd = ["g++"] + cflags + list(sources) + ["-o", tmp_path] + (extra_ldflags or [])
     if verbose:
         logger.info(" ".join(cmd))
-    subprocess.check_call(cmd)
+    try:
+        subprocess.check_call(cmd)
+        os.replace(tmp_path, so_path)
+    finally:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
     return so_path
 
 

@@ -328,6 +328,23 @@ class DeepSpeedEngine:
             rng=state.rng)
         return new_state, grad_norm
 
+    def _finish_step(self, state: TrainState, loss, grads, rng):
+        """Shared train-step tail: grad placement constraint, overflow
+        check, optimizer update, metrics.  Used by both the dense and the
+        pipeline engines so their semantics cannot diverge."""
+        grads = constrain(grads, self.plan.grad_specs(state.params), self.mesh)
+        fp16 = self._config.fp16_enabled
+        overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
+        new_state, grad_norm = self._apply_update(
+            state.replace(rng=rng), grads, overflow)
+        metrics = StepMetrics(
+            loss=loss.astype(jnp.float32),
+            grad_norm=grad_norm.astype(jnp.float32),
+            lr=jnp.asarray(self._schedule_fn(state.global_step), jnp.float32),
+            loss_scale=new_state.loss_scale.cur_scale,
+            overflow=overflow)
+        return new_state, metrics
+
     def _build_train_step(self, gas: int):
         cfg = self._config
         fp16 = cfg.fp16_enabled
@@ -359,18 +376,7 @@ class DeepSpeedEngine:
             # ZeRO grad placement: stage>=2 spec is fsdp-sharded → XLA lowers
             # the DP reduction as reduce-scatter (reference average_tensor /
             # __reduce_and_partition_ipg_grads)
-            grads = constrain(grads, self.plan.grad_specs(params), self.mesh)
-            overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
-
-            new_state, grad_norm = self._apply_update(
-                state.replace(rng=rng), grads, overflow)
-            metrics = StepMetrics(
-                loss=loss.astype(jnp.float32),
-                grad_norm=grad_norm.astype(jnp.float32),
-                lr=jnp.asarray(self._schedule_fn(state.global_step), jnp.float32),
-                loss_scale=new_state.loss_scale.cur_scale,
-                overflow=overflow)
-            return new_state, metrics
+            return self._finish_step(state, loss, grads, rng)
 
         return train_step
 

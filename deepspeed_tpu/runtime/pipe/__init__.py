@@ -1,0 +1,26 @@
+"""Pipeline parallelism (parity: reference ``deepspeed/runtime/pipe/``).
+
+Exports mirror ``deepspeed.pipe``: ``PipelineModule``, ``LayerSpec``,
+``TiedLayerSpec`` — plus the TPU-native executor/engine pieces.
+"""
+
+from deepspeed_tpu.runtime.pipe.module import (EmbeddingPipe, LayerSpec,
+                                               LMHeadPipe, PipelineModule,
+                                               TiedLayerSpec,
+                                               TransformerBlockPipe,
+                                               lm_loss_fn, partition_balanced,
+                                               partition_uniform,
+                                               transformer_pipeline)
+from deepspeed_tpu.runtime.pipe.pipeline import (pipeline_spmd,
+                                                 stack_stage_params,
+                                                 unstack_stage_params)
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+from deepspeed_tpu.runtime.pipe import schedule
+
+__all__ = [
+    "PipelineModule", "LayerSpec", "TiedLayerSpec", "PipelineEngine",
+    "EmbeddingPipe", "TransformerBlockPipe", "LMHeadPipe", "lm_loss_fn",
+    "partition_balanced", "partition_uniform", "pipeline_spmd",
+    "stack_stage_params", "unstack_stage_params", "transformer_pipeline",
+    "schedule",
+]

@@ -1,0 +1,124 @@
+"""SPMD pipeline executor — the TPU-native replacement for the reference's
+instruction-interpreter pipeline engine.
+
+Reference design (``runtime/pipe/engine.py:1360 _exec_schedule``): every rank
+runs a Python loop over schedule instructions (LoadMicroBatch / ForwardPass /
+SendActivation / ... ) and moves activations with point-to-point NCCL calls
+(``pipe/p2p.py``).
+
+TPU-first redesign: the WHOLE pipelined step is one jitted SPMD program.
+
+* Stage parameters carry a leading ``[P, ...]`` dim sharded over the ``pp``
+  mesh axis; each device therefore *is* one pipeline stage.
+* A ``lax.scan`` over ``T = M + P - 1`` clock ticks advances a ``[P, ...]``
+  activation buffer.  Per tick every stage applies its chunk of layers
+  (``jax.vmap`` over the stage dim — the SPMD partitioner assigns each
+  stage's compute to its pp rank), then the buffer is shifted one slot with
+  ``jnp.roll`` along the pp-sharded dim, which XLA lowers to a
+  ``CollectivePermute`` over ICI — the p2p send/recv of the reference.
+* The backward pipeline is **not hand-written**: differentiating the scan
+  yields the reverse-clocked pipeline (grad ticks flow last-stage→first),
+  which is exactly the reference's BackwardPass/SendGrad/RecvGrad stream.
+
+This is the GPipe schedule (fill, steady state, drain — bubble fraction
+``(P-1)/(M+P-1)``).  The reference's 1F1B ``TrainSchedule`` reduces peak
+activation memory, not bubble; here ``jax.checkpoint`` on the stage body plays
+that role (recompute in the drain instead of storing M microbatches).
+"""
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import BATCH_AXES, PP_AXIS
+from deepspeed_tpu.runtime.zero.stage_plan import maybe_constrain
+
+
+def _buf_spec(ndim: int) -> P:
+    """[P, mb, ...]: stage dim over pp, microbatch dim over the data axes."""
+    entries = [PP_AXIS, tuple(BATCH_AXES)] + [None] * (ndim - 2)
+    return P(*entries)
+
+
+def pipeline_spmd(stage_fn: Callable,
+                  stage_params: Any,
+                  x_mbs: jax.Array,
+                  num_stages: int,
+                  remat: bool = False) -> jax.Array:
+    """Run ``M`` microbatches through ``P = num_stages`` pipeline stages.
+
+    Args:
+      stage_fn: ``(stage_params_slice, x) -> y`` with ``y.shape == x.shape``
+        (one stage's chunk of layers).
+      stage_params: pytree whose leaves have leading dim ``P`` (shard it over
+        the ``pp`` mesh axis).
+      x_mbs: ``[M, ...]`` microbatched activations entering stage 0.
+      remat: rematerialise stage activations (plays the reference 1F1B
+        memory role).
+
+    Returns: ``[M, ...]`` outputs of the last stage.
+    """
+    M = x_mbs.shape[0]
+    Pn = num_stages
+    T = M + Pn - 1
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    if Pn == 1:
+        # degenerate pipeline: plain microbatch loop
+        def one(carry, x):
+            return carry, stage_fn(
+                jax.tree_util.tree_map(lambda p: p[0], stage_params), x)
+        _, ys = jax.lax.scan(one, (), x_mbs)
+        return ys
+
+    vstage = jax.vmap(stage_fn)
+    feat_shape = x_mbs.shape[1:]
+    buf = jnp.zeros((Pn,) + feat_shape, x_mbs.dtype)
+    buf = maybe_constrain(buf, _buf_spec(buf.ndim))
+    out = jnp.zeros_like(x_mbs)
+
+    def tick(carry, t):
+        buf, out = carry
+        # LoadMicroBatch: microbatch t enters stage 0 while t < M
+        inp = jax.lax.dynamic_index_in_dim(
+            x_mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        slot0 = jnp.where(t < M, inp, buf[0])
+        buf = jax.lax.dynamic_update_index_in_dim(buf, slot0, 0, 0)
+        buf = maybe_constrain(buf, _buf_spec(buf.ndim))
+        # ForwardPass on every stage (stage s holds microbatch t - s)
+        y = vstage(stage_params, buf)
+        y = maybe_constrain(y, _buf_spec(y.ndim))
+        # microbatch t-(P-1) exits the last stage
+        oidx = jnp.clip(t - (Pn - 1), 0, M - 1)
+        cur = jax.lax.dynamic_index_in_dim(out, oidx, 0, keepdims=False)
+        upd = jnp.where(t - (Pn - 1) >= 0, y[Pn - 1], cur)
+        out = jax.lax.dynamic_update_index_in_dim(out, upd, oidx, 0)
+        # SendActivation/RecvActivation: shift one slot down the pipe
+        # (roll over the pp-sharded dim → CollectivePermute)
+        buf = jnp.roll(y, 1, axis=0)
+        return (buf, out), None
+
+    (_, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(T))
+    return out
+
+
+def stack_stage_params(body_params: Any, num_stages: int) -> Any:
+    """Reshape stacked per-layer params ``[L, ...]`` into per-stage chunks
+    ``[P, L/P, ...]`` (contiguous layer ranges per stage, like the
+    reference's ``PipelineModule`` uniform partitioning)."""
+    def reshape(leaf):
+        L = leaf.shape[0]
+        assert L % num_stages == 0, \
+            f"n_layers {L} not divisible by num_stages {num_stages}"
+        return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
+    return jax.tree_util.tree_map(reshape, body_params)
+
+
+def unstack_stage_params(stage_params: Any) -> Any:
+    """Inverse of :func:`stack_stage_params`: ``[P, L/P, ...]`` → ``[L, ...]``."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((-1,) + leaf.shape[2:]), stage_params)

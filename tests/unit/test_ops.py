@@ -38,14 +38,20 @@ def test_cpu_adam_matches_fused():
     n = 512
     rng = np.random.default_rng(1)
     p_host = rng.normal(size=n).astype(np.float32)
-    p_dev = jnp.asarray(p_host)
+    # deep-copy onto the device: jnp.asarray may zero-copy share the host
+    # buffer, and JAX's async dispatch would then read it AFTER the C++ side
+    # mutates it in place (flaky off-by-one-update race)
+    p_dev = jnp.array(p_host, copy=True) + 0.0
+    p_dev.block_until_ready()
     host_state = cpu_adam.init_state(n)
     dev_state = adam.init_state(p_dev)
     for i in range(3):
         g = rng.normal(size=n).astype(np.float32)
+        g_dev = (jnp.array(g, copy=True) + 0.0)
+        g_dev.block_until_ready()
         host_state = cpu_adam.adam_update(p_host, g, host_state, lr=1e-3,
                                           weight_decay=0.01)
-        p_dev, dev_state = adam.reference_impl(p_dev, jnp.asarray(g), dev_state,
+        p_dev, dev_state = adam.reference_impl(p_dev, g_dev, dev_state,
                                                lr=1e-3, weight_decay=0.01)
     np.testing.assert_allclose(p_host, p_dev, rtol=1e-5, atol=1e-6)
 

@@ -1,0 +1,278 @@
+"""Pipeline-parallelism tests.
+
+Parity model: reference ``tests/unit/runtime/pipe/`` (schedule invariants,
+module partitioning) + ``test_pipe.py`` (pipeline training matches the
+non-pipeline baseline trajectory).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import CausalTransformerLM, TransformerConfig
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import TopologyConfig
+from deepspeed_tpu.runtime.pipe import (LayerSpec, PipelineEngine,
+                                        PipelineModule, TiedLayerSpec,
+                                        partition_balanced, partition_uniform,
+                                        pipeline_spmd, stack_stage_params,
+                                        transformer_pipeline,
+                                        unstack_stage_params)
+from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                 InferenceSchedule,
+                                                 LoadMicroBatch, RecvActivation,
+                                                 SendActivation, TrainSchedule)
+
+
+@pytest.fixture
+def pp_mesh():
+    groups.reset_mesh()
+    mesh = groups.initialize_mesh(TopologyConfig(pp=4, fsdp=-1))
+    yield mesh
+    groups.reset_mesh()
+
+
+# ----------------------------------------------------------------------
+# partitioning helpers
+# ----------------------------------------------------------------------
+def test_partition_uniform():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(10, 3) == [0, 4, 7, 10]
+
+
+def test_partition_balanced():
+    parts = partition_balanced([1, 1, 1, 1], 2)
+    assert parts == [0, 2, 4]
+    # heavy head layer should sit alone
+    parts = partition_balanced([10, 1, 1, 1], 2)
+    assert parts[1] == 1
+    # bottleneck is minimised
+    parts = partition_balanced([1, 2, 3, 4, 5], 3)
+    weights = [1, 2, 3, 4, 5]
+    loads = [sum(weights[parts[i]:parts[i + 1]]) for i in range(3)]
+    assert max(loads) == 6  # [1,2,3][4][5]
+
+
+# ----------------------------------------------------------------------
+# schedules
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (2, 4)])
+def test_train_schedule_instruction_counts(micro_batches, stages):
+    for stage_id in range(stages):
+        sched = TrainSchedule(micro_batches, stages, stage_id)
+        cmds = [c for step in sched.steps() for c in step]
+        fwd = [c for c in cmds if isinstance(c, ForwardPass)]
+        bwd = [c for c in cmds if isinstance(c, BackwardPass)]
+        assert len(fwd) == micro_batches
+        assert len(bwd) == micro_batches
+        loads = [c for c in cmds if isinstance(c, LoadMicroBatch)]
+        if stage_id == 0:
+            assert len(loads) == micro_batches
+        else:
+            assert len(loads) == 0
+        sends = [c for c in cmds if isinstance(c, SendActivation)]
+        assert len(sends) == (micro_batches if stage_id < stages - 1 else 0)
+
+
+def test_inference_schedule_is_forward_only():
+    sched = InferenceSchedule(micro_batches=3, stages=2, stage_id=1)
+    cmds = [c for step in sched.steps() for c in step]
+    assert not any(isinstance(c, BackwardPass) for c in cmds)
+    assert sum(isinstance(c, RecvActivation) for c in cmds) == 3
+
+
+# ----------------------------------------------------------------------
+# the SPMD executor
+# ----------------------------------------------------------------------
+def _linear_stages(rng, num_stages, dim):
+    w = jax.random.normal(rng, (num_stages, dim, dim)) / np.sqrt(dim)
+
+    def stage_fn(wp, x):
+        return jnp.tanh(x @ wp)
+    return stage_fn, w
+
+
+@pytest.mark.parametrize("M,P", [(4, 4), (6, 2), (1, 4)])
+def test_pipeline_spmd_matches_sequential(pp_mesh, M, P):
+    dim = 8
+    stage_fn, w = _linear_stages(jax.random.key(0), P, dim)
+    x = jax.random.normal(jax.random.key(1), (M, 2, dim))
+
+    with pp_mesh:
+        out = jax.jit(
+            lambda w, x: pipeline_spmd(stage_fn, w, x, P))(w, x)
+
+    expected = x
+    for s in range(P):
+        expected = jnp.tanh(expected @ w[s])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_spmd_gradients_match(pp_mesh):
+    """Autodiff through the pipelined scan == grads of the sequential net
+    (the compiled backward pipeline is numerically exact)."""
+    M, P, dim = 4, 4, 8
+    stage_fn, w = _linear_stages(jax.random.key(0), P, dim)
+    x = jax.random.normal(jax.random.key(1), (M, 2, dim))
+
+    def pipe_loss(w):
+        return jnp.sum(pipeline_spmd(stage_fn, w, x, P) ** 2)
+
+    def seq_loss(w):
+        h = x
+        for s in range(P):
+            h = jnp.tanh(h @ w[s])
+        return jnp.sum(h ** 2)
+
+    with pp_mesh:
+        g_pipe = jax.jit(jax.grad(pipe_loss))(w)
+    g_seq = jax.grad(seq_loss)(w)
+    np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_stack_roundtrip():
+    body = {"w": jnp.arange(24.0).reshape(8, 3)}
+    stacked = stack_stage_params(body, 4)
+    assert stacked["w"].shape == (4, 2, 3)
+    back = unstack_stage_params(stacked)
+    np.testing.assert_array_equal(back["w"], body["w"])
+
+
+# ----------------------------------------------------------------------
+# PipelineModule vs the flagship model
+# ----------------------------------------------------------------------
+def _model_to_pipe_params(model_params, cfg):
+    """Map CausalTransformerLM params onto the PipelineModule layout."""
+    pre, tied = [], {}
+    embed = {}
+    if cfg.tie_embeddings:
+        tied["embed"] = {"tok_embed": model_params["tok_embed"]}
+    else:
+        embed["tok_embed"] = model_params["tok_embed"]
+    if not cfg.use_rope:
+        embed["pos_embed"] = model_params["pos_embed"]
+    pre.append(embed)
+    post = [{"final_norm": model_params["final_norm"],
+             **({} if cfg.tie_embeddings
+                else {"lm_head": model_params["lm_head"]})}]
+    return {"pre": pre, "body": model_params["layers"], "post": post,
+            "tied": tied}
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_pipeline_loss_matches_flagship_model(pp_mesh, tie):
+    cfg = TransformerConfig.tiny(n_layers=4, tie_embeddings=tie,
+                                 use_rope=not tie, use_rmsnorm=not tie,
+                                 activation="silu" if not tie else "gelu")
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+
+    pipe = transformer_pipeline(cfg, num_stages=4)
+    pipe_params = pipe.init(jax.random.key(0))  # sets the body split
+    pipe_params = _model_to_pipe_params(params, cfg)
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 2, 32))
+    batch_mbs = {"input_ids": jnp.asarray(ids, jnp.int32)}
+    flat = {"input_ids": jnp.asarray(ids.reshape(8, 32), jnp.int32)}
+
+    with pp_mesh:
+        pipe_loss = jax.jit(pipe.loss)(pipe_params, batch_mbs)
+    ref_loss = model.loss(params, flat)
+    np.testing.assert_allclose(float(pipe_loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_partition_report(pp_mesh):
+    cfg = TransformerConfig.tiny(n_layers=4)
+    pipe = transformer_pipeline(cfg, num_stages=4)
+    pipe.init(jax.random.key(0))
+    report = pipe.partition_layers()
+    stages = [s for _, name, s in report if name == "TransformerBlockPipe"]
+    assert stages == ["stage0", "stage1", "stage2", "stage3"]
+    assert report[0][2] == "replicated"  # embedding
+    assert report[-1][2] == "replicated"  # head
+
+
+# ----------------------------------------------------------------------
+# PipelineEngine end-to-end
+# ----------------------------------------------------------------------
+def _lm_batch(cfg, M, b, S, seed):
+    ids = np.random.default_rng(seed).integers(0, cfg.vocab_size, (M, b, S))
+    return {"input_ids": ids.astype(np.int32)}
+
+
+def test_pipeline_engine_matches_dense_engine():
+    """PP training trajectory == plain engine with the same microbatches
+    (reference test_pipe.py compares against a DDP baseline the same way)."""
+    cfg = TransformerConfig.tiny(n_layers=4)
+    M, b, S, steps = 4, 8, 32, 3
+
+    def dense_losses():
+        groups.reset_mesh()
+        model = CausalTransformerLM(cfg)
+        params = model.init(jax.random.key(0))
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": b,
+                    "gradient_accumulation_steps": M,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+        return [float(engine.train_batch(batch=_lm_batch(cfg, M, b, S, i)))
+                for i in range(steps)], engine
+
+    def pipe_losses():
+        groups.reset_mesh()
+        pipe = transformer_pipeline(cfg, num_stages=2)
+        pipe.init(jax.random.key(0))
+        model = CausalTransformerLM(cfg)
+        params = _model_to_pipe_params(model.init(jax.random.key(0)), cfg)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=pipe, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": b,
+                    "gradient_accumulation_steps": M,
+                    "mesh": {"pp": 2},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+        assert isinstance(engine, PipelineEngine)
+        return [float(engine.train_batch(batch=_lm_batch(cfg, M, b, S, i)))
+                for i in range(steps)], engine
+
+    d_losses, _ = dense_losses()
+    p_losses, engine = pipe_losses()
+    np.testing.assert_allclose(p_losses, d_losses, rtol=2e-4, atol=2e-5)
+    assert engine.is_pipe_parallel()
+    groups.reset_mesh()
+
+
+def test_pipeline_engine_body_params_pp_sharded():
+    groups.reset_mesh()
+    cfg = TransformerConfig.tiny(n_layers=4)
+    pipe = transformer_pipeline(cfg, num_stages=2)
+    params = pipe.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=pipe, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 2,
+                "mesh": {"pp": 2},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    wq = engine.state.params["body"]["wq"]
+    assert "pp" in str(wq.sharding.spec), wq.sharding
+    engine.train_batch(batch=_lm_batch(cfg, 2, 4, 16, 0))
+    groups.reset_mesh()
+
+
+def test_zero23_rejected_with_pipeline():
+    groups.reset_mesh()
+    cfg = TransformerConfig.tiny(n_layers=2)
+    pipe = transformer_pipeline(cfg, num_stages=2)
+    params = pipe.init(jax.random.key(0))
+    with pytest.raises(AssertionError, match="incompatible"):
+        deepspeed_tpu.initialize(
+            model=pipe, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "mesh": {"pp": 2},
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    groups.reset_mesh()
