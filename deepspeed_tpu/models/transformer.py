@@ -158,6 +158,29 @@ def _norm(x, weight, eps, use_rms):
     return (out * weight.astype(jnp.float32)).astype(x.dtype)
 
 
+def next_token_xent(logits, batch):
+    """Next-token cross-entropy shared by the dense model and the pipeline
+    default loss.  ``batch``: dict with ``input_ids`` [B,S] (+ optional
+    ``labels``, ``loss_mask``) or a raw [B,S] array.  When ``labels`` is
+    absent, labels are the inputs shifted left and the last logit is dropped."""
+    if isinstance(batch, dict):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        loss_mask = batch.get("loss_mask")
+    else:
+        input_ids, labels, loss_mask = batch, None, None
+    if labels is None:
+        labels = input_ids[:, 1:]
+        logits = logits[:, :-1]
+        if loss_mask is not None:
+            loss_mask = loss_mask[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if loss_mask is not None:
+        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
+    return jnp.mean(nll)
+
+
 def _rope(x, positions, theta):
     """Rotary embedding; x: [B, S, H, D]."""
     B, S, H, D = x.shape
@@ -488,25 +511,8 @@ class CausalTransformerLM:
     def loss(self, params, batch, rng=None):
         """Next-token cross-entropy.  batch: dict with ``input_ids`` [B,S]
         (+ optional ``labels``, ``loss_mask``) or a raw [B,S] array."""
-        if isinstance(batch, dict):
-            input_ids = batch["input_ids"]
-            labels = batch.get("labels")
-            loss_mask = batch.get("loss_mask")
-        else:
-            input_ids, labels, loss_mask = batch, None, None
-
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         logits, aux = self.apply(params, input_ids, rng=rng, return_aux=True)
-        if labels is None:
-            labels = input_ids[:, 1:]
-            logits = logits[:, :-1]
-            if loss_mask is not None:
-                loss_mask = loss_mask[:, 1:]
-
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        if loss_mask is not None:
-            ce = jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
-        else:
-            ce = jnp.mean(nll)
+        ce = next_token_xent(logits, batch)
         # MoE load-balancing loss (reference engine adds l_aux scaled by coef)
         return ce + self.config.moe_aux_loss_coef * aux

@@ -1,0 +1,1 @@
+from deepspeed_tpu.profiling import flops_profiler  # noqa: F401

@@ -248,9 +248,11 @@ class DeepSpeedEngine:
     def _init_state(self, params) -> TrainState:
         cfg = self._config
         # master params in fp32 (reference: fp16/bf16 optimizers keep fp32
-        # master copies; we ONLY store the master and cast per-step)
+        # master copies; we ONLY store the master and cast per-step).
+        # jnp.array (copy) rather than asarray: the train step donates the
+        # state, and an aliased no-copy view would delete the caller's arrays.
         params = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, jnp.float32), params)
+            lambda x: jnp.array(x, jnp.float32), params)
 
         if cfg.fp16_enabled:
             if cfg.dynamic_loss_scale:
@@ -490,6 +492,7 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
         step_fn = self._get_compiled_train_step(gas)
+        self._maybe_profile_flops(batch, gas)
         with self.mesh:
             self.state, metrics = step_fn(self.state, batch)
         self.global_steps += 1
@@ -501,6 +504,13 @@ class DeepSpeedEngine:
         self._write_monitor(metrics)
         return metrics.loss
 
+    # subclass hooks: PipelineEngine preps (stacks) the batch and runs with
+    # a leading microbatch dim — everything else is shared here.
+    _eval_leading_gas_dim = False
+
+    def _prep_eval_batch(self, batch):
+        return batch
+
     def eval_batch(self, batch, rng=None):
         if not hasattr(self, "_compiled_eval"):
             def ev(state, batch):
@@ -510,7 +520,9 @@ class DeepSpeedEngine:
                     state.params)
                 return self.loss_fn(p_c, batch, state.rng)
             self._compiled_eval = jax.jit(ev)
-        batch = self._shard_batch(batch)
+        batch = self._prep_eval_batch(batch)
+        batch = self._shard_batch(batch,
+                                  leading_gas_dim=self._eval_leading_gas_dim)
         with self.mesh:
             return self._compiled_eval(self.state, batch)
 
@@ -564,6 +576,43 @@ class DeepSpeedEngine:
                 events.append(("Train/Samples/loss_scale",
                                float(metrics.loss_scale), self.global_samples()))
         self.monitor.write_events(events)
+
+    def _maybe_profile_flops(self, batch, gas):
+        """Parity: reference ``engine.py:1792,1810`` — run the flops profiler
+        at ``flops_profiler.profile_step`` and print the model profile.
+
+        Profiles the *forward* loss function on one microbatch (reference
+        counts forward MACs via module hooks), inside the mesh context so
+        sharding constraints trace the same as the executed program.  No XLA
+        recompile — analytic jaxpr counting only."""
+        fpc = self._config.flops_profiler_config
+        if not fpc.enabled or self.global_steps != fpc.profile_step:
+            return
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+        micro = batch
+        if gas > 1:
+            micro = jax.tree_util.tree_map(lambda x: x[0], batch)
+        rng = self.state.rng
+
+        def fwd(params, mb):
+            p_c = jax.tree_util.tree_map(
+                lambda x: x.astype(self.compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+            return self.loss_fn(p_c, mb, rng)
+
+        prof = FlopsProfiler()
+        prof.start_profile()
+        with self.mesh:
+            prof.profile(fwd, self.state.params, micro,
+                         measure_time=False, xla_analysis=False)
+        if dist.get_rank() == 0:
+            prof.print_model_profile(profile_step=fpc.profile_step,
+                                     module_depth=fpc.module_depth,
+                                     top_modules=fpc.top_modules,
+                                     detailed=fpc.detailed,
+                                     output_file=fpc.output_file)
+        prof.end_profile()
+        self.flops_profiler = prof
 
     def global_samples(self):
         return self.global_steps * self._config.train_batch_size

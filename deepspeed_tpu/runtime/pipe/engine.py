@@ -83,20 +83,13 @@ class PipelineEngine(DeepSpeedEngine):
             "PipelineEngine does not support step(); "
             "use train_batch() instead")
 
-    def eval_batch(self, batch, rng=None):
-        """Forward-only pipelined evaluation (reference ``eval_batch:380``)."""
-        if not hasattr(self, "_compiled_pipe_eval"):
-            def ev(state, batch):
-                p_c = jax.tree_util.tree_map(
-                    lambda x: x.astype(self.compute_dtype)
-                    if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                    state.params)
-                return self.loss_fn(p_c, batch, state.rng)
-            self._compiled_pipe_eval = jax.jit(ev)
-        batch = self._stack_if_flat(batch)
-        batch = self._shard_batch(batch, leading_gas_dim=True)
-        with self.mesh:
-            return self._compiled_pipe_eval(self.state, batch)
+    # eval_batch is the parent's, with pipelined batch prep: stack a flat
+    # batch into an M=1 microbatch dim and keep the leading clock dim
+    # (reference ``eval_batch:380``).
+    _eval_leading_gas_dim = True
+
+    def _prep_eval_batch(self, batch):
+        return self._stack_if_flat(batch)
 
     def _stack_if_flat(self, batch):
         """Add an M=1 microbatch dim when the caller passed a flat batch."""

@@ -239,24 +239,11 @@ class LMHeadPipe:
 
 
 def lm_loss_fn(logits, batch):
-    """Default next-token cross-entropy (mirrors
-    ``CausalTransformerLM.loss``)."""
-    if isinstance(batch, dict):
-        input_ids = batch["input_ids"]
-        labels = batch.get("labels")
-        loss_mask = batch.get("loss_mask")
-    else:
-        input_ids, labels, loss_mask = batch, None, None
-    if labels is None:
-        labels = input_ids[:, 1:]
-        logits = logits[:, :-1]
-        if loss_mask is not None:
-            loss_mask = loss_mask[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    if loss_mask is not None:
-        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
-    return jnp.mean(nll)
+    """Default next-token cross-entropy — the same function the dense model
+    uses (``models/transformer.py next_token_xent``), so pipeline-vs-dense
+    trajectories cannot diverge."""
+    from deepspeed_tpu.models.transformer import next_token_xent
+    return next_token_xent(logits, batch)
 
 
 # ----------------------------------------------------------------------
@@ -285,12 +272,13 @@ class PipelineModule:
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
                  seed_layers: bool = False):
-        if num_stages is None and topology is None:
-            from deepspeed_tpu.parallel import groups
-            num_stages = max(groups.get_pipe_parallel_world_size(), 1)
         if topology is not None and num_stages is None:
             num_stages = topology.get_dim("pipe") or topology.get_dim("pp")
-        self.num_stages = int(num_stages)
+        # num_stages=None resolves lazily from the active mesh's pp axis.
+        # Resolving eagerly here would install a default (pp=1) mesh when the
+        # module is built before deepspeed_tpu.initialize — silently
+        # disabling pipelining.
+        self._num_stages = int(num_stages) if num_stages is not None else None
         self.loss_fn = loss_fn or lm_loss_fn
         if partition_method not in ("uniform", "parameters"):
             raise ValueError(
@@ -308,6 +296,19 @@ class PipelineModule:
         self._tied_keys = [s.key if isinstance(s, TiedLayerSpec) else None
                            for s in self._specs]
         self._split = None      # (body_start, body_end) — set in init()
+
+    @property
+    def num_stages(self) -> int:
+        if self._num_stages is None:
+            from deepspeed_tpu.parallel import groups
+            if not groups.mesh_is_initialized():
+                raise ValueError(
+                    "PipelineModule: num_stages was not given and no device "
+                    "mesh is initialized yet — pass num_stages=/topology=, or "
+                    "call deepspeed_tpu.initialize (or "
+                    "groups.initialize_mesh) before using the module")
+            self._num_stages = max(groups.get_pipe_parallel_world_size(), 1)
+        return self._num_stages
 
     # -- structure ------------------------------------------------------
     def _layer_signature(self, i, rng):
