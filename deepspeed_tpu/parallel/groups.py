@@ -105,3 +105,25 @@ def get_world_size() -> int:
 def get_data_parallel_rank() -> int:
     import jax
     return jax.process_index()
+
+
+def get_model_parallel_rank() -> int:
+    """This process's coordinate on the tp axis (0 when tp fits inside one
+    process, which is always true single-host — SPMD programs see tp ranks
+    as mesh coordinates, not processes)."""
+    import jax
+    mesh = get_mesh()
+    tp = mesh.shape.get(TP_AXIS, 1)
+    if tp <= 1 or jax.process_count() == 1:
+        return 0
+    # multi-host: processes are laid out in mesh order; derive the tp
+    # coordinate of this process's first local device
+    dev = jax.local_devices()[0]
+    idx = int(list(mesh.devices.flat).index(dev))
+    axes = list(mesh.shape.keys())
+    sizes = [mesh.shape[a] for a in axes]
+    coord = {}
+    for a, s in zip(reversed(axes), reversed(sizes)):
+        coord[a] = idx % s
+        idx //= s
+    return coord.get(TP_AXIS, 0)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: F401

@@ -1,0 +1,248 @@
+"""Activation checkpointing — Megatron-compatible surface on jax.checkpoint.
+
+Parity: reference ``runtime/activation_checkpointing/checkpointing.py``
+(``checkpoint:749``, ``CheckpointFunction:499``, ``configure:831``,
+``partition_activations:373``, ``CudaRNGStatesTracker:123``,
+``model_parallel_cuda_manual_seed:199``).
+
+TPU-first redesign
+------------------
+The reference re-implements torch checkpointing with four extra tricks:
+partitioning saved activations across TP ranks, moving them to CPU,
+contiguous buffers, and a CUDA RNG state tracker so dropout replays
+identically in the recompute pass.  Under XLA:
+
+* recompute-in-backward IS ``jax.checkpoint`` (with a policy choosing what
+  to save);
+* "partition activations over TP" = a sharding constraint on the saved
+  residuals — expressed by constraining the wrapped function's inputs to
+  the tp axis, so what gets saved is the sharded array;
+* "checkpoint_in_cpu" = ``jax.checkpoint`` offload policies
+  (``save_and_offload_only_these_names`` / pinned-host offload);
+* the RNG tracker is trivial: JAX PRNG keys are values, so replay
+  determinism is automatic.  The tracker below exists for API parity and
+  for deriving distinct named streams (e.g. tensor-model-parallel dropout
+  seeds offset per tp rank, reference ``:199``).
+"""
+
+import contextlib
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.parallel.topology import TP_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+# ----------------------------------------------------------------------
+# module-level config (parity: reference module globals)
+# ----------------------------------------------------------------------
+PARTITION_ACTIVATIONS = False
+CPU_CHECKPOINT = False
+CONTIGUOUS_CHECKPOINTING = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+NUM_CHECKPOINTS = None
+_POLICY_NAME = "nothing_saveable"
+_CONFIGURED = False
+
+_OFFLOAD_POLICIES = ("save_and_offload_only_these_names",
+                     "offload_dot_with_no_batch_dims")
+
+
+def _resolve_policy():
+    """The jax.checkpoint policy implied by the configured knobs."""
+    if CPU_CHECKPOINT:
+        # offload the dot-product residuals to pinned host memory — the XLA
+        # analogue of the reference copying partitioned activations to CPU
+        try:
+            return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                "device", "pinned_host")
+        except Exception:  # pragma: no cover - older jax
+            logger.warning("offload policy unavailable; saving nothing")
+            return jax.checkpoint_policies.nothing_saveable
+    pol = getattr(jax.checkpoint_policies, _POLICY_NAME, None)
+    if pol is None:
+        raise ValueError(
+            f"unknown activation-checkpointing policy '{_POLICY_NAME}' "
+            "(see jax.checkpoint_policies)")
+    return pol
+
+
+def _maybe_partition(x):
+    """Shard a to-be-saved tensor over the tp axis (reference
+    ``partition_activations:373`` slices the flattened activation across
+    model-parallel ranks).  Constraint applies on the first dim divisible
+    by the tp degree; replicated otherwise."""
+    if not hasattr(x, "ndim") or x.ndim == 0:
+        return x
+    mesh = groups.get_mesh()
+    tp = mesh.shape.get(TP_AXIS, 1)
+    if tp <= 1:
+        return x
+    from jax.sharding import PartitionSpec as P
+    for dim in range(x.ndim):
+        if x.shape[dim] % tp == 0:
+            spec = [None] * x.ndim
+            spec[dim] = TP_AXIS
+            return jax.lax.with_sharding_constraint(x, P(*spec))
+    return x
+
+
+def checkpoint(function: Callable, *args):
+    """Checkpoint a model block: recompute its internals in backward.
+
+    Parity: reference ``checkpoint:749`` (drop-in for
+    ``torch.utils.checkpoint.checkpoint``).  Returns ``function(*args)``
+    with gradient rematerialisation under the configured policy.
+    """
+    policy = _resolve_policy()
+
+    fn = function
+    if PARTITION_ACTIVATIONS:
+        def fn(*inner):  # noqa: F811 — wrap to shard the saved inputs
+            inner = jax.tree_util.tree_map(_maybe_partition, inner)
+            return function(*inner)
+
+    return jax.checkpoint(fn, policy=policy)(*args)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    """Decorator form: ``f = checkpoint_wrapper(f)``."""
+    def wrapped(*args):
+        return checkpoint(function, *args)
+    return wrapped
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, checkpoint_in_cpu=None,
+              synchronize=None, profile=None, num_checkpoints=None,
+              policy=None):
+    """Parity: reference ``configure:831`` — set module-level knobs from the
+    DeepSpeed config and/or explicit args (explicit args win)."""
+    global PARTITION_ACTIVATIONS, CPU_CHECKPOINT, CONTIGUOUS_CHECKPOINTING
+    global SYNCHRONIZE, PROFILE_TIME, NUM_CHECKPOINTS, _POLICY_NAME, _CONFIGURED
+
+    cfg = None
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing_config",
+                      None)
+        if cfg is None and isinstance(deepspeed_config, dict):
+            from deepspeed_tpu.runtime.config import (
+                ActivationCheckpointingConfig)
+            cfg = ActivationCheckpointingConfig(
+                deepspeed_config.get("activation_checkpointing", {}))
+    if cfg is not None:
+        PARTITION_ACTIVATIONS = cfg.partition_activations
+        CONTIGUOUS_CHECKPOINTING = cfg.contiguous_memory_optimization
+        CPU_CHECKPOINT = cfg.cpu_checkpointing
+        SYNCHRONIZE = cfg.synchronize_checkpoint_boundary
+        PROFILE_TIME = cfg.profile
+        NUM_CHECKPOINTS = cfg.number_checkpoints
+        _POLICY_NAME = cfg.policy
+
+    if partition_activations is not None:
+        PARTITION_ACTIVATIONS = partition_activations
+    if contiguous_checkpointing is not None:
+        CONTIGUOUS_CHECKPOINTING = contiguous_checkpointing
+    if checkpoint_in_cpu is not None:
+        CPU_CHECKPOINT = checkpoint_in_cpu
+    if synchronize is not None:
+        SYNCHRONIZE = synchronize
+    if profile is not None:
+        PROFILE_TIME = profile
+    if num_checkpoints is not None:
+        NUM_CHECKPOINTS = num_checkpoints
+    if policy is not None:
+        _POLICY_NAME = policy
+    if CONTIGUOUS_CHECKPOINTING:
+        # XLA lays out saved residuals itself; the reference's hand-managed
+        # contiguous buffers have no analogue (and need NUM_CHECKPOINTS)
+        logger.info("contiguous_memory_optimization: handled by XLA buffer "
+                    "assignment; no user-visible effect")
+    _CONFIGURED = True
+
+
+def is_configured():
+    return _CONFIGURED
+
+
+def reset():
+    """Parity: reference ``reset()`` — drop per-iteration buffers (no-op
+    here; kept for API compatibility)."""
+
+
+def model_parallel_reconfigure_tp_seed(seed):
+    get_rng_tracker().add("model-parallel-rng",
+                          _tp_offset_seed(seed))
+
+
+# ----------------------------------------------------------------------
+# RNG state tracker (parity: CudaRNGStatesTracker:123)
+# ----------------------------------------------------------------------
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+_DEFAULT_RNG = "default-rng"
+
+
+def _tp_offset_seed(seed: int) -> int:
+    """Distinct seed per tp rank (reference ``:199``: tensor-model-parallel
+    regions use ``seed + 2718 + tp_rank``)."""
+    return int(seed) + 2718 + groups.get_model_parallel_rank()
+
+
+class RNGStatesTracker:
+    """Named PRNG streams.  Keys are split on every ``fork`` so repeated
+    forks yield fresh-but-deterministic keys — the functional analogue of
+    get_state/set_state in the reference tracker."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"RNG state {name} already exists")
+        self.states_[name] = jax.random.key(int(seed))
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise Exception(f"RNG state {name} is not added")
+        key, sub = jax.random.split(self.states_[name])
+        self.states_[name] = key
+        yield sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_TRACKER
+
+
+# reference name kept as an alias (no CUDA here)
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_manual_seed(seed: int):
+    """Seed the default + model-parallel RNG streams; tp ranks get offset
+    seeds so e.g. dropout differs across tensor-parallel shards.
+    Parity: reference ``model_parallel_cuda_manual_seed:199``."""
+    tracker = get_rng_tracker()
+    tracker.reset()
+    tracker.add(_DEFAULT_RNG, seed)
+    tracker.add(_MODEL_PARALLEL_RNG, _tp_offset_seed(seed))
+    return tracker
+
+
+model_parallel_cuda_manual_seed = model_parallel_manual_seed

@@ -160,6 +160,10 @@ class DeepSpeedEngine:
         self._step_applied = False
         self._global_grad_norm = 0.0
 
+        # activation checkpointing knobs (reference _configure_checkpointing)
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+        checkpointing.configure(deepspeed_config=config)
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size,
