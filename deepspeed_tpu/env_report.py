@@ -1,0 +1,91 @@
+"""Environment/compatibility report — the ``ds_report`` CLI.
+
+Parity: reference ``deepspeed/env_report.py`` (op compat matrix + version
+report).  TPU flavor: reports jax/jaxlib/libtpu versions, the device
+inventory (platform, chip kind, HBM), and per-op compatibility from the
+op-builder registry.
+"""
+
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def op_report(verbose=False):
+    from deepspeed_tpu.ops.op_builder import ALL_OPS
+    max_dots = 23
+    print("-" * 72)
+    print("DeepSpeed-TPU C++/Pallas op report")
+    print("-" * 72)
+    print("op name" + "." * (max_dots - len("op name")) + "compatible")
+    print("-" * 72)
+    rows = []
+    for name, builder in sorted(ALL_OPS.items()):
+        compatible = builder.is_compatible(verbose=verbose)
+        status = OKAY if compatible else NO
+        print(name + "." * (max_dots - len(name)) + status)
+        rows.append((name, compatible))
+    return rows
+
+
+def debug_report():
+    import jax
+    import jaxlib
+
+    print("-" * 72)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 72)
+    rows = [
+        ("python version", sys.version.replace("\n", " ")),
+        ("jax version", jax.__version__),
+        ("jaxlib version", jaxlib.__version__),
+    ]
+    try:
+        rows += [("default backend", jax.default_backend()),
+                 ("process count", jax.process_count())]
+    except RuntimeError as e:
+        rows.append(("backend init failed", str(e).split("\n")[0]))
+    try:
+        import deepspeed_tpu
+        rows.append(("deepspeed_tpu version", deepspeed_tpu.__version__))
+    except Exception:
+        pass
+    try:
+        devs = jax.devices()
+        rows.append(("device count", len(devs)))
+        if devs:
+            d = devs[0]
+            rows.append(("device kind", getattr(d, "device_kind", "?")))
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                pass
+            if "bytes_limit" in stats:
+                rows.append(("HBM per device",
+                             f"{stats['bytes_limit'] / 2**30:.1f} GiB"))
+    except Exception as e:  # pragma: no cover
+        rows.append(("device query failed", str(e)))
+    for k, v in rows:
+        print(f"{k} {'.' * max(1, 40 - len(k))} {v}")
+    return rows
+
+
+def main(verbose=False):
+    op_report(verbose=verbose)
+    debug_report()
+    return 0
+
+
+def cli_main():  # console entry point
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Launcher constants.  Parity: reference ``deepspeed/launcher/constants.py``."""
+
+PDSH_LAUNCHER = "pdsh"
+PDSH_MAX_FAN_OUT = 1024
+
+OPENMPI_LAUNCHER = "openmpi"
+MPICH_LAUNCHER = "mpich"
+SLURM_LAUNCHER = "slurm"
+MVAPICH_LAUNCHER = "mvapich"
+MVAPICH_TMP_HOSTFILE = "/tmp/deepspeed_mvapich_hostfile"
+GCLOUD_TPU_LAUNCHER = "gcloud-tpu"
+
+DEFAULT_MASTER_PORT = 29500
+
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"

@@ -1,0 +1,117 @@
+"""Per-node process spawner.
+
+Parity: reference ``launcher/launch.py:129`` — decodes the world-info
+blob, forks the local training processes with the distributed env set, and
+propagates signals / reaps children (``sigkill_handler:316``).
+
+Env contract per process (read by ``comm.init_distributed`` /
+``jax.distributed.initialize``):
+
+* ``RANK`` / ``LOCAL_RANK`` / ``WORLD_SIZE`` — process-level (parity)
+* ``MASTER_ADDR`` / ``MASTER_PORT``
+* ``JAX_COORDINATOR_ADDRESS`` = master:port, ``JAX_NUM_PROCESSES``,
+  ``JAX_PROCESS_ID`` — the JAX rendezvous trio
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="localhost")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--dry_run", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER, default=[])
+    return parser.parse_args(args=args)
+
+
+def build_process_envs(world_info, node_rank, master_addr, master_port):
+    """Per-local-process env dicts for this node."""
+    hosts = list(world_info.keys())
+    assert 0 <= node_rank < len(hosts), \
+        f"node_rank {node_rank} out of range for {len(hosts)} hosts"
+    global_rank_offset = sum(len(world_info[h]) for h in hosts[:node_rank])
+    world_size = sum(len(s) for s in world_info.values())
+    this_slots = world_info[hosts[node_rank]]
+
+    envs = []
+    for local_rank, _slot in enumerate(this_slots):
+        rank = global_rank_offset + local_rank
+        env = {
+            "RANK": str(rank),
+            "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world_size),
+            "MASTER_ADDR": master_addr,
+            "MASTER_PORT": str(master_port),
+            "JAX_COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+            "JAX_NUM_PROCESSES": str(world_size),
+            "JAX_PROCESS_ID": str(rank),
+        }
+        envs.append(env)
+    return envs
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    process_envs = build_process_envs(world_info, args.node_rank,
+                                      args.master_addr, args.master_port)
+    if args.dry_run:
+        for env in process_envs:
+            print(json.dumps(env))
+        return 0
+
+    procs = []
+    for env_overrides in process_envs:
+        env = os.environ.copy()
+        env.update(env_overrides)
+        cmd = [sys.executable, "-u", args.user_script] + args.user_args
+        logger.info(f"launching rank {env_overrides['RANK']}: {' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def sigkill_handler(sig, frame):  # parity: launch.py:316
+        for p in procs:
+            logger.info(f"killing subprocess {p.pid}")
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        sys.exit(128 + sig)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+
+    alive = list(procs)
+    rc = 0
+    while alive:
+        time.sleep(0.2)
+        for p in list(alive):
+            ret = p.poll()
+            if ret is None:
+                continue
+            alive.remove(p)
+            if ret != 0:
+                rc = ret
+                logger.error(f"process {p.pid} exited with {ret}; "
+                             "terminating remaining processes")
+                for q in alive:
+                    q.terminate()
+                alive = []
+                break
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,183 @@
+"""Pluggable multi-node launch backends.
+
+Parity: reference ``launcher/multinode_runner.py:15`` (``MultiNodeRunner``
+ABC; PDSH:47, OpenMPI:118, MPICH:173, Slurm:222, MVAPICH:269).  TPU
+addition: ``GcloudTPURunner`` drives ``gcloud compute tpus tpu-vm ssh
+--worker=all`` — the idiomatic way to fan a command across a TPU pod's
+hosts.
+"""
+
+import os
+import shutil
+import shlex
+import sys
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.launcher.constants import (GCLOUD_TPU_LAUNCHER,
+                                              MPICH_LAUNCHER,
+                                              MVAPICH_LAUNCHER,
+                                              OPENMPI_LAUNCHER, PDSH_LAUNCHER,
+                                              PDSH_MAX_FAN_OUT,
+                                              SLURM_LAUNCHER)
+
+
+class MultiNodeRunner(ABC):
+
+    def __init__(self, args, world_info_base64):
+        self.args = args
+        self.user_script = args.user_script
+        self.user_arguments = list(args.user_args)
+        self.world_info_base64 = world_info_base64
+        self.exports = {}
+
+    @abstractmethod
+    def backend_exists(self) -> bool:
+        """Whether this backend's binary is available."""
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        """The command to execute from the controller host."""
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = str(var).strip()
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+    def _export_flags(self, fmt):
+        out = []
+        for k, v in self.exports.items():
+            out += fmt(k, v)
+        return out
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Parallel-ssh fan-out; each node runs ``launch.py`` with its
+    node_rank derived from ``%n`` (pdsh's per-host rank substitution is not
+    portable, so we pass the hostlist and let launch.py find itself)."""
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active_resources.keys())
+        exports = " ".join(f"export {k}={shlex.quote(v)};"
+                           for k, v in self.exports.items())
+        # %n → pdsh's 0-based host index = node_rank
+        inner = (f"{exports} cd {os.path.abspath('.')}; "
+                 f"{sys.executable} -u -m deepspeed_tpu.launcher.launch "
+                 f"--world_info={self.world_info_base64} "
+                 f"--node_rank=%n "
+                 f"--master_addr={self.args.master_addr} "
+                 f"--master_port={self.args.master_port} "
+                 f"{self.user_script} "
+                 + " ".join(map(shlex.quote, self.user_arguments)))
+        return ["pdsh", "-S", "-f", str(PDSH_MAX_FAN_OUT), "-w", hosts,
+                inner]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+
+    def backend_exists(self):
+        return shutil.which("ompi_info") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = sum(len(s) for s in active_resources.values())
+        cmd = ["mpirun", "-n", str(total), "-hostfile", self.args.hostfile,
+               "--mca", "btl", "^openib", "--mca", "btl_tcp_if_include",
+               "eth0"]
+        cmd += self._export_flags(lambda k, v: ["-x", f"{k}={v}"])
+        cmd += shlex.split(self.args.launcher_args)
+        return cmd + [sys.executable, "-u", self.user_script] + \
+            self.user_arguments
+
+
+class MPICHRunner(MultiNodeRunner):
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = sum(len(s) for s in active_resources.values())
+        per_host = len(next(iter(active_resources.values())))
+        cmd = ["mpirun", "-n", str(total), "-ppn", str(per_host)]
+        cmd += self._export_flags(lambda k, v: ["-genv", k, v])
+        cmd += shlex.split(self.args.launcher_args)
+        return cmd + [sys.executable, "-u", self.user_script] + \
+            self.user_arguments
+
+
+class SlurmRunner(MultiNodeRunner):
+
+    def backend_exists(self):
+        return shutil.which("sinfo") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = sum(len(s) for s in active_resources.values())
+        cmd = ["srun", "-n", str(total)]
+        if getattr(self.args, "include", ""):
+            cmd += ["--include", self.args.include]
+        if getattr(self.args, "num_nodes", -1) > 0:
+            cmd += ["--nodes", str(self.args.num_nodes)]
+        cmd += shlex.split(self.args.launcher_args)
+        exports = ",".join(f"{k}={v}" for k, v in self.exports.items())
+        if exports:
+            cmd += [f"--export=ALL,{exports}"]
+        return cmd + [sys.executable, "-u", self.user_script] + \
+            self.user_arguments
+
+
+class MVAPICHRunner(MPICHRunner):
+    """MVAPICH shares mpirun's CLI; differences are env-var tuning only."""
+
+    def backend_exists(self):
+        mpiname = shutil.which("mpiname")
+        return mpiname is not None
+
+    def get_cmd(self, environment, active_resources):
+        self.add_export("MV2_SMP_USE_CMA", "0")
+        return super().get_cmd(environment, active_resources)
+
+
+class GcloudTPURunner(MultiNodeRunner):
+    """Fan the launcher across a TPU pod's hosts with gcloud.  Requires
+    ``--launcher_args "--zone=... --project=... tpu-name"`` (last token is
+    the TPU name).  Each worker resolves its own node_rank from the TPU
+    metadata (JAX does this automatically on TPU VMs, so only the script
+    and env need distributing)."""
+
+    def backend_exists(self):
+        return shutil.which("gcloud") is not None
+
+    def get_cmd(self, environment, active_resources):
+        extra = shlex.split(self.args.launcher_args)
+        assert extra, ("gcloud-tpu launcher needs --launcher_args "
+                       "'[flags] TPU_NAME'")
+        tpu_name = extra[-1]
+        flags = extra[:-1]
+        exports = " ".join(f"export {k}={shlex.quote(v)};"
+                           for k, v in self.exports.items())
+        inner = (f"{exports} cd {os.path.abspath('.')}; "
+                 f"{sys.executable} -u {self.user_script} "
+                 + " ".join(map(shlex.quote, self.user_arguments)))
+        return (["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+                 "--worker=all"] + flags + [f"--command={inner}"])
+
+
+_RUNNERS = {
+    PDSH_LAUNCHER: PDSHRunner,
+    OPENMPI_LAUNCHER: OpenMPIRunner,
+    MPICH_LAUNCHER: MPICHRunner,
+    SLURM_LAUNCHER: SlurmRunner,
+    MVAPICH_LAUNCHER: MVAPICHRunner,
+    GCLOUD_TPU_LAUNCHER: GcloudTPURunner,
+}
+
+
+def build_runner(name, args, world_info_base64) -> MultiNodeRunner:
+    if name not in _RUNNERS:
+        raise ValueError(f"unknown launcher '{name}' "
+                         f"(choices: {sorted(_RUNNERS)})")
+    return _RUNNERS[name](args, world_info_base64)

@@ -1,0 +1,273 @@
+"""deepspeed CLI front-end: resource parsing + multi-host process launch.
+
+Parity: reference ``launcher/runner.py`` (``main:380``,
+``fetch_hostfile:184``, ``parse_resource_filter:245``,
+``encode_world_info:345``).
+
+TPU-first: the unit of launch is a *host process* (JAX: one process per
+host drives all local chips), not one process per accelerator.  A
+hostfile line ``host slots=N`` therefore means N processes on that host
+(N=1 on TPU VMs; N>1 is used for CPU-simulated multi-process testing).
+The spawned processes rendezvous via ``jax.distributed.initialize`` using
+the ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID`` env contract (our MASTER_ADDR/RANK analogue).
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.constants import (DEFAULT_MASTER_PORT,
+                                              GCLOUD_TPU_LAUNCHER,
+                                              MPICH_LAUNCHER,
+                                              MVAPICH_LAUNCHER,
+                                              OPENMPI_LAUNCHER,
+                                              PDSH_LAUNCHER, SLURM_LAUNCHER)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher: run a training script across "
+        "TPU hosts (or local processes)")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="MPI-style hostfile: lines of 'host slots=N'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="NODE_SPEC[@NODE_SPEC...]; "
+                        "NODE_SPEC=NAME[:SLOT[,SLOT...]]")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="same grammar as --include; mutually exclusive")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit to first N nodes of the resource pool")
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus",
+                        help="processes per node (slots) to use")
+    parser.add_argument("--master_port", type=int,
+                        default=int(os.environ.get("DS_MASTER_PORT",
+                                                   DEFAULT_MASTER_PORT)))
+    parser.add_argument("--master_addr", type=str,
+                        default=os.environ.get("DS_MASTER_ADDR", ""))
+    parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
+                        choices=[PDSH_LAUNCHER, OPENMPI_LAUNCHER,
+                                 MPICH_LAUNCHER, SLURM_LAUNCHER,
+                                 MVAPICH_LAUNCHER, GCLOUD_TPU_LAUNCHER])
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra args for the cluster launcher backend")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="force multi-node mode even for one host")
+    parser.add_argument("--autotuning", type=str, default="",
+                        choices=["", "tune", "run"],
+                        help="run the autotuner before/instead of training")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print the launch plan, do not spawn")
+    parser.add_argument("user_script", type=str, nargs="?", default=None,
+                        help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER, default=[])
+    return parser.parse_args(args=args)
+
+
+# ----------------------------------------------------------------------
+# resource pool (parity: fetch_hostfile:184 + filters :245)
+# ----------------------------------------------------------------------
+def _parse_hostfile_lines(lines):
+    pool = collections.OrderedDict()
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            host, slots = line.split()
+            key, count = slots.split("=")
+            if key != "slots":
+                raise ValueError(key)
+            count = int(count)
+        except ValueError:
+            raise ValueError(
+                f"hostfile line '{line}' is not of the form 'host slots=N'")
+        if host in pool:
+            raise ValueError(f"hostfile: duplicate host '{host}'")
+        pool[host] = count
+    return pool
+
+
+def fetch_hostfile(hostfile_path):
+    """Returns OrderedDict host -> slot count, or None when no hostfile
+    exists (single-node mode)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"no hostfile at {hostfile_path}; launching locally")
+        return None
+    with open(hostfile_path) as f:
+        return _parse_hostfile_lines(f.readlines())
+
+
+def _parse_node_spec(spec):
+    if ":" in spec:
+        name, slots = spec.split(":")
+        return name, [int(s) for s in slots.split(",")]
+    return spec, None
+
+
+def parse_resource_filter(resource_pool, include_str="", exclude_str=""):
+    """Apply --include/--exclude node specs to the pool.  Slot lists select
+    (or remove) individual slot indices."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    pool = collections.OrderedDict(
+        (host, list(range(n))) for host, n in resource_pool.items())
+
+    if include_str:
+        keep = collections.OrderedDict()
+        for spec in include_str.split("@"):
+            name, slots = _parse_node_spec(spec)
+            if name not in pool:
+                raise ValueError(f"--include: unknown host '{name}'")
+            avail = pool[name]
+            if slots is None:
+                keep[name] = avail
+            else:
+                bad = [s for s in slots if s not in avail]
+                if bad:
+                    raise ValueError(
+                        f"--include: host '{name}' has no slots {bad}")
+                keep[name] = sorted(slots)
+        return keep
+
+    if exclude_str:
+        for spec in exclude_str.split("@"):
+            name, slots = _parse_node_spec(spec)
+            if name not in pool:
+                raise ValueError(f"--exclude: unknown host '{name}'")
+            if slots is None:
+                del pool[name]
+            else:
+                pool[name] = [s for s in pool[name] if s not in slots]
+                if not pool[name]:
+                    del pool[name]
+        return pool
+
+    return pool
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    return parse_resource_filter(resource_pool, include_str=inclusion,
+                                 exclude_str=exclusion)
+
+
+def encode_world_info(active_resources):
+    """base64(json) of host -> slot list — the cross-process contract read
+    by ``launch.py`` (parity: ``encode_world_info:345``)."""
+    as_lists = {h: list(s) for h, s in active_resources.items()}
+    return base64.urlsafe_b64encode(
+        json.dumps(as_lists).encode()).decode()
+
+
+def decode_world_info(world_info_base64):
+    return json.loads(base64.urlsafe_b64decode(world_info_base64.encode()))
+
+
+# ----------------------------------------------------------------------
+# main
+# ----------------------------------------------------------------------
+def main(args=None):
+    args = parse_args(args)
+
+    if args.elastic_training:
+        from deepspeed_tpu.elasticity import compute_elastic_config  # noqa: F401
+        assert args.min_elastic_nodes > 0, \
+            "--elastic_training needs --min_elastic_nodes"
+
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if args.autotuning:
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+        tuner = Autotuner(args, active_resources=resource_pool)
+        tuner.tune()
+        if args.autotuning == "tune":
+            return 0
+        # "run": continue with the best config the tuner wrote
+
+    if resource_pool is None or (len(resource_pool) == 1
+                                 and not args.force_multi):
+        return _launch_single_node(args, resource_pool)
+    return _launch_multi_node(args, resource_pool)
+
+
+def _nproc_for(args, resource_pool):
+    if args.num_gpus > 0:
+        return args.num_gpus
+    if resource_pool:
+        return next(iter(resource_pool.values()))
+    return 1
+
+
+def _launch_single_node(args, resource_pool):
+    nproc = _nproc_for(args, resource_pool)
+    host = next(iter(resource_pool)) if resource_pool else "localhost"
+    world = collections.OrderedDict([(host, list(range(nproc)))])
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--world_info={encode_world_info(world)}",
+           f"--node_rank=0",
+           f"--master_addr={args.master_addr or 'localhost'}",
+           f"--master_port={args.master_port}"]
+    if args.user_script is None:
+        raise ValueError("no user script given")
+    cmd += [args.user_script] + args.user_args
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    logger.info(f"cmd = {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, env=os.environ.copy())
+
+    def sig_handler(sig, frame):  # pragma: no cover
+        proc.send_signal(sig)
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+    proc.wait()
+    return proc.returncode
+
+
+def _launch_multi_node(args, resource_pool):
+    from deepspeed_tpu.launcher.multinode_runner import build_runner
+    active = parse_inclusion_exclusion(resource_pool, args.include,
+                                       args.exclude)
+    if args.num_nodes > 0:
+        active = collections.OrderedDict(
+            list(active.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        active = collections.OrderedDict(
+            (h, list(range(args.num_gpus))) for h in active)
+    if not active:
+        raise ValueError("no resources left after include/exclude filters")
+
+    if not args.master_addr:
+        args.master_addr = next(iter(active))
+    world_info = encode_world_info(active)
+    runner = build_runner(args.launcher, args, world_info)
+    env = os.environ.copy()
+    cmd = runner.get_cmd(env, active)
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    if not runner.backend_exists():  # pragma: no cover - host dependent
+        raise RuntimeError(f"launcher backend '{args.launcher}' not found "
+                           "on PATH")
+    logger.info(f"cmd = {' '.join(cmd)}")  # pragma: no cover
+    result = subprocess.Popen(cmd, env=env)  # pragma: no cover
+    result.wait()  # pragma: no cover
+    return result.returncode  # pragma: no cover
+
+
+if __name__ == "__main__":
+    sys.exit(main())

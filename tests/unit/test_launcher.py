@@ -1,0 +1,207 @@
+"""Launcher tests — parity with reference ``tests/unit/launcher``
+(hostfile parsing, include/exclude filters, world-info encoding, runner
+command construction, per-process env assembly)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import runner as ds_runner
+from deepspeed_tpu.launcher.launch import build_process_envs
+from deepspeed_tpu.launcher.multinode_runner import (GcloudTPURunner,
+                                                     MPICHRunner,
+                                                     OpenMPIRunner,
+                                                     PDSHRunner, SlurmRunner,
+                                                     build_runner)
+from deepspeed_tpu.launcher.runner import (decode_world_info,
+                                           encode_world_info,
+                                           fetch_hostfile,
+                                           parse_resource_filter)
+
+
+# -- hostfile ----------------------------------------------------------
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("# comment\nworker-0 slots=4\nworker-1 slots=2\n\n")
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 2}
+    assert list(pool) == ["worker-0", "worker-1"]  # order preserved
+
+
+def test_fetch_hostfile_missing_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_hostfile_bad_line(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError, match="host slots=N"):
+        fetch_hostfile(str(hf))
+
+
+def test_hostfile_duplicate_host(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=2\nw0 slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(str(hf))
+
+
+# -- include/exclude ---------------------------------------------------
+POOL = {"w0": 4, "w1": 4, "w2": 2}
+
+
+def test_include_whole_host():
+    out = parse_resource_filter(POOL, include_str="w1")
+    assert out == {"w1": [0, 1, 2, 3]}
+
+
+def test_include_slots():
+    out = parse_resource_filter(POOL, include_str="w0:1,3@w2:0")
+    assert out == {"w0": [1, 3], "w2": [0]}
+
+
+def test_exclude_host_and_slots():
+    out = parse_resource_filter(POOL, exclude_str="w1@w0:0,1")
+    assert out == {"w0": [2, 3], "w2": [0, 1]}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_resource_filter(POOL, include_str="w0", exclude_str="w1")
+
+
+def test_filter_unknown_host():
+    with pytest.raises(ValueError, match="unknown host"):
+        parse_resource_filter(POOL, include_str="nope")
+
+
+# -- world info --------------------------------------------------------
+def test_world_info_round_trip():
+    active = {"w0": [0, 1], "w1": [0]}
+    blob = encode_world_info(active)
+    assert decode_world_info(blob) == {"w0": [0, 1], "w1": [0]}
+
+
+def test_build_process_envs():
+    world = {"w0": [0, 1], "w1": [0, 1]}
+    envs = build_process_envs(world, node_rank=1, master_addr="w0",
+                              master_port=12345)
+    assert len(envs) == 2
+    assert envs[0]["RANK"] == "2" and envs[1]["RANK"] == "3"
+    assert envs[0]["LOCAL_RANK"] == "0"
+    assert envs[0]["WORLD_SIZE"] == "4"
+    assert envs[0]["JAX_COORDINATOR_ADDRESS"] == "w0:12345"
+    assert envs[0]["JAX_NUM_PROCESSES"] == "4"
+    assert envs[1]["JAX_PROCESS_ID"] == "3"
+
+
+# -- runner cmds -------------------------------------------------------
+def _args(**kw):
+    argv = kw.pop("argv", ["train.py", "--foo", "1"])
+    args = ds_runner.parse_args(argv)
+    for k, v in kw.items():
+        setattr(args, k, v)
+    return args
+
+
+WORLD = encode_world_info({"w0": [0], "w1": [0]})
+
+
+def test_pdsh_cmd():
+    r = build_runner("pdsh", _args(master_addr="w0"), WORLD)
+    assert isinstance(r, PDSHRunner)
+    env = {}
+    cmd = r.get_cmd(env, {"w0": [0], "w1": [0]})
+    assert cmd[0] == "pdsh"
+    assert "-w" in cmd and "w0,w1" in cmd
+    assert "deepspeed_tpu.launcher.launch" in cmd[-1]
+    assert "--node_rank=%n" in cmd[-1]
+    assert env["PDSH_RCMD_TYPE"] == "ssh"
+
+
+def test_openmpi_cmd():
+    r = build_runner("openmpi", _args(hostfile="/tmp/hf"), WORLD)
+    assert isinstance(r, OpenMPIRunner)
+    r.add_export("JAX_FOO", "1")
+    cmd = r.get_cmd({}, {"w0": [0], "w1": [0]})
+    assert cmd[:3] == ["mpirun", "-n", "2"]
+    assert "-x" in cmd and "JAX_FOO=1" in cmd
+    assert "train.py" in cmd
+
+
+def test_mpich_cmd():
+    r = build_runner("mpich", _args(), WORLD)
+    assert isinstance(r, MPICHRunner)
+    cmd = r.get_cmd({}, {"w0": [0, 1], "w1": [0, 1]})
+    assert cmd[:5] == ["mpirun", "-n", "4", "-ppn", "2"]
+
+
+def test_slurm_cmd():
+    r = build_runner("slurm", _args(), WORLD)
+    assert isinstance(r, SlurmRunner)
+    r.add_export("A", "b")
+    cmd = r.get_cmd({}, {"w0": [0], "w1": [0]})
+    assert cmd[:3] == ["srun", "-n", "2"]
+    assert any(c.startswith("--export=ALL,A=b") for c in cmd)
+
+
+def test_gcloud_tpu_cmd():
+    r = build_runner("gcloud-tpu",
+                     _args(launcher_args="--zone=us-central2-b my-tpu"),
+                     WORLD)
+    assert isinstance(r, GcloudTPURunner)
+    cmd = r.get_cmd({}, {"w0": [0]})
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
+                       "my-tpu"]
+    assert "--worker=all" in cmd
+    assert any(c.startswith("--command=") for c in cmd)
+
+
+def test_unknown_launcher_raises():
+    with pytest.raises(ValueError, match="unknown launcher"):
+        build_runner("k8s", _args(), WORLD)
+
+
+# -- end-to-end dry runs ----------------------------------------------
+def test_runner_single_node_dry_run(tmp_path, capsys):
+    rc = ds_runner.main(["--dry_run", "--num_gpus", "2",
+                         "--hostfile", str(tmp_path / "none"),
+                         "train.py", "--lr", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "deepspeed_tpu.launcher.launch" in out
+    assert "--world_info=" in out and "train.py" in out
+
+
+def test_runner_multi_node_dry_run(tmp_path, capsys):
+    hf = tmp_path / "hostfile"
+    hf.write_text("w0 slots=1\nw1 slots=1\n")
+    rc = ds_runner.main(["--dry_run", "--hostfile", str(hf),
+                         "--launcher", "pdsh", "train.py"])
+    # pdsh may not exist on this host: accept either the printed plan or
+    # the explicit backend error
+    out = capsys.readouterr().out
+    if rc == 0:
+        assert "pdsh" in out
+
+
+def test_env_report_runs(capsys):
+    from deepspeed_tpu.env_report import main
+    assert main() == 0
+    out = capsys.readouterr().out
+    assert "op name" in out and "jax version" in out
+
+
+def test_comm_bench_smoke(mesh_1d):
+    """ds_bench collectives on the 8-device CPU mesh."""
+    import numpy as np
+    from jax.sharding import Mesh
+    import jax
+    from deepspeed_tpu.benchmarks.communication import run_collective
+    mesh = Mesh(np.array(jax.devices()), ("world",))
+    for coll in ("all_reduce", "all_gather", "reduce_scatter",
+                 "all_to_all", "pt2pt"):
+        r = run_collective(coll, 1 << 12, mesh, trials=2, warmups=1)
+        assert r["latency_us"] > 0 and r["busbw_GBps"] > 0, coll
