@@ -251,6 +251,12 @@ class DeepSpeedEngine:
 
     def _init_state(self, params) -> TrainState:
         cfg = self._config
+        zc = cfg.zero_config
+        # ZeRO-Offload / ZeRO-Infinity: optimizer lives on the host (and
+        # optionally NVMe); device keeps compute-dtype params only.
+        self._offload = None
+        if zc.offload_optimizer_device != "none":
+            return self._init_offload_state(params)
         # master params in fp32 (reference: fp16/bf16 optimizers keep fp32
         # master copies; we ONLY store the master and cast per-step).
         # jnp.array (copy) rather than asarray: the train step donates the
@@ -280,6 +286,60 @@ class DeepSpeedEngine:
         ls = jax.device_put(ls, repl)
         return TrainState(
             params=params, opt_state=opt_state, loss_scale=ls,
+            global_step=jax.device_put(jnp.asarray(0, jnp.int32), repl),
+            skipped_steps=jax.device_put(jnp.asarray(0, jnp.int32), repl),
+            rng=jax.device_put(rng, repl))
+
+    def _init_offload_state(self, params) -> TrainState:
+        """ZeRO-Offload mode state: host master + moments (see
+        ``runtime/zero/offload.py``), device params in compute dtype."""
+        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+        cfg = self._config
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "offload_optimizer requires a single-controller process: "
+                "fsdp-sharded gradients are not fully addressable from one "
+                "host on a multi-host pod")
+        opt_name = self.optimizer_name_ or "adamw"
+        supported = {"adam", "adamw", "fusedadam", "cpuadam", "adagrad"}
+        if opt_name not in supported:
+            raise ValueError(
+                f"offload_optimizer supports {sorted(supported)}; got "
+                f"'{opt_name}' (reference: ZeRO-Offload requires "
+                "DeepSpeedCPUAdam/Adagrad)")
+        opt_params = (dict(cfg.optimizer_config.params)
+                      if cfg.optimizer_config else {})
+        host_params = jax.tree_util.tree_map(
+            lambda x: (np.asarray(x, np.float32)
+                       if np.issubdtype(np.asarray(x).dtype, np.floating)
+                       else np.asarray(x)), params)
+        self._offload = HostOffloadOptimizer(
+            host_params, cfg.zero_config, opt_name=opt_name,
+            opt_params=opt_params,
+            rank=jax.process_index(), world_size=jax.process_count())
+
+        if cfg.fp16_enabled and cfg.dynamic_loss_scale:
+            ls = dynamic_loss_scale_state(
+                cfg.fp16_config.initial_scale_power,
+                hysteresis=cfg.fp16_config.hysteresis)
+        elif cfg.fp16_enabled:
+            ls = static_loss_scale_state(cfg.loss_scale)
+        else:
+            ls = static_loss_scale_state(1.0)
+
+        dev_params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, self.compute_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else jnp.asarray(x), params)
+        param_sh = self.plan._to_sharding(self.plan.param_specs(dev_params))
+        with self.mesh:
+            dev_params = jax.device_put(dev_params, param_sh)
+        self._offload_param_sh = param_sh
+        repl = self.plan.replicated_sharding()
+        rng = jax.random.key(cfg.seed)
+        return TrainState(
+            params=dev_params, opt_state=(),
+            loss_scale=jax.device_put(ls, repl),
             global_step=jax.device_put(jnp.asarray(0, jnp.int32), repl),
             skipped_steps=jax.device_put(jnp.asarray(0, jnp.int32), repl),
             rng=jax.device_put(rng, repl))
@@ -351,34 +411,37 @@ class DeepSpeedEngine:
             overflow=overflow)
         return new_state, metrics
 
+    def _forward_grads(self, params, scale, step_rng, batch, gas: int):
+        """GAS microbatch accumulation (``lax.scan``) shared by the fused and
+        the offload step builders (reference: one grad-accumulation semantic,
+        ``backward:1931`` scaling by 1/GAS)."""
+        if gas > 1:
+            def micro(carry, inp):
+                idx, mb = inp
+                acc, rloss = carry
+                mb_rng = jax.random.fold_in(step_rng, idx)
+                loss, grads = self._loss_and_grads(params, scale, mb, mb_rng)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                return (acc, rloss + loss), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), params)
+            (gsum, lsum), _ = jax.lax.scan(
+                micro, (zeros, jnp.float32(0.0)),
+                (jnp.arange(gas), batch))
+            grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
+            return lsum / gas, grads
+        return self._loss_and_grads(params, scale, batch, step_rng)
+
     def _build_train_step(self, gas: int):
         cfg = self._config
         fp16 = cfg.fp16_enabled
 
         def train_step(state: TrainState, batch):
-            params = state.params
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             rng, step_rng = jax.random.split(state.rng)
-
-            if gas > 1:
-                def micro(carry, inp):
-                    idx, mb = inp
-                    acc, rloss = carry
-                    mb_rng = jax.random.fold_in(step_rng, idx)
-                    loss, grads = self._loss_and_grads(params, scale, mb, mb_rng)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return (acc, rloss + loss), None
-
-                zeros = jax.tree_util.tree_map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), params)
-                (gsum, lsum), _ = jax.lax.scan(
-                    micro, (zeros, jnp.float32(0.0)),
-                    (jnp.arange(gas), batch))
-                grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
-                loss = lsum / gas
-            else:
-                loss, grads = self._loss_and_grads(params, scale, batch, step_rng)
-
+            loss, grads = self._forward_grads(state.params, scale, step_rng,
+                                              batch, gas)
             # ZeRO grad placement: stage>=2 spec is fsdp-sharded → XLA lowers
             # the DP reduction as reduce-scatter (reference average_tensor /
             # __reduce_and_partition_ipg_grads)
@@ -391,6 +454,65 @@ class DeepSpeedEngine:
             step = self._build_train_step(gas)
             self._compiled_train_step = jax.jit(step, donate_argnums=(0,))
         return self._compiled_train_step
+
+    # ------------------------------------------------------------------
+    # ZeRO-Offload step path: device computes grads, host applies Adam
+    # ------------------------------------------------------------------
+    def _get_compiled_offload_grad_step(self, gas: int):
+        if getattr(self, "_compiled_offload_grad", None) is None:
+            fp16 = self._config.fp16_enabled
+
+            def grad_step(state: TrainState, batch):
+                scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
+                rng, step_rng = jax.random.split(state.rng)
+                loss, grads = self._forward_grads(state.params, scale,
+                                                  step_rng, batch, gas)
+                grads = constrain(grads, self.plan.grad_specs(state.params),
+                                  self.mesh)
+                overflow = (has_inf_or_nan(grads) if fp16
+                            else jnp.asarray(False))
+                grad_norm = optax.global_norm(grads)
+                return loss, grads, overflow, grad_norm, rng
+            self._compiled_offload_grad = jax.jit(grad_step)
+        return self._compiled_offload_grad
+
+    def _offload_host_apply(self, grads, overflow, grad_norm):
+        """Host tail of the offload step: stream grads D2H, fused C++ Adam on
+        the flat master (NVMe-swapped moments under ZeRO-Infinity), stream
+        updated params H2D, run the loss-scale automaton."""
+        cfg = self._config
+        overflow_b = bool(jax.device_get(overflow))
+        if not overflow_b:
+            lr = float(jax.device_get(
+                jnp.asarray(self._schedule_fn(self.state.global_step))))
+            grads_np = jax.device_get(grads)
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                gn = float(jax.device_get(grad_norm))
+                clip = cfg.gradient_clipping
+                if gn > clip:
+                    coef = clip / (gn + 1e-6)
+                    grads_np = jax.tree_util.tree_map(
+                        lambda g: g * coef, grads_np)
+            self._offload.step(grads_np, lr=lr)
+            new_params = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(
+                    x.astype(self.compute_dtype)
+                    if np.issubdtype(x.dtype, np.floating) else x),
+                self._offload.params_tree())
+            with self.mesh:
+                new_params = jax.device_put(new_params, self._offload_param_sh)
+            self.state = self.state.replace(params=new_params)
+        new_ls = update_scale(
+            self.state.loss_scale, jnp.asarray(overflow_b),
+            dynamic=cfg.fp16_enabled and cfg.dynamic_loss_scale,
+            scale_window=cfg.fp16_config.loss_scale_window,
+            min_scale=cfg.fp16_config.min_loss_scale,
+            hysteresis=cfg.fp16_config.hysteresis)
+        self.state = self.state.replace(
+            loss_scale=new_ls,
+            global_step=self.state.global_step + 1,
+            skipped_steps=self.state.skipped_steps + int(overflow_b))
+        return overflow_b
 
     # ------------------------------------------------------------------
     # DeepSpeed-parity 3-call API
@@ -453,13 +575,17 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(STEP_GLOBAL_TIMER).start()
-        if self._compiled_apply is None:
-            self._compiled_apply = jax.jit(self._apply_update,
-                                           donate_argnums=(0, 1))
-
-        with self.mesh:
-            self.state, grad_norm = self._compiled_apply(
-                self.state, self._accum_grads, self._accum_overflow)
+        if self._offload is not None:
+            grad_norm = optax.global_norm(self._accum_grads)
+            self._offload_host_apply(self._accum_grads,
+                                     self._accum_overflow, grad_norm)
+        else:
+            if self._compiled_apply is None:
+                self._compiled_apply = jax.jit(self._apply_update,
+                                               donate_argnums=(0, 1))
+            with self.mesh:
+                self.state, grad_norm = self._compiled_apply(
+                    self.state, self._accum_grads, self._accum_overflow)
         self._global_grad_norm = float(grad_norm)
         self._accum_grads = None
         self._accum_count = 0
@@ -495,10 +621,25 @@ class DeepSpeedEngine:
                 batch = micro_batches[0]
         self.tput_timer.start()
         batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
-        step_fn = self._get_compiled_train_step(gas)
         self._maybe_profile_flops(batch, gas)
-        with self.mesh:
-            self.state, metrics = step_fn(self.state, batch)
+        if self._offload is not None:
+            grad_fn = self._get_compiled_offload_grad_step(gas)
+            with self.mesh:
+                loss, grads, overflow, grad_norm, rng = grad_fn(
+                    self.state, batch)
+            self.state = self.state.replace(rng=rng)
+            lr_now = self._schedule_fn(self.state.global_step)
+            self._offload_host_apply(grads, overflow, grad_norm)
+            metrics = StepMetrics(
+                loss=loss.astype(jnp.float32),
+                grad_norm=grad_norm.astype(jnp.float32),
+                lr=jnp.asarray(lr_now, jnp.float32),
+                loss_scale=self.state.loss_scale.cur_scale,
+                overflow=overflow)
+        else:
+            step_fn = self._get_compiled_train_step(gas)
+            with self.mesh:
+                self.state, metrics = step_fn(self.state, batch)
         self.global_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
@@ -668,6 +809,8 @@ class DeepSpeedEngine:
         ``_zero3_consolidated_16bit_state_dict:3432`` rolled into one: orbax
         handles gather-on-save, so consolidation is just a replicated
         device_get."""
+        if self._offload is not None:
+            return self._offload.params_tree()
         repl = self.plan.replicated_sharding()
         gathered = jax.device_get(jax.device_put(self.state.params, repl))
         return gathered
@@ -689,6 +832,8 @@ class DeepSpeedEngine:
                              if self.lr_scheduler else None),
         })
         eng.save(self.state, save_dir, tag, client_state=client_state)
+        if self._offload is not None:
+            self._offload.save(save_dir, tag)
         if save_latest and jax.process_index() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(tag)
@@ -712,6 +857,28 @@ class DeepSpeedEngine:
             load_optimizer_states=load_optimizer_states,
             load_module_only=load_module_only)
         self.state = state
+        if self._offload is not None:
+            restored = load_optimizer_states and self._offload.load(load_dir,
+                                                                    tag)
+            if restored:
+                with self.mesh:
+                    self.state = self.state.replace(
+                        params=jax.device_put(
+                            jax.tree_util.tree_map(
+                                lambda x: jnp.asarray(
+                                    x.astype(self.compute_dtype)
+                                    if np.issubdtype(x.dtype, np.floating)
+                                    else x),
+                                self._offload.params_tree()),
+                            self._offload_param_sh))
+            else:
+                # no host shard restored (fresh fp32 weights or
+                # load_optimizer_states=False): resync the host master from
+                # the just-loaded device params so the next step doesn't
+                # revert them to construction-time weights
+                loaded = jax.device_get(jax.device_put(
+                    self.state.params, self.plan.replicated_sharding()))
+                self._offload.layout.flatten(loaded, out=self._offload.master)
         self.global_steps = client_state.get("global_steps", 0)
         self.micro_steps = client_state.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None and \

@@ -37,6 +37,12 @@ class PipelineEngine(DeepSpeedEngine):
                              "is required")
         if kwargs.get("tp_rules") is None:
             kwargs["tp_rules"] = model.tp_rules()
+        if config.zero_config.offload_optimizer_device != "none":
+            raise NotImplementedError(
+                "offload_optimizer is not supported with pipeline "
+                "parallelism: the offload step path bypasses the pipeline "
+                "schedule (reference: PP composes with ZeRO-Offload only "
+                "through BF16_Optimizer, not the CPU-Adam path)")
         super().__init__(model=model, config=config, **kwargs)
         assert self.zero_stage <= 1, (
             "ZeRO-2/3 is incompatible with pipeline parallelism "
