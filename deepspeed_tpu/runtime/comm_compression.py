@@ -1,0 +1,176 @@
+"""1-bit (sign-compressed, error-feedback) gradient communication.
+
+Parity: reference ``runtime/comm/nccl.py:14 NcclBackend.compressed_allreduce``
+(+ ``runtime/comm/mpi.py``, ``runtime/compression/cupy.py`` bit packing) — the
+communication engine behind OnebitAdam/OnebitLamb/ZeroOneAdam
+(``fp16/onebit/{adam,lamb,zoadam}.py``): each worker sign-compresses its
+gradient with error feedback, workers exchange 1-bit chunks (igather), each
+worker acts as "server" for its chunk (average → re-compress with server
+error feedback), and the compressed result is allgathered.  16× less traffic
+than fp32 allreduce during the compression stage.
+
+TPU design
+----------
+Two layers:
+
+1. ``compressed_allreduce`` — the REAL collective, for use inside
+   ``shard_map`` over a data-parallel mesh axis: bit-packs signs into uint8,
+   ``all_to_all`` scatters worker chunks (phase 1 = reference igather),
+   majority-sign server reduction with server error feedback, ``all_gather``
+   of the 1-bit result (phase 2).  On a multi-pod mesh this is the DCN-side
+   option where bandwidth, not latency, dominates.
+2. ``error_feedback_compress`` — an optax gradient transformation giving the
+   OnebitAdam *optimizer semantics* in the SPMD engine: a warmup stage
+   (plain Adam; reference ``freeze_step``) followed by a compression stage
+   where the (XLA-reduced) gradient is sign-quantized with error feedback
+   before the inner update.  The engine selects it via the optimizer names
+   ``OneBitAdam``/``ZeroOneAdam``/``OneBitLamb``.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+# ----------------------------------------------------------------------
+# bit packing (reference: cupy packbits/unpackbits)
+# ----------------------------------------------------------------------
+
+
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """Pack the sign bits of flat ``x`` (numel divisible by 8) into uint8."""
+    bits = (x >= 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return (bits * weights).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """uint8 → ±1 float32, inverse of :func:`pack_signs`."""
+    shifts = jnp.asarray([7, 6, 5, 4, 3, 2, 1, 0], jnp.uint8)
+    bits = (packed[:, None] >> shifts) & 1
+    return jnp.where(bits.reshape(-1) > 0, 1.0, -1.0).astype(jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# the collective (shard_map layer)
+# ----------------------------------------------------------------------
+
+
+def compressed_allreduce(grad: jnp.ndarray, worker_error: jnp.ndarray,
+                         server_error: jnp.ndarray, axis_name: str):
+    """Error-feedback sign-compressed allreduce of a flat fp32 vector.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound.  ``grad`` is this
+    worker's local gradient (full length ``n``); ``worker_error`` has length
+    ``n``; ``server_error`` has length ``n // world``.  ``n`` must be
+    divisible by ``world * 8`` (pad upstream).
+
+    Returns ``(reduced, new_worker_error, new_server_error)`` where
+    ``reduced`` is the same quantity on every worker (the averaged,
+    twice-compressed gradient).
+    """
+    world = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+
+    # ---- worker compression (phase-1 sender side) --------------------
+    # worker compresses the RAW local grad; the averaging over workers
+    # happens once, at the server reduction (reference compressed_allreduce)
+    corrected = grad + worker_error
+    worker_scale = jnp.mean(jnp.abs(corrected))
+    signs = jnp.where(corrected >= 0, 1.0, -1.0).astype(jnp.float32)
+    new_worker_error = corrected - worker_scale * signs
+
+    packed = pack_signs(signs)                       # n/8 uint8
+    chunk_bytes = packed.shape[0] // world
+
+    # phase 1: worker i sends its j-th chunk to worker j (reference igather)
+    send = packed.reshape(world, chunk_bytes)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    recv = recv.reshape(world, chunk_bytes)
+    scales = lax.all_gather(worker_scale, axis_name)  # [world]
+
+    # ---- server reduction of my chunk --------------------------------
+    # decompress every worker's version of my chunk and average
+    worker_chunks = jax.vmap(unpack_signs)(recv)      # [world, chunk*8]
+    avg = (worker_chunks * scales[:, None]).mean(axis=0)
+    server_corrected = avg + server_error
+    server_scale = jnp.mean(jnp.abs(server_corrected))
+    server_signs = jnp.where(server_corrected >= 0, 1.0, -1.0)
+    new_server_error = server_corrected - server_scale * server_signs
+
+    # phase 2: allgather the 1-bit server results
+    out_packed = pack_signs(server_signs)             # chunk_bytes
+    all_packed = lax.all_gather(out_packed, axis_name)   # [world, chunk_bytes]
+    all_scales = lax.all_gather(server_scale, axis_name)  # [world]
+    all_signs = jax.vmap(unpack_signs)(all_packed)    # [world, chunk*8]
+    reduced = (all_signs * all_scales[:, None]).reshape(-1)
+
+    del idx
+    return reduced, new_worker_error, new_server_error
+
+
+def compressed_allreduce_bytes(numel: int, world: int) -> int:
+    """Traffic per worker in bytes (both phases) — for comms logging; the
+    fp32 ring-allreduce equivalent is ``~2 * 4 * numel``."""
+    phase1 = numel // 8                 # send 1 bit/elem total across peers
+    phase2 = (numel // world // 8) * world
+    return phase1 + phase2 + 8 * world  # + scales
+
+
+# ----------------------------------------------------------------------
+# optimizer-side error feedback (engine layer)
+# ----------------------------------------------------------------------
+
+
+class EFCompressionState(NamedTuple):
+    count: jnp.ndarray       # i32 step counter
+    error: Any               # pytree of per-leaf error-feedback buffers
+
+
+def error_feedback_compress(freeze_step: int = 100
+                            ) -> optax.GradientTransformation:
+    """Optax transform: identity during warmup (``step <= freeze_step``),
+    then EF sign quantization per leaf — the OnebitAdam two-stage schedule
+    (reference ``fp16/onebit/adam.py`` ``freeze_step`` semantics)."""
+
+    def init_fn(params):
+        return EFCompressionState(
+            count=jnp.zeros([], jnp.int32),
+            error=jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        enabled = count > freeze_step
+
+        def leaf(g, e):
+            c = g.astype(jnp.float32) + e
+            scale = jnp.mean(jnp.abs(c))
+            q = scale * jnp.where(c >= 0, 1.0, -1.0)
+            out = jnp.where(enabled, q, g)
+            new_e = jnp.where(enabled, c - q, e)
+            return out.astype(g.dtype), new_e
+
+        flat = jax.tree_util.tree_map(leaf, updates, state.error)
+        outs = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                      is_leaf=lambda t: isinstance(t, tuple))
+        errs = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                      is_leaf=lambda t: isinstance(t, tuple))
+        return outs, EFCompressionState(count=count, error=errs)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int):
+    """Pad a flat vector so ``compressed_allreduce`` size constraints hold;
+    returns (padded, original_numel)."""
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    return jnp.concatenate([x, jnp.zeros((rem,), x.dtype)]), n

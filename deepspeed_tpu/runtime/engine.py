@@ -51,8 +51,7 @@ from deepspeed_tpu.runtime.loss_scaler import (LossScaleState,
                                                static_loss_scale_state,
                                                update_scale)
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
-from deepspeed_tpu.runtime.optimizers import (COMPRESSED_COMM_OPTIMIZERS,
-                                              build_optimizer)
+from deepspeed_tpu.runtime.optimizers import build_optimizer
 from deepspeed_tpu.runtime.zero.stage_plan import ZeroShardingPlan, constrain
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER,

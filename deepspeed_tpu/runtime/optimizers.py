@@ -71,11 +71,24 @@ def _adagrad(params: Dict[str, Any]) -> optax.GradientTransformation:
     return optax.adagrad(lr, eps=eps)
 
 
+def _onebit(params: Dict[str, Any],
+            inner: optax.GradientTransformation
+            ) -> optax.GradientTransformation:
+    """Two-stage 1-bit optimizer (reference ``fp16/onebit/*``): warmup runs
+    the inner rule on raw grads; after ``freeze_step`` the gradient is
+    sign-quantized with error feedback (``runtime/comm_compression.py``)
+    before the inner update — the trajectory of compressed communication."""
+    from deepspeed_tpu.runtime.comm_compression import error_feedback_compress
+    freeze_step = int(params.get("freeze_step", 100))
+    return optax.chain(error_feedback_compress(freeze_step), inner)
+
+
 def _onebit_adam(params: Dict[str, Any]) -> optax.GradientTransformation:
-    # The compression happens in the gradient-reduction path (engine selects
-    # sign-SGD-with-error-feedback allreduce after `freeze_step` steps);
-    # the local update rule is plain Adam.
-    return _adam(params, adamw_mode=False)
+    return _onebit(params, _adam(params, adamw_mode=False))
+
+
+def _onebit_lamb(params: Dict[str, Any]) -> optax.GradientTransformation:
+    return _onebit(params, _lamb(params))
 
 
 OPTIMIZER_REGISTRY: Dict[str, Callable[[Dict[str, Any]], optax.GradientTransformation]] = {
@@ -87,7 +100,7 @@ OPTIMIZER_REGISTRY: Dict[str, Callable[[Dict[str, Any]], optax.GradientTransform
     FUSED_LAMB: _lamb,
     ONEBIT_ADAM_OPTIMIZER: _onebit_adam,
     ZERO_ONE_ADAM_OPTIMIZER: _onebit_adam,
-    ONEBIT_LAMB_OPTIMIZER: _lamb,
+    ONEBIT_LAMB_OPTIMIZER: _onebit_lamb,
     SGD_OPTIMIZER: _sgd,
     ADAGRAD_OPTIMIZER: _adagrad,
 }
