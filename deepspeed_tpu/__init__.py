@@ -86,6 +86,12 @@ def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
     cfg_dict = dict(config or {})
     cfg_dict.update(kwargs)
     cfg = DeepSpeedInferenceConfig(cfg_dict)
+
+    # HF torch model → policy-driven conversion (reference
+    # replace_transformer_layer kernel injection path)
+    from deepspeed_tpu.module_inject import is_hf_model, replace_transformer_layer
+    if model is not None and is_hf_model(model):
+        model, params = replace_transformer_layer(model)
     return InferenceEngine(model, cfg, params=params, mesh=mesh)
 
 

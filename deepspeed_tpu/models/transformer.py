@@ -47,9 +47,12 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
-    activation: str = "silu"                # "silu" (SwiGLU) | "gelu"
+    activation: str = "silu"                # "silu" (SwiGLU) | "gelu" | "relu"
     use_rmsnorm: bool = True
     use_rope: bool = True                   # False → learned positions (GPT-2)
+    rope_dim: Optional[int] = None          # partial rotary (GPT-NeoX); None → full
+    use_bias: bool = False                  # linear biases (GPT-2/OPT families)
+    norm_bias: bool = False                 # LayerNorm beta (GPT-2/OPT)
     tie_embeddings: bool = False
     remat: bool = True
     remat_policy: str = "nothing_saveable"
@@ -84,6 +87,10 @@ class TransformerConfig:
             d = int(8 * self.hidden_size / 3)
             return 256 * ((d + 255) // 256)
         return 4 * self.hidden_size
+
+    @property
+    def rotary_dim(self):
+        return self.rope_dim or self.head_dim
 
     # ---- presets -----------------------------------------------------
     @staticmethod
@@ -146,7 +153,7 @@ class TransformerConfig:
         return total
 
 
-def _norm(x, weight, eps, use_rms):
+def _norm(x, weight, eps, use_rms, bias=None):
     xf = x.astype(jnp.float32)
     if use_rms:
         var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
@@ -155,7 +162,10 @@ def _norm(x, weight, eps, use_rms):
         mu = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.var(xf, axis=-1, keepdims=True)
         out = (xf - mu) * jax.lax.rsqrt(var + eps)
-    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+    out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
 
 
 def next_token_xent(logits, batch):
@@ -181,8 +191,13 @@ def next_token_xent(logits, batch):
     return jnp.mean(nll)
 
 
-def _rope(x, positions, theta):
-    """Rotary embedding; x: [B, S, H, D]."""
+def _rope(x, positions, theta, rope_dim=None):
+    """Rotary embedding; x: [B, S, H, D].  ``rope_dim`` < D rotates only the
+    leading dims (GPT-NeoX partial rotary)."""
+    if rope_dim is not None and rope_dim < x.shape[-1]:
+        rot, rest = x[..., :rope_dim], x[..., rope_dim:]
+        return jnp.concatenate(
+            [_rope(rot, positions, theta), rest], axis=-1)
     B, S, H, D = x.shape
     half = D // 2
     freqs = jnp.exp(-math.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
@@ -246,11 +261,21 @@ class CausalTransformerLM:
         }
         if c.activation == "silu":
             layers["w_gate"] = dense(keys[6], (L, d, f), d)
+        if c.use_bias:
+            for name, width in (("wq_b", H * dh), ("wk_b", Hkv * dh),
+                                ("wv_b", Hkv * dh), ("wo_b", d),
+                                ("w_up_b", f), ("w_down_b", d)):
+                layers[name] = jnp.zeros((L, width), dtype)
+        if c.norm_bias:
+            layers["attn_norm_b"] = jnp.zeros((L, d), dtype)
+            layers["mlp_norm_b"] = jnp.zeros((L, d), dtype)
         params = {
             "tok_embed": dense(keys[7], (v, d), d),
             "final_norm": jnp.ones((d,), dtype),
             "layers": layers,
         }
+        if c.norm_bias:
+            params["final_norm_b"] = jnp.zeros((d,), dtype)
         if not c.use_rope:
             params["pos_embed"] = dense(keys[8], (c.max_seq_len, d), d)
         if not c.tie_embeddings:
@@ -318,23 +343,40 @@ class CausalTransformerLM:
                 (r"lm_head", P(None, TP_AXIS)),
             ]
         return [
+            # biases first: the generic weight patterns would also match them
+            (r"wq_b|wk_b|wv_b|w_up_b|w_gate_b", P(None, TP_AXIS)),
+            (r"wo_b|w_down_b|_norm", P()),
             (r"wq|wk|wv|w_up|w_gate", P(None, None, TP_AXIS)),
             (r"wo|w_down", P(None, TP_AXIS, None)),
             (r"lm_head", P(None, TP_AXIS)),
         ]
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _proj(h, layer, name):
+        out = h @ layer[name]
+        if f"{name}_b" in layer:
+            out = out + layer[f"{name}_b"].astype(out.dtype)
+        return out
+
+    def _qkv(self, h, layer, B, S, positions):
+        c = self.config
+        H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
+        q = self._proj(h, layer, "wq").reshape(B, S, H, dh)
+        k = self._proj(h, layer, "wk").reshape(B, S, Hkv, dh)
+        v = self._proj(h, layer, "wv").reshape(B, S, Hkv, dh)
+        if c.use_rope:
+            q = _rope(q, positions, c.rope_theta, c.rope_dim)
+            k = _rope(k, positions, c.rope_theta, c.rope_dim)
+        return q, k, v
+
     def _attn_block(self, x, layer, positions):
         c = self.config
         B, S, d = x.shape
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
-        h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm)
-        q = (h @ layer["wq"]).reshape(B, S, H, dh)
-        k = (h @ layer["wk"]).reshape(B, S, Hkv, dh)
-        v = (h @ layer["wv"]).reshape(B, S, Hkv, dh)
-        if c.use_rope:
-            q = _rope(q, positions, c.rope_theta)
-            k = _rope(k, positions, c.rope_theta)
+        h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
+                  layer.get("attn_norm_b"))
+        q, k, v = self._qkv(h, layer, B, S, positions)
         if c.attn_impl == "ring":
             from deepspeed_tpu.ops.ring_attention import ring_attention
             attn = ring_attention(q, k, v, causal=True)
@@ -358,12 +400,13 @@ class CausalTransformerLM:
             raise ValueError(
                 f"unknown attn_impl '{c.attn_impl}'; expected one of "
                 "auto/pallas/reference/ring/ulysses")
-        return x + attn.reshape(B, S, H * dh) @ layer["wo"]
+        return x + self._proj(attn.reshape(B, S, H * dh), layer, "wo")
 
     def _mlp_block(self, x, layer, rng=None, train=True):
         """Dense or MoE FFN; returns (x, aux_loss)."""
         c = self.config
-        h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm)
+        h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
+                  layer.get("mlp_norm_b"))
         if "moe" in layer:
             from deepspeed_tpu.moe.sharded_moe import moe_layer_forward
             act = jax.nn.silu if c.activation == "silu" else jax.nn.gelu
@@ -380,10 +423,13 @@ class CausalTransformerLM:
                 expert_fn, h, train=train, rng=rng)
             return x + moe_out, l_aux
         if c.activation == "silu":
-            inner = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+            inner = jax.nn.silu(h @ layer["w_gate"]) * \
+                self._proj(h, layer, "w_up")
+        elif c.activation == "relu":
+            inner = jax.nn.relu(self._proj(h, layer, "w_up"))
         else:
-            inner = jax.nn.gelu(h @ layer["w_up"])
-        return x + inner @ layer["w_down"], jnp.float32(0.0)
+            inner = jax.nn.gelu(self._proj(h, layer, "w_up"))
+        return x + self._proj(inner, layer, "w_down"), jnp.float32(0.0)
 
     def _layer(self, x, layer, positions, rng=None, train=True):
         x = self._attn_block(x, layer, positions)
@@ -425,7 +471,8 @@ class CausalTransformerLM:
             x, l_auxs = jax.lax.scan(body, x, params["layers"])
             aux = jnp.sum(l_auxs)
 
-        x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm)
+        x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
+                  params.get("final_norm_b"))
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
@@ -456,16 +503,12 @@ class CausalTransformerLM:
         c = self.config
         B, T, d = x.shape
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
-        h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm)
-        q = (h @ layer["wq"]).reshape(B, T, H, dh)
-        k = (h @ layer["wk"]).reshape(B, T, Hkv, dh)
-        v = (h @ layer["wv"]).reshape(B, T, Hkv, dh)
-        if c.use_rope:
-            q = _rope(q, positions, c.rope_theta)
-            k = _rope(k, positions, c.rope_theta)
+        h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
+                  layer.get("attn_norm_b"))
+        q, k, v = self._qkv(h, layer, B, T, positions)
         cache = update_cache(KVCache(k=cache_k, v=cache_v, length=length), k, v)
         attn = decode_attention(q, cache)
-        x = x + attn.reshape(B, T, H * dh) @ layer["wo"]
+        x = x + self._proj(attn.reshape(B, T, H * dh), layer, "wo")
         x, _ = self._mlp_block(x, layer, train=False)
         return x, cache
 
@@ -501,7 +544,8 @@ class CausalTransformerLM:
                 body, x, (params["layers"], caches.k, caches.v))
             out_caches = KVCache(k=new_k, v=new_v, length=start + T)
 
-        x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm)
+        x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
+                  params.get("final_norm_b"))
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)

@@ -1,0 +1,16 @@
+"""Policy-driven module injection (reference ``deepspeed/module_inject/``)."""
+
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, get_tp_rules
+from deepspeed_tpu.module_inject.policies import (GPT2Policy, GPTNeoXPolicy,
+                                                  InjectionPolicy, LlamaPolicy,
+                                                  OPTPolicy, REPLACE_POLICIES,
+                                                  find_policy)
+from deepspeed_tpu.module_inject.replace_module import (convert_hf_model,
+                                                        is_hf_model,
+                                                        replace_transformer_layer)
+
+__all__ = [
+    "AutoTP", "get_tp_rules", "InjectionPolicy", "GPT2Policy", "LlamaPolicy",
+    "OPTPolicy", "GPTNeoXPolicy", "REPLACE_POLICIES", "find_policy",
+    "convert_hf_model", "is_hf_model", "replace_transformer_layer",
+]

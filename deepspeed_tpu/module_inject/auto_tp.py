@@ -1,0 +1,79 @@
+"""Auto tensor-parallelism — sharding heuristics for models without a policy.
+
+Parity: reference ``module_inject/auto_tp.py`` (``AutoTP``: find the linear
+layers to shard without an explicit policy; row-parallel layers get an
+all-reduce) and ``module_inject/layers.py`` (``LinearAllreduce`` /
+``LinearLayer``).
+
+TPU design: AutoTP emits ``tp_rules`` — ``(path_regex, PartitionSpec)``
+pairs — from parameter names/shapes.  Column-parallel (output-dim) specs for
+fan-out projections, row-parallel (input-dim) specs for fan-in projections;
+XLA materialises the all-reduce at the row-parallel boundary.  Works on any
+params pytree, so unknown architectures still get a TP plan.
+"""
+
+import re
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import TP_AXIS
+
+# fan-out (column-parallel: shard the LAST dim) / fan-in (row-parallel:
+# shard the FIRST weight dim) name fragments, per the reference heuristics
+_COLUMN_PAT = re.compile(
+    r"(wq|wk|wv|w_up|w_gate|q_proj|k_proj|v_proj|up_proj|gate_proj|"
+    r"c_attn|c_fc|query_key_value|fc1|lm_head|dense_h_to_4h)(?!.*_b)")
+_ROW_PAT = re.compile(
+    r"(wo|w_down|o_proj|out_proj|down_proj|c_proj|fc2|dense_4h_to_h|"
+    r"attention\.dense)(?!.*_b)")
+
+
+def get_tp_rules(params, tp_size: int = 1) -> List[Tuple[str, P]]:
+    """Build tp_rules for an arbitrary params pytree.
+
+    Known projection names get Megatron column/row splits; everything else
+    stays replicated.  Only 2-D+ leaves whose candidate dim divides
+    ``tp_size`` are sharded (the reference skips unshardable layers too).
+    """
+    rules: List[Tuple[str, P]] = []
+    seen = set()
+
+    def visit(path, leaf):
+        key = jax.tree_util.keystr(path)
+        shape = np.shape(leaf)
+        if len(shape) < 2:
+            return
+        ndim = len(shape)
+        if _ROW_PAT.search(key):
+            # row-parallel: shard the second-to-last (input) dim
+            dim = ndim - 2
+            pat_kind = "row"
+        elif _COLUMN_PAT.search(key):
+            dim = ndim - 1
+            pat_kind = "col"
+        else:
+            return
+        if tp_size > 1 and shape[dim] % tp_size != 0:
+            return
+        # derive a stable regex from the leaf name (last path component)
+        name = re.findall(r"[A-Za-z0-9_.]+", key)[-1]
+        if (name, ndim, pat_kind) in seen:
+            return
+        seen.add((name, ndim, pat_kind))
+        entries = [None] * ndim
+        entries[dim] = TP_AXIS
+        rules.append((re.escape(name) + r"'?\]?$", P(*entries)))
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return rules
+
+
+class AutoTP:
+    """Parity shim of the reference class surface."""
+
+    @staticmethod
+    def tp_parser(params):
+        return get_tp_rules(params)

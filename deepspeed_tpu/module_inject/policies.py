@@ -1,0 +1,279 @@
+"""Per-architecture injection policies.
+
+Parity: reference ``module_inject/replace_policy.py`` + the container classes
+under ``module_inject/containers/`` (``bloom.py:13``, ``opt.py:15``,
+``gpt2.py``, ``llama``-style megatron containers): each policy knows how an
+upstream HuggingFace architecture lays out its weights and how to map them
+into the fused runtime module.
+
+TPU design: the "fused runtime module" is ``CausalTransformerLM`` (one
+jit-compiled program — XLA does the fusing the reference's CUDA kernels do by
+hand).  A policy maps an HF ``model_type`` to (a) a ``TransformerConfig``
+and (b) a params pytree built from the HF ``state_dict``.  Tensor-parallel
+slicing (reference ``ReplaceWithTensorSlicing``, ``replace_module.py:25``)
+is not done by copying shards: the converted params carry ``tp_rules`` and
+``device_put`` shards them over the ``tp`` mesh axis.
+"""
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor | ndarray → fp32 numpy (host)."""
+    if isinstance(t, np.ndarray):
+        return t.astype(np.float32)
+    # torch path — lazy import so jax-only installs work
+    return t.detach().to("cpu").float().numpy()
+
+
+def _stack(sd: Dict[str, Any], fmt: str, n: int, transpose=False) -> np.ndarray:
+    mats = [_np(sd[fmt.format(i)]) for i in range(n)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return np.stack(mats)
+
+
+class InjectionPolicy:
+    """Base policy (reference ``DSPolicy``/``TransformerPolicy``)."""
+
+    model_types: Tuple[str, ...] = ()
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        return getattr(hf_config, "model_type", None) in cls.model_types
+
+    @classmethod
+    def build(cls, hf_config, sd: Dict[str, Any]
+              ) -> Tuple[TransformerConfig, Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class GPT2Policy(InjectionPolicy):
+    """HF ``GPT2LMHeadModel`` (reference ``containers/gpt2.py`` HFGPT2Layer
+    policy).  Conv1D weights are stored [in, out] — already our layout; the
+    fused c_attn splits into q/k/v thirds."""
+
+    model_types = ("gpt2",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L = hf.n_embd, hf.n_layer
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.n_head, max_seq_len=hf.n_positions,
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=False, use_bias=True,
+            norm_bias=True, tie_embeddings=True, remat=False)
+
+        pre = "transformer.h.{}."
+        qkv_w = _stack(sd, pre + "attn.c_attn.weight", L)   # [L, d, 3d]
+        qkv_b = _stack(sd, pre + "attn.c_attn.bias", L)     # [L, 3d]
+        layers = {
+            "attn_norm": _stack(sd, pre + "ln_1.weight", L),
+            "attn_norm_b": _stack(sd, pre + "ln_1.bias", L),
+            "wq": qkv_w[:, :, :d], "wk": qkv_w[:, :, d:2 * d],
+            "wv": qkv_w[:, :, 2 * d:],
+            "wq_b": qkv_b[:, :d], "wk_b": qkv_b[:, d:2 * d],
+            "wv_b": qkv_b[:, 2 * d:],
+            "wo": _stack(sd, pre + "attn.c_proj.weight", L),
+            "wo_b": _stack(sd, pre + "attn.c_proj.bias", L),
+            "mlp_norm": _stack(sd, pre + "ln_2.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "ln_2.bias", L),
+            "w_up": _stack(sd, pre + "mlp.c_fc.weight", L),
+            "w_up_b": _stack(sd, pre + "mlp.c_fc.bias", L),
+            "w_down": _stack(sd, pre + "mlp.c_proj.weight", L),
+            "w_down_b": _stack(sd, pre + "mlp.c_proj.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "pos_embed": _np(sd["transformer.wpe.weight"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class LlamaPolicy(InjectionPolicy):
+    """HF ``LlamaForCausalLM`` / ``MistralForCausalLM`` (reference has no
+    llama container in 0.8.3 — auto-TP handles it; here it is first-class).
+    Linear weights are [out, in] → transpose.  GQA via num_key_value_heads."""
+
+    model_types = ("llama", "mistral")
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L = hf.hidden_size, hf.num_hidden_layers
+        n_kv = getattr(hf, "num_key_value_heads", None) or hf.num_attention_heads
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.num_attention_heads,
+            n_kv_heads=(None if n_kv == hf.num_attention_heads else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=getattr(hf, "max_position_embeddings", 4096),
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True,
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, transpose=True),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L, transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L, transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
+class OPTPolicy(InjectionPolicy):
+    """HF ``OPTForCausalLM`` (reference ``containers/opt.py:15`` HFOPTLayer
+    policy).  ReLU FFN, learned positions with the OPT +2 offset (folded in
+    by slicing the embedding), pre-LN only."""
+
+    model_types = ("opt",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        if not getattr(hf, "do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=False (350m) is "
+                             "not supported (post-LN architecture)")
+        if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size is not "
+                             "supported")
+        d, L = hf.hidden_size, hf.num_hidden_layers
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.num_attention_heads,
+            ffn_hidden_size=hf.ffn_dim,
+            max_seq_len=hf.max_position_embeddings,
+            activation="relu", use_rmsnorm=False, use_rope=False,
+            use_bias=True, norm_bias=True, tie_embeddings=True, remat=False)
+
+        pre = "model.decoder.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "self_attn_layer_norm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "self_attn_layer_norm.bias", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, pre + "self_attn.out_proj.weight", L, transpose=True),
+            "wq_b": _stack(sd, pre + "self_attn.q_proj.bias", L),
+            "wk_b": _stack(sd, pre + "self_attn.k_proj.bias", L),
+            "wv_b": _stack(sd, pre + "self_attn.v_proj.bias", L),
+            "wo_b": _stack(sd, pre + "self_attn.out_proj.bias", L),
+            "mlp_norm": _stack(sd, pre + "final_layer_norm.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "final_layer_norm.bias", L),
+            "w_up": _stack(sd, pre + "fc1.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "fc1.bias", L),
+            "w_down": _stack(sd, pre + "fc2.weight", L, transpose=True),
+            "w_down_b": _stack(sd, pre + "fc2.bias", L),
+        }
+        # OPT's learned positions index with a +2 offset
+        pos = _np(sd["model.decoder.embed_positions.weight"])[2:]
+        params = {
+            "tok_embed": _np(sd["model.decoder.embed_tokens.weight"]),
+            "pos_embed": pos,
+            "final_norm": _np(sd["model.decoder.final_layer_norm.weight"]),
+            "final_norm_b": _np(sd["model.decoder.final_layer_norm.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class GPTNeoXPolicy(InjectionPolicy):
+    """HF ``GPTNeoXForCausalLM`` (Pythia; reference ``containers/gptneox.py``).
+    Fused QKV is laid out [H, 3, dh] per head; partial rotary via
+    ``rotary_pct``.  Requires ``use_parallel_residual=False`` models (the
+    sequential-residual variant) — parallel residual is a different dataflow.
+    """
+
+    model_types = ("gpt_neox",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        if getattr(hf, "use_parallel_residual", True):
+            raise ValueError("GPT-NeoX with use_parallel_residual=True is "
+                             "not supported yet; set it to False or use a "
+                             "sequential-residual checkpoint")
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = d // H
+        rot = int(dh * getattr(hf, "rotary_pct", 1.0))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rotary_emb_base", 10000.0)),
+            norm_eps=hf.layer_norm_eps, activation="gelu",
+            use_rmsnorm=False, use_rope=True,
+            rope_dim=(None if rot == dh else rot),
+            use_bias=True, norm_bias=True, tie_embeddings=False, remat=False)
+
+        pre = "gpt_neox.layers.{}."
+        # fused qkv: weight [3d, d] arranged [H, 3, dh, d]
+        wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
+        for i in range(L):
+            w = _np(sd[pre.format(i) + "attention.query_key_value.weight"])
+            b = _np(sd[pre.format(i) + "attention.query_key_value.bias"])
+            w = w.reshape(H, 3, dh, d)
+            b = b.reshape(H, 3, dh)
+            wq.append(w[:, 0].reshape(H * dh, d).T)
+            wk.append(w[:, 1].reshape(H * dh, d).T)
+            wv.append(w[:, 2].reshape(H * dh, d).T)
+            bq.append(b[:, 0].reshape(-1))
+            bk.append(b[:, 1].reshape(-1))
+            bv.append(b[:, 2].reshape(-1))
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "input_layernorm.bias", L),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wq_b": np.stack(bq), "wk_b": np.stack(bk), "wv_b": np.stack(bv),
+            "wo": _stack(sd, pre + "attention.dense.weight", L, transpose=True),
+            "wo_b": _stack(sd, pre + "attention.dense.bias", L),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "post_attention_layernorm.bias", L),
+            "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.dense_4h_to_h.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["gpt_neox.embed_in.weight"]),
+            "final_norm": _np(sd["gpt_neox.final_layer_norm.weight"]),
+            "final_norm_b": _np(sd["gpt_neox.final_layer_norm.bias"]),
+            "lm_head": _np(sd["embed_out.weight"]).T,
+            "layers": layers,
+        }
+        return cfg, params
+
+
+REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
+                                GPTNeoXPolicy]
+
+
+def find_policy(hf_config) -> Optional[type]:
+    for pol in REPLACE_POLICIES:
+        if pol.matches(hf_config):
+            return pol
+    return None

@@ -1,0 +1,65 @@
+"""Policy-driven model replacement (HF → TPU-native runtime model).
+
+Parity: reference ``module_inject/replace_module.py:308
+replace_transformer_layer`` — walk the model, match a policy, build
+containers that copy/slice weights into the fused kernel module.
+
+TPU design: instead of mutating the torch module in place, the whole HF
+model is converted ONCE into a ``CausalTransformerLM`` + params pytree
+(stacked layers → ``lax.scan``), and sharding (auto-TP) happens by
+``device_put`` with the model's ``tp_rules`` — XLA inserts the row-parallel
+all-reduces the reference issues by hand after attention/MLP.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.module_inject.policies import (REPLACE_POLICIES,
+                                                  find_policy)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _state_dict_of(model) -> Dict[str, Any]:
+    if isinstance(model, dict):
+        return model
+    sd = model.state_dict()
+    return dict(sd)
+
+
+def is_hf_model(model) -> bool:
+    """True for torch ``nn.Module``-like objects carrying an HF config."""
+    return (hasattr(model, "state_dict") and hasattr(model, "config")
+            and hasattr(model.config, "model_type"))
+
+
+def replace_transformer_layer(model, hf_config=None, dtype=None,
+                              checkpoint_dict=None
+                              ) -> Tuple[CausalTransformerLM, Dict[str, Any]]:
+    """Convert an HF model (or raw ``state_dict`` + ``hf_config``) into
+    ``(CausalTransformerLM, params)``.
+
+    The returned model's ``tp_rules()`` is the auto-TP sharding plan
+    (reference ``auto_tp.py`` + ``ReplaceWithTensorSlicing``).
+    """
+    if hf_config is None:
+        assert not isinstance(model, dict), \
+            "raw state_dict conversion needs hf_config="
+        hf_config = model.config
+    policy = find_policy(hf_config)
+    if policy is None:
+        known = sorted({t for p in REPLACE_POLICIES for t in p.model_types})
+        raise ValueError(
+            f"no injection policy for model_type="
+            f"'{getattr(hf_config, 'model_type', '?')}'; supported: {known}")
+    sd = checkpoint_dict if checkpoint_dict is not None else _state_dict_of(model)
+    cfg, params = policy.build(hf_config, sd)
+    logger.info(
+        f"module_inject: {hf_config.model_type} → CausalTransformerLM "
+        f"(L={cfg.n_layers} d={cfg.hidden_size} H={cfg.n_heads} "
+        f"V={cfg.vocab_size}) via {policy.__name__}")
+    return CausalTransformerLM(cfg), params
+
+
+# parity alias (the reference API name most users call indirectly)
+convert_hf_model = replace_transformer_layer
