@@ -1,0 +1,10 @@
+"""Compression library (reference ``deepspeed/compression/``)."""
+
+from deepspeed_tpu.compression.compress import (CompressionSpec,
+                                                init_compression,
+                                                redundancy_clean)
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+__all__ = ["CompressionSpec", "CompressionConfig", "CompressionScheduler",
+           "init_compression", "redundancy_clean"]

@@ -1,0 +1,167 @@
+"""Compression entry points + the params transform.
+
+Parity: reference ``compression/compress.py`` (``init_compression``: walk
+model, wrap matched modules in *_Compress layers; ``redundancy_clean``:
+physically remove pruned structures after training) and
+``compression/scheduler.py`` hookup in the engine (``engine.py:1401``).
+
+TPU design: ``init_compression`` compiles the config into a
+``CompressionSpec`` — a list of (leaf-matcher, transform) pairs.  The spec's
+``transform(params, step)`` runs INSIDE the jitted train step: each matched
+leaf goes through STE fake-quant/pruning, gated on
+``step >= schedule_offset`` with ``jnp.where`` so the same compiled program
+covers warmup and compression phases.
+"""
+
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression import transforms as T
+from deepspeed_tpu.compression.config import (ACTIVATION_QUANTIZATION,
+                                              CHANNEL_PRUNING,
+                                              CompressionConfig,
+                                              HEAD_PRUNING, ROW_PRUNING,
+                                              SPARSE_PRUNING,
+                                              WEIGHT_QUANTIZATION)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _glob_to_regex(pat: str) -> str:
+    if pat == "*":
+        return r".*"
+    return ".*".join(re.escape(p) for p in pat.split("*"))
+
+
+class CompressionSpec:
+    """Compiled compression plan over a params pytree."""
+
+    def __init__(self, config: CompressionConfig, num_heads: Optional[int] = None):
+        self.config = config
+        self.num_heads = num_heads
+        self.groups = config.groups
+
+    # ------------------------------------------------------------------
+    def _leaf_transform(self, group, leaf, step):
+        m, p = group.method, group.params
+        enabled = step >= group.schedule_offset
+        if m == WEIGHT_QUANTIZATION:
+            bits = int(p.get("target_bits", p.get("bits", 8)))
+            out = T.quantize_weight(
+                leaf, bits=bits,
+                groups=int(group.shared.get("quantize_groups", 1)),
+                symmetric=group.shared.get("quantization_type",
+                                           "symmetric") == "symmetric")
+        elif m == SPARSE_PRUNING:
+            out = T.sparse_prune(leaf, float(p.get("dense_ratio", 0.5)),
+                                 method=group.shared.get("method", "l1"))
+        elif m == ROW_PRUNING:
+            out = T.row_prune(leaf, float(p.get("dense_ratio", 0.5)))
+        elif m == HEAD_PRUNING:
+            heads = int(p.get("num_heads",
+                              group.shared.get("num_heads",
+                                               self.num_heads or 0)))
+            if heads <= 1 or leaf.ndim < 2 or leaf.shape[-2] % heads:
+                return leaf
+            out = T.head_prune(leaf, heads, float(p.get("dense_ratio", 0.5)))
+        elif m == CHANNEL_PRUNING:
+            out = T.channel_prune(leaf, float(p.get("dense_ratio", 0.5)))
+        else:
+            return leaf
+        return jnp.where(enabled, out, leaf)
+
+    def _matches(self, group, path: str, leaf) -> bool:
+        if np.ndim(leaf) < 2:
+            return False            # norms/biases are never compressed
+        return any(re.search(_glob_to_regex(mod), path)
+                   for mod in group.modules)
+
+    def transform(self, params, step):
+        """params → compressed params (jit-traceable; ``step`` may be traced)."""
+        step = jnp.asarray(step, jnp.int32)
+
+        def visit(path, leaf):
+            key = jax.tree_util.keystr(path)
+            for group in self.groups:
+                if group.method == ACTIVATION_QUANTIZATION:
+                    continue       # handled at activation sites, not params
+                if self._matches(group, key, leaf):
+                    leaf = self._leaf_transform(group, leaf, step)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    # activation quantization parameters for model-side use --------------
+    def activation_bits(self) -> Optional[int]:
+        for g in self.groups:
+            if g.method == ACTIVATION_QUANTIZATION:
+                return int(g.params.get("bits", 8))
+        return None
+
+
+def init_compression(model_or_params, deepspeed_config,
+                     teacher_model=None, mpu=None) -> CompressionSpec:
+    """Parity: reference ``init_compression(model, deepspeed_config)``.
+    Accepts the engine's parsed config, a raw ``compression_training`` dict,
+    or a JSON path."""
+    cfg = _coerce_config(deepspeed_config)
+    num_heads = None
+    model_cfg = getattr(model_or_params, "config", None)
+    if model_cfg is not None:
+        num_heads = getattr(model_cfg, "n_heads", None)
+    spec = CompressionSpec(cfg, num_heads=num_heads)
+    if cfg.enabled:
+        logger.info(f"compression enabled: {len(cfg.groups)} group(s), "
+                    f"layer_reduction={cfg.layer_reduction.enabled}")
+    return spec
+
+
+def _coerce_config(deepspeed_config) -> CompressionConfig:
+    if isinstance(deepspeed_config, CompressionConfig):
+        return deepspeed_config
+    if isinstance(deepspeed_config, str):
+        import json
+        with open(deepspeed_config) as f:
+            deepspeed_config = json.load(f)
+    if isinstance(deepspeed_config, dict):
+        return CompressionConfig(
+            deepspeed_config.get("compression_training", deepspeed_config))
+    # engine-parsed DeepSpeedConfig
+    return CompressionConfig(getattr(deepspeed_config, "compression_config",
+                                     {}))
+
+
+# ----------------------------------------------------------------------
+# redundancy_clean: physically remove pruned structure
+# ----------------------------------------------------------------------
+def redundancy_clean(params, deepspeed_config, mpu=None):
+    """Parity: reference ``redundancy_clean`` — after compressed training,
+    make the compression real: bake STE fake-quant values in, drop layers
+    per ``layer_reduction`` (student keeps ``teacher_layer`` indices), and
+    hard-zero pruned weights.
+
+    Works on stacked-layer pytrees (leaves with a leading n_layers dim).
+    """
+    cfg = _coerce_config(deepspeed_config)
+    spec = CompressionSpec(cfg)
+    # bake at a step past every offset so every transform is active
+    max_off = max([g.schedule_offset for g in cfg.groups], default=0)
+    params = jax.tree_util.tree_map(np.asarray,
+                                    spec.transform(params, max_off + 1))
+
+    lr = cfg.layer_reduction
+    if lr.enabled and lr.teacher_layer:
+        keep = np.asarray(sorted(int(i) for i in lr.teacher_layer))
+
+        def slice_layers(tree):
+            return jax.tree_util.tree_map(lambda x: x[keep], tree)
+        if isinstance(params, dict) and "layers" in params:
+            if isinstance(params["layers"], (list, tuple)):
+                params["layers"] = [params["layers"][i] for i in keep]
+            else:
+                params["layers"] = slice_layers(params["layers"])
+            logger.info(f"layer_reduction: kept layers {keep.tolist()}")
+    return params

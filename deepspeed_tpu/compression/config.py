@@ -1,0 +1,78 @@
+"""Compression config parsing.
+
+Parity: reference ``compression/config.py`` + ``compression/constants.py`` —
+the ``compression_training`` JSON section with per-method
+``shared_parameters`` / ``different_groups`` (weight_quantization,
+activation_quantization, sparse_pruning, row_pruning, head_pruning,
+channel_pruning, layer_reduction).  Keys keep reference spellings.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+ACTIVATION_QUANTIZATION = "activation_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+LAYER_REDUCTION = "layer_reduction"
+
+PRUNING_METHODS = (SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING)
+
+
+class CompressionGroup:
+    """One entry of ``different_groups``: parameter patterns + method params."""
+
+    def __init__(self, name: str, method: str, modules: List[str],
+                 params: Dict[str, Any], shared: Dict[str, Any]):
+        self.name = name
+        self.method = method
+        self.modules = modules or ["*"]
+        self.params = params or {}
+        self.shared = shared or {}
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared.get("schedule_offset", 0))
+
+    def __repr__(self):
+        return (f"CompressionGroup({self.method}:{self.name} "
+                f"modules={self.modules} params={self.params})")
+
+
+class LayerReductionConfig(DeepSpeedConfigModel):
+    enabled = False
+    keep_number_layer = None
+    module_name_prefix = ""
+    teacher_layer = []
+    other_module_name = []
+
+
+class CompressionConfig:
+    """Parses the full ``compression_training`` dict into a group list."""
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        pd = dict(param_dict or {})
+        self.groups: List[CompressionGroup] = []
+        self.layer_reduction = LayerReductionConfig(
+            pd.get(LAYER_REDUCTION, {}))
+        for method in (WEIGHT_QUANTIZATION, ACTIVATION_QUANTIZATION) + \
+                PRUNING_METHODS:
+            section = pd.get(method, {})
+            shared = section.get("shared_parameters", {})
+            if not shared.get("enabled", False):
+                continue
+            diff = section.get("different_groups", {})
+            if not diff:
+                self.groups.append(CompressionGroup(
+                    method, method, ["*"], {}, shared))
+            for gname, g in diff.items():
+                self.groups.append(CompressionGroup(
+                    gname, method, g.get("modules", ["*"]),
+                    g.get("params", {}), shared))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.groups) or self.layer_reduction.enabled

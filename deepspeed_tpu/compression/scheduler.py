@@ -1,0 +1,32 @@
+"""Compression scheduler.
+
+Parity: reference ``compression/scheduler.py`` (``compression_scheduler``:
+per-step check that flips each method on at its ``schedule_offset``; the
+engine calls it every step, ``engine.py:1401``).
+
+TPU design: the on/off gating is *traced* into the train step
+(``CompressionSpec.transform`` gates on the step counter), so this class is
+the host-side bookkeeping/reporting view of the same schedule.
+"""
+
+from deepspeed_tpu.compression.compress import CompressionSpec
+from deepspeed_tpu.utils.logging import logger
+
+
+class CompressionScheduler:
+
+    def __init__(self, spec: CompressionSpec):
+        self.spec = spec
+        self._announced = set()
+
+    def check(self, global_step: int):
+        """Host-side step hook (reference ``step()``): logs phase changes."""
+        for g in self.spec.groups:
+            if g.name in self._announced:
+                continue
+            if global_step >= g.schedule_offset:
+                self._announced.add(g.name)
+                logger.info(f"compression active from step {global_step}: "
+                            f"{g.method}/{g.name} {g.params}")
+
+    step = check

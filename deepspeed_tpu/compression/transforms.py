@@ -1,0 +1,147 @@
+"""Compression primitives: STE fake-quantization and magnitude pruning.
+
+Parity: reference ``compression/basic_layer.py`` (``LinearLayer_Compress``
+with sparse/row/head/channel pruning + weight quantization under a
+straight-through estimator, ``QuantAct`` activation quantization,
+``Embedding_Compress``) and ``compression/utils.py`` (TopKBinarizer,
+Symmetric/AsymmetricQuantizer).
+
+TPU design: the reference subclasses ``nn.Linear`` and mutates weights in
+``forward``; here compression is a pure params→params transform applied
+inside the jitted train step.  The STE is the classic
+``x + stop_gradient(q(x) - x)`` identity — forward sees the quantized value,
+backward sees identity — so no custom VJP machinery is needed and XLA fuses
+the fake-quant into the consuming matmul.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ste(x, qx):
+    """Straight-through estimator."""
+    return x + lax.stop_gradient(qx - x)
+
+
+# ----------------------------------------------------------------------
+# quantizers (reference SymmetricQuantizer / AsymmetricQuantizer)
+# ----------------------------------------------------------------------
+def quantize_weight(w, bits: int = 8, groups: int = 1,
+                    symmetric: bool = True, stochastic: bool = False,
+                    rng=None):
+    """Group-wise fake quantization with STE.
+
+    ``groups`` splits the flattened tensor into quantization groups with
+    independent scales (reference ``quantize_groups``); ``stochastic``
+    rounds stochastically (reference ``ds_sr_quantize``).
+    """
+    orig_shape = w.shape
+    flat = w.reshape(groups, -1)
+    levels = 2 ** (bits - 1)
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / (levels - 1)
+        scale = jnp.maximum(scale, 1e-8)
+        q = flat / scale
+        q = _round(q, stochastic, rng)
+        q = jnp.clip(q, -levels, levels - 1) * scale
+    else:
+        mn = jnp.min(flat, axis=1, keepdims=True)
+        mx = jnp.max(flat, axis=1, keepdims=True)
+        scale = jnp.maximum((mx - mn) / (2 ** bits - 1), 1e-8)
+        q = (flat - mn) / scale
+        q = _round(q, stochastic, rng)
+        q = jnp.clip(q, 0, 2 ** bits - 1) * scale + mn
+    return _ste(flat, q).reshape(orig_shape)
+
+
+def _round(x, stochastic, rng):
+    if stochastic:
+        assert rng is not None, "stochastic rounding needs rng"
+        return jnp.floor(x + jax.random.uniform(rng, x.shape))
+    return jnp.round(x)
+
+
+def quantize_activation(x, bits: int = 8, symmetric: bool = False,
+                        static_range: Optional[float] = None):
+    """Activation fake-quant (reference ``QuantAct``); dynamic per-tensor
+    range by default, static range when calibrated."""
+    if static_range is not None:
+        mx = jnp.asarray(static_range, x.dtype)
+        mn = -mx
+    else:
+        mx = jnp.max(x)
+        mn = jnp.min(x)
+    if symmetric:
+        levels = 2 ** (bits - 1)
+        scale = jnp.maximum(jnp.maximum(jnp.abs(mx), jnp.abs(mn)) /
+                            (levels - 1), 1e-8)
+        q = jnp.clip(jnp.round(x / scale), -levels, levels - 1) * scale
+    else:
+        scale = jnp.maximum((mx - mn) / (2 ** bits - 1), 1e-8)
+        q = jnp.clip(jnp.round((x - mn) / scale), 0, 2 ** bits - 1) * scale + mn
+    return _ste(x, q)
+
+
+# ----------------------------------------------------------------------
+# pruning (reference TopKBinarizer + *_pruning in LinearLayer_Compress)
+# ----------------------------------------------------------------------
+def _topk_mask(scores, dense_ratio):
+    """1.0 for the top ``dense_ratio`` fraction by score, else 0.0."""
+    flat = scores.reshape(-1)
+    k = jnp.maximum(1, jnp.round(dense_ratio * flat.shape[0])).astype(jnp.int32)
+    order = jnp.argsort(flat)[::-1]
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(flat.shape[0]))
+    return (ranks < k).astype(scores.dtype).reshape(scores.shape)
+
+
+def sparse_prune(w, dense_ratio: float = 0.5, method: str = "l1"):
+    """Unstructured magnitude pruning with STE (reference sparse_pruning;
+    ``method='l1'`` |w|, ``'topk'`` same ranking)."""
+    scores = jnp.abs(w)
+    mask = _topk_mask(scores, dense_ratio)
+    return _ste(w, w * mask)
+
+
+def row_prune(w, dense_ratio: float = 0.5, axis: int = -1):
+    """Structured output-row pruning: ranks rows (slices of ``axis``) by L1
+    norm (reference row_pruning on nn.Linear output rows)."""
+    reduce_axes = tuple(a for a in range(w.ndim) if a != axis % w.ndim)
+    scores = jnp.sum(jnp.abs(w), axis=reduce_axes, keepdims=False)
+    mask1d = _topk_mask(scores, dense_ratio)
+    shape = [1] * w.ndim
+    shape[axis % w.ndim] = w.shape[axis % w.ndim]
+    return _ste(w, w * mask1d.reshape(shape))
+
+
+def head_prune(w, num_heads: int, dense_ratio: float = 0.5):
+    """Attention head pruning: ranks head blocks of the output projection's
+    input dim by L1 norm (reference head_pruning on attention.output.dense).
+    ``w``: [..., H*dh, d]."""
+    in_dim = w.shape[-2]
+    dh = in_dim // num_heads
+    blocks = w.reshape(w.shape[:-2] + (num_heads, dh, w.shape[-1]))
+    reduce_axes = tuple(a for a in range(blocks.ndim)
+                        if a != blocks.ndim - 3)
+    scores = jnp.sum(jnp.abs(blocks), axis=reduce_axes)
+    mask = _topk_mask(scores, dense_ratio)          # [H]
+    shape = [1] * blocks.ndim
+    shape[blocks.ndim - 3] = num_heads
+    masked = blocks * mask.reshape(shape)
+    return _ste(w, masked.reshape(w.shape))
+
+
+def channel_prune(w, dense_ratio: float = 0.5):
+    """Conv-style channel pruning: ranks output channels (dim 0)."""
+    return row_prune(w, dense_ratio, axis=0)
+
+
+def embedding_quantize(e, bits: int = 8):
+    """Embedding_Compress: per-row symmetric quantization."""
+    levels = 2 ** (bits - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(e), axis=-1, keepdims=True) /
+                        (levels - 1), 1e-8)
+    q = jnp.clip(jnp.round(e / scale), -levels, levels - 1) * scale
+    return _ste(e, q)

@@ -163,6 +163,17 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
         checkpointing.configure(deepspeed_config=config)
 
+        # compression (reference engine.py:1401 compression_scheduler hookup)
+        self._compression = None
+        self.compression_scheduler = None
+        if config.compression_config:
+            from deepspeed_tpu.compression import (CompressionScheduler,
+                                                   init_compression)
+            spec = init_compression(model, config)
+            if spec.config.enabled:
+                self._compression = spec
+                self.compression_scheduler = CompressionScheduler(spec)
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size,
@@ -346,12 +357,14 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # the compiled step
     # ------------------------------------------------------------------
-    def _loss_and_grads(self, params, loss_scale, batch, rng):
+    def _loss_and_grads(self, params, loss_scale, batch, rng, step=None):
         """value_and_grad of the (possibly loss-scaled) compute-dtype loss."""
         def scaled_loss(p):
             p_c = jax.tree_util.tree_map(
                 lambda x: x.astype(self.compute_dtype)
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+            if self._compression is not None and step is not None:
+                p_c = self._compression.transform(p_c, step)
             loss = self.loss_fn(p_c, batch, rng)
             return (loss * loss_scale).astype(jnp.float32), loss
 
@@ -410,7 +423,8 @@ class DeepSpeedEngine:
             overflow=overflow)
         return new_state, metrics
 
-    def _forward_grads(self, params, scale, step_rng, batch, gas: int):
+    def _forward_grads(self, params, scale, step_rng, batch, gas: int,
+                       step=None):
         """GAS microbatch accumulation (``lax.scan``) shared by the fused and
         the offload step builders (reference: one grad-accumulation semantic,
         ``backward:1931`` scaling by 1/GAS)."""
@@ -419,7 +433,8 @@ class DeepSpeedEngine:
                 idx, mb = inp
                 acc, rloss = carry
                 mb_rng = jax.random.fold_in(step_rng, idx)
-                loss, grads = self._loss_and_grads(params, scale, mb, mb_rng)
+                loss, grads = self._loss_and_grads(params, scale, mb, mb_rng,
+                                                   step=step)
                 acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 return (acc, rloss + loss), None
 
@@ -430,7 +445,7 @@ class DeepSpeedEngine:
                 (jnp.arange(gas), batch))
             grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
             return lsum / gas, grads
-        return self._loss_and_grads(params, scale, batch, step_rng)
+        return self._loss_and_grads(params, scale, batch, step_rng, step=step)
 
     def _build_train_step(self, gas: int):
         cfg = self._config
@@ -440,7 +455,8 @@ class DeepSpeedEngine:
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             rng, step_rng = jax.random.split(state.rng)
             loss, grads = self._forward_grads(state.params, scale, step_rng,
-                                              batch, gas)
+                                              batch, gas,
+                                              step=state.global_step)
             # ZeRO grad placement: stage>=2 spec is fsdp-sharded → XLA lowers
             # the DP reduction as reduce-scatter (reference average_tensor /
             # __reduce_and_partition_ipg_grads)
@@ -465,7 +481,8 @@ class DeepSpeedEngine:
                 scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
                 rng, step_rng = jax.random.split(state.rng)
                 loss, grads = self._forward_grads(state.params, scale,
-                                                  step_rng, batch, gas)
+                                                  step_rng, batch, gas,
+                                                  step=state.global_step)
                 grads = constrain(grads, self.plan.grad_specs(state.params),
                                   self.mesh)
                 overflow = (has_inf_or_nan(grads) if fp16
@@ -526,7 +543,8 @@ class DeepSpeedEngine:
                          if self._config.fp16_enabled else jnp.float32(1.0))
                 rng, step_rng = jax.random.split(state.rng)
                 loss, grads = self._loss_and_grads(state.params, scale, batch,
-                                                   step_rng)
+                                                   step_rng,
+                                                   step=state.global_step)
                 grads = constrain(grads, self.plan.grad_specs(state.params),
                                   self.mesh)
                 overflow = (has_inf_or_nan(grads)
@@ -619,6 +637,8 @@ class DeepSpeedEngine:
             else:
                 batch = micro_batches[0]
         self.tput_timer.start()
+        if self.compression_scheduler is not None:
+            self.compression_scheduler.check(self.global_steps)
         batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
         self._maybe_profile_flops(batch, gas)
         if self._offload is not None:

@@ -1,0 +1,150 @@
+"""Compression library tests.
+
+Parity model: reference ``tests/unit/compression/test_compression.py``
+(LinearLayer_Compress quant/prune behaviour, init_compression config
+parsing, redundancy_clean).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionConfig, init_compression,
+                                       redundancy_clean)
+from deepspeed_tpu.compression import transforms as T
+from unit.simple_model import SimpleModel, base_config, random_batch
+
+HIDDEN = 16
+
+
+# ----------------------------------------------------------------------
+# primitive transforms
+# ----------------------------------------------------------------------
+def test_quantize_weight_levels_and_ste():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(32, 32)), jnp.float32)
+    q = T.quantize_weight(w, bits=4, groups=4)
+    # 4-bit symmetric → at most 16 distinct values per group
+    per_group = np.asarray(q).reshape(4, -1)
+    for g in per_group:
+        assert len(np.unique(np.round(g, 6))) <= 16
+    # STE: gradient of sum(q(w)) w.r.t. w is all-ones (identity backward)
+    grad = jax.grad(lambda w: jnp.sum(T.quantize_weight(w, bits=4)))(w)
+    np.testing.assert_allclose(np.asarray(grad), 1.0)
+
+
+def test_quantize_asymmetric_preserves_range():
+    w = jnp.asarray(np.linspace(0.0, 1.0, 64), jnp.float32)
+    q = np.asarray(T.quantize_weight(w, bits=8, symmetric=False))
+    assert abs(q.min() - 0.0) < 1e-2 and abs(q.max() - 1.0) < 1e-2
+
+
+def test_sparse_prune_ratio():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+    out = np.asarray(T.sparse_prune(w, dense_ratio=0.25))
+    nnz = np.count_nonzero(out)
+    assert abs(nnz / out.size - 0.25) < 0.01
+    # survivors are the largest-magnitude entries
+    thresh = np.quantile(np.abs(np.asarray(w)), 0.75)
+    assert np.all(np.abs(out[out != 0]) >= thresh - 1e-6)
+
+
+def test_row_and_head_prune_structured():
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+    out = np.asarray(T.row_prune(w, dense_ratio=0.5, axis=-1))
+    col_nnz = np.count_nonzero(np.abs(out).sum(axis=0))
+    assert col_nnz == 4
+    w2 = jnp.asarray(rng.normal(size=(4 * 4, 8)), jnp.float32)  # H=4, dh=4
+    out2 = np.asarray(T.head_prune(w2, num_heads=4, dense_ratio=0.5))
+    blocks = out2.reshape(4, 4, 8)
+    alive = [i for i in range(4) if np.abs(blocks[i]).sum() > 0]
+    assert len(alive) == 2
+
+
+def test_activation_quantization():
+    x = jnp.asarray(np.linspace(-2, 2, 100), jnp.float32)
+    q = np.asarray(T.quantize_activation(x, bits=8))
+    assert np.max(np.abs(q - np.asarray(x))) < 0.05
+
+
+# ----------------------------------------------------------------------
+# config → spec → transform
+# ----------------------------------------------------------------------
+CFG = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "quantize_groups": 1,
+                              "quantization_type": "symmetric",
+                              "schedule_offset": 2},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 8, "target_bits": 8},
+                    "modules": ["layer_0"]}},
+    },
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "method": "l1",
+                              "schedule_offset": 0},
+        "different_groups": {
+            "sp1": {"params": {"dense_ratio": 0.5},
+                    "modules": ["layer_1"]}},
+    },
+}
+
+
+def test_config_parsing():
+    cc = CompressionConfig(CFG)
+    assert cc.enabled and len(cc.groups) == 2
+    methods = {g.method for g in cc.groups}
+    assert methods == {"weight_quantization", "sparse_pruning"}
+
+
+def test_spec_schedule_gating():
+    spec = init_compression(None, {"compression_training": CFG})
+    params = {"layer_0": {"w": jnp.asarray(
+        np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)},
+        "layer_1": {"w": jnp.asarray(
+            np.random.default_rng(1).normal(size=(8, 8)), jnp.float32)}}
+    # step 0: quant group (offset 2) inactive, sparse group (offset 0) active
+    out0 = spec.transform(params, 0)
+    np.testing.assert_array_equal(np.asarray(out0["layer_0"]["w"]),
+                                  np.asarray(params["layer_0"]["w"]))
+    assert np.count_nonzero(np.asarray(out0["layer_1"]["w"])) == 32
+    # step 5: both active
+    out5 = spec.transform(params, 5)
+    assert not np.array_equal(np.asarray(out5["layer_0"]["w"]),
+                              np.asarray(params["layer_0"]["w"]))
+
+
+def test_redundancy_clean_layer_reduction():
+    cfg = {"compression_training": {
+        "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                            "teacher_layer": [0, 2]}}}
+    params = {"layers": {"w": np.arange(4 * 3, dtype=np.float32).reshape(4, 3)},
+              "final_norm": np.ones(3, np.float32)}
+    out = redundancy_clean(params, cfg)
+    assert out["layers"]["w"].shape == (2, 3)
+    np.testing.assert_array_equal(out["layers"]["w"][1],
+                                  params["layers"]["w"][2])
+
+
+# ----------------------------------------------------------------------
+# engine integration
+# ----------------------------------------------------------------------
+def test_engine_compressed_training():
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(compression_training={
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "schedule_offset": 1},
+                "different_groups": {
+                    "all": {"params": {"target_bits": 8},
+                            "modules": ["*"]}}}}))
+    assert engine._compression is not None
+    losses = [float(engine.train_batch(batch=random_batch(8, HIDDEN, seed=0)))
+              for _ in range(6)]
+    assert losses[-1] < losses[0]  # trains through the phase flip
