@@ -196,6 +196,10 @@ class DeepSpeedConfig:
 
         self.elasticity_enabled = bool(pd.get(C.ELASTICITY, {}).get("enabled", False))
         self.data_efficiency_config = pd.get(C.DATA_EFFICIENCY, {})
+        self.curriculum_learning_config = pd.get(C.CURRICULUM_LEARNING_LEGACY, {})
+        self.progressive_layer_drop_config = pd.get(
+            "progressive_layer_drop", {})
+        self.eigenvalue_config = pd.get("eigenvalue", {})
         self.compression_config = pd.get(C.COMPRESSION_TRAINING, {})
         self.pipeline_config = pd.get(C.PIPELINE, {})
 

@@ -1,0 +1,15 @@
+"""Data-efficiency pipeline (reference ``runtime/data_pipeline/``)."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
+    CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    RandomLTDScheduler, random_ltd_layer)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import \
+    DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, make_builder, make_dataset)
+
+__all__ = ["CurriculumScheduler", "DataAnalyzer", "RandomLTDScheduler",
+           "random_ltd_layer", "DeepSpeedDataSampler", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder", "make_builder", "make_dataset"]

@@ -1,0 +1,75 @@
+"""Curriculum learning scheduler.
+
+Parity: reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler``: difficulty schedules ``fixed_linear``,
+``fixed_root``, ``fixed_discrete``, ``custom``) used for seqlen curriculum
+(legacy ``curriculum_learning`` config) and by the data sampler for
+difficulty-based example selection.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+CURRICULUM_LEARNING_MIN_DIFFICULTY = "min_difficulty"
+CURRICULUM_LEARNING_MAX_DIFFICULTY = "max_difficulty"
+CURRICULUM_LEARNING_SCHEDULE_TYPE = "schedule_type"
+CURRICULUM_LEARNING_SCHEDULE_CONFIG = "schedule_config"
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict[str, Any]):
+        self.state = dict(config)
+        self.schedule_type = config.get(CURRICULUM_LEARNING_SCHEDULE_TYPE,
+                                        FIXED_LINEAR)
+        self.min_difficulty = int(config.get(
+            CURRICULUM_LEARNING_MIN_DIFFICULTY, 8))
+        self.max_difficulty = int(config.get(
+            CURRICULUM_LEARNING_MAX_DIFFICULTY, 1024))
+        self.sc = dict(config.get(CURRICULUM_LEARNING_SCHEDULE_CONFIG, {}))
+        self.custom_fn: Optional[Callable[[int], int]] = None
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+        if self.schedule_type == FIXED_DISCRETE:
+            assert "difficulty" in self.sc and "max_step" in self.sc, \
+                "fixed_discrete needs schedule_config.difficulty + max_step"
+            assert len(self.sc["difficulty"]) == len(self.sc["max_step"]) + 1
+        elif self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in self.sc, \
+                f"{self.schedule_type} needs schedule_config.total_curriculum_step"
+            self.sc.setdefault("difficulty_step", 8)
+            if self.schedule_type == FIXED_ROOT:
+                self.sc.setdefault("root_degree", 2)
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_fn = fn
+        self.schedule_type = CUSTOM
+
+    # ------------------------------------------------------------------
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == CUSTOM:
+            assert self.custom_fn is not None
+            return int(self.custom_fn(global_steps))
+        if self.schedule_type == FIXED_DISCRETE:
+            for diff, until in zip(self.sc["difficulty"], self.sc["max_step"]):
+                if global_steps <= until:
+                    return int(diff)
+            return int(self.sc["difficulty"][-1])
+        total = self.sc["total_curriculum_step"]
+        frac = min(1.0, max(0.0, global_steps / total))
+        if self.schedule_type == FIXED_ROOT:
+            frac = frac ** (1.0 / self.sc["root_degree"])
+        diff = self.min_difficulty + frac * (self.max_difficulty -
+                                             self.min_difficulty)
+        step = self.sc["difficulty_step"]
+        diff = int(diff // step * step)
+        return max(self.min_difficulty, min(self.max_difficulty, diff))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty

@@ -1,0 +1,75 @@
+"""Offline data analyzer — builds difficulty indexes for curriculum sampling.
+
+Parity: reference ``runtime/data_pipeline/data_sampling/data_analyzer.py``
+(``DataAnalyzer``: map metric functions over the dataset in parallel
+workers, write per-metric ``sample_to_metric`` / ``metric_to_sample``
+indexed files, then ``index_to_sample_percentile_merged``).
+
+TPU design: host-side numpy + the mmap indexed dataset; the output feeds
+``DeepSpeedDataSampler`` difficulties directly.
+"""
+
+import os
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder)
+from deepspeed_tpu.utils.logging import logger
+
+
+class DataAnalyzer:
+
+    def __init__(self, dataset: Sequence, metric_names: List[str],
+                 metric_functions: List[Callable], save_path: str,
+                 num_workers: int = 1, worker_id: int = 0,
+                 metric_types: List[str] = None):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = metric_names
+        self.metric_functions = metric_functions
+        self.metric_types = metric_types or ["single_value_per_sample"] * \
+            len(metric_names)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    def _prefix(self, name: str) -> str:
+        return os.path.join(self.save_path, f"{name}_sample_to_metric")
+
+    def run_map(self) -> Dict[str, np.ndarray]:
+        """Compute each metric over this worker's shard and persist."""
+        os.makedirs(self.save_path, exist_ok=True)
+        n = len(self.dataset)
+        my = range(self.worker_id, n, self.num_workers)
+        out: Dict[str, np.ndarray] = {}
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            vals = np.zeros(n, np.int64)
+            for i in my:
+                vals[i] = int(fn(self.dataset[i]))
+            out[name] = vals
+            if self.num_workers == 1:
+                b = MMapIndexedDatasetBuilder(self._prefix(name),
+                                              dtype=np.int64)
+                b.add_item(vals)
+                b.finalize()
+                logger.info(f"data_analyzer: wrote {self._prefix(name)}")
+        return out
+
+    def run_reduce(self, partials: List[Dict[str, np.ndarray]]
+                   ) -> Dict[str, np.ndarray]:
+        """Merge worker shards (element-wise max — shards are disjoint)."""
+        merged = {}
+        for name in self.metric_names:
+            acc = partials[0][name].copy()
+            for p in partials[1:]:
+                acc = np.maximum(acc, p[name])
+            merged[name] = acc
+            b = MMapIndexedDatasetBuilder(self._prefix(name), dtype=np.int64)
+            b.add_item(acc)
+            b.finalize()
+        return merged
+
+    def load_metric(self, name: str) -> np.ndarray:
+        return MMapIndexedDataset(self._prefix(name))[0]

@@ -1,0 +1,67 @@
+"""Random-LTD (layer token drop) — data routing.
+
+Parity: reference ``runtime/data_pipeline/data_routing/basic_layer.py:13``
+(``RandomLayerTokenDrop``: per-layer random token subset during training,
+full sequence in the reserved first/last layers) + ``scheduler.py``
+(``RandomLTDScheduler``: linear ramp of kept-token count) + the CUDA
+``random_ltd`` ops (token_sort/gather/scatter — ours: ``ops/random_ltd.py``
+jnp gather/scatter).
+
+TPU design: a functional wrapper — ``random_ltd_layer(layer_fn)`` gathers a
+random token subset, runs the layer on the short sequence, scatters results
+back; XLA sees static shapes because the kept count is scheduled on the host
+(one recompile per schedule milestone, amortised over many steps).
+"""
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.random_ltd import (sample_token_indices, token_gather,
+                                          token_scatter)
+
+
+class RandomLTDScheduler:
+    """Linear seqlen ramp (reference RandomLTDScheduler).
+
+    Config keys follow the reference ``random_ltd`` section:
+    ``total_layer_num``, ``random_ltd_layer_num``, ``random_ltd_layer_id``,
+    ``random_ltd_schedule``: {min_value, max_value, schedule_config:
+    {seq_per_step, require_steps}}.
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("random_ltd_schedule", {})
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 1024))
+        sc = sched.get("schedule_config", {})
+        self.seq_per_step = int(sc.get("seq_per_step", 16))
+        self.require_steps = int(sc.get("require_steps", 100))
+        self.layer_ids = config.get("random_ltd_layer_id", [])
+        self.current_seq = self.min_value
+
+    def get_current_seq(self, global_step: int) -> int:
+        inc = (global_step // self.require_steps) * self.seq_per_step
+        self.current_seq = min(self.max_value, self.min_value + inc)
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd.get("current_seq", self.min_value)
+
+
+def random_ltd_layer(layer_fn: Callable, x: jnp.ndarray, rng,
+                     keep_tokens: int, *args, **kwargs):
+    """Run ``layer_fn`` on a random ``keep_tokens`` subset of the sequence,
+    scattering the outputs back into the full-resolution residual stream
+    (dropped tokens pass through unchanged)."""
+    B, S = x.shape[0], x.shape[1]
+    if keep_tokens >= S:
+        return layer_fn(x, *args, **kwargs)
+    idx = sample_token_indices(rng, S, keep_tokens, batch=B)
+    short = token_gather(x, idx)
+    out_short = layer_fn(short, *args, **kwargs)
+    return token_scatter(x, out_short, idx)

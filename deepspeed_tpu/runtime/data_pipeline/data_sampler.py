@@ -1,0 +1,75 @@
+"""Curriculum-aware data sampler.
+
+Parity: reference ``runtime/data_pipeline/data_sampling/data_sampler.py:33``
+(``DeepSpeedDataSampler``: consults per-metric difficulty indexes built by
+the data analyzer, and at each step yields the global batch drawn from the
+pool of samples whose difficulty ≤ the curriculum's current threshold).
+"""
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
+    CurriculumScheduler
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedDataSampler:
+    """Iterates global-batch index lists.
+
+    ``difficulties``: per-sample difficulty values (one per dataset item) for
+    one metric (reference supports several; pass the composed metric).  The
+    eligible pool at step t is ``difficulty <= scheduler.difficulty(t)``;
+    shuffling is deterministic per epoch.
+    """
+
+    def __init__(self, total_samples: int, batch_size: int,
+                 difficulties: Optional[np.ndarray] = None,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 seed: int = 0, drop_last: bool = True):
+        self.total_samples = int(total_samples)
+        self.batch_size = int(batch_size)
+        self.difficulties = (np.asarray(difficulties)
+                             if difficulties is not None else None)
+        if self.difficulties is not None:
+            assert len(self.difficulties) == total_samples
+        self.curriculum = curriculum
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def state_dict(self) -> Dict:
+        return {"global_step": self.global_step, "epoch": self.epoch}
+
+    def load_state_dict(self, sd: Dict):
+        self.global_step = sd.get("global_step", 0)
+        self.epoch = sd.get("epoch", 0)
+
+    # ------------------------------------------------------------------
+    def _eligible(self) -> np.ndarray:
+        if self.curriculum is None or self.difficulties is None:
+            return np.arange(self.total_samples)
+        thresh = self.curriculum.update_difficulty(self.global_step)
+        pool = np.nonzero(self.difficulties <= thresh)[0]
+        if pool.size < self.batch_size:
+            # reference pads the pool with the easiest samples
+            order = np.argsort(self.difficulties)
+            pool = order[:self.batch_size]
+        return pool
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self.epoch)
+        while True:
+            pool = self._eligible()
+            batch = rng.choice(pool, size=self.batch_size,
+                               replace=pool.size < self.batch_size)
+            self.global_step += 1
+            yield batch.tolist()
+
+    def __len__(self):
+        return self.total_samples // self.batch_size

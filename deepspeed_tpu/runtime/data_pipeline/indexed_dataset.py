@@ -1,0 +1,102 @@
+"""Memory-mapped indexed dataset (Megatron format).
+
+Parity: reference ``runtime/data_pipeline/data_sampling/indexed_dataset.py``
+(``MMapIndexedDataset`` + builder: a ``.bin`` of concatenated sample arrays
+and a ``.idx`` with dtype/sizes/pointers), used by the data analyzer and
+sampler for out-of-core metric/index storage.
+
+TPU note: host-side numpy mmap — identical on any platform; the arrays feed
+``device_put`` directly.
+"""
+
+import os
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+           9: np.uint32, 10: np.uint64}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Append numpy arrays; ``finalize`` writes the index."""
+
+    def __init__(self, out_file: str, dtype=np.int32):
+        self._path = out_file
+        self._dtype = np.dtype(dtype)
+        self._bin = open(data_file_path(out_file), "wb")
+        self._sizes: List[int] = []
+
+    def add_item(self, array) -> None:
+        arr = np.asarray(array, self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def add_batch(self, arrays) -> None:
+        for a in arrays:
+            self.add_item(a)
+
+    def finalize(self) -> None:
+        self._bin.close()
+        sizes = np.asarray(self._sizes, np.int64)
+        pointers = np.concatenate([[0], np.cumsum(sizes[:-1])]) * \
+            self._dtype.itemsize
+        with open(index_file_path(self._path), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<B", _CODES[self._dtype]))
+            f.write(struct.pack("<q", len(sizes)))
+            f.write(sizes.tobytes())
+            f.write(pointers.astype(np.int64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Random access over the builder's output without loading the .bin."""
+
+    def __init__(self, prefix: str):
+        with open(index_file_path(prefix), "rb") as f:
+            assert f.read(8) == _MAGIC, f"bad index file {prefix}.idx"
+            (code,) = struct.unpack("<B", f.read(1))
+            (n,) = struct.unpack("<q", f.read(8))
+            self.dtype = np.dtype(_DTYPES[code])
+            self.sizes = np.frombuffer(f.read(8 * n), np.int64)
+            self.pointers = np.frombuffer(f.read(8 * n), np.int64)
+        self._data = np.memmap(data_file_path(prefix), mode="r",
+                               dtype=self.dtype)
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        off = self.pointers[idx] // self.dtype.itemsize
+        return np.asarray(self._data[off:off + self.sizes[idx]])
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None):
+        full = self[idx]
+        return full[offset:offset + length if length else None]
+
+    @property
+    def supports_prefetch(self) -> bool:
+        return False
+
+
+def make_builder(out_file, impl="mmap", dtype=np.int32):
+    assert impl in ("mmap", "cached", "lazy"), impl
+    return MMapIndexedDatasetBuilder(out_file, dtype=dtype)
+
+
+def make_dataset(prefix, impl="mmap", skip_warmup=True):
+    assert os.path.exists(index_file_path(prefix)), \
+        f"no index at {prefix}.idx"
+    return MMapIndexedDataset(prefix)

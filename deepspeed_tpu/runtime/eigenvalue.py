@@ -1,0 +1,91 @@
+"""Block Hessian eigenvalue estimation (MoQ quantization scheduling).
+
+Parity: reference ``runtime/eigenvalue.py`` (``Eigenvalue``: power iteration
+on per-block Hessians via double backward; the engine feeds the values to
+the quantizer to schedule per-layer quantization aggressiveness).
+
+TPU design: Hessian-vector products are a one-liner under jax
+(``jvp`` of ``grad``), so the power iteration is exact and jittable —
+no retain_graph bookkeeping.
+"""
+
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Eigenvalue:
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "layers", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    # ------------------------------------------------------------------
+    def _hvp(self, loss_fn: Callable, params, vec):
+        """Hessian-vector product: jvp of grad."""
+        grad_fn = jax.grad(loss_fn)
+        _, hv = jax.jvp(grad_fn, (params,), (vec,))
+        return hv
+
+    def compute_eigenvalue(self, loss_fn: Callable, params,
+                           rng=None) -> float:
+        """Largest Hessian eigenvalue of ``loss_fn(params)`` by power
+        iteration over the whole params block."""
+        rng = rng if rng is not None else jax.random.key(0)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v = jax.tree_util.tree_unflatten(
+            treedef, [jax.random.normal(k, jnp.shape(x), jnp.float32)
+                      for k, x in zip(keys, leaves)])
+
+        def normalize(t):
+            n = jnp.sqrt(sum(jnp.vdot(x, x)
+                             for x in jax.tree_util.tree_leaves(t)))
+            n = jnp.maximum(n, self.stability)
+            return jax.tree_util.tree_map(lambda x: x / n, t), n
+
+        v, _ = normalize(v)
+        eig = 0.0
+        for it in range(self.max_iter):
+            hv = self._hvp(loss_fn, params, v)
+            v, norm = normalize(hv)
+            new_eig = float(norm)
+            if eig and abs(new_eig - eig) / max(abs(eig), 1e-12) < self.tol:
+                eig = new_eig
+                break
+            eig = new_eig
+        return eig
+
+    def compute_layer_eigenvalues(self, loss_fn: Callable, params,
+                                  layer_params: List[Any],
+                                  rng=None) -> List[float]:
+        """Per-block eigenvalues: power-iterate with perturbations confined
+        to each block (other blocks' tangents zero) — the reference's
+        per-layer scheme."""
+        rng = rng if rng is not None else jax.random.key(0)
+        out = []
+        for i, block in enumerate(layer_params):
+            def block_loss(b):
+                # splice block back into params by object identity
+                def swap(leaf):
+                    return b if leaf is block else leaf
+                return loss_fn(jax.tree_util.tree_map(
+                    swap, params, is_leaf=lambda x: x is block))
+            out.append(self.compute_eigenvalue(
+                block_loss, block, jax.random.fold_in(rng, i)))
+        return out
+
+    def post_process(self, eigenvalues: List[float]) -> List[float]:
+        """Reference normalises by the max so the quantizer gets [0,1]."""
+        mx = max(eigenvalues) if eigenvalues else 1.0
+        return [e / mx if mx > 0 else 0.0 for e in eigenvalues]

@@ -163,6 +163,22 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
         checkpointing.configure(deepspeed_config=config)
 
+        # curriculum seqlen (reference engine.py:1820-1826) + PLD (:1646)
+        self.curriculum_scheduler_ = None
+        cl_cfg = config.curriculum_learning_config
+        if cl_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler \
+                import CurriculumScheduler
+            self.curriculum_scheduler_ = CurriculumScheduler(cl_cfg)
+        self.progressive_layer_drop = None
+        pld_cfg = config.progressive_layer_drop_config
+        if pld_cfg.get("enabled", False):
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5),
+                gamma=pld_cfg.get("gamma", 0.001))
+
         # compression (reference engine.py:1401 compression_scheduler hookup)
         self._compression = None
         self.compression_scheduler = None
@@ -639,6 +655,10 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         if self.compression_scheduler is not None:
             self.compression_scheduler.check(self.global_steps)
+        if self.curriculum_scheduler_ is not None:
+            batch = self._apply_curriculum(batch, leading_gas_dim=gas > 1)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
         self._maybe_profile_flops(batch, gas)
         if self._offload is not None:
@@ -667,6 +687,29 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True)
         self._write_monitor(metrics)
         return metrics.loss
+
+    def _apply_curriculum(self, batch, leading_gas_dim=False):
+        """Truncate sequences to the curriculum difficulty (reference
+        ``engine.py:1820-1826`` curriculum_seqlen slicing).  Each difficulty
+        milestone is a new static shape → one recompile, amortised over the
+        steps at that difficulty."""
+        seqlen = self.curriculum_scheduler_.update_difficulty(self.global_steps)
+        dim = 2 if leading_gas_dim else 1
+
+        def trunc(x):
+            if np.ndim(x) > dim and x.shape[dim] > seqlen:
+                slicer = [slice(None)] * np.ndim(x)
+                slicer[dim] = slice(0, seqlen)
+                return x[tuple(slicer)]
+            return x
+        return jax.tree_util.tree_map(trunc, batch)
+
+    def pld_enabled(self):
+        return self.progressive_layer_drop is not None
+
+    def pld_theta(self):
+        return (self.progressive_layer_drop.get_theta()
+                if self.progressive_layer_drop else 1.0)
 
     # subclass hooks: PipelineEngine preps (stacks) the batch and runs with
     # a leading microbatch dim — everything else is shared here.

@@ -1,0 +1,37 @@
+"""Progressive layer drop (PLD).
+
+Parity: reference ``runtime/progressive_layer_drop.py`` (``ProgressiveLayerDrop``:
+theta schedule theta(t) = (1 - theta_min) * gamma-decay + theta_min; engine
+``_configure_progressive_layer_drop:1646`` updates theta each step and models
+scale layer keep-probability by depth: p_l = 1 - l/L * (1 - theta)).
+"""
+
+import math
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        def _prob(x, gamma, p):
+            return (1.0 - p) * math.exp(-gamma * x) + p
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta
+
+    def layer_keep_prob(self, layer_idx: int, n_layers: int) -> float:
+        """Depth-scaled keep probability (deeper layers drop more)."""
+        return 1.0 - (layer_idx + 1) / n_layers * (1.0 - self.current_theta)

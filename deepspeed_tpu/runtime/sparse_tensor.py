@@ -1,0 +1,61 @@
+"""Sparse tensor support (sparse embedding-gradient reduction).
+
+Parity: reference ``runtime/sparse_tensor.py`` (``SparseTensor``: index/value
+COO wrapper built from torch sparse grads) + engine
+``sparse_allreduce_no_retain:2477`` (allgather indices+values across DP
+instead of dense allreduce).
+
+TPU design: embedding gradients under jax are dense by default; for very
+large vocabularies the win is reducing only the touched rows.  ``SparseTensor``
+carries (indices, values, dense_shape); ``sparse_grad_from_dense`` extracts
+touched rows; ``sparse_allreduce`` concatenates row sets across the dp axis
+(the allgather the reference does) and ``to_dense`` scatter-adds.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class SparseTensor:
+    """COO rows: ``indices`` [nnz] row ids, ``values`` [nnz, ...row shape]."""
+
+    def __init__(self, indices, values, dense_size: Tuple[int, ...]):
+        self.indices = jnp.asarray(indices)
+        self.values = jnp.asarray(values)
+        self.dense_size = tuple(dense_size)
+
+    @staticmethod
+    def from_dense(dense, max_rows: Optional[int] = None) -> "SparseTensor":
+        """Extract non-zero rows.  ``max_rows`` bounds nnz for static shapes
+        under jit (extra slots point at row 0 with zero values)."""
+        dense = jnp.asarray(dense)
+        row_nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        k = int(max_rows or dense.shape[0])
+        # top-k by nonzero flag gives the nonzero rows first (stable order)
+        _, idx = lax.top_k(row_nz.astype(jnp.int32), k)
+        vals = dense[idx] * row_nz[idx][(...,) + (None,) * (dense.ndim - 1)]
+        return SparseTensor(idx, vals, dense.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> int:
+        return int(self.indices.size + np.prod(self.values.shape))
+
+    def __repr__(self):
+        return (f"SparseTensor(nnz_rows={self.indices.shape[0]}, "
+                f"dense_size={self.dense_size})")
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str) -> SparseTensor:
+    """Inside shard_map: allgather row sets over the dp axis and average —
+    the reference's indices/values allgather (``sparse_allreduce:2492``)."""
+    world = lax.psum(1, axis_name)
+    all_idx = lax.all_gather(st.indices, axis_name, tiled=True)
+    all_val = lax.all_gather(st.values, axis_name, tiled=True) / world
+    return SparseTensor(all_idx, all_val, st.dense_size)
