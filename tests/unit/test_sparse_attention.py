@@ -1,0 +1,136 @@
+"""Sparse attention tests.
+
+Parity model: reference ``tests/unit/ops/sparse_attention/test_sparse_attention.py``
+(matmul/softmax vs dense reference under a block layout).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.attention import reference_attention
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                LocalSlidingWindowSparsityConfig,
+                                                SparseSelfAttention,
+                                                SparseAttentionUtils,
+                                                VariableSparsityConfig,
+                                                expand_layout_mask,
+                                                sparse_attention)
+
+H, BLOCK, NB = 4, 16, 8
+S = BLOCK * NB  # 128
+
+
+def _qkv(seed=0, d=8):
+    rng = np.random.default_rng(seed)
+    shp = (2, S, H, d)
+    return tuple(jnp.asarray(rng.normal(size=shp), jnp.float32)
+                 for _ in range(3))
+
+
+def test_dense_layout_matches_dense_attention():
+    q, k, v = _qkv()
+    layout = DenseSparsityConfig(H, BLOCK).make_layout(S)
+    out = sparse_attention(q, k, v, layout, BLOCK, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fixed_layout_structure():
+    cfg = FixedSparsityConfig(H, BLOCK, num_local_blocks=4,
+                              num_global_blocks=1,
+                              attention="unidirectional")
+    lay = cfg.make_layout(S)
+    assert lay.shape == (H, NB, NB)
+    # diagonal always attended; causal (no upper triangle)
+    for r in range(NB):
+        assert lay[0, r, r]
+        assert not lay[0, r, r + 1:].any()
+    # global column (last of each window) visible to later rows
+    assert lay[0, 7, 3]   # block 3 = global of window 0..3
+    # sparsity is real
+    assert lay[0].sum() < NB * NB * 0.7
+
+
+def test_fixed_layout_per_head_patterns():
+    cfg = FixedSparsityConfig(H, BLOCK, different_layout_per_head=True,
+                              num_local_blocks=4, num_global_blocks=1,
+                              num_different_global_patterns=4,
+                              attention="unidirectional")
+    lay = cfg.make_layout(S)
+    assert any(not np.array_equal(lay[0], lay[h]) for h in range(1, H))
+
+
+def test_bigbird_and_longformer_layouts():
+    bb = BigBirdSparsityConfig(H, BLOCK, num_random_blocks=1,
+                               num_sliding_window_blocks=3,
+                               num_global_blocks=1).make_layout(S)
+    # global first block row+col
+    assert bb[0, :, 0].all() and bb[0, 0, :].all()
+    # sliding window around diagonal
+    assert all(bb[0, r, r] for r in range(NB))
+
+    lf = BSLongformerSparsityConfig(
+        H, BLOCK, num_sliding_window_blocks=3,
+        global_block_indices=[0]).make_layout(S)
+    assert lf[0, :, 0].all() and lf[0, 0, :].all()
+    assert not lf[0, 2, 6]   # outside window, not global
+
+
+def test_sliding_window_causal():
+    cfg = LocalSlidingWindowSparsityConfig(H, BLOCK,
+                                           num_sliding_window_blocks=2,
+                                           attention="unidirectional")
+    lay = cfg.make_layout(S)
+    for r in range(NB):
+        cols = np.nonzero(lay[0, r])[0]
+        assert cols.max() == r and cols.min() == max(0, r - 1)
+
+
+def test_variable_layout_random_blocks():
+    cfg = VariableSparsityConfig(H, BLOCK, num_random_blocks=2,
+                                 local_window_blocks=[2, 4],
+                                 attention="bidirectional")
+    lay = cfg.make_layout(S)
+    assert lay[0].sum() > 0
+    # global col 0
+    assert lay[0, :, 0].all()
+
+
+def test_sparse_masks_attention_values():
+    """Tokens outside the layout must not influence the output."""
+    q, k, v = _qkv()
+    cfg = LocalSlidingWindowSparsityConfig(H, BLOCK,
+                                           num_sliding_window_blocks=1,
+                                           attention="unidirectional")
+    lay = cfg.make_layout(S)
+    out1 = sparse_attention(q, k, v, lay, BLOCK, causal=True)
+    # perturb keys/values far outside the window of the last block row
+    k2 = k.at[:, :BLOCK].set(99.0)
+    v2 = v.at[:, :BLOCK].set(99.0)
+    out2 = sparse_attention(q, k2, v2, lay, BLOCK, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, -BLOCK:]),
+                               np.asarray(out2[:, -BLOCK:]), rtol=1e-5)
+
+
+def test_sparse_self_attention_module_and_utils():
+    q, k, v = _qkv()
+    attn = SparseSelfAttention(FixedSparsityConfig(
+        H, BLOCK, attention="unidirectional"))
+    out = attn(q, k, v)
+    assert out.shape == q.shape
+    # layout cache reused
+    assert attn.get_layout(S) is attn.get_layout(S)
+
+    ids = jnp.ones((2, 100), jnp.int32)
+    pad, ids2, _, _ = SparseAttentionUtils.pad_to_block_size(
+        BLOCK, input_ids=ids)
+    assert pad == 12 and ids2.shape[1] == 112
+    unp = SparseAttentionUtils.unpad_sequence_output(
+        pad, jnp.zeros((2, 112, 4)))
+    assert unp.shape[1] == 100
