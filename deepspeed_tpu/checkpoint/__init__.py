@@ -1,0 +1,19 @@
+"""Checkpoint conversion tools (reference ``deepspeed/checkpoint/``)."""
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
+    DeepSpeedCheckpoint, load_checkpoint_tree, merge_pp_layer_shards,
+    merge_tp_shards, read_latest_tag, slice_tp_shards)
+from deepspeed_tpu.checkpoint.universal_checkpoint import (
+    ds_to_universal, load_hp_checkpoint_state, load_universal_checkpoint)
+from deepspeed_tpu.checkpoint.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint)
+
+__all__ = [
+    "DeepSpeedCheckpoint", "load_checkpoint_tree", "read_latest_tag",
+    "merge_tp_shards", "slice_tp_shards", "merge_pp_layer_shards",
+    "ds_to_universal", "load_universal_checkpoint",
+    "load_hp_checkpoint_state",
+    "convert_zero_checkpoint_to_fp32_state_dict",
+    "get_fp32_state_dict_from_zero_checkpoint",
+]

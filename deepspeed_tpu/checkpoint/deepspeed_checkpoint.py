@@ -1,0 +1,119 @@
+"""Checkpoint inspection and reshaping.
+
+Parity: reference ``checkpoint/deepspeed_checkpoint.py:39``
+(``DeepSpeedCheckpoint``: enumerate a saved checkpoint's TP/PP/DP layout and
+re-slice it to new degrees via ``reshape_meg_2d.py``/``reshape_3d_utils.py``).
+
+TPU design: our checkpoints are orbax pytrees of *whole* (logically global)
+arrays — sharding is applied at restore time, so changing dp/fsdp/tp/pp
+degrees needs no file rewriting (orbax reshards against the target
+shardings).  This class therefore (a) loads checkpoints for offline tools,
+and (b) offers ``merge_tp_shards``/``slice_tp_shards`` to interoperate with
+rank-sharded formats (importing Megatron-style per-rank files).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def read_latest_tag(ckpt_dir: str) -> Optional[str]:
+    latest = os.path.join(ckpt_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    return None
+
+
+def load_checkpoint_tree(ckpt_dir: str, tag: Optional[str] = None
+                         ) -> Dict[str, Any]:
+    """Restore a checkpoint as host numpy pytree (no mesh required)."""
+    import orbax.checkpoint as ocp
+    tag = tag or read_latest_tag(ckpt_dir)
+    assert tag is not None, f"no 'latest' file under {ckpt_dir}; pass tag="
+    path = os.path.join(os.path.abspath(ckpt_dir), tag, "state")
+    restored = ocp.StandardCheckpointer().restore(path)
+
+    def to_np(x):
+        try:
+            if jax.dtypes.issubdtype(getattr(x, "dtype", None),
+                                     jax.dtypes.prng_key):
+                return np.asarray(jax.random.key_data(x))
+        except TypeError:
+            pass
+        return np.asarray(x)
+    return jax.tree_util.tree_map(to_np, restored)
+
+
+class DeepSpeedCheckpoint:
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None,
+                 tp_degree: Optional[int] = None,
+                 pp_degree: Optional[int] = None,
+                 dp_degree: Optional[int] = None):
+        self.dir = ckpt_dir
+        self.tag = tag or read_latest_tag(ckpt_dir)
+        self.state = load_checkpoint_tree(ckpt_dir, self.tag)
+        self.client_state = {}
+        cs = os.path.join(ckpt_dir, self.tag or "", "client_state.json")
+        if os.path.exists(cs):
+            with open(cs) as f:
+                self.client_state = json.load(f)
+        # target degrees are advisory: resharding happens at restore
+        self.tp_degree = tp_degree or 1
+        self.pp_degree = pp_degree or 1
+        self.dp_degree = dp_degree or 1
+        self.global_state = {
+            "iteration": self.client_state.get("global_steps", 0)}
+
+    # ---- reference surface -------------------------------------------
+    @property
+    def params(self):
+        return self.state.get("params", self.state)
+
+    def get_iteration(self) -> int:
+        return int(self.global_state["iteration"])
+
+    def show_tp_degree(self):
+        logger.info(f"target tp_degree: {self.tp_degree}")
+
+    def validate_files(self):
+        path = os.path.join(self.dir, self.tag or "", "state")
+        assert os.path.exists(path), f"missing checkpoint state at {path}"
+
+
+# ----------------------------------------------------------------------
+# rank-sharded interop (reference reshape_meg_2d / merge utilities)
+# ----------------------------------------------------------------------
+def merge_tp_shards(shards: List[np.ndarray], partition_dim: int
+                    ) -> np.ndarray:
+    """Concatenate per-TP-rank weight shards into the whole tensor."""
+    return np.concatenate([np.asarray(s) for s in shards],
+                          axis=partition_dim)
+
+
+def slice_tp_shards(tensor: np.ndarray, tp_degree: int, partition_dim: int
+                    ) -> List[np.ndarray]:
+    """Whole tensor → per-TP-rank shards (inverse of merge_tp_shards)."""
+    assert tensor.shape[partition_dim] % tp_degree == 0, (
+        f"dim {partition_dim} ({tensor.shape[partition_dim]}) not divisible "
+        f"by tp={tp_degree}")
+    return [np.ascontiguousarray(s) for s in
+            np.split(tensor, tp_degree, axis=partition_dim)]
+
+
+def merge_pp_layer_shards(stage_layers: List[Dict[str, np.ndarray]]
+                          ) -> Dict[str, np.ndarray]:
+    """Stack per-PP-stage layer dicts (each with a leading layer dim) into
+    the full stacked-layer tree (reference reshape_3d merge along PP)."""
+    keys = stage_layers[0].keys()
+    out = {}
+    for k in keys:
+        out[k] = np.concatenate([np.asarray(s[k]) for s in stage_layers],
+                                axis=0)
+    return out

@@ -1,0 +1,89 @@
+"""Universal checkpoint format.
+
+Parity: reference ``checkpoint/universal_checkpoint.py:13``
+(``load_hp_checkpoint_state``) + the ds_to_universal flow: a
+topology-independent on-disk format (one fp32 file per parameter path) that
+any tp/pp/dp layout can be loaded from.
+
+TPU design: the universal format is a directory of ``.npy`` files keyed by
+flattened pytree path + ``universal_meta.json``.  ``ds_to_universal``
+converts an orbax checkpoint; ``load_universal_checkpoint`` rebuilds the
+params pytree (and the engine's standard loader reshards it onto whatever
+mesh is active).
+"""
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
+    load_checkpoint_tree, read_latest_tag)
+from deepspeed_tpu.utils.logging import logger
+
+META_NAME = "universal_meta.json"
+
+
+def _safe(key: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", key).strip("_")
+
+
+def ds_to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None,
+                    include_optimizer: bool = False) -> str:
+    """Convert a saved checkpoint into the universal layout."""
+    state = load_checkpoint_tree(ckpt_dir, tag)
+    tree = state.get("params", state)
+    if include_optimizer and "opt_state" in state:
+        tree = {"params": tree, "opt_state": state["opt_state"]}
+    os.makedirs(out_dir, exist_ok=True)
+    meta = {"keys": {}, "tag": tag or read_latest_tag(ckpt_dir)}
+
+    def visit(path, leaf):
+        key = jax.tree_util.keystr(path)
+        fname = _safe(key) + ".npy"
+        np.save(os.path.join(out_dir, fname),
+                np.asarray(leaf, np.float32)
+                if np.issubdtype(np.asarray(leaf).dtype, np.floating)
+                else np.asarray(leaf))
+        meta["keys"][key] = {"file": fname,
+                             "shape": list(np.shape(leaf)),
+                             "dtype": str(np.asarray(leaf).dtype)}
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    with open(os.path.join(out_dir, META_NAME), "w") as f:
+        json.dump(meta, f, indent=1)
+    logger.info(f"universal checkpoint: {len(meta['keys'])} tensors → "
+                f"{out_dir}")
+    return out_dir
+
+
+def load_universal_checkpoint(out_dir: str, template: Any = None):
+    """Rebuild the pytree.  With ``template``, files are matched to the
+    template's paths (missing keys raise); without, returns a flat
+    {path: array} dict."""
+    with open(os.path.join(out_dir, META_NAME)) as f:
+        meta = json.load(f)
+    flat = {k: np.load(os.path.join(out_dir, v["file"]))
+            for k, v in meta["keys"].items()}
+    if template is None:
+        return flat
+
+    def visit(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if key not in flat:
+            raise KeyError(f"universal checkpoint missing '{key}'")
+        arr = flat[key]
+        assert list(arr.shape) == list(np.shape(leaf)), \
+            f"shape mismatch for {key}: {arr.shape} vs {np.shape(leaf)}"
+        return arr.astype(np.asarray(leaf).dtype)
+
+    return jax.tree_util.tree_map_with_path(visit, template)
+
+
+# parity alias (reference function name)
+def load_hp_checkpoint_state(out_dir: str, template=None):
+    return load_universal_checkpoint(out_dir, template)

@@ -1,0 +1,86 @@
+"""Offline ZeRO-checkpoint → consolidated fp32 weights.
+
+Parity: reference ``deepspeed/utils/zero_to_fp32.py``
+(``convert_zero_checkpoint_to_fp32_state_dict`` /
+``get_fp32_state_dict_from_zero_checkpoint``) — the script users run to turn
+per-rank ZeRO shards into one loadable fp32 state dict.
+
+TPU design: orbax checkpoints restore as whole arrays, so consolidation is
+a host-side load + fp32 cast; the ZeRO-Offload host shard (``zero_offload_
+rank*.npz``) is preferred when present since it *is* the fp32 master.
+Runnable as a module: ``python -m deepspeed_tpu.checkpoint.zero_to_fp32
+<ckpt_dir> <out.npz>``.
+"""
+
+import argparse
+import glob
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
+    load_checkpoint_tree, read_latest_tag)
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
+                                             tag: Optional[str] = None
+                                             ) -> Dict[str, Any]:
+    tag = tag or read_latest_tag(ckpt_dir)
+    # ZeRO-Offload: the flat fp32 master on the host side is authoritative
+    off = sorted(glob.glob(os.path.join(ckpt_dir, tag or "",
+                                        "zero_offload_rank*.npz")))
+    state = load_checkpoint_tree(ckpt_dir, tag)
+    params = state.get("params", state)
+    params = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float32)
+        if np.issubdtype(np.asarray(x).dtype, np.floating)
+        else np.asarray(x), params)
+    if off:
+        from deepspeed_tpu.runtime.zero.offload import FlatLayout
+        with np.load(off[0]) as z:
+            master = z["master"]
+        lay = FlatLayout(params)
+        if lay.total == master.size:
+            params = lay.unflatten(master)
+            logger.info(f"consolidated from offload master {off[0]}")
+        else:
+            logger.warning(
+                f"offload master numel {master.size} != params {lay.total}; "
+                "using device params")
+    return params
+
+
+def _flatten_keys(tree) -> Dict[str, np.ndarray]:
+    out = {}
+
+    def visit(path, leaf):
+        out[jax.tree_util.keystr(path)] = np.asarray(leaf)
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(ckpt_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None):
+    params = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    np.savez(output_file, **_flatten_keys(params))
+    logger.info(f"saved consolidated fp32 state dict to {output_file}")
+    return params
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Consolidate a checkpoint into one fp32 .npz")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output_file")
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()
