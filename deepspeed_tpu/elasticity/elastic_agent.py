@@ -1,0 +1,67 @@
+"""Elastic agent — restart-on-membership-change supervision.
+
+Parity: reference ``elasticity/elastic_agent.py:25`` (``DSElasticAgent``
+extends torch-elastic's ``LocalElasticAgent``: on a rendezvous membership
+change it tears down workers and restarts them with the new world size).
+
+TPU design: jax has no in-process rendezvous to re-enter, so the agent is a
+supervisor loop around the training entrypoint: on a worker failure or an
+explicit scale event it recomputes the elastic batch configuration for the
+new chip count (``compute_elastic_config``) and re-invokes the entrypoint,
+which resumes from the latest checkpoint (orbax reshards the ZeRO state to
+the new mesh).
+"""
+
+import time
+from typing import Callable, Dict, Optional
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityIncompatibleWorldSize, compute_elastic_config)
+from deepspeed_tpu.utils.logging import logger
+
+
+class ScaleEvent(Exception):
+    """Raise from the train fn to request a restart at a new world size."""
+
+    def __init__(self, new_world_size: int):
+        self.new_world_size = new_world_size
+        super().__init__(f"scale to {new_world_size}")
+
+
+class DSElasticAgent:
+
+    def __init__(self, ds_config: Dict, start_world_size: int,
+                 max_restarts: int = 100, restart_delay_s: float = 0.0):
+        self.ds_config = ds_config
+        self.world_size = start_world_size
+        self.max_restarts = max_restarts
+        self.restart_delay_s = restart_delay_s
+        self.restarts = 0
+
+    def run(self, train_fn: Callable[[Dict, int], Optional[int]]):
+        """``train_fn(ds_config, world_size)`` runs training; return value
+        is the exit status (None/0 = done).  Raising ``ScaleEvent`` (or any
+        exception, up to ``max_restarts``) re-enters with refreshed elastic
+        batch settings."""
+        while True:
+            batch, valid, micro = compute_elastic_config(
+                self.ds_config, world_size=self.world_size)
+            cfg = dict(self.ds_config)
+            cfg["train_batch_size"] = batch
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            try:
+                return train_fn(cfg, self.world_size)
+            except ScaleEvent as ev:
+                logger.warning(f"elastic scale event: {self.world_size} → "
+                               f"{ev.new_world_size}")
+                self.world_size = ev.new_world_size
+            except ElasticityIncompatibleWorldSize:
+                raise
+            except Exception as e:  # worker failure → restart
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise
+                logger.warning(f"worker failure ({e}); restart "
+                               f"{self.restarts}/{self.max_restarts}")
+            if self.restart_delay_s:
+                time.sleep(self.restart_delay_s)

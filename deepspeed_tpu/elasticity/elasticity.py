@@ -1,0 +1,200 @@
+"""Elastic training: batch-size ⇄ device-count co-design.
+
+Parity: reference ``elasticity/elasticity.py`` (``compute_elastic_config:287``
+with the v0.1 solver ``:125`` and the model-parallel-aware v0.2 ``:173``):
+pick a global batch size ≤ ``max_acceptable_batch_size`` that is compatible
+with the largest set of device counts, so scaling events never change the
+effective batch size (checkpoint-compatible rescaling).
+
+TPU design: "GPUs" are chips; with model parallelism the data-parallel
+degree is ``chips / (tp*pp)``, which v0.2 accounts for.  The engine's ZeRO
+sharding is mesh-shaped, so a scaling event is: recompute the mesh from the
+new chip count, restore the checkpoint (orbax reshards), continue.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+ELASTICITY = "elasticity"
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Parses the ``elasticity`` config section (reference
+    ``elasticity/config.py`` keys)."""
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = param_dict.get("enabled", False)
+        self.max_acceptable_batch_size = param_dict.get(
+            "max_train_batch_size", 2000)
+        self.micro_batches = param_dict.get("micro_batch_sizes",
+                                            [2, 4, 6])
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", 10000)
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = float(param_dict.get("version", 0.2))
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch",
+                                                       True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+        self.model_parallel_size = param_dict.get("model_parallel_size", 1)
+        self.num_gpus_per_node = param_dict.get("num_gpus_per_node", 1)
+        if not isinstance(self.micro_batches, list) or \
+                any(m <= 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"micro_batch_sizes must be positive ints, got "
+                f"{self.micro_batches}")
+
+
+# ----------------------------------------------------------------------
+# solvers
+# ----------------------------------------------------------------------
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Device counts g for which some micro-batch m gives
+    ``batch_size % (m*g) == 0``."""
+    valid = []
+    for g in range(min_valid_gpus, max_valid_gpus + 1):
+        if any(batch_size % (g * m) == 0 for m in micro_batches):
+            valid.append(g)
+    return valid
+
+
+def _candidate_batch_sizes(micro_batches: List[int],
+                           max_batch: int) -> List[int]:
+    """All m * 2^k ≤ max_batch plus the highly-composite neighbourhood of
+    max_batch itself."""
+    cands = set()
+    for m in micro_batches:
+        b = m
+        while b <= max_batch:
+            cands.add(b)
+            b *= 2
+    # LCM ladder: multiples of all micro batches pack the most device counts
+    lcm = 1
+    for m in micro_batches:
+        from math import gcd
+        lcm = lcm * m // gcd(lcm, m)
+    b = lcm
+    while b <= max_batch:
+        cands.add(b)
+        b += lcm
+    return sorted(cands)
+
+
+def _get_compatible_gpus_v01(micro_batches: List[int],
+                             max_acceptable_batch_size: int,
+                             min_gpus: int, max_gpus: int,
+                             prefer_larger: bool = True
+                             ) -> Tuple[int, List[int]]:
+    """v0.1: maximise |valid device counts|, tie-break on batch size."""
+    best = (0, 0, [])
+    for batch in _candidate_batch_sizes(micro_batches,
+                                        max_acceptable_batch_size):
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        score = (len(valid), batch if prefer_larger else -batch)
+        if score > (best[0], best[1] if prefer_larger else -best[1]):
+            best = (len(valid), batch, valid)
+    if not best[2]:
+        raise ElasticityError(
+            f"no compatible batch size ≤ {max_acceptable_batch_size} for "
+            f"micro_batches={micro_batches}, gpus "
+            f"[{min_gpus},{max_gpus}]")
+    return best[1], best[2]
+
+
+def _get_compatible_gpus_v02(micro_batches: List[int],
+                             max_acceptable_batch_size: int,
+                             current_num_gpus: int,
+                             min_gpus: int, max_gpus: int,
+                             prefer_larger: bool,
+                             num_gpus_per_node: int,
+                             model_parallel_size: int
+                             ) -> Tuple[int, List[int], int]:
+    """v0.2: model-parallel aware — data-parallel workers are groups of
+    ``model_parallel_size`` chips; device counts must be multiples."""
+    if model_parallel_size > 1:
+        if current_num_gpus % model_parallel_size != 0:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {current_num_gpus} not divisible by "
+                f"model_parallel_size {model_parallel_size}")
+        dp_min = max(1, min_gpus // model_parallel_size)
+        dp_max = max_gpus // model_parallel_size
+    else:
+        dp_min, dp_max = min_gpus, max_gpus
+    batch, valid_dp = _get_compatible_gpus_v01(
+        micro_batches, max_acceptable_batch_size, dp_min, dp_max,
+        prefer_larger)
+    valid_gpus = [d * model_parallel_size for d in valid_dp]
+    current_dp = current_num_gpus // model_parallel_size
+    if current_dp not in valid_dp:
+        raise ElasticityIncompatibleWorldSize(
+            f"current world size {current_num_gpus} (dp={current_dp}) is not "
+            f"in the valid set {valid_gpus}")
+    # micro batch for the current dp: largest m with batch % (m*dp) == 0
+    micro = max(m for m in micro_batches if batch % (m * current_dp) == 0)
+    return batch, valid_gpus, micro
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Parity: reference ``compute_elastic_config:287``.
+
+    Returns ``(final_batch_size, valid_gpus)`` and, with ``world_size`` or
+    ``return_microbatch``, the per-worker micro batch for that world size.
+    """
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(f"config missing '{ELASTICITY}' section")
+    cfg = ElasticityConfig(ds_config[ELASTICITY])
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity.enabled is false")
+    if not cfg.ignore_non_elastic_batch_info:
+        for key in ("train_batch_size", "train_micro_batch_size_per_gpu",
+                    "gradient_accumulation_steps"):
+            if key in ds_config:
+                raise ElasticityConfigError(
+                    f"fixed '{key}' conflicts with elasticity; remove it or "
+                    "set ignore_non_elastic_batch_info")
+
+    if cfg.version >= 0.2 and (cfg.model_parallel_size > 1 or world_size):
+        ws = world_size or cfg.model_parallel_size
+        batch, valid, micro = _get_compatible_gpus_v02(
+            cfg.micro_batches, cfg.max_acceptable_batch_size, ws,
+            cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size,
+            cfg.num_gpus_per_node, cfg.model_parallel_size)
+        logger.info(f"elasticity v0.2: batch={batch} valid_gpus={valid} "
+                    f"micro={micro}")
+        return (batch, valid, micro) if (world_size or return_microbatch) \
+            else (batch, valid)
+
+    batch, valid = _get_compatible_gpus_v01(
+        cfg.micro_batches, cfg.max_acceptable_batch_size,
+        cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size)
+    logger.info(f"elasticity v0.1: batch={batch} valid_gpus={valid}")
+    return batch, valid
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict,
+                                    checkpoint_elastic_config_dict: Dict):
+    """Scaling events must not change the elastic config (reference check)."""
+    for k in ("max_train_batch_size", "micro_batch_sizes", "version"):
+        a = runtime_elastic_config_dict.get(k)
+        b = checkpoint_elastic_config_dict.get(k)
+        if a != b:
+            raise ElasticityConfigError(
+                f"elastic config changed across restart: {k}: {b} → {a}")

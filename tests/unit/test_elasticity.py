@@ -1,0 +1,99 @@
+"""Elasticity tests.
+
+Parity model: reference ``tests/unit/elasticity/test_elastic.py``
+(v0.1/v0.2 solver cases, config validation, immutability check).
+"""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (DSElasticAgent,
+                                      ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      ScaleEvent, compute_elastic_config,
+                                      ensure_immutable_elastic_config,
+                                      get_valid_gpus)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_validation_rejects_fixed_batch_keys():
+    cfg = dict(BASE)
+    cfg["train_batch_size"] = 128
+    with pytest.raises(ElasticityConfigError, match="train_batch_size"):
+        compute_elastic_config(cfg)
+
+
+def test_v01_solver_properties():
+    batch, valid = compute_elastic_config(BASE)
+    assert batch <= 10000 and len(valid) > 0
+    # every advertised device count must actually divide some (mb, g) combo
+    for g in valid:
+        assert any(batch % (g * m) == 0
+                   for m in BASE["elasticity"]["micro_batch_sizes"])
+    # the solver should find a batch compatible with many counts
+    assert len(valid) >= 20
+
+
+def test_get_valid_gpus():
+    valid = get_valid_gpus(96, [8, 12], 1, 32)
+    for g in valid:
+        assert 96 % (g * 8) == 0 or 96 % (g * 12) == 0
+    assert 12 in valid and 5 not in valid
+
+
+def test_v02_model_parallel():
+    cfg = {"elasticity": {**BASE["elasticity"], "version": 0.2,
+                          "model_parallel_size": 4, "min_gpus": 4,
+                          "max_gpus": 64}}
+    batch, valid, micro = compute_elastic_config(cfg, world_size=16)
+    assert all(v % 4 == 0 for v in valid)
+    assert 16 in valid
+    assert batch % (micro * (16 // 4)) == 0
+
+
+def test_v02_incompatible_world_size():
+    cfg = {"elasticity": {**BASE["elasticity"], "version": 0.2,
+                          "model_parallel_size": 4, "min_gpus": 4,
+                          "max_gpus": 64}}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=6)
+
+
+def test_immutability_check():
+    a = dict(BASE["elasticity"])
+    b = {**a, "max_train_batch_size": 5000}
+    with pytest.raises(ElasticityConfigError, match="changed"):
+        ensure_immutable_elastic_config(a, b)
+    ensure_immutable_elastic_config(a, dict(a))  # identical → fine
+
+
+def test_elastic_agent_scale_and_restart():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 128,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 16, "version": 0.2}}
+    seen = []
+
+    def train(ds_config, world):
+        seen.append((world, ds_config["train_batch_size"],
+                     ds_config["train_micro_batch_size_per_gpu"]))
+        if len(seen) == 1:
+            raise ScaleEvent(12)         # membership change
+        if len(seen) == 2:
+            raise RuntimeError("chip failure")  # fault → restart same size
+        return 0
+
+    agent = DSElasticAgent(cfg, start_world_size=4, max_restarts=3)
+    assert agent.run(train) == 0
+    assert [w for w, _, _ in seen] == [4, 12, 12]
+    for world, batch, micro in seen:
+        assert batch % (micro * world) == 0
