@@ -1,0 +1,9 @@
+"""Autotuning (reference ``deepspeed/autotuning/``)."""
+
+from deepspeed_tpu.autotuning.autotuner import (Autotuner,
+                                                model_memory_per_chip)
+from deepspeed_tpu.autotuning.config import AutotuningConfig
+from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
+
+__all__ = ["Autotuner", "AutotuningConfig", "Experiment", "ResourceManager",
+           "model_memory_per_chip"]

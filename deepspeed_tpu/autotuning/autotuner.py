@@ -1,0 +1,162 @@
+"""The autotuner.
+
+Parity: reference ``autotuning/autotuner.py:39`` (``Autotuner``: profile the
+model (``:707`` model-info run), prune ZeRO stages by a memory model, tune
+micro-batch size per stage from measured short runs, write
+``autotuning_results/`` and report the best config; entered from the
+launcher ``runner.py:351``).
+
+TPU design: the tuning space is (zero stage × micro-batch size); memory
+feasibility uses the ZeRO memory model (params/grads/optimizer bytes per
+chip given the fsdp degree) against the accelerator's reported HBM; each
+trial builds a real engine and measures steady-state samples/sec over
+``end_profile_step - start_profile_step`` fused steps.
+"""
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.autotuning.config import (AUTOTUNING,
+                                             AUTOTUNING_METRIC_THROUGHPUT,
+                                             AutotuningConfig)
+from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
+from deepspeed_tpu.utils.logging import logger
+
+BYTES_PER_PARAM_BF16 = 2
+# Adam: fp32 master + m + v
+BYTES_OPTIM_PER_PARAM = 12
+BYTES_GRAD_PER_PARAM = 4
+
+
+def model_memory_per_chip(num_params: int, stage: int, dp: int,
+                          offload_optimizer: bool = False) -> int:
+    """ZeRO memory model (reference ``autotuner.py`` stage pruning):
+    bytes/chip of params + grads + optimizer states."""
+    p = num_params * BYTES_PER_PARAM_BF16
+    g = num_params * BYTES_GRAD_PER_PARAM
+    o = 0 if offload_optimizer else num_params * BYTES_OPTIM_PER_PARAM
+    if stage >= 3:
+        p //= dp
+    if stage >= 2:
+        g //= dp
+    if stage >= 1:
+        o //= dp
+    return p + g + o
+
+
+class Autotuner:
+
+    def __init__(self, ds_config: Dict[str, Any],
+                 model_num_params: Optional[int] = None,
+                 hbm_bytes: Optional[int] = None):
+        self.base_config = {k: v for k, v in ds_config.items()
+                            if k != AUTOTUNING}
+        self.at_config = AutotuningConfig(ds_config.get(AUTOTUNING, {}))
+        self.model_num_params = model_num_params
+        if hbm_bytes is None:
+            try:
+                from deepspeed_tpu.accelerator import get_accelerator
+                hbm_bytes = get_accelerator().total_memory()
+            except Exception:
+                hbm_bytes = 16 << 30
+        self.hbm_bytes = hbm_bytes
+        self.rm = ResourceManager(self.at_config.results_dir,
+                                  metric=self.at_config.metric)
+
+    # ------------------------------------------------------------------
+    def feasible_stages(self, dp: int) -> List[int]:
+        if self.model_num_params is None:
+            return [0, 1, 2, 3]
+        stages = [s for s in (0, 1, 2, 3)
+                  if model_memory_per_chip(self.model_num_params, s, dp)
+                  < self.hbm_bytes * 0.9]
+        # always consider the most-sharded stage even if the model says no
+        # (offload may rescue it)
+        return stages or [3]
+
+    def candidate_micro_batches(self) -> List[int]:
+        at = self.at_config
+        out, m = [], max(1, at.min_train_micro_batch_size_per_gpu)
+        while m <= at.max_train_micro_batch_size_per_gpu and \
+                len(out) < at.num_tuning_micro_batch_sizes:
+            out.append(m)
+            m *= 2
+        return out
+
+    def tuning_space(self, dp: int) -> List[Dict[str, Any]]:
+        space = []
+        for stage, micro in itertools.product(self.feasible_stages(dp),
+                                              self.candidate_micro_batches()):
+            cfg = dict(self.base_config)
+            zo = dict(cfg.get("zero_optimization", {}))
+            zo["stage"] = stage
+            cfg["zero_optimization"] = zo
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg.pop("train_batch_size", None)
+            space.append(cfg)
+        return space
+
+    # ------------------------------------------------------------------
+    def _default_runner(self, make_batch: Callable[[int], Any],
+                        model, params) -> Callable[[Experiment], Dict]:
+        at = self.at_config
+
+        def run(exp: Experiment) -> Dict[str, Any]:
+            import deepspeed_tpu
+            from deepspeed_tpu.parallel import groups
+            groups.reset_mesh()
+            engine, *_ = deepspeed_tpu.initialize(
+                model=model,
+                model_parameters=jax.tree_util.tree_map(np.asarray, params),
+                config=exp.ds_config)
+            micro = exp.ds_config["train_micro_batch_size_per_gpu"]
+            batch = make_batch(engine.train_batch_size())
+            for _ in range(at.start_profile_step):   # warmup + compile
+                engine.train_batch(batch=batch)
+            steps = max(1, at.end_profile_step - at.start_profile_step)
+            t0 = time.time()
+            for _ in range(steps):
+                loss = engine.train_batch(batch=batch)
+            jax.block_until_ready(loss)
+            dt = time.time() - t0
+            samples = engine.train_batch_size() * steps
+            return {"throughput": samples / dt,
+                    "latency": dt / steps,
+                    "micro_batch": micro,
+                    "zero_stage": engine.zero_stage}
+        return run
+
+    def tune(self, model=None, params=None,
+             make_batch: Optional[Callable[[int], Any]] = None,
+             run_fn: Optional[Callable[[Experiment], Dict]] = None
+             ) -> Dict[str, Any]:
+        """Run the search; returns the best ds_config."""
+        from deepspeed_tpu.parallel import groups
+        dp = max(1, jax.device_count())
+        space = self.tuning_space(dp)
+        exps = [Experiment(
+            f"z{c['zero_optimization']['stage']}_"
+            f"mbs{c['train_micro_batch_size_per_gpu']}", c) for c in space]
+        logger.info(f"autotuning: {len(exps)} experiments "
+                    f"(stages×micro-batches), metric={self.at_config.metric}")
+        self.rm.schedule_experiments(exps)
+        if run_fn is None:
+            assert model is not None and params is not None and \
+                make_batch is not None, \
+                "tune() needs model/params/make_batch or a custom run_fn"
+            run_fn = self._default_runner(make_batch, model, params)
+        self.rm.run(run_fn)
+        best = self.rm.best_experiment()
+        assert best is not None, "no experiment finished"
+        logger.info(f"autotuning: best = {best.name} "
+                    f"({self.at_config.metric}="
+                    f"{best.result.get(self.at_config.metric):.2f})")
+        return best.ds_config
+
+    # parity aliases ----------------------------------------------------
+    def run_autotuning(self, *a, **kw):
+        return self.tune(*a, **kw)

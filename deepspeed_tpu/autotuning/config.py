@@ -1,0 +1,39 @@
+"""Autotuning config.
+
+Parity: reference ``autotuning/config.py`` (``DeepSpeedAutotuningConfig``) —
+keys keep reference spellings (enabled, fast, metric, start/end profile
+steps, tuner type, early stopping, results/exps dirs).
+"""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+AUTOTUNING = "autotuning"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_LATENCY = "latency"
+AUTOTUNING_METRIC_FLOPS = "flops"
+
+GRIDSEARCH = "gridsearch"
+RANDOM = "random"
+MODEL_BASED = "model_based"
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled = False
+    fast = True
+    results_dir = "autotuning_results"
+    exps_dir = "autotuning_exps"
+    overwrite = True
+    start_profile_step = 3
+    end_profile_step = 5
+    metric = AUTOTUNING_METRIC_THROUGHPUT
+    model_info = None
+    tuner_type = GRIDSEARCH
+    tuner_early_stopping = 5
+    tuner_num_trials = 50
+    arg_mappings = None
+    max_train_batch_size = None
+    min_train_batch_size = 1
+    max_train_micro_batch_size_per_gpu = 1024
+    min_train_micro_batch_size_per_gpu = 1
+    num_tuning_micro_batch_sizes = 3
+    mp_size = 1

@@ -1,0 +1,74 @@
+"""Experiment scheduler / resource manager.
+
+Parity: reference ``autotuning/scheduler.py`` (``ResourceManager``: queue of
+experiments, per-experiment result JSON under ``autotuning_results/``,
+best-tracking).  On a single TPU host experiments run sequentially in
+process (the reference schedules across free nodes); the journal format is
+kept so results survive crashes and re-runs skip finished experiments.
+"""
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Experiment:
+
+    def __init__(self, name: str, ds_config: Dict[str, Any]):
+        self.name = name
+        self.ds_config = ds_config
+        self.result: Optional[Dict[str, Any]] = None
+
+    def done(self) -> bool:
+        return self.result is not None
+
+
+class ResourceManager:
+
+    def __init__(self, results_dir: str = "autotuning_results",
+                 metric: str = "throughput"):
+        self.results_dir = results_dir
+        self.metric = metric
+        self.experiments: List[Experiment] = []
+        os.makedirs(results_dir, exist_ok=True)
+
+    def _result_path(self, exp: Experiment) -> str:
+        return os.path.join(self.results_dir, f"{exp.name}.json")
+
+    def schedule_experiments(self, exps: List[Experiment]):
+        self.experiments.extend(exps)
+
+    def run(self, run_fn: Callable[[Experiment], Dict[str, Any]]):
+        """Run all pending experiments; previously-journaled results are
+        reused (reference skip-finished behaviour)."""
+        for exp in self.experiments:
+            path = self._result_path(exp)
+            if exp.result is None and os.path.exists(path):
+                with open(path) as f:
+                    exp.result = json.load(f)
+                logger.info(f"autotuning: reusing journaled {exp.name}")
+                continue
+            if exp.result is not None:
+                continue
+            t0 = time.time()
+            try:
+                metrics = run_fn(exp)
+            except Exception as e:  # infeasible config (e.g. OOM) scores 0
+                logger.warning(f"autotuning: {exp.name} failed: {e}")
+                metrics = {self.metric: 0.0, "error": str(e)}
+            metrics["wall_s"] = time.time() - t0
+            metrics["ds_config"] = exp.ds_config
+            exp.result = metrics
+            with open(path, "w") as f:
+                json.dump(metrics, f, indent=1, default=str)
+
+    def best_experiment(self) -> Optional[Experiment]:
+        done = [e for e in self.experiments if e.done()]
+        if not done:
+            return None
+        sign = -1 if self.metric == "latency" else 1
+        return max(done, key=lambda e: sign * float(
+            e.result.get(self.metric, 0.0)))

@@ -1,0 +1,103 @@
+"""Autotuning tests.
+
+Parity model: reference ``tests/unit/autotuning/test_autotuning.py``
+(tuning-space enumeration, resource manager journaling, memory model).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, Experiment, ResourceManager,
+                                      model_memory_per_chip)
+from unit.simple_model import SimpleModel, base_config, random_batch
+
+HIDDEN = 16
+
+
+def test_memory_model_monotone_in_stage():
+    n, dp = 1_000_000_000, 8
+    mems = [model_memory_per_chip(n, s, dp) for s in (0, 1, 2, 3)]
+    assert mems[0] > mems[1] > mems[2] > mems[3]
+    # stage 3 shards everything
+    assert mems[3] == pytest.approx(mems[0] / dp, rel=0.01)
+    # offload removes optimizer bytes
+    assert model_memory_per_chip(n, 1, dp, offload_optimizer=True) < mems[1]
+
+
+def test_tuning_space_and_stage_pruning(tmp_path):
+    cfg = base_config()
+    cfg["autotuning"] = {"enabled": True,
+                         "results_dir": str(tmp_path),
+                         "num_tuning_micro_batch_sizes": 2}
+    # model too big for stage 0 on a tiny "HBM"
+    at = Autotuner(cfg, model_num_params=10_000_000,
+                   hbm_bytes=100 * 1024 * 1024)
+    stages = at.feasible_stages(dp=8)
+    assert 0 not in stages and 3 in stages
+    space = at.tuning_space(dp=8)
+    assert len(space) == len(stages) * 2
+    assert all("train_batch_size" not in c for c in space)
+
+
+def test_resource_manager_journal_and_best(tmp_path):
+    rm = ResourceManager(str(tmp_path), metric="throughput")
+    exps = [Experiment("a", {"x": 1}), Experiment("b", {"x": 2}),
+            Experiment("c", {"x": 3})]
+    rm.schedule_experiments(exps)
+    scores = {"a": 5.0, "b": 9.0, "c": 7.0}
+    rm.run(lambda e: {"throughput": scores[e.name]})
+    assert rm.best_experiment().name == "b"
+    # journals written
+    assert sorted(os.listdir(tmp_path)) == ["a.json", "b.json", "c.json"]
+    with open(tmp_path / "b.json") as f:
+        assert json.load(f)["throughput"] == 9.0
+
+    # a fresh manager reuses journals without re-running
+    rm2 = ResourceManager(str(tmp_path), metric="throughput")
+    rm2.schedule_experiments([Experiment("a", {}), Experiment("b", {})])
+    calls = []
+    rm2.run(lambda e: calls.append(e.name) or {"throughput": 0.0})
+    assert calls == []
+    assert rm2.best_experiment().name == "b"
+
+
+def test_failed_experiment_scores_zero(tmp_path):
+    rm = ResourceManager(str(tmp_path))
+
+    def run(e):
+        if e.name == "bad":
+            raise RuntimeError("OOM")
+        return {"throughput": 1.0}
+    rm.schedule_experiments([Experiment("bad", {}), Experiment("ok", {})])
+    rm.run(run)
+    assert rm.best_experiment().name == "ok"
+    with open(tmp_path / "bad.json") as f:
+        assert "OOM" in json.load(f)["error"]
+
+
+def test_end_to_end_tune_real_engine(tmp_path):
+    """Full tune() over 2 stages × 2 micro-batches with real measured runs."""
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init(jax.random.key(0))
+    cfg = base_config()
+    cfg.pop("train_batch_size", None)
+    cfg["autotuning"] = {"enabled": True, "results_dir": str(tmp_path),
+                         "start_profile_step": 1, "end_profile_step": 2,
+                         "num_tuning_micro_batch_sizes": 2,
+                         "min_train_micro_batch_size_per_gpu": 8}
+    at = Autotuner(cfg)
+    at.feasible_stages = lambda dp: [0, 2]   # keep the space small
+
+    def make_batch(global_batch):
+        return random_batch(global_batch, HIDDEN, seed=0)
+
+    best = at.tune(model=model, params=params, make_batch=make_batch)
+    assert best["zero_optimization"]["stage"] in (0, 2)
+    assert best["train_micro_batch_size_per_gpu"] in (8, 16)
+    # every experiment journaled a real throughput
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert len(files) == 4
