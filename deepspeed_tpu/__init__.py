@@ -14,6 +14,8 @@ from deepspeed_tpu.accelerator import get_accelerator, set_accelerator  # noqa: 
 from deepspeed_tpu import comm  # noqa: F401
 from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from deepspeed_tpu.runtime import zero  # noqa: F401
+from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: F401
 from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
 
 

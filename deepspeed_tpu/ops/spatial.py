@@ -1,0 +1,29 @@
+"""Spatial (diffusers/UNet) inference ops.
+
+Parity: reference ``csrc/spatial/csrc/opt_bias_add.cu`` (``nhwc_bias_add``,
+``nhwc_bias_add_add``, ``nhwc_bias_add_bias_add`` — fused NHWC bias/residual
+adds for Stable-Diffusion UNet/VAE).
+
+TPU design: jnp expressions — XLA fuses them into the surrounding convs;
+NHWC is already TPU's preferred conv layout.  Provided for API parity and
+as the op_builder "spatial_inference" surface.
+"""
+
+import jax.numpy as jnp
+
+
+def nhwc_bias_add(activation, bias):
+    """activation [N,H,W,C] + bias [C]."""
+    return activation + bias.astype(activation.dtype)
+
+
+def nhwc_bias_add_add(activation, bias, other):
+    return activation + bias.astype(activation.dtype) + other
+
+
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    return (activation + bias.astype(activation.dtype) + other +
+            other_bias.astype(activation.dtype))
+
+
+reference_impl = nhwc_bias_add

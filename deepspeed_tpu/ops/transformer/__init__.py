@@ -1,0 +1,6 @@
+"""Transformer layer op surface (reference ``deepspeed/ops/transformer/``)."""
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+__all__ = ["DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]

@@ -1,0 +1,24 @@
+"""ZeRO (reference ``deepspeed/runtime/zero/``): sharding plans, offload,
+param-partitioning surface, tiling, allocator."""
+
+from deepspeed_tpu.runtime.zero.config import (DeepSpeedZeroConfig,
+                                               OffloadDeviceEnum)
+from deepspeed_tpu.runtime.zero.contiguous_memory_allocator import \
+    ContiguousMemoryAllocator
+from deepspeed_tpu.runtime.zero.offload import (FlatLayout,
+                                                HostOffloadOptimizer,
+                                                OptimizerStateSwapper,
+                                                PartitionedParamSwapper)
+from deepspeed_tpu.runtime.zero.partition_parameters import (
+    GatheredParameters, Init, shutdown_init_context)
+from deepspeed_tpu.runtime.zero.stage_plan import (ZeroShardingPlan,
+                                                   constrain, maybe_constrain)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, tiled_linear
+
+__all__ = [
+    "DeepSpeedZeroConfig", "OffloadDeviceEnum", "ContiguousMemoryAllocator",
+    "FlatLayout", "HostOffloadOptimizer", "OptimizerStateSwapper",
+    "PartitionedParamSwapper", "GatheredParameters", "Init",
+    "shutdown_init_context", "ZeroShardingPlan", "constrain",
+    "maybe_constrain", "TiledLinear", "tiled_linear",
+]

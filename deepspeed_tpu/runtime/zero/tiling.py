@@ -1,0 +1,76 @@
+"""Tiled linear — split huge matmuls into a tile grid.
+
+Parity: reference ``runtime/zero/tiling.py:29`` (``TiledLinear``: split an
+``in_features x out_features`` linear into a grid of sub-linears so ZeRO-3
+can partition/fetch pieces independently and memory stays bounded).
+
+TPU design: XLA already shards big matmuls across the mesh, so the residual
+use case is *memory-bounded single-tile compute* — e.g. a 8192x256k vocab
+projection whose activation+logit buffers blow HBM.  ``tiled_linear``
+iterates output tiles under ``jax.checkpoint`` (activations of tile i are
+freed before tile i+1), trading recompute in the backward for peak memory —
+the same trade the reference makes by splitting the module.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tiled_linear(x, w, b=None, in_splits: int = 1, out_splits: int = 1,
+                 use_checkpoint: bool = True):
+    """y = x @ w (+ b), computed over an ``in_splits × out_splits`` tile
+    grid.  x: [..., d_in]; w: [d_in, d_out]."""
+    d_in, d_out = w.shape
+    assert d_in % in_splits == 0, (d_in, in_splits)
+    assert d_out % out_splits == 0, (d_out, out_splits)
+    ti, to = d_in // in_splits, d_out // out_splits
+
+    def out_tile(j):
+        wj = jax.lax.dynamic_slice_in_dim(w, j * to, to, axis=1)
+
+        def compute(x, wj):
+            acc = jnp.zeros(x.shape[:-1] + (to,), x.dtype)
+            for i in range(in_splits):
+                xi = jax.lax.dynamic_slice_in_dim(x, i * ti, ti, axis=-1)
+                wij = jax.lax.dynamic_slice_in_dim(wj, i * ti, ti, axis=0)
+                acc = acc + xi @ wij
+            return acc
+        fn = jax.checkpoint(compute) if use_checkpoint else compute
+        return fn(x, wj)
+
+    out = jnp.concatenate([out_tile(j) for j in range(out_splits)], axis=-1)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
+
+
+class TiledLinear:
+    """Module-style parity surface (reference class constructor args)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 in_splits: int = 1, out_splits: int = 1,
+                 input_is_already_split: bool = False,
+                 combine_out_splits: bool = True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.use_bias = bias
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+
+    def init(self, rng, dtype=jnp.float32):
+        import math
+        k = 1.0 / math.sqrt(self.in_features)
+        w = jax.random.uniform(rng, (self.in_features, self.out_features),
+                               dtype, -k, k)
+        p = {"weight": w}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.out_features,), dtype)
+        return p
+
+    def __call__(self, params, x):
+        return tiled_linear(x, params["weight"], params.get("bias"),
+                            self.in_splits, self.out_splits)
+
+    forward = __call__

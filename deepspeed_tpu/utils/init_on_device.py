@@ -1,0 +1,68 @@
+"""Meta-device (abstract) initialisation.
+
+Parity: reference ``utils/init_on_device.py`` (``OnDevice``: construct a
+model with meta tensors so no memory is allocated until weights are
+materialised — exported at ``deepspeed/__init__.py:28``).
+
+TPU design: ``jax.eval_shape`` IS the meta device — it traces an init
+function to ``ShapeDtypeStruct``s without allocating.  ``OnDevice`` wraps
+initialisers accordingly; ``materialize`` later produces real arrays.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """``with OnDevice(dtype=jnp.bfloat16, device="meta"): params =
+    OnDevice.run(model.init, rng)`` → abstract tree, zero bytes."""
+
+    _active = None
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        OnDevice._active = self if self.enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = None
+        return False
+
+    # ------------------------------------------------------------------
+    def run(self, init_fn: Callable, *args, **kwargs) -> Any:
+        """Abstractly evaluate ``init_fn`` (meta) or run it for real."""
+        if self.device == "meta":
+            out = jax.eval_shape(init_fn, *args, **kwargs)
+        else:
+            out = init_fn(*args, **kwargs)
+        if self.dtype is not None:
+            def cast(x):
+                if isinstance(x, jax.ShapeDtypeStruct):
+                    if jnp.issubdtype(x.dtype, jnp.floating):
+                        return jax.ShapeDtypeStruct(x.shape, self.dtype)
+                    return x
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                    return jnp.asarray(x, self.dtype)
+                return x
+            out = jax.tree_util.tree_map(cast, out)
+        return out
+
+    @staticmethod
+    def materialize(abstract_tree, init_fn: Callable, *args, **kwargs):
+        """Turn a meta tree back into real arrays by running the
+        initialiser (optionally under a sharding plan via zero.Init)."""
+        real = init_fn(*args, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda a, r: jnp.asarray(r, getattr(a, "dtype", None)),
+            abstract_tree, real)
+
+
+def is_meta(tree) -> bool:
+    return any(isinstance(x, jax.ShapeDtypeStruct)
+               for x in jax.tree_util.tree_leaves(tree))
