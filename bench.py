@@ -23,8 +23,9 @@ def main():
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
+        # batch 16 measured best on v5e (MXU utilisation vs HBM working set)
         cfg = TransformerConfig.gpt2_125m(remat=True)
-        batch, seq, steps = 8, 1024, 20
+        batch, seq, steps = 16, 1024, 20
     else:  # CI smoke
         cfg = TransformerConfig.tiny()
         batch, seq, steps = 4, 128, 3
