@@ -66,7 +66,12 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     def set_params(self, params):
         """Cast + shard weights (reference dtype convert + weight slicing in
-        module_inject; here: device_put with TP/fsdp shardings)."""
+        module_inject; here: device_put with TP/fsdp shardings).
+
+        With int8/quantized configs the weights are stored groupwise int8 +
+        scales (reference ``GroupQuantizer``/ZeroQuant weight-only path) and
+        dequantised inside the jitted step — XLA fuses the dequant into the
+        consuming matmul, so HBM holds 1 byte/weight."""
         tp_rules = (self.module.tp_rules()
                     if hasattr(self.module, "tp_rules") else None)
         # stage-3-style sharding over fsdp for memory, + tp rules: this is
@@ -74,12 +79,59 @@ class InferenceEngine:
         plan = ZeroShardingPlan(self.mesh, stage=3, tp_rules=tp_rules,
                                 param_persistence_threshold=0)
         self.plan = plan
-        cast = jax.tree_util.tree_map(
-            lambda x: x.astype(self.dtype)
-            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.asarray(x),
-            params)
+        qc = self._config.quant
+        self._quantized = bool(qc.enabled) or str(
+            self._config.dtype) in ("int8", "torch.int8")
+        if self._quantized:
+            self._quant_bits = int(qc.num_bits)
+            self._quant_group_size = int(qc.group_size)
+            if self.dtype == jnp.int8:      # int8 stores, bf16 computes
+                self.dtype = jnp.bfloat16
+            cast = self._quantize_tree(params)
+        else:
+            cast = jax.tree_util.tree_map(
+                lambda x: x.astype(self.dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                else jnp.asarray(x), params)
         with self.mesh:
             self.params = jax.device_put(cast, plan.param_shardings(cast))
+
+    # ---- weight-only quantization ------------------------------------
+    @staticmethod
+    def _is_qleaf(x):
+        return isinstance(x, dict) and "qv" in x and "qs" in x
+
+    def _quantize_tree(self, params):
+        from deepspeed_tpu.ops.quantizer import quantize
+
+        def q(x):
+            x = jnp.asarray(x)
+            if x.ndim >= 2 and jnp.issubdtype(x.dtype, jnp.floating):
+                groups = (x.size // self._quant_group_size
+                          if x.size % self._quant_group_size == 0 else 1)
+                qt = quantize(x, groups=max(1, groups),
+                              num_bits=self._quant_bits)
+                return {"qv": qt.values, "qs": qt.scale, "qz": qt.zero_point}
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.dtype)
+            return x
+        return jax.tree_util.tree_map(q, params)
+
+    def _maybe_dequant(self, params):
+        """Inside-jit dequant of quantized leaves (fused by XLA)."""
+        if not getattr(self, "_quantized", False):
+            return params
+        from deepspeed_tpu.ops.quantizer import QuantizedTensor, dequantize
+
+        def dq(x):
+            if self._is_qleaf(x):
+                qt = QuantizedTensor(
+                    values=x["qv"], scale=x["qs"], zero_point=x["qz"],
+                    num_bits=self._quant_bits, group_shape=x["qv"].shape,
+                    symmetric=True)
+                return dequantize(qt, dtype=self.dtype)
+            return x
+        return jax.tree_util.tree_map(dq, params, is_leaf=self._is_qleaf)
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, caches=None):
@@ -90,7 +142,8 @@ class InferenceEngine:
                 input_ids.shape[0], self._config.max_out_tokens, self.dtype)
         if self._compiled_prefill is None:
             def prefill(params, ids, caches):
-                return self.module.apply_with_cache(params, ids, caches)
+                return self.module.apply_with_cache(
+                    self._maybe_dequant(params), ids, caches)
             self._compiled_prefill = jax.jit(prefill)
         with self.mesh:
             logits, caches = self._compiled_prefill(self.params, input_ids, caches)
@@ -111,6 +164,7 @@ class InferenceEngine:
 
         if key not in self._compiled_generate:
             def gen(params, ids, rng):
+                params = self._maybe_dequant(params)
                 caches = self.module.init_caches(B, max_seq, self.dtype)
                 logits, caches = self.module.apply_with_cache(params, ids, caches)
                 last = logits[:, -1]

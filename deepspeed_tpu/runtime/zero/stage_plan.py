@@ -93,7 +93,10 @@ def add_axis_to_spec(spec: Optional[P], shape, axis_name: str, axis_size: int,
             else:
                 entries[d] = tuple(_axes_in(entry) + [axis_name])
             return P(*entries)
-    return spec if spec is not None else P()
+    # nothing divides: keep the base spec, truncated to the leaf's rank
+    # (a rule written for a 3-D weight may match an auxiliary 1-D leaf,
+    # e.g. quantization scales)
+    return P(*entries)
 
 
 def _leaf_size(leaf) -> int:
