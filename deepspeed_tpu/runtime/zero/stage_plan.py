@@ -96,6 +96,8 @@ def add_axis_to_spec(spec: Optional[P], shape, axis_name: str, axis_size: int,
     # nothing divides: keep the base spec, truncated to the leaf's rank
     # (a rule written for a 3-D weight may match an auxiliary 1-D leaf,
     # e.g. quantization scales)
+    while entries and entries[-1] is None:
+        entries.pop()
     return P(*entries)
 
 
