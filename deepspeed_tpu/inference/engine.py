@@ -52,6 +52,8 @@ class InferenceEngine:
         self.mesh = mesh
 
         self.params = None
+        if params is None and config.checkpoint:
+            params = self.load_model_with_checkpoint(config.checkpoint)
         if params is not None:
             self.set_params(params)
         elif hasattr(model, "params"):
@@ -132,6 +134,29 @@ class InferenceEngine:
                 return dequantize(qt, dtype=self.dtype)
             return x
         return jax.tree_util.tree_map(dq, params, is_leaf=self._is_qleaf)
+
+    # ------------------------------------------------------------------
+    def load_model_with_checkpoint(self, checkpoint: str):
+        """Load weights from a training checkpoint dir (orbax layout) or a
+        universal-checkpoint dir (reference ``load_model_with_checkpoint:292``
+        sharded-checkpoint loading)."""
+        import os
+        if os.path.exists(os.path.join(checkpoint, "universal_meta.json")):
+            from deepspeed_tpu.checkpoint import load_universal_checkpoint
+            flat = load_universal_checkpoint(checkpoint)
+            log_dist(f"loaded universal checkpoint: {len(flat)} tensors",
+                     ranks=[0])
+            template = (self.module.init(jax.random.key(0))
+                        if hasattr(self.module, "init") else None)
+            if template is not None:
+                return load_universal_checkpoint(checkpoint,
+                                                 template=template)
+            return flat
+        from deepspeed_tpu.checkpoint import load_checkpoint_tree
+        state = load_checkpoint_tree(checkpoint)
+        params = state.get("params", state)
+        log_dist(f"loaded checkpoint params from {checkpoint}", ranks=[0])
+        return params
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, caches=None):

@@ -1,0 +1,47 @@
+"""Debug helpers.
+
+Parity: reference ``utils/debug.py`` (module/param name mapping, rank-0
+printing helpers used while debugging ZeRO partitioning).
+"""
+
+import os
+
+import jax
+import numpy as np
+
+_module_names = {}
+_param_names = {}
+
+
+def debug_extract_module_and_param_names(params_tree):
+    """Index a params pytree: path → leaf (reference walks nn.Module)."""
+    global _param_names
+    _param_names = {}
+
+    def visit(path, leaf):
+        _param_names[jax.tree_util.keystr(path)] = leaf
+    jax.tree_util.tree_map_with_path(visit, params_tree)
+    return _param_names
+
+
+def debug_param2name(leaf) -> str:
+    for name, p in _param_names.items():
+        if p is leaf:
+            return name
+    return "unknown"
+
+
+def debug_rank0_print(*msg):
+    if jax.process_index() == 0:
+        print("[rank0]", *msg, flush=True)
+
+
+def print_rank_0(message, debug=False, force=False):
+    if jax.process_index() == 0 and (debug or force):
+        print(message, flush=True)
+
+
+def debug_tree_summary(tree, name="tree"):
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = sum(int(np.prod(np.shape(x))) for x in leaves)
+    print(f"{name}: {len(leaves)} leaves, {total:,} elements", flush=True)

@@ -1,0 +1,35 @@
+"""Profiler range annotation.
+
+Parity: reference ``utils/nvtx.py`` (``instrument_w_nvtx``: wrap a function
+in an NVTX range so kernels attribute to Python frames in nsys).
+
+TPU design: ``jax.profiler.TraceAnnotation`` puts the range into the XLA
+profiler timeline (xprof/tensorboard), which is the TPU equivalent.
+"""
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Decorator: annotate ``func``'s dispatch in the profiler timeline."""
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+    return wrapped
+
+
+def range_push(name: str):
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _stack.append(ann)
+
+
+def range_pop():
+    if _stack:
+        _stack.pop().__exit__(None, None, None)
+
+
+_stack = []
