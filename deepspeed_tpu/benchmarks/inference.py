@@ -1,0 +1,103 @@
+"""Inference latency benchmark (gpt-bench).
+
+Parity: reference ``benchmarks/inference/gpt-bench.py`` (``print_latency:38``
+— p50/p90/p99 token latency, fp16/int8, kernel-inject on/off).
+
+Usage::
+
+    python -m deepspeed_tpu.benchmarks.inference --model tiny --dtype bf16 \
+        --batch 1 --prompt-len 128 --max-new-tokens 64 --trials 10
+"""
+
+import argparse
+import time
+from typing import List
+
+import numpy as np
+
+
+def print_latency(latency_set: List[float], title: str, warmup: int = 3):
+    """Reference gpt-bench.print_latency: trim warmup, report percentiles."""
+    lat = sorted(latency_set[warmup:])
+    if not lat:
+        return
+    n = len(lat)
+    avg = sum(lat) / n
+    p50 = lat[int(n * 0.5)]
+    p90 = lat[min(n - 1, int(n * 0.9))]
+    p99 = lat[min(n - 1, int(n * 0.99))]
+    print(f"== {title} =============")
+    print(f"\tAvg Latency: {avg * 1000:.2f} ms")
+    print(f"\tP50 Latency: {p50 * 1000:.2f} ms")
+    print(f"\tP90 Latency: {p90 * 1000:.2f} ms")
+    print(f"\tP99 Latency: {p99 * 1000:.2f} ms")
+    return {"avg": avg, "p50": p50, "p90": p90, "p99": p99}
+
+
+def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
+                  max_new_tokens=64, trials=10, quant=False, tp=1):
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+
+    presets = {
+        "tiny": TransformerConfig.tiny,
+        "gpt2-125m": TransformerConfig.gpt2_125m,
+        "gpt2-1.5b": TransformerConfig.gpt2_1_5b,
+        "llama2-7b": TransformerConfig.llama2_7b,
+    }
+    cfg = presets[model_size](remat=False)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    kwargs = {"dtype": dtype}
+    if quant:
+        kwargs["quant"] = {"enabled": True, "num_bits": 8}
+    if tp > 1:
+        kwargs["tensor_parallel"] = {"tp_size": tp}
+    engine = deepspeed_tpu.init_inference(model=model, params=params,
+                                          max_out_tokens=prompt_len +
+                                          max_new_tokens, **kwargs)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, prompt_len))
+
+    e2e, per_token = [], []
+    for t in range(trials + 3):
+        t0 = time.time()
+        out = engine.generate(ids, max_new_tokens=max_new_tokens, seed=t)
+        # host transfer, not block_until_ready: remote-tunnel backends ack
+        # the dispatch before the compute queue drains
+        np.asarray(out)
+        dt = time.time() - t0
+        e2e.append(dt)
+        per_token.append(dt / max_new_tokens)
+
+    stats = print_latency(per_token, f"generation token latency "
+                          f"({model_size}, {dtype}"
+                          f"{', int8' if quant else ''}, bs={batch})")
+    print_latency(e2e, f"end-to-end latency ({max_new_tokens} tokens)")
+    tput = batch * max_new_tokens / (sum(e2e[3:]) / max(1, len(e2e[3:])))
+    print(f"\tThroughput: {tput:.1f} tokens/s")
+    return stats
+
+
+def main():
+    ap = argparse.ArgumentParser(description="deepspeed_tpu gpt-bench")
+    ap.add_argument("--model", default="tiny",
+                    choices=["tiny", "gpt2-125m", "gpt2-1.5b", "llama2-7b"])
+    ap.add_argument("--dtype", default="bf16")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--tp", type=int, default=1)
+    args = ap.parse_args()
+    run_benchmark(args.model, args.dtype, args.batch, args.prompt_len,
+                  args.max_new_tokens, args.trials, quant=args.int8,
+                  tp=args.tp)
+
+
+if __name__ == "__main__":
+    main()

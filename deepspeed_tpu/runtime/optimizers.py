@@ -7,8 +7,9 @@ TPU design: optimizers are optax ``GradientTransformation``s.  The reference's
 "fused" multi-tensor CUDA kernels exist because eager torch launches one
 kernel per tensor; under XLA every optimizer is already fused across the whole
 pytree in one compiled program, so ``FusedAdam``/``Adam`` converge to the same
-thing.  A Pallas fused-Adam over the flat ZeRO partition buffer exists in
-``ops/adam.py`` and is used by the engine for the flat-partition path.
+thing.  A standalone fused-Adam over a flat partition buffer exists in
+``ops/adam.py`` (the op_builder surface; the engine's optax update compiles
+to the same fused program).
 
 ``OneBitAdam``/``ZeroOneAdam``/``OneBitLamb`` (reference ``fp16/onebit/*``) are
 error-feedback *communication* compressors; on TPU the gradient reduction is
