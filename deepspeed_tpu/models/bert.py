@@ -1,0 +1,189 @@
+"""BERT-style bidirectional encoder (MLM).
+
+Parity role: the reference's BERT track — the fused training layer's
+original target (``docs/_posts/2020-05-28-fastest-bert-training.md``), the
+BingBertSquad model tests, and the BERT/DistilBERT inference containers
+(``module_inject/containers/bert.py``).
+
+TPU design: same functional pattern as ``CausalTransformerLM`` but post-LN
+residuals (x = LN(x + sublayer(x))), learned position + token-type
+embeddings, padding attention mask, and an MLM head (transform + tied
+decoder).  Stacked layers → ``lax.scan``.
+"""
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import _norm
+from deepspeed_tpu.ops.attention import reference_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_heads
+
+    @property
+    def ffn_dim(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @staticmethod
+    def tiny(**kw):
+        base = BertConfig(vocab_size=256, hidden_size=64, n_layers=2,
+                          n_heads=4, max_seq_len=128)
+        return replace(base, **kw)
+
+    @staticmethod
+    def bert_large(**kw):
+        base = BertConfig(hidden_size=1024, n_layers=24, n_heads=16)
+        return replace(base, **kw)
+
+
+class BertEncoder:
+    """Functional BERT: ``init`` → params; ``apply`` → MLM logits;
+    ``loss`` → masked-LM cross entropy (the engine's model contract)."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+
+    # ------------------------------------------------------------------
+    def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
+        c = self.config
+        d, f, v = c.hidden_size, c.ffn_dim, c.vocab_size
+        L = c.n_layers
+        keys = jax.random.split(rng, 12)
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32) /
+                    math.sqrt(fan_in)).astype(dtype)
+
+        layers = {
+            "wq": dense(keys[0], (L, d, d), d),
+            "wk": dense(keys[1], (L, d, d), d),
+            "wv": dense(keys[2], (L, d, d), d),
+            "wo": dense(keys[3], (L, d, d), d),
+            "w_up": dense(keys[4], (L, d, f), d),
+            "w_down": dense(keys[5], (L, f, d), f),
+        }
+        for name, width in (("wq_b", d), ("wk_b", d), ("wv_b", d),
+                            ("wo_b", d), ("w_up_b", f), ("w_down_b", d)):
+            layers[name] = jnp.zeros((L, width), dtype)
+        layers["attn_norm"] = jnp.ones((L, d), dtype)
+        layers["attn_norm_b"] = jnp.zeros((L, d), dtype)
+        layers["mlp_norm"] = jnp.ones((L, d), dtype)
+        layers["mlp_norm_b"] = jnp.zeros((L, d), dtype)
+
+        return {
+            "tok_embed": dense(keys[6], (v, d), d),
+            "pos_embed": dense(keys[7], (c.max_seq_len, d), d),
+            "type_embed": dense(keys[8], (c.type_vocab_size, d), d),
+            "embed_norm": jnp.ones((d,), dtype),
+            "embed_norm_b": jnp.zeros((d,), dtype),
+            "layers": layers,
+            # MLM head: transform (dense+gelu+LN), decoder tied to tok_embed
+            "mlm_dense": dense(keys[9], (d, d), d),
+            "mlm_dense_b": jnp.zeros((d,), dtype),
+            "mlm_norm": jnp.ones((d,), dtype),
+            "mlm_norm_b": jnp.zeros((d,), dtype),
+            "mlm_bias": jnp.zeros((v,), dtype),
+        }
+
+    # ------------------------------------------------------------------
+    def tp_rules(self):
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.parallel.topology import TP_AXIS
+        return [
+            (r"wq_b|wk_b|wv_b|w_up_b", P(None, TP_AXIS)),
+            (r"wo_b|w_down_b|_norm", P()),
+            (r"wq|wk|wv|w_up", P(None, None, TP_AXIS)),
+            (r"wo|w_down", P(None, TP_AXIS, None)),
+        ]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _proj(h, layer, name):
+        return h @ layer[name] + layer[f"{name}_b"].astype(h.dtype)
+
+    def _layer(self, x, layer, pad_mask):
+        """Post-LN encoder block (BERT residual order)."""
+        c = self.config
+        B, S, d = x.shape
+        H, dh = c.n_heads, c.head_dim
+        q = self._proj(x, layer, "wq").reshape(B, S, H, dh)
+        k = self._proj(x, layer, "wk").reshape(B, S, H, dh)
+        v = self._proj(x, layer, "wv").reshape(B, S, H, dh)
+        attn = reference_attention(q, k, v, causal=False,
+                                   segment_ids=pad_mask)
+        x = _norm(x + self._proj(attn.reshape(B, S, d), layer, "wo"),
+                  layer["attn_norm"], c.norm_eps, False,
+                  layer["attn_norm_b"])
+        inner = jax.nn.gelu(self._proj(x, layer, "w_up"))
+        x = _norm(x + self._proj(inner, layer, "w_down"),
+                  layer["mlp_norm"], c.norm_eps, False,
+                  layer["mlp_norm_b"])
+        return x
+
+    def apply(self, params, input_ids, token_type_ids=None,
+              attention_mask=None, train=True, rng=None):
+        c = self.config
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        x = params["tok_embed"][input_ids] + \
+            params["pos_embed"][positions].astype(params["tok_embed"].dtype)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + params["type_embed"][token_type_ids].astype(x.dtype)
+        x = _norm(x, params["embed_norm"], c.norm_eps, False,
+                  params["embed_norm_b"])
+        # padding via segment ids: pad tokens get a different segment so
+        # attention never crosses; 1 = real token
+        pad_mask = (attention_mask.astype(jnp.int32)
+                    if attention_mask is not None
+                    else jnp.ones((B, S), jnp.int32))
+
+        def body(x, layer):
+            return self._layer(x, layer, pad_mask), None
+        body_fn = jax.checkpoint(body) if c.remat else body
+        x, _ = jax.lax.scan(body_fn, x, params["layers"])
+
+        h = jax.nn.gelu(x @ params["mlm_dense"] +
+                        params["mlm_dense_b"].astype(x.dtype))
+        h = _norm(h, params["mlm_norm"], c.norm_eps, False,
+                  params["mlm_norm_b"])
+        logits = (h @ params["tok_embed"].T.astype(h.dtype)).astype(
+            jnp.float32) + params["mlm_bias"].astype(jnp.float32)
+        return logits
+
+    __call__ = apply
+
+    # ------------------------------------------------------------------
+    def loss(self, params, batch, rng=None):
+        """Masked-LM loss: positions where ``labels != -100`` count."""
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        logits = self.apply(params, input_ids,
+                            token_type_ids=batch.get("token_type_ids"),
+                            attention_mask=batch.get("attention_mask"),
+                            rng=rng)
+        if labels is None:   # self-supervised fallback: reconstruct inputs
+            labels = input_ids
+        mask = (labels != -100).astype(jnp.float32)
+        safe = jnp.where(labels == -100, 0, labels)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

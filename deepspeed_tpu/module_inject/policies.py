@@ -268,8 +268,78 @@ class GPTNeoXPolicy(InjectionPolicy):
         return cfg, params
 
 
+class BertPolicy(InjectionPolicy):
+    """HF ``BertForMaskedLM`` (reference ``containers/bert.py`` HFBertLayer
+    policy).  Post-LN encoder → ``BertEncoder``; MLM head transform +
+    tied decoder + bias."""
+
+    model_types = ("bert",)
+
+    @classmethod
+    def model_cls(cls):
+        from deepspeed_tpu.models.bert import BertEncoder
+        return BertEncoder
+
+    @classmethod
+    def build(cls, hf, sd):
+        from deepspeed_tpu.models.bert import BertConfig
+        d, L = hf.hidden_size, hf.num_hidden_layers
+        cfg = BertConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.num_attention_heads,
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            type_vocab_size=hf.type_vocab_size,
+            norm_eps=hf.layer_norm_eps)
+
+        pre = "bert.encoder.layer.{}."
+        layers = {
+            "wq": _stack(sd, pre + "attention.self.query.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "attention.self.key.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "attention.self.value.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "attention.output.dense.weight", L,
+                         transpose=True),
+            "wq_b": _stack(sd, pre + "attention.self.query.bias", L),
+            "wk_b": _stack(sd, pre + "attention.self.key.bias", L),
+            "wv_b": _stack(sd, pre + "attention.self.value.bias", L),
+            "wo_b": _stack(sd, pre + "attention.output.dense.bias", L),
+            "attn_norm": _stack(sd, pre + "attention.output.LayerNorm.weight",
+                                L),
+            "attn_norm_b": _stack(sd, pre + "attention.output.LayerNorm.bias",
+                                  L),
+            "w_up": _stack(sd, pre + "intermediate.dense.weight", L,
+                           transpose=True),
+            "w_up_b": _stack(sd, pre + "intermediate.dense.bias", L),
+            "w_down": _stack(sd, pre + "output.dense.weight", L,
+                             transpose=True),
+            "w_down_b": _stack(sd, pre + "output.dense.bias", L),
+            "mlp_norm": _stack(sd, pre + "output.LayerNorm.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "output.LayerNorm.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["bert.embeddings.word_embeddings.weight"]),
+            "pos_embed": _np(sd["bert.embeddings.position_embeddings.weight"]),
+            "type_embed": _np(
+                sd["bert.embeddings.token_type_embeddings.weight"]),
+            "embed_norm": _np(sd["bert.embeddings.LayerNorm.weight"]),
+            "embed_norm_b": _np(sd["bert.embeddings.LayerNorm.bias"]),
+            "layers": layers,
+            "mlm_dense": _np(
+                sd["cls.predictions.transform.dense.weight"]).T,
+            "mlm_dense_b": _np(sd["cls.predictions.transform.dense.bias"]),
+            "mlm_norm": _np(
+                sd["cls.predictions.transform.LayerNorm.weight"]),
+            "mlm_norm_b": _np(sd["cls.predictions.transform.LayerNorm.bias"]),
+            "mlm_bias": _np(sd["cls.predictions.bias"]),
+        }
+        return cfg, params
+
+
 REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
-                                GPTNeoXPolicy]
+                                GPTNeoXPolicy, BertPolicy]
 
 
 def find_policy(hf_config) -> Optional[type]:

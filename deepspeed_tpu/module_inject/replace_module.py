@@ -54,11 +54,13 @@ def replace_transformer_layer(model, hf_config=None, dtype=None,
             f"'{getattr(hf_config, 'model_type', '?')}'; supported: {known}")
     sd = checkpoint_dict if checkpoint_dict is not None else _state_dict_of(model)
     cfg, params = policy.build(hf_config, sd)
+    model_cls = (policy.model_cls() if hasattr(policy, "model_cls")
+                 else CausalTransformerLM)
     logger.info(
-        f"module_inject: {hf_config.model_type} → CausalTransformerLM "
-        f"(L={cfg.n_layers} d={cfg.hidden_size} H={cfg.n_heads} "
-        f"V={cfg.vocab_size}) via {policy.__name__}")
-    return CausalTransformerLM(cfg), params
+        f"module_inject: {hf_config.model_type} → {model_cls.__name__} "
+        f"(L={cfg.n_layers} d={cfg.hidden_size} V={cfg.vocab_size}) "
+        f"via {policy.__name__}")
+    return model_cls(cfg), params
 
 
 # parity alias (the reference API name most users call indirectly)
