@@ -162,6 +162,15 @@ class InferenceEngine:
     def forward(self, input_ids, caches=None):
         """Single forward (prefill if caches empty).  Returns logits."""
         input_ids = jnp.asarray(input_ids)
+        if not hasattr(self.module, "apply_with_cache"):
+            # encoder-style model (e.g. BertEncoder): plain forward
+            if self._compiled_prefill is None:
+                def enc(params, ids):
+                    return self.module.apply(self._maybe_dequant(params),
+                                             ids, train=False)
+                self._compiled_prefill = jax.jit(enc)
+            with self.mesh:
+                return self._compiled_prefill(self.params, input_ids), None
         if caches is None:
             caches = self.module.init_caches(
                 input_ids.shape[0], self._config.max_out_tokens, self.dtype)

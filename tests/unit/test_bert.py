@@ -62,6 +62,21 @@ def test_bert_attention_mask_blocks_padding():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_bert_init_inference_forward():
+    hf = _hf_bert()
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    engine = deepspeed_tpu.init_inference(model=hf, dtype="fp32")
+    ids = np.random.default_rng(0).integers(0, V, (B, S))
+    logits, caches = engine.forward(ids)
+    assert caches is None and logits.shape == (B, S, V)
+    hf.eval()
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_array_equal(np.asarray(logits).argmax(-1),
+                                  ref.argmax(-1))
+
+
 def test_bert_mlm_training_with_engine():
     cfg = BertConfig.tiny(vocab_size=V, hidden_size=32, n_heads=4)
     model = BertEncoder(cfg)
