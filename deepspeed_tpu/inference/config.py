@@ -42,6 +42,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     return_tuple = True
     triangular_masking = True
     ep_size = 1
+    # ZeRO-Inference (reference engine.py:1581 offload-for-inference):
+    # {"offload_param": {"device": "cpu"|"nvme", "nvme_path": ...}}
+    zero = {}
 
     def _validate(self):
         if isinstance(self.tensor_parallel, dict):

@@ -52,6 +52,7 @@ class InferenceEngine:
         self.mesh = mesh
 
         self.params = None
+        self._streaming = False
         if params is None and config.checkpoint:
             params = self.load_model_with_checkpoint(config.checkpoint)
         if params is not None:
@@ -81,6 +82,9 @@ class InferenceEngine:
         plan = ZeroShardingPlan(self.mesh, stage=3, tp_rules=tp_rules,
                                 param_persistence_threshold=0)
         self.plan = plan
+        offload = dict(self._config.zero or {}).get("offload_param") or {}
+        if offload.get("device") in ("cpu", "nvme"):
+            return self._set_params_streaming(params, offload)
         qc = self._config.quant
         self._quantized = bool(qc.enabled) or str(
             self._config.dtype) in ("int8", "torch.int8")
@@ -97,6 +101,138 @@ class InferenceEngine:
                 else jnp.asarray(x), params)
         with self.mesh:
             self.params = jax.device_put(cast, plan.param_shardings(cast))
+
+    # ---- ZeRO-Inference weight streaming ------------------------------
+    def _set_params_streaming(self, params, offload):
+        """ZeRO-Inference for models larger than HBM: transformer-layer
+        weights live on the host (or NVMe) and stream to the device
+        layer-by-layer, double-buffered so the transfer of layer i+1
+        overlaps layer i's compute (reference: ZeRO-3 param offload reused
+        for inference, docs 2022-09-10-zero-inference.md)."""
+        assert hasattr(self.module, "config") and \
+            hasattr(self.module, "_layer_cached"), \
+            "weight streaming needs a CausalTransformerLM-style module"
+        c = self.module.config
+        np_dtype = np.dtype(jnp.bfloat16 if self.dtype == jnp.bfloat16
+                            else np.float32)
+
+        def host_cast(x):
+            x = np.asarray(x)
+            return x.astype(np_dtype) if np.issubdtype(x.dtype, np.floating) \
+                else x
+
+        layers = params["layers"]
+        assert not isinstance(layers, (list, tuple)), \
+            "streaming expects the stacked-layer layout"
+        self._n_layers = c.n_layers
+        host_layers = [
+            {k: host_cast(v[i]) for k, v in layers.items()}
+            for i in range(c.n_layers)]
+        self._nvme_swapper = None
+        if offload.get("device") == "nvme":
+            from deepspeed_tpu.runtime.zero.offload import \
+                PartitionedParamSwapper
+            import os
+            swap_dir = os.path.join(
+                str(offload.get("nvme_path") or "/tmp"),
+                "zero_inference_params")
+            self._nvme_swapper = PartitionedParamSwapper(
+                swap_dir, dtype=np_dtype,
+                buffer_count=int(offload.get("buffer_count", 5)))
+            self._layer_keys = [sorted(host_layers[0].keys())] * c.n_layers
+            for i, hl in enumerate(host_layers):
+                for k, v in hl.items():
+                    self._nvme_swapper.swap_out(f"L{i}.{k}", v)
+            self._nvme_swapper.release()
+            self._host_layers = None
+            log_dist(f"ZeRO-Inference: {c.n_layers} layers on NVMe at "
+                     f"{swap_dir}", ranks=[0])
+        else:
+            self._host_layers = host_layers
+            log_dist(f"ZeRO-Inference: {c.n_layers} layers in host RAM",
+                     ranks=[0])
+
+        rest = {k: v for k, v in params.items() if k != "layers"}
+        cast = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).astype(self.dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else jnp.asarray(x), rest)
+        with self.mesh:
+            self.params = jax.device_put(cast,
+                                         self.plan.param_shardings(cast))
+        self._streaming = True
+        self._jit_layer = None
+        self._jit_embed = None
+        self._jit_head = None
+
+    def _fetch_layer(self, i):
+        """Host/NVMe → device, asynchronously (device_put returns before
+        the transfer completes, so it overlaps compute)."""
+        if self._host_layers is not None:
+            host = self._host_layers[i]
+        else:
+            host = {k: self._nvme_swapper.swap_in(f"L{i}.{k}")
+                    for k in self._layer_keys[i]}
+        return jax.device_put(host)
+
+    def _streaming_apply_with_cache(self, input_ids, caches):
+        """Layer-streamed twin of ``CausalTransformerLM.apply_with_cache``
+        (list-of-caches layout; weights fetched per layer)."""
+        model, c = self.module, self.module.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, T = input_ids.shape
+        start = caches[0].length
+
+        if self._jit_embed is None:
+            def embed(rest, ids, start):
+                positions = start + jnp.broadcast_to(
+                    jnp.arange(ids.shape[1])[None, :], ids.shape)
+                x = rest["tok_embed"][ids]
+                if not c.use_rope:
+                    x = x + rest["pos_embed"][positions].astype(x.dtype)
+                return x, positions
+            self._jit_embed = jax.jit(embed)
+
+            def layer_step(layer, x, ck, cv, length, positions):
+                return model._layer_cached(x, layer, ck, cv, length,
+                                           positions)
+            self._jit_layer = jax.jit(layer_step)
+
+            def head(rest, x):
+                from deepspeed_tpu.models.transformer import _norm
+                x = _norm(x, rest["final_norm"], c.norm_eps, c.use_rmsnorm,
+                          rest.get("final_norm_b"))
+                hd = (rest["tok_embed"].T if c.tie_embeddings
+                      else rest["lm_head"])
+                return (x @ hd.astype(x.dtype)).astype(jnp.float32)
+            self._jit_head = jax.jit(head)
+
+        x, positions = self._jit_embed(self.params, input_ids, start)
+        new_caches = []
+        nxt = self._fetch_layer(0)
+        for i in range(self._n_layers):
+            layer, nxt = nxt, (self._fetch_layer(i + 1)
+                               if i + 1 < self._n_layers else None)
+            x, cache = self._jit_layer(layer, x, caches[i].k, caches[i].v,
+                                       start, positions)
+            new_caches.append(cache)
+        return self._jit_head(self.params, x), new_caches
+
+    def _streaming_generate(self, input_ids, max_new_tokens):
+        from deepspeed_tpu.ops.decode_attention import init_cache
+        c = self.module.config
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, S = input_ids.shape
+        caches = [init_cache(B, S + max_new_tokens, c.kv_heads, c.head_dim,
+                             self.dtype) for _ in range(self._n_layers)]
+        logits, caches = self._streaming_apply_with_cache(input_ids, caches)
+        toks = [jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)]
+        for _ in range(max_new_tokens - 1):
+            logits, caches = self._streaming_apply_with_cache(
+                toks[-1][:, None], caches)
+            toks.append(jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32))
+        return jnp.concatenate([input_ids] +
+                               [t[:, None] for t in toks], axis=1)
 
     # ---- weight-only quantization ------------------------------------
     @staticmethod
@@ -162,6 +298,16 @@ class InferenceEngine:
     def forward(self, input_ids, caches=None):
         """Single forward (prefill if caches empty).  Returns logits."""
         input_ids = jnp.asarray(input_ids)
+        if self._streaming:
+            if caches is None:
+                from deepspeed_tpu.ops.decode_attention import init_cache
+                c = self.module.config
+                caches = [init_cache(input_ids.shape[0],
+                                     self._config.max_out_tokens,
+                                     c.kv_heads, c.head_dim, self.dtype)
+                          for _ in range(self._n_layers)]
+            with self.mesh:
+                return self._streaming_apply_with_cache(input_ids, caches)
         if not hasattr(self.module, "apply_with_cache"):
             # encoder-style model (e.g. BertEncoder): plain forward
             if self._compiled_prefill is None:
@@ -193,6 +339,11 @@ class InferenceEngine:
         reference's CUDA-graph replay per token)."""
         input_ids = jnp.asarray(input_ids, jnp.int32)
         B, S = input_ids.shape
+        if self._streaming:
+            assert not temperature, \
+                "weight-streaming generate is greedy-only"
+            with self.mesh:
+                return self._streaming_generate(input_ids, max_new_tokens)
         max_seq = S + max_new_tokens
         key = (max_new_tokens, bool(temperature), top_k, B, S)
 
