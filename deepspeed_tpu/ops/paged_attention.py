@@ -1,0 +1,150 @@
+"""Paged (block-table) KV cache + ragged decode attention.
+
+Parity role: reference decode serving is a contiguous per-request KV
+workspace (``inference_context.h`` KV-cache workspace management).  The
+TPU-native upgrade is a *paged* cache — fixed-size pages shared across
+sequences through per-sequence block tables (vLLM/ragged-paged-attention
+style, cf. PAPERS.md) — which removes max-length over-allocation and lets
+sequences of very different lengths batch together.
+
+Layout:
+  k_pages/v_pages: [num_pages, page_size, Hkv, D] — the physical pool
+  block_tables:    [B, max_pages_per_seq] int32 — page ids per sequence
+  lengths:         [B] int32 — tokens currently stored per sequence
+
+Compute path is jnp (gather + masked softmax, fused by XLA); the Pallas
+kernel can swap in under the same API.  Page allocation is host-side
+(``PagedAllocator``) because it is control flow, not compute.
+"""
+
+import math
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PagedKVCache(NamedTuple):
+    k_pages: jnp.ndarray   # [P, page, Hkv, D]
+    v_pages: jnp.ndarray
+
+
+def init_paged_cache(num_pages, page_size, n_kv_heads, head_dim,
+                     dtype=jnp.bfloat16) -> PagedKVCache:
+    shape = (num_pages, page_size, n_kv_heads, head_dim)
+    return PagedKVCache(k_pages=jnp.zeros(shape, dtype),
+                        v_pages=jnp.zeros(shape, dtype))
+
+
+def append_paged(cache: PagedKVCache, block_tables, lengths, k_new, v_new
+                 ) -> Tuple[PagedKVCache, jnp.ndarray]:
+    """Append ONE token per sequence (decode step).
+
+    k_new/v_new: [B, 1, Hkv, D].  Returns (cache, new lengths).  The pages
+    written must already be mapped in ``block_tables`` (allocator's job).
+    """
+    B = k_new.shape[0]
+    page_size = cache.k_pages.shape[1]
+    page_idx = jnp.take_along_axis(
+        block_tables, (lengths // page_size)[:, None], axis=1)[:, 0]
+    offset = lengths % page_size
+    k = cache.k_pages.at[page_idx, offset].set(
+        k_new[:, 0].astype(cache.k_pages.dtype))
+    v = cache.v_pages.at[page_idx, offset].set(
+        v_new[:, 0].astype(cache.v_pages.dtype))
+    return PagedKVCache(k_pages=k, v_pages=v), lengths + 1
+
+
+def prefill_paged(cache: PagedKVCache, block_tables, lengths, k_new, v_new
+                  ) -> Tuple[PagedKVCache, jnp.ndarray]:
+    """Write a whole prompt [B, T, Hkv, D] starting at ``lengths`` (which is
+    typically zero)."""
+    B, T = k_new.shape[:2]
+    page_size = cache.k_pages.shape[1]
+    pos = lengths[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    page_idx = jnp.take_along_axis(block_tables, pos // page_size, axis=1)
+    offset = pos % page_size
+    k = cache.k_pages.at[page_idx, offset].set(
+        k_new.astype(cache.k_pages.dtype))
+    v = cache.v_pages.at[page_idx, offset].set(
+        v_new.astype(cache.v_pages.dtype))
+    return PagedKVCache(k_pages=k, v_pages=v), lengths + T
+
+
+def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
+                           softmax_scale: Optional[float] = None):
+    """q: [B, T, H, D] — the last T tokens of each sequence (T=1 decode).
+
+    Gathers each sequence's pages into its logical view and runs masked
+    attention over the valid ragged prefix."""
+    B, T, H, D = q.shape
+    page_size = cache.k_pages.shape[1]
+    Hkv = cache.k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    S = max_pages * page_size
+
+    # [B, max_pages, page, Hkv, D] → [B, S, Hkv, D]
+    k = cache.k_pages[block_tables].reshape(B, S, Hkv, D)
+    v = cache.v_pages[block_tables].reshape(B, S, Hkv, D)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    kpos = jnp.arange(S)[None, None, :]                       # [1, 1, S]
+    qpos = (lengths[:, None] - T + jnp.arange(T)[None, :])[..., None]
+    mask = kpos <= qpos                                       # [B, T, S]
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+class PagedAllocator:
+    """Host-side page bookkeeping (the control-flow half of vLLM's block
+    manager): per-sequence page lists over a fixed pool, with free-list
+    reuse."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 max_pages_per_seq: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self.free: List[int] = list(range(num_pages))
+        self.seq_pages = {}
+
+    def can_allocate(self, n_pages: int) -> bool:
+        return len(self.free) >= n_pages
+
+    def allocate(self, seq_id, n_tokens: int) -> List[int]:
+        need = -(-n_tokens // self.page_size)
+        assert need <= self.max_pages_per_seq, \
+            f"{n_tokens} tokens exceed max_pages_per_seq"
+        assert self.can_allocate(need), "out of KV pages"
+        pages = [self.free.pop() for _ in range(need)]
+        self.seq_pages[seq_id] = pages
+        return pages
+
+    def extend(self, seq_id, total_tokens: int) -> List[int]:
+        """Ensure ``seq_id`` has pages for ``total_tokens``; allocates new
+        pages as it crosses page boundaries."""
+        pages = self.seq_pages[seq_id]
+        need = -(-total_tokens // self.page_size)
+        assert need <= self.max_pages_per_seq, \
+            f"{total_tokens} tokens exceed max_pages_per_seq"
+        while len(pages) < need:
+            assert self.free, "out of KV pages"
+            pages.append(self.free.pop())
+        return pages
+
+    def free_sequence(self, seq_id):
+        self.free.extend(self.seq_pages.pop(seq_id, []))
+
+    def block_table(self, seq_ids) -> np.ndarray:
+        """[B, max_pages_per_seq] table (0-padded) for the given batch."""
+        out = np.zeros((len(seq_ids), self.max_pages_per_seq), np.int32)
+        for b, sid in enumerate(seq_ids):
+            pages = self.seq_pages[sid]
+            out[b, :len(pages)] = pages
+        return out
