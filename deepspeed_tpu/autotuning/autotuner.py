@@ -65,7 +65,8 @@ class Autotuner:
                 hbm_bytes = 16 << 30
         self.hbm_bytes = hbm_bytes
         self.rm = ResourceManager(self.at_config.results_dir,
-                                  metric=self.at_config.metric)
+                                  metric=self.at_config.metric,
+                                  overwrite=self.at_config.overwrite)
 
     # ------------------------------------------------------------------
     def feasible_stages(self, dp: int) -> List[int]:

@@ -29,9 +29,10 @@ class Experiment:
 class ResourceManager:
 
     def __init__(self, results_dir: str = "autotuning_results",
-                 metric: str = "throughput"):
+                 metric: str = "throughput", overwrite: bool = True):
         self.results_dir = results_dir
         self.metric = metric
+        self.overwrite = overwrite
         self.experiments: List[Experiment] = []
         os.makedirs(results_dir, exist_ok=True)
 
@@ -42,15 +43,25 @@ class ResourceManager:
         self.experiments.extend(exps)
 
     def run(self, run_fn: Callable[[Experiment], Dict[str, Any]]):
-        """Run all pending experiments; previously-journaled results are
-        reused (reference skip-finished behaviour)."""
+        """Run all pending experiments.  With ``overwrite=False``,
+        previously-journaled results are reused (reference skip-finished
+        behaviour) — but only when the journaled ds_config matches this
+        experiment's, so a stale ``autotuning_results/`` dir from a
+        different model can't supply wrong measurements under the same
+        experiment name."""
         for exp in self.experiments:
             path = self._result_path(exp)
-            if exp.result is None and os.path.exists(path):
+            if exp.result is None and not self.overwrite \
+                    and os.path.exists(path):
                 with open(path) as f:
-                    exp.result = json.load(f)
-                logger.info(f"autotuning: reusing journaled {exp.name}")
-                continue
+                    journaled = json.load(f)
+                if journaled.get("ds_config") == json.loads(
+                        json.dumps(exp.ds_config, default=str)):
+                    exp.result = journaled
+                    logger.info(f"autotuning: reusing journaled {exp.name}")
+                    continue
+                logger.info(f"autotuning: journaled {exp.name} has a "
+                            "different ds_config; re-running")
             if exp.result is not None:
                 continue
             t0 = time.time()
@@ -66,7 +77,10 @@ class ResourceManager:
                 json.dump(metrics, f, indent=1, default=str)
 
     def best_experiment(self) -> Optional[Experiment]:
-        done = [e for e in self.experiments if e.done()]
+        # failed experiments (crash/OOM) must never win — a {metric: 0.0}
+        # sentinel would rank first under minimize metrics like latency
+        done = [e for e in self.experiments
+                if e.done() and "error" not in e.result]
         if not done:
             return None
         sign = -1 if self.metric == "latency" else 1

@@ -140,6 +140,13 @@ def _get_compatible_gpus_v02(micro_batches: List[int],
         micro_batches, max_acceptable_batch_size, dp_min, dp_max,
         prefer_larger)
     valid_gpus = [d * model_parallel_size for d in valid_dp]
+    if current_num_gpus == 0:
+        # inspection path (no running world, e.g. bin/ds_elastic): report
+        # the solved batch/valid set without a current-world membership
+        # check; the micro batch is the solver's own candidate (reference
+        # returns candidate_microbatch_size when world_size is absent)
+        micro = max(m for m in micro_batches if batch % m == 0)
+        return batch, valid_gpus, micro
     current_dp = current_num_gpus // model_parallel_size
     if current_dp not in valid_dp:
         raise ElasticityIncompatibleWorldSize(
@@ -172,9 +179,8 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
                     "set ignore_non_elastic_batch_info")
 
     if cfg.version >= 0.2 and (cfg.model_parallel_size > 1 or world_size):
-        ws = world_size or cfg.model_parallel_size
         batch, valid, micro = _get_compatible_gpus_v02(
-            cfg.micro_batches, cfg.max_acceptable_batch_size, ws,
+            cfg.micro_batches, cfg.max_acceptable_batch_size, world_size,
             cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size,
             cfg.num_gpus_per_node, cfg.model_parallel_size)
         logger.info(f"elasticity v0.2: batch={batch} valid_gpus={valid} "

@@ -165,14 +165,27 @@ class InferenceEngine:
         self._jit_embed = None
         self._jit_head = None
 
+    def _issue_layer_reads(self, i):
+        """Queue async NVMe reads for layer ``i`` (they run while the
+        device crunches earlier layers)."""
+        if self._nvme_swapper is None or not (0 <= i < self._n_layers):
+            return
+        if i not in self._nvme_pending:
+            self._nvme_pending[i] = {
+                k: self._nvme_swapper.swap_in(f"L{i}.{k}", async_op=True)
+                for k in self._layer_keys[i]}
+
     def _fetch_layer(self, i):
-        """Host/NVMe → device, asynchronously (device_put returns before
-        the transfer completes, so it overlaps compute)."""
+        """Host/NVMe → device.  Host path: device_put returns before the
+        transfer completes, so it overlaps compute.  NVMe path: reads were
+        issued earlier by ``_issue_layer_reads`` and only synchronized
+        here, after the previous layer's compute was dispatched."""
         if self._host_layers is not None:
             host = self._host_layers[i]
         else:
-            host = {k: self._nvme_swapper.swap_in(f"L{i}.{k}")
-                    for k in self._layer_keys[i]}
+            self._issue_layer_reads(i)
+            self._nvme_swapper.synchronize_reads()
+            host = self._nvme_pending.pop(i)
         return jax.device_put(host)
 
     def _streaming_apply_with_cache(self, input_ids, caches):
@@ -209,13 +222,18 @@ class InferenceEngine:
 
         x, positions = self._jit_embed(self.params, input_ids, start)
         new_caches = []
+        self._nvme_pending = {}
         nxt = self._fetch_layer(0)
+        self._issue_layer_reads(1)
         for i in range(self._n_layers):
-            layer, nxt = nxt, (self._fetch_layer(i + 1)
-                               if i + 1 < self._n_layers else None)
-            x, cache = self._jit_layer(layer, x, caches[i].k, caches[i].v,
+            # dispatch layer i (async on device), THEN wait for layer
+            # i+1's host/NVMe transfer — so I/O overlaps compute
+            x, cache = self._jit_layer(nxt, x, caches[i].k, caches[i].v,
                                        start, positions)
             new_caches.append(cache)
+            if i + 1 < self._n_layers:
+                nxt = self._fetch_layer(i + 1)
+                self._issue_layer_reads(i + 2)
         return self._jit_head(self.params, x), new_caches
 
     def _streaming_generate(self, input_ids, max_new_tokens):
@@ -239,21 +257,42 @@ class InferenceEngine:
     def _is_qleaf(x):
         return isinstance(x, dict) and "qv" in x and "qs" in x
 
+    @staticmethod
+    def _is_linear_weight(path, x):
+        """Weight-only quantization targets matmul weights only — the
+        reference ZeroQuant path never quantizes norm scales/biases or
+        embeddings (doing so needlessly degrades accuracy)."""
+        name = str(path[-1]).strip("'[]") if path else ""
+        lname = name.lower()
+        if "norm" in lname or "embed" in lname or lname.endswith("_b") \
+                or "bias" in lname:
+            return False
+        if lname == "wg":
+            # MoE router gate: kept fp32 by the model for routing
+            # precision — quantizing it can flip expert assignments
+            return False
+        # stacked layout: linear weights are [L, in, out] (3-D) or plain
+        # [in, out] (2-D, e.g. lm_head / per-layer MoE dicts)
+        return x.ndim >= 2
+
     def _quantize_tree(self, params):
         from deepspeed_tpu.ops.quantizer import quantize
 
-        def q(x):
+        def q(path, x):
             x = jnp.asarray(x)
-            if x.ndim >= 2 and jnp.issubdtype(x.dtype, jnp.floating):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            name = (str(path[-1]).strip("'[]") if path else "").lower()
+            if name == "wg":
+                return x  # router gate stays in its fp32 compute dtype
+            if self._is_linear_weight(path, x):
                 groups = (x.size // self._quant_group_size
                           if x.size % self._quant_group_size == 0 else 1)
                 qt = quantize(x, groups=max(1, groups),
                               num_bits=self._quant_bits)
                 return {"qv": qt.values, "qs": qt.scale, "qz": qt.zero_point}
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                return x.astype(self.dtype)
-            return x
-        return jax.tree_util.tree_map(q, params)
+            return x.astype(self.dtype)
+        return jax.tree_util.tree_map_with_path(q, params)
 
     def _maybe_dequant(self, params):
         """Inside-jit dequant of quantized leaves (fused by XLA)."""

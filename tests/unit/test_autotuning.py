@@ -56,13 +56,30 @@ def test_resource_manager_journal_and_best(tmp_path):
     with open(tmp_path / "b.json") as f:
         assert json.load(f)["throughput"] == 9.0
 
-    # a fresh manager reuses journals without re-running
-    rm2 = ResourceManager(str(tmp_path), metric="throughput")
-    rm2.schedule_experiments([Experiment("a", {}), Experiment("b", {})])
+    # a fresh manager with overwrite=False reuses journals (same ds_config)
+    rm2 = ResourceManager(str(tmp_path), metric="throughput",
+                          overwrite=False)
+    rm2.schedule_experiments([Experiment("a", {"x": 1}),
+                              Experiment("b", {"x": 2})])
     calls = []
     rm2.run(lambda e: calls.append(e.name) or {"throughput": 0.0})
     assert calls == []
     assert rm2.best_experiment().name == "b"
+
+    # a journaled result for a DIFFERENT ds_config is not trusted
+    rm3 = ResourceManager(str(tmp_path), metric="throughput",
+                          overwrite=False)
+    rm3.schedule_experiments([Experiment("a", {"x": 999})])
+    calls = []
+    rm3.run(lambda e: calls.append(e.name) or {"throughput": 1.0})
+    assert calls == ["a"]
+
+    # default overwrite=True always re-runs
+    rm4 = ResourceManager(str(tmp_path), metric="throughput")
+    rm4.schedule_experiments([Experiment("a", {"x": 1})])
+    calls = []
+    rm4.run(lambda e: calls.append(e.name) or {"throughput": 1.0})
+    assert calls == ["a"]
 
 
 def test_failed_experiment_scores_zero(tmp_path):
@@ -77,6 +94,27 @@ def test_failed_experiment_scores_zero(tmp_path):
     assert rm.best_experiment().name == "ok"
     with open(tmp_path / "bad.json") as f:
         assert "OOM" in json.load(f)["error"]
+
+
+def test_failed_experiment_never_wins_latency(tmp_path):
+    """A crashed/OOM experiment must not win under a minimize metric —
+    its 0.0 sentinel would otherwise rank as the best latency."""
+    rm = ResourceManager(str(tmp_path), metric="latency")
+
+    def run(e):
+        if e.name == "bad":
+            raise RuntimeError("OOM")
+        return {"latency": 3.5}
+    rm.schedule_experiments([Experiment("bad", {}), Experiment("ok", {})])
+    rm.run(run)
+    assert rm.best_experiment().name == "ok"
+
+
+def test_all_failed_experiments_best_is_none(tmp_path):
+    rm = ResourceManager(str(tmp_path))
+    rm.schedule_experiments([Experiment("bad", {})])
+    rm.run(lambda e: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert rm.best_experiment() is None
 
 
 def test_end_to_end_tune_real_engine(tmp_path):

@@ -61,6 +61,18 @@ def test_v02_model_parallel():
     assert batch % (micro * (16 // 4)) == 0
 
 
+def test_v02_inspection_no_world_size():
+    """bin/ds_elastic path: model_parallel_size>1 with NO running world —
+    must report (batch, valid_gpus) without a current-world membership
+    check (reference behaviour when world_size is not supplied)."""
+    cfg = {"elasticity": {**BASE["elasticity"], "version": 0.2,
+                          "model_parallel_size": 4, "min_gpus": 32,
+                          "max_gpus": 64}}
+    batch, valid = compute_elastic_config(cfg)
+    assert valid and all(v % 4 == 0 for v in valid)
+    assert all(32 <= v <= 64 for v in valid)
+
+
 def test_v02_incompatible_world_size():
     cfg = {"elasticity": {**BASE["elasticity"], "version": 0.2,
                           "model_parallel_size": 4, "min_gpus": 4,
