@@ -5,9 +5,11 @@ the fused attention-with-KV-cache kernel behind ``DeepSpeedTransformerInference`
 
 TPU design: the cache is a static-shape ring buffer [B, max_seq, Hkv, D]
 updated with ``lax.dynamic_update_slice`` (static shapes keep XLA happy in a
-decode loop); attention masks positions ≥ cur_len.  A Pallas paged/ragged
-variant can replace the inner product for long-context serving (see
-PAPERS.md ragged paged attention).
+decode loop); attention masks positions ≥ cur_len.  Two compute paths
+behind one API: the Pallas online-softmax kernel
+(``ops/pallas/decode_attention.py`` — never fetches cache blocks past the
+valid length, never materialises [S] logits in HBM) on TPU, and this
+module's jnp path as the oracle/fallback.
 """
 
 import math
@@ -15,6 +17,25 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+DEFAULT_BLOCK_K = 256
+
+
+def use_pallas(impl, seq_len=None, block_k=DEFAULT_BLOCK_K):
+    """Shared impl-dispatch policy for decode/paged attention.
+
+    ``impl``: "jnp" | "pallas" | None (auto: Pallas on TPU when the cache
+    tiles).  ``seq_len=None`` skips the divisibility check (paged caches
+    always tile by page)."""
+    if impl == "jnp":
+        return False
+    if impl == "pallas":
+        return True
+    assert impl is None, f"unknown impl {impl!r}; expected jnp/pallas/None"
+    if jax.default_backend() != "tpu":
+        return False
+    return seq_len is None or seq_len % min(block_k, seq_len) == 0
 
 
 class KVCache(NamedTuple):
@@ -39,10 +60,22 @@ def update_cache(cache: KVCache, k_new, v_new) -> KVCache:
     return KVCache(k=k, v=v, length=start + k_new.shape[1])
 
 
-def decode_attention(q, cache: KVCache, softmax_scale=None):
+def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
+                     block_k=DEFAULT_BLOCK_K, interpret=False):
     """q: [B, T, H, D] (T=1 decode or T=prompt prefill, already appended to
-    cache); attends over cache[:length].  fp32 softmax."""
+    cache); attends over cache[:length].  fp32 softmax.
+
+    ``impl``: None (auto: Pallas kernel on TPU, jnp elsewhere), "pallas",
+    or "jnp"."""
     B, T, H, D = q.shape
+    if use_pallas(impl, cache.k.shape[1], block_k):
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            decode_attention_pallas
+        lengths = jnp.broadcast_to(jnp.asarray(cache.length, jnp.int32), (B,))
+        return decode_attention_pallas(q, cache.k, cache.v, lengths,
+                                       softmax_scale=softmax_scale,
+                                       block_k=block_k,
+                                       interpret=interpret)
     Hkv = cache.k.shape[2]
     k, v = cache.k, cache.v
     if Hkv != H:

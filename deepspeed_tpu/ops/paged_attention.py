@@ -12,9 +12,12 @@ Layout:
   block_tables:    [B, max_pages_per_seq] int32 — page ids per sequence
   lengths:         [B] int32 — tokens currently stored per sequence
 
-Compute path is jnp (gather + masked softmax, fused by XLA); the Pallas
-kernel can swap in under the same API.  Page allocation is host-side
-(``PagedAllocator``) because it is control flow, not compute.
+Two compute paths behind one API: the Pallas kernel
+(``ops/pallas/decode_attention.py:paged_attention_pallas`` — the key-block
+index map reads the block table so only each sequence's own pages are
+DMA'd) on TPU, and this module's jnp gather + masked softmax as the
+oracle/fallback.  Page allocation is host-side (``PagedAllocator``)
+because it is control flow, not compute.
 """
 
 import math
@@ -73,11 +76,22 @@ def prefill_paged(cache: PagedKVCache, block_tables, lengths, k_new, v_new
 
 
 def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
-                           softmax_scale: Optional[float] = None):
+                           softmax_scale: Optional[float] = None,
+                           impl: Optional[str] = None,
+                           interpret: bool = False):
     """q: [B, T, H, D] — the last T tokens of each sequence (T=1 decode).
 
-    Gathers each sequence's pages into its logical view and runs masked
-    attention over the valid ragged prefix."""
+    ``impl``: None (auto: Pallas kernel on TPU, jnp elsewhere), "pallas",
+    or "jnp".  The jnp path gathers each sequence's pages into its logical
+    view and runs masked attention over the valid ragged prefix."""
+    from deepspeed_tpu.ops.decode_attention import use_pallas
+    if use_pallas(impl):
+        from deepspeed_tpu.ops.pallas.decode_attention import \
+            paged_attention_pallas
+        return paged_attention_pallas(q, cache.k_pages, cache.v_pages,
+                                      block_tables, lengths,
+                                      softmax_scale=softmax_scale,
+                                      interpret=interpret)
     B, T, H, D = q.shape
     page_size = cache.k_pages.shape[1]
     Hkv = cache.k_pages.shape[2]
@@ -98,7 +112,8 @@ def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
     mask = kpos <= qpos                                       # [B, T, S]
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)   # impl-independent output dtype
 
 
 class PagedAllocator:

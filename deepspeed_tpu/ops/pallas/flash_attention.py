@@ -11,9 +11,14 @@ in VMEM (fine to S≈8k at D=128); inner ``fori_loop`` over K blocks carries
 (acc, row-max, row-sum) registers.  Causal blocks beyond the diagonal are
 skipped via the loop bound, the diagonal block is masked with iota.
 
-Backward: custom VJP using the saved log-sum-exp — the standard flash
-backward expressed as jnp einsums (XLA tiles them); a full Pallas backward
-kernel can replace it behind the same signature.
+Backward: custom VJP using the saved log-sum-exp, as two Pallas kernels —
+``_bwd_dq_kernel`` (grid over q blocks; streams K/V) and
+``_bwd_dkv_kernel`` (grid over k blocks; streams Q/dO) — O(S) memory,
+recomputing the probabilities tile-by-tile instead of materialising the
+[B,H,S,S] score matrix.  ``_flash_bwd`` (jnp einsums) is the test oracle
+only: non-tiling shapes never reach the custom VJP, because
+``flash_attention()`` routes them to ``reference_attention`` (whose
+autodiff handles their gradient) before the VJP is involved.
 """
 
 import functools
@@ -118,6 +123,163 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False):
     return out, lse.reshape(B, H, S)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_len):
+    """dQ for one (batch·head, q-block): stream K/V blocks, recompute P
+    from the saved LSE, accumulate dq = Σ_kb dS @ K."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
+    lse = lse_ref[0].reshape(block_q, 1)               # [BQ, 1]
+    delta = delta_ref[0].reshape(block_q, 1)           # [BQ, 1]
+    d = q.shape[-1]
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse)
+        p = jnp.where(s <= _NEG / 2, 0.0, p)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_len):
+    """dK/dV for one (batch·head, k-block): stream Q/dO blocks.
+    dv = Σ_qb Pᵀ @ dO;  dk = Σ_qb dSᵀ @ Q."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                   # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+
+    num_q_blocks = seq_len // block_q
+    lo = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)].reshape(
+            block_q, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse)
+        p = jnp.where(s <= _NEG / 2, 0.0, p)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
+                      interpret=False):
+    """O(S)-memory flash backward: recompute P per tile from the saved LSE.
+    Returns (dq, dk, dv) with GQA group reduction."""
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+
+    qr = jnp.swapaxes(q, 1, 2).reshape(B * H, S, D)
+    kr = jnp.swapaxes(k, 1, 2).reshape(B * Hkv, S, D)
+    vr = jnp.swapaxes(v, 1, 2).reshape(B * Hkv, S, D)
+    gr = jnp.swapaxes(g, 1, 2).reshape(B * H, S, D)
+    of = jnp.swapaxes(out, 1, 2).reshape(B * H, S, D)
+    lser = lse.reshape(B * H, S)
+    # delta_i = Σ_d dO_i · O_i  (the softmax-jacobian row term)
+    delta = jnp.sum(gr.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)
+
+    kv_spec = pl.BlockSpec((1, S, D), lambda bh, i, g=group: (bh // g, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, delta)
+
+    full_spec = pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(B * H, S // block_k),
+        in_specs=[
+            full_spec,                                     # q
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, ki, g=group: (bh // g, ki, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, ki, g=group: (bh // g, ki, 0)),
+            full_spec,                                     # dO
+            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),  # lse
+            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, delta)
+
+    dq = jnp.swapaxes(dq.reshape(B, H, S, D), 1, 2)
+    dk = dk.reshape(B, Hkv, group, S, D).sum(axis=2)     # GQA group reduce
+    dv = dv.reshape(B, Hkv, group, S, D).sum(axis=2)
+    dk = jnp.swapaxes(dk, 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dv, 1, 2).astype(v.dtype)
+    return dq, dk, dv
+
+
 def _flash_bwd(scale, causal, res, g):
     """Flash backward from saved LSE (jnp einsums; fp32)."""
     q, k, v, out, lse = res
@@ -171,7 +333,10 @@ def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k,
 
 
 def _flash_attention_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(scale, causal, res, g)
+    # the forward only runs the kernel on tiling shapes, so the tiled
+    # backward applies whenever this VJP is reached
+    return _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
+                             interpret)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
