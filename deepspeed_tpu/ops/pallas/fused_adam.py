@@ -66,12 +66,11 @@ def fused_adam_pallas(params, grads, state, lr=1e-3, beta1=0.9, beta2=0.999,
     # pad + tile the flat buffer to [rows, 128]
     tile = _BLOCK_ROWS * _LANES
     n_pad = -n % tile
-    def shape2d(x, dtype=None):
+    def shape2d(x):
         x = x.reshape(-1)
         if n_pad:
             x = jnp.pad(x, (0, n_pad))
-        return x.reshape(-1, _LANES) if dtype is None else \
-            x.reshape(-1, _LANES).astype(dtype)
+        return x.reshape(-1, _LANES)
 
     p2 = shape2d(params)
     g2 = shape2d(grads)

@@ -4,12 +4,14 @@ Parity: reference ``deepspeed/ops/sparse_attention/`` — Triton block-sparse
 sddmm/softmax/dsd kernels (``matmul.py:8-14``, ``softmax.py``) behind
 ``SparseSelfAttention``/``SparseAttentionUtils``.
 
-TPU design: the layout is a tile mask.  The kernel path reuses the Pallas
-flash attention with a block-mask bias; the portable path materialises the
-block mask and runs masked softmax attention — XLA already tiles the masked
-QK^T onto the MXU, and fully-masked tiles are skipped by the flash kernel's
-block iteration.  Same asymptotics as the Triton kernels: compute scales
-with the number of set blocks.
+TPU design: two paths behind one API.  The Pallas kernel
+(``ops/pallas/sparse_attention.py``) precomputes the static layout into an
+active-block index table and iterates ONLY set blocks — DMA and MXU work
+scale with the set-block count, the same asymptotics the reference gets
+from Triton sddmm/dsd.  The jnp path here materialises the block mask and
+runs dense masked softmax (O(S²) compute): it is the oracle, the CPU
+fallback, and the path for ``key_padding_mask`` (dynamic per-batch
+masking, which the static-layout kernel does not take).
 """
 
 from typing import Optional
@@ -32,9 +34,26 @@ def expand_layout_mask(layout: np.ndarray, block: int, seq_len: int
 
 def sparse_attention(q, k, v, layout: np.ndarray, block: int,
                      causal: bool = False, softmax_scale: Optional[float] = None,
-                     key_padding_mask=None):
-    """Block-sparse attention.  q/k/v: [B, S, H, D]; layout [H, nb, nb]."""
+                     key_padding_mask=None, impl: Optional[str] = None,
+                     interpret: bool = False):
+    """Block-sparse attention.  q/k/v: [B, S, H, D]; layout [H, nb, nb].
+
+    ``impl``: None (auto: Pallas kernel on TPU when applicable), "pallas",
+    or "jnp"."""
+    from deepspeed_tpu.ops.decode_attention import use_pallas
     B, S, H, D = q.shape
+    kernel_ok = key_padding_mask is None and S % block == 0
+    if impl is None and not kernel_ok:
+        impl = "jnp"   # auto never picks the kernel for padded/non-tiling
+    if use_pallas(impl, seq_len=None):
+        assert kernel_ok, "pallas sparse attention needs block-tiling " \
+            "shapes and no key_padding_mask"
+        from deepspeed_tpu.ops.pallas.sparse_attention import \
+            sparse_attention_pallas
+        return sparse_attention_pallas(q, k, v, layout, block,
+                                       causal=causal,
+                                       softmax_scale=softmax_scale,
+                                       interpret=interpret)
     scale = softmax_scale if softmax_scale is not None else D ** -0.5
     mask = jnp.asarray(expand_layout_mask(layout, block, S))  # [H, S, S]
     if causal:
