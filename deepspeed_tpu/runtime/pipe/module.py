@@ -271,7 +271,8 @@ class PipelineModule:
                  topology=None, loss_fn: Optional[Callable] = None,
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
-                 seed_layers: bool = False):
+                 seed_layers: bool = False,
+                 schedule: str = "1f1b"):
         if topology is not None and num_stages is None:
             num_stages = topology.get_dim("pipe") or topology.get_dim("pp")
         # num_stages=None resolves lazily from the active mesh's pp axis.
@@ -289,6 +290,9 @@ class PipelineModule:
         # is exported for grid-planning parity)
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        # "1f1b" caps in-flight activation residuals at ~P microbatches
+        # (reference TrainSchedule memory behaviour); "gpipe" stores all M
+        self.schedule = schedule
 
         self._specs = list(layers)
         self._layers = [s.build() if isinstance(s, LayerSpec) else s
@@ -418,7 +422,8 @@ class PipelineModule:
 
         x = jax.vmap(pre_fn)(batch_mbs)
         stage_params = stack_stage_params(params["body"], self.num_stages)
-        x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages)
+        x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages,
+                          schedule=self.schedule)
 
         def post_fn(h):
             for j in range(end, len(self._layers)):
@@ -447,7 +452,8 @@ class PipelineModule:
         # _stage_fn already checkpoints per layer when activation
         # checkpointing is on — no second stage-level remat wrap
         stage_params = stack_stage_params(params["body"], self.num_stages)
-        x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages)
+        x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages,
+                          schedule=self.schedule)
 
         def mb_loss(args):
             h, mb = args
@@ -476,8 +482,8 @@ class PipelineModule:
 def transformer_pipeline(config: TransformerConfig,
                          num_stages: Optional[int] = None,
                          loss_fn: Optional[Callable] = None,
-                         activation_checkpoint_interval: int = 0
-                         ) -> PipelineModule:
+                         activation_checkpoint_interval: int = 0,
+                         schedule: str = "1f1b") -> PipelineModule:
     """GPT2ModelPipe-style convenience: embedding → N blocks → norm+head
     (parity: Megatron-DeepSpeed ``GPT2ModelPipe`` construction)."""
     specs: List[LayerSpec] = []
@@ -493,4 +499,5 @@ def transformer_pipeline(config: TransformerConfig,
         specs.append(LayerSpec(LMHeadPipe, config))
     return PipelineModule(
         specs, num_stages=num_stages, loss_fn=loss_fn,
-        activation_checkpoint_interval=activation_checkpoint_interval)
+        activation_checkpoint_interval=activation_checkpoint_interval,
+        schedule=schedule)

@@ -20,10 +20,20 @@ TPU-first redesign: the WHOLE pipelined step is one jitted SPMD program.
   yields the reverse-clocked pipeline (grad ticks flow last-stage→first),
   which is exactly the reference's BackwardPass/SendGrad/RecvGrad stream.
 
-This is the GPipe schedule (fill, steady state, drain — bubble fraction
-``(P-1)/(M+P-1)``).  The reference's 1F1B ``TrainSchedule`` reduces peak
-activation memory, not bubble; here ``jax.checkpoint`` on the stage body plays
-that role (recompute in the drain instead of storing M microbatches).
+Schedules (both have bubble fraction ``(P-1)/(M+P-1)``; they differ in
+peak activation memory, exactly like the reference's ``InferenceSchedule``
+vs ``TrainSchedule``):
+
+* ``"gpipe"`` — one flat scan over the T clock ticks.  Scan autodiff saves
+  every tick's [P, ...] stage-input buffer: O(M) residuals per device.
+* ``"1f1b"`` (default) — the T ticks run as an outer scan over chunks of P
+  ticks with the chunk body rematerialised (``jax.checkpoint``).  Autodiff
+  then saves only the [P, ...] carry at each chunk boundary and replays a
+  chunk's ticks during backward: O(M/P + P) residuals per device — the
+  1F1B operating point (peak ≈ P in-flight microbatches), bought with one
+  forward recompute, the same price the reference pays for
+  activation-checkpointed 1F1B (``runtime/pipe/schedule.py:184``
+  ``TrainSchedule`` + activation checkpointing).
 """
 
 from functools import partial
@@ -47,7 +57,8 @@ def pipeline_spmd(stage_fn: Callable,
                   stage_params: Any,
                   x_mbs: jax.Array,
                   num_stages: int,
-                  remat: bool = False) -> jax.Array:
+                  remat: bool = False,
+                  schedule: str = "1f1b") -> jax.Array:
     """Run ``M`` microbatches through ``P = num_stages`` pipeline stages.
 
     Args:
@@ -56,11 +67,16 @@ def pipeline_spmd(stage_fn: Callable,
       stage_params: pytree whose leaves have leading dim ``P`` (shard it over
         the ``pp`` mesh axis).
       x_mbs: ``[M, ...]`` microbatched activations entering stage 0.
-      remat: rematerialise stage activations (plays the reference 1F1B
-        memory role).
+      remat: rematerialise the stage body itself (intra-stage activations).
+      schedule: ``"1f1b"`` (chunked remat over ticks — peak activation
+        residuals capped at ~P in-flight microbatches) or ``"gpipe"``
+        (flat scan — O(M) residuals, no tick recompute).
 
     Returns: ``[M, ...]`` outputs of the last stage.
     """
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown pipeline schedule '{schedule}' "
+                         "(1f1b|gpipe)")
     M = x_mbs.shape[0]
     Pn = num_stages
     T = M + Pn - 1
@@ -79,10 +95,8 @@ def pipeline_spmd(stage_fn: Callable,
     feat_shape = x_mbs.shape[1:]
     buf = jnp.zeros((Pn,) + feat_shape, x_mbs.dtype)
     buf = maybe_constrain(buf, _buf_spec(buf.ndim))
-    out = jnp.zeros_like(x_mbs)
 
-    def tick(carry, t):
-        buf, out = carry
+    def tick(buf, t):
         # LoadMicroBatch: microbatch t enters stage 0 while t < M
         inp = jax.lax.dynamic_index_in_dim(
             x_mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
@@ -92,18 +106,32 @@ def pipeline_spmd(stage_fn: Callable,
         # ForwardPass on every stage (stage s holds microbatch t - s)
         y = vstage(stage_params, buf)
         y = maybe_constrain(y, _buf_spec(y.ndim))
-        # microbatch t-(P-1) exits the last stage
-        oidx = jnp.clip(t - (Pn - 1), 0, M - 1)
-        cur = jax.lax.dynamic_index_in_dim(out, oidx, 0, keepdims=False)
-        upd = jnp.where(t - (Pn - 1) >= 0, y[Pn - 1], cur)
-        out = jax.lax.dynamic_update_index_in_dim(out, upd, oidx, 0)
         # SendActivation/RecvActivation: shift one slot down the pipe
-        # (roll over the pp-sharded dim → CollectivePermute)
-        buf = jnp.roll(y, 1, axis=0)
-        return (buf, out), None
+        # (roll over the pp-sharded dim → CollectivePermute); the last
+        # stage's output is this tick's exit (microbatch t - (P-1))
+        return jnp.roll(y, 1, axis=0), y[Pn - 1]
 
-    (_, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(T))
-    return out
+    if schedule == "gpipe":
+        _, ys = jax.lax.scan(tick, buf, jnp.arange(T))
+    else:
+        # 1f1b-memory schedule: chunks of P ticks, chunk body remat'd, so
+        # autodiff saves one [P, ...] carry per chunk boundary instead of
+        # every tick's buffer (padding ticks past T are harmless: they
+        # load nothing and their outputs are sliced off below)
+        chunk = Pn
+        T_pad = -(-T // chunk) * chunk
+
+        def run_chunk(buf, ts):
+            return jax.lax.scan(tick, buf, ts)
+
+        run_chunk = jax.checkpoint(run_chunk, prevent_cse=False)
+        _, ys = jax.lax.scan(run_chunk, buf,
+                             jnp.arange(T_pad).reshape(-1, chunk))
+        ys = ys.reshape((T_pad,) + ys.shape[2:])
+    # tick t emits microbatch t-(P-1): the valid window is [P-1, P-1+M)
+    out = jax.lax.slice_in_dim(ys, Pn - 1, Pn - 1 + M, axis=0)
+    entries = [None, tuple(BATCH_AXES)] + [None] * (out.ndim - 2)
+    return maybe_constrain(out, P(*entries))
 
 
 def stack_stage_params(body_params: Any, num_stages: int) -> Any:
