@@ -22,7 +22,7 @@ decoders).  TPU-first design:
 
 import math
 from dataclasses import dataclass, field, replace
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,15 @@ class TransformerConfig:
     rope_dim: Optional[int] = None          # partial rotary (GPT-NeoX); None → full
     use_bias: bool = False                  # linear biases (GPT-2/OPT families)
     norm_bias: bool = False                 # LayerNorm beta (GPT-2/OPT)
+    use_alibi: bool = False                 # ALiBi slopes, no positions (Bloom)
+    embed_norm: bool = False                # LayerNorm after embedding (Bloom)
+    parallel_block: bool = False            # x + attn(ln(x)) + mlp(ln'(x))
+    #                                         (GPT-J / parallel-residual NeoX)
+    lm_head_bias: bool = False              # bias on the LM head (GPT-J)
+    attn_scale: Optional[float] = None      # softmax scale override (GPT-Neo
+    #                                         uses 1.0 instead of 1/sqrt(dh))
+    local_attn_pattern: Optional[Tuple[int, ...]] = None  # per-layer sliding
+    #                window (0 = global); GPT-Neo alternates (0, 256, 0, ...)
     tie_embeddings: bool = False
     remat: bool = True
     remat_policy: str = "nothing_saveable"
@@ -148,8 +157,12 @@ class TransformerConfig:
         total = self.n_layers * per_layer + v * d + d
         if not self.tie_embeddings:
             total += v * d
-        if not self.use_rope:
+            if self.lm_head_bias:
+                total += v
+        if not self.use_rope and not self.use_alibi:
             total += self.max_seq_len * d
+        if self.embed_norm:
+            total += d
         return total
 
 
@@ -207,6 +220,22 @@ def _rope(x, positions, theta, rope_dim=None):
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
+
+
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (Bloom; reference serves Bloom through
+    ``module_inject/containers/bloom.py`` whose kernels consume the same
+    slope schedule).  Matches HF ``build_alibi_tensor``: geometric slopes
+    for the largest power-of-two head count, interleaved extras beyond."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+
+    n = 2 ** math.floor(math.log2(n_heads))
+    slopes = pow2_slopes(n)
+    if n < n_heads:
+        slopes += pow2_slopes(2 * n)[0::2][: n_heads - n]
+    return jnp.asarray(slopes, jnp.float32)
 
 
 class CausalTransformerLM:
@@ -276,10 +305,16 @@ class CausalTransformerLM:
         }
         if c.norm_bias:
             params["final_norm_b"] = jnp.zeros((d,), dtype)
-        if not c.use_rope:
+        if c.embed_norm:
+            params["embed_norm"] = jnp.ones((d,), dtype)
+            if c.norm_bias:
+                params["embed_norm_b"] = jnp.zeros((d,), dtype)
+        if not c.use_rope and not c.use_alibi:
             params["pos_embed"] = dense(keys[8], (c.max_seq_len, d), d)
         if not c.tie_embeddings:
             params["lm_head"] = dense(keys[9], (d, v), d)
+            if c.lm_head_bias:
+                params["lm_head_b"] = jnp.zeros((v,), dtype)
         return params
 
     def _init_moe(self, rng, dtype, dense):
@@ -370,14 +405,46 @@ class CausalTransformerLM:
             k = _rope(k, positions, c.rope_theta, c.rope_dim)
         return q, k, v
 
-    def _attn_block(self, x, layer, positions):
+    def _attn_bias(self, layer, Sq, Sk):
+        """Additive attention bias beyond the causal mask: ALiBi slopes
+        (Bloom) and/or a per-layer sliding window (GPT-Neo ``local``
+        layers; ``layer['attn_window']`` is a traced scalar, 0 = global).
+        Returns None when neither applies so the flash path stays usable."""
         c = self.config
-        B, S, d = x.shape
+        bias = None
+        if c.use_alibi:
+            # slopes * key position; softmax row-shift invariance makes
+            # this equal to slopes * (k - q) on the causal support
+            bias = (alibi_slopes(c.n_heads)[None, :, None, None] *
+                    jnp.arange(Sk, dtype=jnp.float32)[None, None, None, :])
+        if "attn_window" in layer:
+            w = layer["attn_window"]   # per-layer scalar, traced under scan
+            delta = (jnp.arange(Sq, dtype=jnp.int32)[:, None] + (Sk - Sq) -
+                     jnp.arange(Sk, dtype=jnp.int32)[None, :])
+            allowed = (delta < w) | (w <= 0)
+            wbias = jnp.where(allowed, 0.0, -1e30).astype(jnp.float32)
+            bias = wbias if bias is None else bias + wbias
+        return bias
+
+    def _attn_block(self, x, layer, positions):
+        h = _norm(x, layer["attn_norm"], self.config.norm_eps,
+                  self.config.use_rmsnorm, layer.get("attn_norm_b"))
+        return x + self._attn_delta(h, layer, positions)
+
+    def _attn_delta(self, h, layer, positions):
+        """Attention sub-block on pre-normed input; returns the residual
+        delta (wo projection applied, no residual add)."""
+        c = self.config
+        B, S, d = h.shape
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
-        h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
-                  layer.get("attn_norm_b"))
         q, k, v = self._qkv(h, layer, B, S, positions)
-        if c.attn_impl == "ring":
+        bias = self._attn_bias(layer, S, S)
+        if bias is not None:
+            # additive-bias attention rides the jnp path (the Pallas flash
+            # kernel has no bias operand yet); XLA still fuses the chain
+            attn = reference_attention(q, k, v, causal=True, bias=bias,
+                                       softmax_scale=c.attn_scale)
+        elif c.attn_impl == "ring":
             from deepspeed_tpu.ops.ring_attention import ring_attention
             attn = ring_attention(q, k, v, causal=True)
         elif c.attn_impl == "ulysses":
@@ -395,18 +462,25 @@ class CausalTransformerLM:
             attn = ulysses_attention(
                 q, k, v, lambda q, k, v: attention(q, k, v, causal=True))
         elif c.attn_impl in ("auto", "pallas", "reference"):
-            attn = attention(q, k, v, causal=True, impl=c.attn_impl)
+            attn = attention(q, k, v, causal=True,
+                             softmax_scale=c.attn_scale, impl=c.attn_impl)
         else:
             raise ValueError(
                 f"unknown attn_impl '{c.attn_impl}'; expected one of "
                 "auto/pallas/reference/ring/ulysses")
-        return x + self._proj(attn.reshape(B, S, H * dh), layer, "wo")
+        return self._proj(attn.reshape(B, S, H * dh), layer, "wo")
 
     def _mlp_block(self, x, layer, rng=None, train=True):
         """Dense or MoE FFN; returns (x, aux_loss)."""
         c = self.config
         h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
                   layer.get("mlp_norm_b"))
+        delta, aux = self._mlp_delta(h, layer, rng=rng, train=train)
+        return x + delta, aux
+
+    def _mlp_delta(self, h, layer, rng=None, train=True):
+        """FFN sub-block on pre-normed input; returns (delta, aux_loss)."""
+        c = self.config
         if "moe" in layer:
             from deepspeed_tpu.moe.sharded_moe import moe_layer_forward
             act = jax.nn.silu if c.activation == "silu" else jax.nn.gelu
@@ -421,7 +495,7 @@ class CausalTransformerLM:
             moe_out, l_aux, _ = moe_layer_forward(
                 self.gate, {"wg": layer["moe"]["wg"]}, layer["moe"],
                 expert_fn, h, train=train, rng=rng)
-            return x + moe_out, l_aux
+            return moe_out, l_aux
         if c.activation == "silu":
             inner = jax.nn.silu(h @ layer["w_gate"]) * \
                 self._proj(h, layer, "w_up")
@@ -429,9 +503,21 @@ class CausalTransformerLM:
             inner = jax.nn.relu(self._proj(h, layer, "w_up"))
         else:
             inner = jax.nn.gelu(self._proj(h, layer, "w_up"))
-        return x + self._proj(inner, layer, "w_down"), jnp.float32(0.0)
+        return self._proj(inner, layer, "w_down"), jnp.float32(0.0)
 
     def _layer(self, x, layer, positions, rng=None, train=True):
+        c = self.config
+        if c.parallel_block:
+            # GPT-J / parallel-residual NeoX: both sub-blocks read the
+            # residual stream, one fused add (GPT-J shares one LN — the
+            # policy duplicates it into attn_norm/mlp_norm; NeoX parallel
+            # keeps two distinct LNs)
+            ha = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
+                       layer.get("attn_norm_b"))
+            hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
+                       layer.get("mlp_norm_b"))
+            mlp, aux = self._mlp_delta(hm, layer, rng=rng, train=train)
+            return x + self._attn_delta(ha, layer, positions) + mlp, aux
         x = self._attn_block(x, layer, positions)
         return self._mlp_block(x, layer, rng=rng, train=train)
 
@@ -443,12 +529,19 @@ class CausalTransformerLM:
             positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
 
         x = params["tok_embed"][input_ids]
-        if not c.use_rope:
+        if not c.use_rope and not c.use_alibi:
             x = x + params["pos_embed"][positions].astype(x.dtype)
+        if c.embed_norm:
+            x = _norm(x, params["embed_norm"], c.norm_eps, c.use_rmsnorm,
+                      params.get("embed_norm_b"))
         # activation layout: batch over all data axes, sequence over sp
         x = maybe_constrain(x, P(tuple(BATCH_AXES), SP_AXIS, None))
 
         aux = jnp.float32(0.0)
+        # per-layer local-attention windows ride the scan as a side input
+        # (NOT a param leaf: integer leaves would break jax.grad)
+        windows = (jnp.asarray(c.local_attn_pattern, jnp.int32)
+                   if c.local_attn_pattern else None)
         if isinstance(params["layers"], (list, tuple)):
             # MoE / heterogeneous stack: unrolled layer loop
             layer_fn = self._layer
@@ -457,18 +550,27 @@ class CausalTransformerLM:
                 layer_fn = jax.checkpoint(layer_fn, policy=policy,
                                           static_argnums=(4,))
             for i, layer in enumerate(params["layers"]):
+                if windows is not None:
+                    layer = dict(layer, attn_window=windows[i])
                 lrng = jax.random.fold_in(rng, i) if rng is not None else None
                 x, l_aux = layer_fn(x, layer, positions, lrng, train)
                 aux = aux + l_aux
         else:
-            def body(x, layer):
+            def body(x, inp):
+                if windows is not None:
+                    layer, w = inp
+                    layer = dict(layer, attn_window=w)
+                else:
+                    layer = inp
                 x, l_aux = self._layer(x, layer, positions, train=train)
                 return x, l_aux
 
             if c.remat:
                 policy = getattr(jax.checkpoint_policies, c.remat_policy, None)
                 body = jax.checkpoint(body, policy=policy)
-            x, l_auxs = jax.lax.scan(body, x, params["layers"])
+            xs = (params["layers"] if windows is None
+                  else (params["layers"], windows))
+            x, l_auxs = jax.lax.scan(body, x, xs)
             aux = jnp.sum(l_auxs)
 
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
@@ -476,6 +578,8 @@ class CausalTransformerLM:
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         if return_aux:
             return logits, aux
         return logits
@@ -499,6 +603,23 @@ class CausalTransformerLM:
             v=jnp.broadcast_to(one.v[None], (c.n_layers,) + one.v.shape).copy(),
             length=one.length)
 
+    def _cached_attn_bias(self, layer, T, S, length):
+        """Decode-path analogue of ``_attn_bias`` over the full cache
+        buffer [S]; query positions are ``length - T + arange(T)``."""
+        c = self.config
+        bias = None
+        if c.use_alibi:
+            bias = (alibi_slopes(c.n_heads)[None, :, None, None] *
+                    jnp.arange(S, dtype=jnp.float32)[None, None, None, :])
+        if "attn_window" in layer:
+            w = layer["attn_window"]
+            qpos = length - T + jnp.arange(T, dtype=jnp.int32)[:, None]
+            delta = qpos - jnp.arange(S, dtype=jnp.int32)[None, :]
+            allowed = (delta < w) | (w <= 0)
+            wbias = jnp.where(allowed, 0.0, -1e30).astype(jnp.float32)
+            bias = wbias if bias is None else bias + wbias
+        return bias
+
     def _layer_cached(self, x, layer, cache_k, cache_v, length, positions):
         c = self.config
         B, T, d = x.shape
@@ -507,8 +628,17 @@ class CausalTransformerLM:
                   layer.get("attn_norm_b"))
         q, k, v = self._qkv(h, layer, B, T, positions)
         cache = update_cache(KVCache(k=cache_k, v=cache_v, length=length), k, v)
-        attn = decode_attention(q, cache)
-        x = x + self._proj(attn.reshape(B, T, H * dh), layer, "wo")
+        bias = self._cached_attn_bias(layer, T, cache.k.shape[1],
+                                      cache.length)
+        attn = decode_attention(q, cache, softmax_scale=c.attn_scale,
+                                bias=bias)
+        attn_delta = self._proj(attn.reshape(B, T, H * dh), layer, "wo")
+        if c.parallel_block:
+            hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
+                       layer.get("mlp_norm_b"))
+            mlp_delta, _ = self._mlp_delta(hm, layer, train=False)
+            return x + attn_delta + mlp_delta, cache
+        x = x + attn_delta
         x, _ = self._mlp_block(x, layer, train=False)
         return x, cache
 
@@ -523,12 +653,19 @@ class CausalTransformerLM:
             start = caches.length
         positions = start + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = params["tok_embed"][input_ids]
-        if not c.use_rope:
+        if not c.use_rope and not c.use_alibi:
             x = x + params["pos_embed"][positions].astype(x.dtype)
+        if c.embed_norm:
+            x = _norm(x, params["embed_norm"], c.norm_eps, c.use_rmsnorm,
+                      params.get("embed_norm_b"))
 
+        windows = (jnp.asarray(c.local_attn_pattern, jnp.int32)
+                   if c.local_attn_pattern else None)
         if isinstance(caches, list):  # MoE / heterogeneous stack
             new_caches = []
-            for layer, cache in zip(params["layers"], caches):
+            for i, (layer, cache) in enumerate(zip(params["layers"], caches)):
+                if windows is not None:
+                    layer = dict(layer, attn_window=windows[i])
                 x, nc = self._layer_cached(x, layer, cache.k, cache.v,
                                            start, positions)
                 new_caches.append(nc)
@@ -536,12 +673,17 @@ class CausalTransformerLM:
         else:
             def body(x, inp):
                 layer, ck, cv = inp
+                if windows is not None:
+                    layer, w = layer
+                    layer = dict(layer, attn_window=w)
                 x, cache = self._layer_cached(x, layer, ck, cv, start,
                                               positions)
                 return x, (cache.k, cache.v)
 
+            lxs = (params["layers"] if windows is None
+                   else (params["layers"], windows))
             x, (new_k, new_v) = jax.lax.scan(
-                body, x, (params["layers"], caches.k, caches.v))
+                body, x, (lxs, caches.k, caches.v))
             out_caches = KVCache(k=new_k, v=new_v, length=start + T)
 
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
@@ -549,6 +691,8 @@ class CausalTransformerLM:
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         return logits, out_caches
 
     # ------------------------------------------------------------------

@@ -203,18 +203,14 @@ class OPTPolicy(InjectionPolicy):
 class GPTNeoXPolicy(InjectionPolicy):
     """HF ``GPTNeoXForCausalLM`` (Pythia; reference ``containers/gptneox.py``).
     Fused QKV is laid out [H, 3, dh] per head; partial rotary via
-    ``rotary_pct``.  Requires ``use_parallel_residual=False`` models (the
-    sequential-residual variant) — parallel residual is a different dataflow.
+    ``rotary_pct``.  ``use_parallel_residual`` maps onto the model's
+    ``parallel_block`` (two distinct LNs, unlike GPT-J's shared one).
     """
 
     model_types = ("gpt_neox",)
 
     @classmethod
     def build(cls, hf, sd):
-        if getattr(hf, "use_parallel_residual", True):
-            raise ValueError("GPT-NeoX with use_parallel_residual=True is "
-                             "not supported yet; set it to False or use a "
-                             "sequential-residual checkpoint")
         d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
         dh = d // H
         rot = int(dh * getattr(hf, "rotary_pct", 1.0))
@@ -226,6 +222,7 @@ class GPTNeoXPolicy(InjectionPolicy):
             norm_eps=hf.layer_norm_eps, activation="gelu",
             use_rmsnorm=False, use_rope=True,
             rope_dim=(None if rot == dh else rot),
+            parallel_block=bool(getattr(hf, "use_parallel_residual", True)),
             use_bias=True, norm_bias=True, tie_embeddings=False, remat=False)
 
         pre = "gpt_neox.layers.{}."
@@ -338,8 +335,254 @@ class BertPolicy(InjectionPolicy):
         return cfg, params
 
 
+class BloomPolicy(InjectionPolicy):
+    """HF ``BloomForCausalLM`` (reference ``containers/bloom.py:13``
+    ``BLOOMLayerPolicy``).  ALiBi positions (no position embeddings), a
+    LayerNorm directly after the word embeddings, and a fused QKV laid out
+    [H, 3, dh] per head — the same head-interleaved split as GPT-NeoX."""
+
+    model_types = ("bloom",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.n_layer, hf.n_head
+        dh = d // H
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            max_seq_len=getattr(hf, "seq_length", 2048),
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=False, use_alibi=True,
+            embed_norm=True, use_bias=True, norm_bias=True,
+            tie_embeddings=True, remat=False)
+
+        pre = "transformer.h.{}."
+        wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
+        for i in range(L):
+            w = _np(sd[pre.format(i) + "self_attention.query_key_value.weight"])
+            b = _np(sd[pre.format(i) + "self_attention.query_key_value.bias"])
+            w = w.reshape(H, 3, dh, d)
+            b = b.reshape(H, 3, dh)
+            wq.append(w[:, 0].reshape(H * dh, d).T)
+            wk.append(w[:, 1].reshape(H * dh, d).T)
+            wv.append(w[:, 2].reshape(H * dh, d).T)
+            bq.append(b[:, 0].reshape(-1))
+            bk.append(b[:, 1].reshape(-1))
+            bv.append(b[:, 2].reshape(-1))
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "input_layernorm.bias", L),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wq_b": np.stack(bq), "wk_b": np.stack(bk), "wv_b": np.stack(bv),
+            "wo": _stack(sd, pre + "self_attention.dense.weight", L,
+                         transpose=True),
+            "wo_b": _stack(sd, pre + "self_attention.dense.bias", L),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "post_attention_layernorm.bias", L),
+            "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.dense_4h_to_h.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.word_embeddings.weight"]),
+            "embed_norm": _np(
+                sd["transformer.word_embeddings_layernorm.weight"]),
+            "embed_norm_b": _np(
+                sd["transformer.word_embeddings_layernorm.bias"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+def _interleaved_to_half_rope_perm(rot: int, dh: int) -> np.ndarray:
+    """Column permutation turning an interleaved-RoPE weight (GPT-J
+    ``rotate_every_two``: pair (2j, 2j+1) gets freq j) into our half-split
+    layout (pair (j, j+rot/2) gets freq j).  Applying it to BOTH wq and wk
+    preserves all q·k dot products, so logits are unchanged."""
+    half = rot // 2
+    return np.asarray([2 * j for j in range(half)] +
+                      [2 * j + 1 for j in range(half)] +
+                      list(range(rot, dh)), np.int64)
+
+
+class GPTJPolicy(InjectionPolicy):
+    """HF ``GPTJForCausalLM`` (reference ``containers/gptj.py``
+    ``HFGPTJLayerPolicy``).  Parallel attention+MLP residual sharing one
+    LayerNorm, partial interleaved rotary (folded into a wq/wk column
+    permutation), biased LM head."""
+
+    model_types = ("gptj",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.n_embd, hf.n_layer, hf.n_head
+        dh = d // H
+        rot = getattr(hf, "rotary_dim", None) or dh
+        perm = _interleaved_to_half_rope_perm(rot, dh)
+
+        def qk(name, i):
+            w = _np(sd[f"transformer.h.{i}.attn.{name}.weight"]).T  # [d, d]
+            return w.reshape(d, H, dh)[:, :, perm].reshape(d, H * dh)
+
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            ffn_hidden_size=getattr(hf, "n_inner", None) or 4 * d,
+            max_seq_len=hf.n_positions,
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=True,
+            rope_dim=(None if rot == dh else rot),
+            parallel_block=True, use_bias=True, norm_bias=True,
+            tie_embeddings=False, lm_head_bias=True, remat=False)
+
+        pre = "transformer.h.{}."
+        ln_w = _stack(sd, pre + "ln_1.weight", L)
+        ln_b = _stack(sd, pre + "ln_1.bias", L)
+        layers = {
+            # one shared LN: duplicated into both sub-block norms
+            "attn_norm": ln_w, "attn_norm_b": ln_b,
+            "mlp_norm": ln_w.copy(), "mlp_norm_b": ln_b.copy(),
+            "wq": np.stack([qk("q_proj", i) for i in range(L)]),
+            "wk": np.stack([qk("k_proj", i) for i in range(L)]),
+            "wv": _stack(sd, pre + "attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, pre + "attn.out_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, pre + "mlp.fc_in.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.fc_in.bias", L),
+            "w_down": _stack(sd, pre + "mlp.fc_out.weight", L, transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.fc_out.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "lm_head": _np(sd["lm_head.weight"]).T,
+            "lm_head_b": _np(sd["lm_head.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class GPTNeoPolicy(InjectionPolicy):
+    """HF ``GPTNeoForCausalLM`` (reference ``containers/gptneo.py``
+    ``HFGPTNEOLayerPolicy``).  Unscaled attention logits (no 1/sqrt(dh)),
+    alternating global/local layers with a sliding window, learned
+    positions, unbiased q/k/v."""
+
+    model_types = ("gpt_neo",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L = hf.hidden_size, hf.num_layers
+        attn_types = [t for block in ([hf.attention_types]
+                                      if isinstance(hf.attention_types[0][0],
+                                                    str)
+                                      else hf.attention_types)
+                      for t in block[0] * block[1]]
+        pattern = tuple(hf.window_size if t == "local" else 0
+                        for t in attn_types)
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.num_heads,
+            ffn_hidden_size=getattr(hf, "intermediate_size", None) or 4 * d,
+            max_seq_len=hf.max_position_embeddings,
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=False, use_bias=True, norm_bias=True,
+            attn_scale=1.0,
+            local_attn_pattern=(pattern if any(pattern) else None),
+            tie_embeddings=True, remat=False)
+
+        pre = "transformer.h.{}."
+        att = "transformer.h.{}.attn.attention."
+        layers = {
+            "attn_norm": _stack(sd, pre + "ln_1.weight", L),
+            "attn_norm_b": _stack(sd, pre + "ln_1.bias", L),
+            "wq": _stack(sd, att + "q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, att + "k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, att + "v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, att + "out_proj.weight", L, transpose=True),
+            "wo_b": _stack(sd, att + "out_proj.bias", L),
+            "mlp_norm": _stack(sd, pre + "ln_2.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "ln_2.bias", L),
+            "w_up": _stack(sd, pre + "mlp.c_fc.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.c_fc.bias", L),
+            "w_down": _stack(sd, pre + "mlp.c_proj.weight", L, transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.c_proj.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "pos_embed": _np(sd["transformer.wpe.weight"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class DistilBertPolicy(InjectionPolicy):
+    """HF ``DistilBertForMaskedLM`` (reference ``containers/distil_bert.py``
+    ``HFDistilBertLayerPolicy``).  BERT post-LN encoder without token-type
+    embeddings → ``BertEncoder`` with a 1-entry (all-zero) type table."""
+
+    model_types = ("distilbert",)
+
+    @classmethod
+    def model_cls(cls):
+        from deepspeed_tpu.models.bert import BertEncoder
+        return BertEncoder
+
+    @classmethod
+    def build(cls, hf, sd):
+        from deepspeed_tpu.models.bert import BertConfig
+        d, L = hf.dim, hf.n_layers
+        cfg = BertConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.n_heads, ffn_hidden_size=hf.hidden_dim,
+            max_seq_len=hf.max_position_embeddings,
+            type_vocab_size=1, norm_eps=1e-12)
+
+        pre = "distilbert.transformer.layer.{}."
+        layers = {
+            "wq": _stack(sd, pre + "attention.q_lin.weight", L, transpose=True),
+            "wk": _stack(sd, pre + "attention.k_lin.weight", L, transpose=True),
+            "wv": _stack(sd, pre + "attention.v_lin.weight", L, transpose=True),
+            "wo": _stack(sd, pre + "attention.out_lin.weight", L,
+                         transpose=True),
+            "wq_b": _stack(sd, pre + "attention.q_lin.bias", L),
+            "wk_b": _stack(sd, pre + "attention.k_lin.bias", L),
+            "wv_b": _stack(sd, pre + "attention.v_lin.bias", L),
+            "wo_b": _stack(sd, pre + "attention.out_lin.bias", L),
+            "attn_norm": _stack(sd, pre + "sa_layer_norm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "sa_layer_norm.bias", L),
+            "w_up": _stack(sd, pre + "ffn.lin1.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "ffn.lin1.bias", L),
+            "w_down": _stack(sd, pre + "ffn.lin2.weight", L, transpose=True),
+            "w_down_b": _stack(sd, pre + "ffn.lin2.bias", L),
+            "mlp_norm": _stack(sd, pre + "output_layer_norm.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "output_layer_norm.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["distilbert.embeddings.word_embeddings.weight"]),
+            "pos_embed": _np(
+                sd["distilbert.embeddings.position_embeddings.weight"]),
+            "type_embed": np.zeros((1, d), np.float32),
+            "embed_norm": _np(sd["distilbert.embeddings.LayerNorm.weight"]),
+            "embed_norm_b": _np(sd["distilbert.embeddings.LayerNorm.bias"]),
+            "layers": layers,
+            "mlm_dense": _np(sd["vocab_transform.weight"]).T,
+            "mlm_dense_b": _np(sd["vocab_transform.bias"]),
+            "mlm_norm": _np(sd["vocab_layer_norm.weight"]),
+            "mlm_norm_b": _np(sd["vocab_layer_norm.bias"]),
+            "mlm_bias": _np(sd["vocab_projector.bias"]),
+        }
+        return cfg, params
+
+
 REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
-                                GPTNeoXPolicy, BertPolicy]
+                                GPTNeoXPolicy, BertPolicy, BloomPolicy,
+                                GPTJPolicy, GPTNeoPolicy, DistilBertPolicy]
 
 
 def find_policy(hf_config) -> Optional[type]:

@@ -61,14 +61,15 @@ def update_cache(cache: KVCache, k_new, v_new) -> KVCache:
 
 
 def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
-                     block_k=DEFAULT_BLOCK_K, interpret=False):
+                     block_k=DEFAULT_BLOCK_K, interpret=False, bias=None):
     """q: [B, T, H, D] (T=1 decode or T=prompt prefill, already appended to
     cache); attends over cache[:length].  fp32 softmax.
 
     ``impl``: None (auto: Pallas kernel on TPU, jnp elsewhere), "pallas",
-    or "jnp"."""
+    or "jnp".  ``bias``: additive logit bias broadcastable to [B, H, T, S]
+    (ALiBi / local-window models); forces the jnp path."""
     B, T, H, D = q.shape
-    if use_pallas(impl, cache.k.shape[1], block_k):
+    if bias is None and use_pallas(impl, cache.k.shape[1], block_k):
         from deepspeed_tpu.ops.pallas.decode_attention import \
             decode_attention_pallas
         lengths = jnp.broadcast_to(jnp.asarray(cache.length, jnp.int32), (B,))
@@ -87,6 +88,8 @@ def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
     S = cache.k.shape[1]
     kpos = jnp.arange(S)[None, :]
     qpos = cache.length - T + jnp.arange(T)[:, None]
+    if bias is not None:
+        logits = logits + bias
     mask = kpos <= qpos  # causal within the valid prefix
     logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)

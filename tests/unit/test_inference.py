@@ -48,6 +48,40 @@ def test_generate_greedy_matches_training_forward(tiny_model):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 
+@pytest.mark.parametrize("variant", [
+    # Bloom-shaped: ALiBi + embedding LayerNorm
+    dict(use_alibi=True, embed_norm=True, use_rope=False, use_rmsnorm=False,
+         activation="gelu", use_bias=True, norm_bias=True,
+         tie_embeddings=True),
+    # GPT-J-shaped: parallel residual + partial rotary + biased head
+    dict(parallel_block=True, rope_dim=8, activation="gelu",
+         use_rmsnorm=False, norm_bias=True, lm_head_bias=True),
+    # GPT-Neo-shaped: unscaled attention + alternating local windows
+    dict(attn_scale=1.0, local_attn_pattern=(0, 4), use_rope=False,
+         use_rmsnorm=False, activation="gelu", use_bias=True, norm_bias=True,
+         tie_embeddings=True),
+])
+def test_decode_matches_training_forward_new_archs(variant):
+    """The KV-cache decode path must reproduce the full forward for the
+    Bloom/GPT-J/GPT-Neo architecture features (alibi, parallel block,
+    local windows) — guards the _layer_cached rewrites of each."""
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, **variant)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(1))
+    engine = deepspeed_tpu.init_inference(
+        model=model, config={"dtype": "float32"}, params=params)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, size=(2, 7))
+    out = engine.generate(prompt, max_new_tokens=5)
+
+    seq = jnp.asarray(prompt)
+    for _ in range(5):
+        logits = model.apply(params, seq, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+        seq = jnp.concatenate([seq, nxt.astype(seq.dtype)], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
 def test_generate_with_tp(tiny_model):
     cfg, model, params = tiny_model
     engine = deepspeed_tpu.init_inference(

@@ -95,6 +95,74 @@ def test_gptneox_conversion_matches_hf():
     _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
 
 
+def test_bloom_conversion_matches_hf():
+    """ALiBi + embedding LayerNorm (reference containers/bloom.py:13)."""
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=96, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(0)
+    hf = transformers.BloomForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.use_alibi and model.config.embed_norm
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_bloom_nonpow2_heads_matches_hf():
+    """ALiBi slope interpolation for head counts off the power-of-two grid."""
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=96, hidden_size=36, n_layer=1, n_head=6)
+    torch.manual_seed(0)
+    hf = transformers.BloomForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_gptj_conversion_matches_hf():
+    """Parallel attn+MLP block, interleaved partial rotary folded into a
+    wq/wk column permutation, biased LM head (reference containers/gptj.py)."""
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        rotary_dim=4)
+    torch.manual_seed(0)
+    hf = transformers.GPTJForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.parallel_block
+    assert model.config.rope_dim == 4
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_gptneo_conversion_matches_hf():
+    """Unscaled attention + alternating global/local window layers
+    (reference containers/gptneo.py)."""
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=96, max_position_embeddings=64, hidden_size=32,
+        num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=8)
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.attn_scale == 1.0
+    assert model.config.local_attn_pattern == (0, 8)
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_distilbert_conversion_matches_hf():
+    """Token-type-free post-LN encoder (reference containers/distil_bert.py)."""
+    hf_cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+        max_position_embeddings=64)
+    torch.manual_seed(0)
+    hf = transformers.DistilBertForMaskedLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    ids = _ids(96)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids), train=False))
+    _assert_close(ours, _hf_logits(hf, ids))
+
+
 def test_unknown_arch_raises():
     class FakeCfg:
         model_type = "not_a_real_arch"
@@ -102,13 +170,19 @@ def test_unknown_arch_raises():
         replace_transformer_layer({}, hf_config=FakeCfg())
 
 
-def test_parallel_residual_neox_rejected():
+def test_parallel_residual_neox_matches_hf():
+    """Pythia-style parallel residual: x + attn(ln1 x) + mlp(ln2 x)."""
     hf_cfg = transformers.GPTNeoXConfig(
-        vocab_size=32, hidden_size=16, intermediate_size=32,
-        num_hidden_layers=1, num_attention_heads=2,
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5,
         use_parallel_residual=True)
-    with pytest.raises(ValueError, match="parallel_residual"):
-        find_policy(hf_cfg).build(hf_cfg, {})
+    torch.manual_seed(0)
+    hf = transformers.GPTNeoXForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.parallel_block
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
 
 
 def test_init_inference_accepts_hf_model():
