@@ -122,6 +122,11 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float32
 
         # ---- ZeRO plan ----------------------------------------------
+        # auto-TP: a model that ships its own sharding rules (the whole
+        # model zoo does) gets them applied without the caller plumbing
+        # them through — the reference's module_inject auto-TP behaviour
+        if tp_rules is None and hasattr(model, "tp_rules"):
+            tp_rules = model.tp_rules()
         zc = config.zero_config
         self.zero_stage = zc.stage
         self.plan = ZeroShardingPlan(

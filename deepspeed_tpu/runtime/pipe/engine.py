@@ -35,8 +35,8 @@ class PipelineEngine(DeepSpeedEngine):
         if kwargs.get("params") is None:
             raise ValueError("model_parameters (from PipelineModule.init) "
                              "is required")
-        if kwargs.get("tp_rules") is None:
-            kwargs["tp_rules"] = model.tp_rules()
+        # tp_rules default comes from the base engine's auto-TP
+        # (DeepSpeedEngine.__init__ pulls model.tp_rules())
         if config.zero_config.offload_optimizer_device != "none":
             raise NotImplementedError(
                 "offload_optimizer is not supported with pipeline "
