@@ -2,7 +2,8 @@
 
 Parity: reference ``runtime/zero/partition_parameters.py`` (``Init:539``
 monkey-patches module construction so params are partitioned at creation;
-``GatheredParameters`` temporarily all-gathers partitioned params;
+``GatheredParameters`` temporarily all-gathers partitioned params and
+writes the modifier rank's changes back on exit;
 ``_convert_to_deepspeed_param:765`` adds all_gather/partition methods).
 
 TPU design: params are an explicit pytree, so "partition at construction"
@@ -11,8 +12,9 @@ machinery.  ``Init`` is a context manager whose ``partition()`` places a
 freshly-initialised tree; inside the context, ``init(fn, *args)`` runs the
 initialiser and places the result (streaming per-leaf so the full
 replicated tree never materialises on one chip).  ``GatheredParameters``
-yields a host-replicated view for surgery and re-partitions modified leaves
-on exit.
+yields a mutable host view and re-partitions it on exit — the reference's
+modifier-rank write-back, except the "broadcast from rank 0" is the
+``device_put`` itself (host surgery is SPMD-identical on every process).
 """
 
 import contextlib
@@ -70,27 +72,83 @@ class Init:
         return self.partition(init_fn(*args, **kwargs))
 
 
+class GatheredView(dict):
+    """Mutable host view yielded by :func:`GatheredParameters`.
+
+    Mutate leaves in place (numpy) or assign new values; after the context
+    exits, ``.repartitioned`` holds the device tree with every change
+    re-partitioned onto the original shardings."""
+
+    repartitioned: Any = None
+
+
+def _repartition(view, shardings, dtypes):
+    def place(g, sh, dt):
+        arr = np.asarray(g)
+        if dt is not None and arr.dtype != dt:
+            arr = arr.astype(dt)
+        return jax.device_put(arr, sh) if sh is not None else arr
+    return jax.tree_util.tree_map(place, view, shardings, dtypes)
+
+
 @contextlib.contextmanager
 def GatheredParameters(params, modifier_rank: Optional[int] = 0,
                        fwd_module=None, enabled: bool = True):
-    """Host-replicated view of (possibly sharded) params.
+    """Temporarily gathered, WRITABLE view of (possibly sharded) params.
 
-    Usage::
+    Usage (raw pytree)::
 
         with GatheredParameters(params) as full:
-            full["tok_embed"][0] = 0         # numpy surgery
-        # exit: nothing to re-partition — caller re-places `full` when
-        # modifications should persist (functional params are immutable)
+            full["tok_embed"][0] = 0          # numpy surgery
+        params = full.repartitioned           # changes, sharded as before
 
-    Yields a dict of host numpy arrays (gathered across shards).
+    Usage (engine): pass the engine itself and its ``state.params`` are
+    gathered AND the surgery is written back into ``engine.state`` on exit
+    (the reference mutates module params the same way)::
+
+        with GatheredParameters(engine) as full:
+            full["tok_embed"][0] = 0
+        # engine.state.params now carries the change, still sharded
+
+    ``modifier_rank`` is accepted for API parity: host surgery runs
+    SPMD-identically on every process, and the re-partitioning
+    ``device_put`` plays the broadcast role.
     """
+    engine = None
+    if hasattr(params, "state") and hasattr(params, "plan"):
+        engine = params
+        params = engine.state.params
     if not enabled:
         yield params
         return
+    shardings = jax.tree_util.tree_map(
+        lambda x: x.sharding if isinstance(x, jax.Array) else None, params)
+    dtypes = jax.tree_util.tree_map(
+        lambda x: np.dtype(x.dtype) if hasattr(x, "dtype")
+        else np.asarray(x).dtype, params)
+    # np.array(): force a writable host copy (device_get may return a
+    # read-only view of the transfer buffer)
     gathered = jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array)
-        else np.asarray(x), params)
-    yield gathered
+        lambda x: np.array(jax.device_get(x)) if isinstance(x, jax.Array)
+        else np.array(x), params)
+    view = GatheredView(gathered) if isinstance(gathered, dict) else gathered
+    try:
+        yield view
+    finally:
+        # modifier_rank=None = read-only inspection (reference semantics:
+        # no write-back); and with neither a GatheredView nor an engine
+        # there is no way to hand the result back — skip the transfer
+        writeback = modifier_rank is not None and \
+            (engine is not None or isinstance(view, GatheredView))
+        if writeback:
+            base = dict(view) if isinstance(view, GatheredView) else view
+            placed = _repartition(base, shardings, dtypes)
+            if isinstance(view, GatheredView):
+                view.repartitioned = placed
+            if engine is not None:
+                engine.state = engine.state.replace(params=placed)
+                logger.info("GatheredParameters: wrote modified params back "
+                            "into the engine state (re-partitioned)")
 
 
 def shutdown_init_context():

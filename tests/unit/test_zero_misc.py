@@ -85,6 +85,48 @@ def test_zero_init_partitions(mesh_1d):
         assert full["layer_0"]["w"].shape == (16, 16)
 
 
+def test_gathered_parameters_writeback(mesh_1d):
+    """Modifier write-back (reference partition_parameters.py:539 area):
+    surgery inside the context must survive re-partitioning, with the
+    original shardings and dtypes intact."""
+    from unit.simple_model import SimpleModel
+    model = SimpleModel(hidden_dim=16)
+    with Init(mesh=mesh_1d) as zi:
+        params = zi.init(model.init, jax.random.key(0))
+    orig_sharding = params["layer_0"]["w"].sharding
+    with GatheredParameters(params) as full:
+        full["layer_0"]["w"][0, :] = 7.0          # in-place numpy surgery
+    new = full.repartitioned
+    w = new["layer_0"]["w"]
+    assert isinstance(w, jax.Array)
+    assert w.sharding == orig_sharding
+    assert w.dtype == params["layer_0"]["w"].dtype
+    np.testing.assert_array_equal(np.asarray(w)[0], np.full(16, 7.0))
+    # untouched leaves unchanged
+    np.testing.assert_array_equal(np.asarray(new["layer_0"]["b"]),
+                                  np.asarray(params["layer_0"]["b"]))
+
+
+def test_gathered_parameters_engine_writeback():
+    """Passing the engine writes the modified params back into
+    engine.state (the reference's in-place module mutation)."""
+    from deepspeed_tpu.parallel import groups
+    from unit.simple_model import SimpleModel, base_config, random_batch
+    groups.reset_mesh()
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=base_config(3))
+    with GatheredParameters(engine) as full:
+        full["layer_0"]["w"][:] = 0.0
+    got = np.asarray(jax.device_get(engine.state.params["layer_0"]["w"]))
+    np.testing.assert_array_equal(got, np.zeros((16, 16), got.dtype))
+    # the engine still trains after surgery
+    loss = engine.train_batch(batch=random_batch(32, 16, seed=0))
+    assert np.isfinite(float(loss))
+    groups.reset_mesh()
+
+
 def test_on_device_meta_init():
     from unit.simple_model import SimpleModel
     model = SimpleModel(hidden_dim=16)
