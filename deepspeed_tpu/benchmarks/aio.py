@@ -1,0 +1,93 @@
+"""Async-I/O micro-benchmark (reference ``csrc/aio/py_test/ds_aio_bench``).
+
+Measures GB/s of the io_uring engine at several queue depths / block sizes
+against the thread-pool fallback tier, on the same pre-faulted pinned
+buffer, and prints one JSON line per configuration.
+
+Run:  python -m deepspeed_tpu.benchmarks.aio [--size-mb 256] [--file PATH]
+"""
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+
+def _bench_read(handle, buf, path, reps):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        handle.async_pread(buf, path)
+        handle.wait()
+        ts.append(time.perf_counter() - t0)
+    return buf.nbytes / min(ts) / 1e9
+
+
+def _bench_write(handle, buf, path, reps):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        handle.async_pwrite(buf, path)
+        handle.wait()
+        ts.append(time.perf_counter() - t0)
+    return buf.nbytes / min(ts) / 1e9
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size-mb", type=int, default=256)
+    ap.add_argument("--file", default=None,
+                    help="target file (put it on NVMe to bench the device; "
+                         "default: a tempfile)")
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    nbytes = args.size_mb << 20
+    tmpdir = None
+    if args.file is None:
+        tmpdir = tempfile.mkdtemp(prefix="ds_aio_bench_")
+        path = os.path.join(tmpdir, "blob.bin")
+    else:
+        path = args.file
+
+    seed_handle = AsyncIOHandle()
+    buf = seed_handle.new_cpu_locked_tensor(nbytes, np.uint8)
+    buf[:] = 1
+    seed_handle.sync_pwrite(buf, path)
+
+    results = []
+    for qd, bs in ((1, 1 << 20), (8, 1 << 20), (16, 1 << 20), (16, 4 << 20)):
+        h = AsyncIOHandle(block_size=bs, queue_depth=qd)
+        tier = "io_uring" if h.uses_io_uring() else "threadpool"
+        row = {"tier": tier, "queue_depth": qd, "block_kb": bs >> 10,
+               "read_gbps": round(_bench_read(h, buf, path, args.reps), 3),
+               "write_gbps": round(_bench_write(h, buf, path, args.reps), 3)}
+        results.append(row)
+        print(json.dumps(row))
+    for threads in (4, 8):
+        h = AsyncIOHandle(thread_count=threads)
+        h._engine = None
+        row = {"tier": "threadpool", "threads": threads,
+               "block_kb": h.get_block_size() >> 10,
+               "read_gbps": round(_bench_read(h, buf, path, args.reps), 3),
+               "write_gbps": round(_bench_write(h, buf, path, args.reps), 3)}
+        results.append(row)
+        print(json.dumps(row))
+
+    seed_handle.free_cpu_locked_tensor(buf)
+    if tmpdir:
+        try:
+            os.unlink(path)
+            os.rmdir(tmpdir)
+        except OSError:
+            pass
+    return results
+
+
+if __name__ == "__main__":
+    main()
