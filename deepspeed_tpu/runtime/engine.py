@@ -52,7 +52,9 @@ from deepspeed_tpu.runtime.loss_scaler import (LossScaleState,
                                                update_scale)
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
 from deepspeed_tpu.runtime.optimizers import build_optimizer
-from deepspeed_tpu.runtime.zero.stage_plan import ZeroShardingPlan, constrain
+from deepspeed_tpu.runtime.zero.stage_plan import (ZeroShardingPlan,
+                                                   constrain,
+                                                   device_put_global)
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER,
                                        FORWARD_GLOBAL_TIMER,
@@ -307,30 +309,38 @@ class DeepSpeedEngine:
 
         param_sh = self.plan._to_sharding(self.plan.master_param_specs(params))
         with self.mesh:
-            params = jax.device_put(params, param_sh)
+            params = device_put_global(params, param_sh)
             opt_state = jax.jit(
                 self.tx.init,
                 out_shardings=self.plan.opt_state_shardings(self.tx, params),
             )(params)
-        rng = jax.random.key(cfg.seed)
         repl = self.plan.replicated_sharding()
-        ls = jax.device_put(ls, repl)
+        seed = cfg.seed
+        with self.mesh:
+            # jit (not device_put): builds replicated state on multi-host
+            # meshes where device_put can't target non-addressable devices
+            rng, step0, skip0 = jax.jit(
+                lambda: (jax.random.key(seed), jnp.asarray(0, jnp.int32),
+                         jnp.asarray(0, jnp.int32)),
+                out_shardings=repl)()
+        ls = device_put_global(
+            ls, jax.tree_util.tree_map(lambda _: repl, ls))
         return TrainState(
             params=params, opt_state=opt_state, loss_scale=ls,
-            global_step=jax.device_put(jnp.asarray(0, jnp.int32), repl),
-            skipped_steps=jax.device_put(jnp.asarray(0, jnp.int32), repl),
-            rng=jax.device_put(rng, repl))
+            global_step=step0, skipped_steps=skip0, rng=rng)
 
     def _init_offload_state(self, params) -> TrainState:
         """ZeRO-Offload mode state: host master + moments (see
         ``runtime/zero/offload.py``), device params in compute dtype."""
-        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+        from deepspeed_tpu.runtime.zero.offload import (HostOffloadOptimizer,
+                                                        ShardedFlatLayout)
         cfg = self._config
-        if jax.process_count() > 1:
+        multihost = jax.process_count() > 1
+        if multihost and cfg.zero_config.stage < 3:
             raise NotImplementedError(
-                "offload_optimizer requires a single-controller process: "
-                "fsdp-sharded gradients are not fully addressable from one "
-                "host on a multi-host pod")
+                "multi-host offload_optimizer needs ZeRO stage 3: each "
+                "process updates only the fsdp shards it can address, which "
+                "requires params and grads to share the fsdp partition")
         opt_name = self.optimizer_name_ or "adamw"
         supported = {"adam", "adamw", "fusedadam", "cpuadam", "adagrad"}
         if opt_name not in supported:
@@ -340,14 +350,37 @@ class DeepSpeedEngine:
                 "DeepSpeedCPUAdam/Adagrad)")
         opt_params = (dict(cfg.optimizer_config.params)
                       if cfg.optimizer_config else {})
-        host_params = jax.tree_util.tree_map(
-            lambda x: (np.asarray(x, np.float32)
-                       if np.issubdtype(np.asarray(x).dtype, np.floating)
-                       else np.asarray(x)), params)
-        self._offload = HostOffloadOptimizer(
-            host_params, cfg.zero_config, opt_name=opt_name,
-            opt_params=opt_params,
-            rank=jax.process_index(), world_size=jax.process_count())
+        if multihost:
+            # per-host partition: fp32 copy placed with the GRAD sharding
+            # (== param sharding at stage 3); each process's master covers
+            # exactly its addressable shards (reference: per-DP-rank fp32
+            # flat partitions, stage3.py).  The fp32 tree stays on HOST —
+            # device_put_global's callback hands each device its slice, so
+            # the full unsharded fp32 model never lands on one chip.
+            def _host_fp32(x):
+                h = np.asarray(jax.device_get(x))
+                return h.astype(np.float32) \
+                    if np.issubdtype(h.dtype, np.floating) else h
+            fp32 = jax.tree_util.tree_map(_host_fp32, params)
+            grad_sh = self.plan._to_sharding(self.plan.grad_specs(fp32))
+            with self.mesh:
+                fp32 = device_put_global(fp32, grad_sh)
+            self._offload = HostOffloadOptimizer(
+                fp32, cfg.zero_config, opt_name=opt_name,
+                opt_params=opt_params, layout=ShardedFlatLayout(fp32),
+                rank=jax.process_index(), world_size=jax.process_count())
+            self._offload_sharded = True
+            del fp32
+        else:
+            host_params = jax.tree_util.tree_map(
+                lambda x: (np.asarray(x, np.float32)
+                           if np.issubdtype(np.asarray(x).dtype, np.floating)
+                           else np.asarray(x)), params)
+            self._offload = HostOffloadOptimizer(
+                host_params, cfg.zero_config, opt_name=opt_name,
+                opt_params=opt_params,
+                rank=jax.process_index(), world_size=jax.process_count())
+            self._offload_sharded = False
 
         if cfg.fp16_enabled and cfg.dynamic_loss_scale:
             ls = dynamic_loss_scale_state(
@@ -364,16 +397,20 @@ class DeepSpeedEngine:
             else jnp.asarray(x), params)
         param_sh = self.plan._to_sharding(self.plan.param_specs(dev_params))
         with self.mesh:
-            dev_params = jax.device_put(dev_params, param_sh)
+            dev_params = device_put_global(dev_params, param_sh)
         self._offload_param_sh = param_sh
         repl = self.plan.replicated_sharding()
-        rng = jax.random.key(cfg.seed)
+        seed = cfg.seed
+        with self.mesh:
+            rng, step0, skip0 = jax.jit(
+                lambda: (jax.random.key(seed), jnp.asarray(0, jnp.int32),
+                         jnp.asarray(0, jnp.int32)),
+                out_shardings=repl)()
         return TrainState(
             params=dev_params, opt_state=(),
-            loss_scale=jax.device_put(ls, repl),
-            global_step=jax.device_put(jnp.asarray(0, jnp.int32), repl),
-            skipped_steps=jax.device_put(jnp.asarray(0, jnp.int32), repl),
-            rng=jax.device_put(rng, repl))
+            loss_scale=device_put_global(
+                ls, jax.tree_util.tree_map(lambda _: repl, ls)),
+            global_step=step0, skipped_steps=skip0, rng=rng)
 
     # ------------------------------------------------------------------
     # the compiled step
@@ -522,22 +559,29 @@ class DeepSpeedEngine:
         if not overflow_b:
             lr = float(jax.device_get(
                 jnp.asarray(self._schedule_fn(self.state.global_step))))
-            grads_np = jax.device_get(grads)
+            coef = None
             if cfg.gradient_clipping and cfg.gradient_clipping > 0:
                 gn = float(jax.device_get(grad_norm))
                 clip = cfg.gradient_clipping
                 if gn > clip:
                     coef = clip / (gn + 1e-6)
-                    grads_np = jax.tree_util.tree_map(
-                        lambda g: g * coef, grads_np)
-            self._offload.step(grads_np, lr=lr)
-            new_params = jax.tree_util.tree_map(
-                lambda x: jnp.asarray(
-                    x.astype(self.compute_dtype)
-                    if np.issubdtype(x.dtype, np.floating) else x),
-                self._offload.params_tree())
-            with self.mesh:
-                new_params = jax.device_put(new_params, self._offload_param_sh)
+            # streamed: per-leaf D2H overlaps per-subgroup host Adam
+            self._offload.step_streamed(grads, lr=lr, clip_coef=coef)
+            if self._offload_sharded:
+                # multi-host: assemble the global device tree from each
+                # process's local master shards
+                with self.mesh:
+                    new_params = self._offload.device_params(
+                        self._offload_param_sh, dtype=self.compute_dtype)
+            else:
+                new_params = jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(
+                        x.astype(self.compute_dtype)
+                        if np.issubdtype(x.dtype, np.floating) else x),
+                    self._offload.params_tree())
+                with self.mesh:
+                    new_params = device_put_global(new_params,
+                                                   self._offload_param_sh)
             self.state = self.state.replace(params=new_params)
         new_ls = update_scale(
             self.state.loss_scale, jnp.asarray(overflow_b),
@@ -877,10 +921,21 @@ class DeepSpeedEngine:
         handles gather-on-save, so consolidation is just a replicated
         device_get."""
         if self._offload is not None:
+            if self._offload_sharded:
+                # multi-host: the host master is shard-local; consolidate
+                # from the (compute-dtype) device params instead
+                return jax.device_get(self._replicate_gather(
+                    self.state.params))
             return self._offload.params_tree()
+        return jax.device_get(self._replicate_gather(self.state.params))
+
+    def _replicate_gather(self, tree):
+        """All-gather a sharded tree to replicated via jit (works on
+        multi-host meshes where a plain device_put cannot re-target
+        non-addressable devices)."""
         repl = self.plan.replicated_sharding()
-        gathered = jax.device_get(jax.device_put(self.state.params, repl))
-        return gathered
+        with self.mesh:
+            return jax.jit(lambda x: x, out_shardings=repl)(tree)
 
     # ------------------------------------------------------------------
     # checkpointing (parity: save_checkpoint:3084 / load_checkpoint:2724)
@@ -929,23 +984,36 @@ class DeepSpeedEngine:
                                                                     tag)
             if restored:
                 with self.mesh:
-                    self.state = self.state.replace(
-                        params=jax.device_put(
+                    if self._offload_sharded:
+                        new_params = self._offload.device_params(
+                            self._offload_param_sh,
+                            dtype=self.compute_dtype)
+                    else:
+                        new_params = device_put_global(
                             jax.tree_util.tree_map(
                                 lambda x: jnp.asarray(
                                     x.astype(self.compute_dtype)
                                     if np.issubdtype(x.dtype, np.floating)
                                     else x),
                                 self._offload.params_tree()),
-                            self._offload_param_sh))
+                            self._offload_param_sh)
+                    self.state = self.state.replace(params=new_params)
             else:
                 # no host shard restored (fresh fp32 weights or
                 # load_optimizer_states=False): resync the host master from
                 # the just-loaded device params so the next step doesn't
                 # revert them to construction-time weights
-                loaded = jax.device_get(jax.device_put(
-                    self.state.params, self.plan.replicated_sharding()))
-                self._offload.layout.flatten(loaded, out=self._offload.master)
+                if self._offload_sharded:
+                    # loaded device params share the grad/fsdp sharding at
+                    # stage 3: flatten local shards directly (fp32 cast in
+                    # the shard fetch)
+                    self._offload.layout.flatten(
+                        self.state.params, out=self._offload.master)
+                else:
+                    loaded = jax.device_get(
+                        self._replicate_gather(self.state.params))
+                    self._offload.layout.flatten(loaded,
+                                                 out=self._offload.master)
         self.global_steps = client_state.get("global_steps", 0)
         self.micro_steps = client_state.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None and \

@@ -14,9 +14,10 @@ device step is one XLA program, so offload is a *mode of the engine*:
 
 - the fp32 master params and Adam moments live in ONE flat host buffer each
   (numpy; the flat layout is the reference's flattened partition buffer).
-  Offload currently requires a single-controller process (the engine rejects
-  ``jax.process_count() > 1``): sharded grads are not fully addressable from
-  one host, so multi-host offload needs per-rank partition streaming;
+  On a multi-host pod (ZeRO stage 3) each process's buffers cover only its
+  addressable fsdp shards (``ShardedFlatLayout`` — the per-DP-rank fp32
+  partition of reference ``stage3.py``) and the updated shards are stitched
+  back into global device arrays;
 - the device holds compute-dtype (bf16/fp16) params only — that is the
   memory saving;
 - gradients stream device→host once per optimizer step, the fused C++
@@ -34,6 +35,7 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.ops import cpu_adam
@@ -92,6 +94,145 @@ class FlatLayout:
             leaves.append(x.astype(dtype) if dtype is not None else x)
             fi += 1
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def pieces(self, tree):
+        """Flat-order stream units for ``step_streamed``: yields
+        ``(offset, size, fetch)`` where ``fetch()`` materialises that
+        range's gradient values on host (fp32, raveled)."""
+        leaves = self.treedef.flatten_up_to(tree)
+        fi = 0
+        for leaf, is_f in zip(leaves, self.is_float):
+            if not is_f:
+                continue
+            off, size = int(self.offsets[fi]), self.sizes[fi]
+            fi += 1
+            yield off, size, (lambda l=leaf: np.asarray(
+                jax.device_get(l), np.float32).reshape(-1))
+
+
+def _shard_key(shard, shape):
+    """Canonical hashable key for a shard's global index."""
+    out = []
+    for s, dim in zip(shard.index, shape):
+        out.append((0 if s.start is None else int(s.start),
+                    dim if s.stop is None else int(s.stop)))
+    return tuple(out)
+
+
+class ShardedFlatLayout:
+    """``FlatLayout`` over the PROCESS-LOCAL shards of a sharded device
+    tree — the multi-host ZeRO-Offload partition (reference: each DP rank's
+    fp32 flat partition buffer in ``stage3.py``; here the partition is
+    whatever fsdp/tp sharding the plan chose, read straight from the
+    arrays' shardings).
+
+    Flat order: float leaves in tree order; within a leaf, distinct local
+    shard indices sorted.  Replicated device groups store one copy.
+    """
+
+    def __init__(self, dev_tree):
+        leaves, self.treedef = jax.tree_util.tree_flatten(dev_tree)
+        self.is_float = [jnp.issubdtype(x.dtype, jnp.floating)
+                         for x in leaves]
+        # non-float leaves: keep every distinct LOCAL shard's value (a
+        # sharded int leaf must not collapse to shard 0's data)
+        self.static_leaves: Dict[int, list] = {}
+        for i, x in enumerate(leaves):
+            if self.is_float[i]:
+                continue
+            groups: Dict[tuple, list] = {}
+            for sh in x.addressable_shards:
+                groups.setdefault(_shard_key(sh, x.shape),
+                                  []).append(sh.device)
+            self.static_leaves[i] = [
+                (key, devs, np.asarray(
+                    next(s for s in x.addressable_shards
+                         if _shard_key(s, x.shape) == key).data))
+                for key, devs in sorted(groups.items())]
+        self.global_shapes = [tuple(x.shape) for x in leaves]
+        # per float leaf: ordered [(index_key, [devices])]
+        self.leaf_groups: List[List[Tuple[tuple, list]]] = []
+        sizes = []
+        for leaf, is_f in zip(leaves, self.is_float):
+            if not is_f:
+                continue
+            groups: Dict[tuple, list] = {}
+            for sh in leaf.addressable_shards:
+                groups.setdefault(_shard_key(sh, leaf.shape),
+                                  []).append(sh.device)
+            ordered = sorted(groups.items())
+            self.leaf_groups.append(ordered)
+            for key, _ in ordered:
+                sizes.append(int(np.prod([hi - lo for lo, hi in key])))
+        self.sizes = sizes
+        self.offsets = np.concatenate(
+            [[0], np.cumsum(sizes)]).astype(np.int64) if sizes else \
+            np.zeros(1, np.int64)
+        self.total = int(self.offsets[-1])
+
+    # -- streaming / flatten -------------------------------------------
+    def pieces(self, dev_tree):
+        """(offset, size, fetch) per local shard, flat order."""
+        leaves = self.treedef.flatten_up_to(dev_tree)
+        pi = 0
+        gi = 0
+        for leaf, is_f in zip(leaves, self.is_float):
+            if not is_f:
+                continue
+            by_key = {_shard_key(sh, leaf.shape): sh
+                      for sh in leaf.addressable_shards}
+            for key, _ in self.leaf_groups[gi]:
+                off, size = int(self.offsets[pi]), self.sizes[pi]
+                sh = by_key[key]
+                yield off, size, (lambda s=sh: np.asarray(
+                    s.data, np.float32).reshape(-1))
+                pi += 1
+            gi += 1
+        assert pi == len(self.sizes), "device tree shards do not match layout"
+
+    def flatten(self, dev_tree, out: Optional[np.ndarray] = None):
+        if out is None:
+            out = np.empty(self.total, np.float32)
+        for off, size, fetch in self.pieces(dev_tree):
+            out[off:off + size] = fetch()
+        return out
+
+    # -- device assembly -----------------------------------------------
+    def to_device(self, flat: np.ndarray, shardings, dtype=None):
+        """Assemble the global device tree from the local flat buffer:
+        one single-device array per local device per leaf, stitched with
+        ``jax.make_array_from_single_device_arrays`` (each process supplies
+        only its addressable shards — the multi-host-safe inverse of
+        ``unflatten`` + ``device_put``)."""
+        sh_leaves = self.treedef.flatten_up_to(shardings)
+        out_leaves = []
+        pi = 0
+        gi = 0
+        for i, (is_f, gshape) in enumerate(
+                zip(self.is_float, self.global_shapes)):
+            sharding = sh_leaves[i]
+            if not is_f:
+                arrs = []
+                for key, devices, host in self.static_leaves[i]:
+                    for d in devices:
+                        arrs.append(jax.device_put(host, d))
+                out_leaves.append(jax.make_array_from_single_device_arrays(
+                    gshape, sharding, arrs))
+                continue
+            arrs = []
+            for key, devices in self.leaf_groups[gi]:
+                off, size = int(self.offsets[pi]), self.sizes[pi]
+                pi += 1
+                shape = tuple(hi - lo for lo, hi in key)
+                host = flat[off:off + size].reshape(shape)
+                if dtype is not None:
+                    host = host.astype(dtype)
+                for d in devices:
+                    arrs.append(jax.device_put(host, d))
+            gi += 1
+            out_leaves.append(jax.make_array_from_single_device_arrays(
+                gshape, sharding, arrs))
+        return jax.tree_util.tree_unflatten(self.treedef, out_leaves)
 
 
 class OptimizerStateSwapper:
@@ -187,11 +328,17 @@ class HostOffloadOptimizer:
 
     def __init__(self, params_tree, zero_config, opt_name: str = "adamw",
                  opt_params: Optional[dict] = None, rank: int = 0,
-                 world_size: int = 1):
+                 world_size: int = 1, layout=None):
         opt_params = dict(opt_params or {})
-        self.layout = FlatLayout(params_tree)
-        self.master = self.layout.flatten(
-            jax.tree_util.tree_map(np.asarray, params_tree))
+        if layout is not None:
+            # pre-built (e.g. ShardedFlatLayout over placed device params —
+            # the multi-host partition); master filled from the same tree
+            self.layout = layout
+            self.master = layout.flatten(params_tree)
+        else:
+            self.layout = FlatLayout(params_tree)
+            self.master = self.layout.flatten(
+                jax.tree_util.tree_map(np.asarray, params_tree))
         self.opt_name = opt_name
         self.lr = float(opt_params.get("lr", 1e-3))
         betas = opt_params.get("betas", (0.9, 0.999))
@@ -230,38 +377,89 @@ class HostOffloadOptimizer:
                             for _ in range(self.n_moments)]
 
     # ------------------------------------------------------------------
+    def _apply_subgroup(self, gi: int, flat_grads: np.ndarray, lr: float):
+        lo, hi = self.subgroups[gi]
+        if self.swapper is not None:
+            moments = self.swapper.swap_in(gi)
+            # prefetch the next sub-group's moments while updating this one
+            if gi + 1 < len(self.subgroups):
+                self.swapper.swap_in(gi + 1, prefetch=True)
+        else:
+            moments = [m[lo:hi] for m in self.moments]
+        p, g = self.master[lo:hi], flat_grads[lo:hi]
+        if self.opt_name == "adagrad":
+            cpu_adam.adagrad_update(p, g, moments[0], lr=lr,
+                                    eps=self.eps,
+                                    weight_decay=self.weight_decay)
+        else:
+            st = cpu_adam.CPUAdamState(m=moments[0], v=moments[1],
+                                       step=self.step_count - 1)
+            cpu_adam.adam_update(p, g, st, lr=lr, beta1=self.beta1,
+                                 beta2=self.beta2, eps=self.eps,
+                                 weight_decay=self.weight_decay,
+                                 adamw_mode=self.adamw_mode)
+        if self.swapper is not None:
+            self.swapper.swap_out(gi)
+
     def step(self, grads_tree, lr: Optional[float] = None):
         """One offloaded optimizer step.  ``grads_tree``: host (numpy) fp32
         gradients, same treedef as params."""
         lr = self.lr if lr is None else float(lr)
         flat_grads = self.layout.flatten(grads_tree)
         self.step_count += 1
-        for gi, (lo, hi) in enumerate(self.subgroups):
-            if self.swapper is not None:
-                moments = self.swapper.swap_in(gi)
-                # prefetch the next sub-group's moments while updating this one
-                if gi + 1 < len(self.subgroups):
-                    self.swapper.swap_in(gi + 1, prefetch=True)
-            else:
-                moments = [m[lo:hi] for m in self.moments]
-            p, g = self.master[lo:hi], flat_grads[lo:hi]
-            if self.opt_name == "adagrad":
-                cpu_adam.adagrad_update(p, g, moments[0], lr=lr,
-                                        eps=self.eps,
-                                        weight_decay=self.weight_decay)
-            else:
-                st = cpu_adam.CPUAdamState(m=moments[0], v=moments[1],
-                                           step=self.step_count - 1)
-                cpu_adam.adam_update(p, g, st, lr=lr, beta1=self.beta1,
-                                     beta2=self.beta2, eps=self.eps,
-                                     weight_decay=self.weight_decay,
-                                     adamw_mode=self.adamw_mode)
-            if self.swapper is not None:
-                self.swapper.swap_out(gi)
+        for gi in range(len(self.subgroups)):
+            self._apply_subgroup(gi, flat_grads, lr)
         if self.swapper is not None:
             self.swapper.release()
 
+    def step_streamed(self, grads_tree, lr: Optional[float] = None,
+                      clip_coef: Optional[float] = None):
+        """``step`` fed directly by DEVICE gradients, pipelined: all D2H
+        transfers are issued up front (``copy_to_host_async``), then each
+        flat-order leaf is awaited individually and a sub-group's fused
+        Adam runs as soon as the transfer frontier passes it — transfer of
+        leaf i+1 overlaps the update covering leaf i (the role of the
+        reference's grad-bucket D2H streams in
+        ``stage3.py``/``cpu_adam`` interplay).  NVMe moment prefetch
+        (``_apply_subgroup``) stacks on top, giving a 3-deep pipeline:
+        D2H grads / NVMe moments / C++ Adam (OpenMP, GIL released)."""
+        lr = self.lr if lr is None else float(lr)
+        leaves = self.layout.treedef.flatten_up_to(grads_tree)
+        for leaf, is_f in zip(leaves, self.layout.is_float):
+            if is_f and hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()       # start every D2H now
+        flat_grads = np.empty(self.layout.total, np.float32)
+        self.step_count += 1
+        gi = 0
+        for off, size, fetch in self.layout.pieces(grads_tree):
+            arr = fetch()
+            if clip_coef is not None:
+                arr = arr * clip_coef
+            flat_grads[off:off + size] = arr
+            frontier = off + size
+            while gi < len(self.subgroups) and \
+                    self.subgroups[gi][1] <= frontier:
+                self._apply_subgroup(gi, flat_grads, lr)
+                gi += 1
+        while gi < len(self.subgroups):
+            self._apply_subgroup(gi, flat_grads, lr)
+            gi += 1
+        if self.swapper is not None:
+            self.swapper.release()
+
+    def device_params(self, shardings, dtype=None):
+        """Assemble the updated master straight into a global DEVICE tree
+        (multi-host path; requires a ShardedFlatLayout)."""
+        assert isinstance(self.layout, ShardedFlatLayout), \
+            "device_params needs the sharded layout (multi-host offload)"
+        return self.layout.to_device(self.master, shardings, dtype=dtype)
+
     def params_tree(self, dtype=None):
+        if isinstance(self.layout, ShardedFlatLayout):
+            raise RuntimeError(
+                "params_tree() is a single-host API: a multi-host offload "
+                "master holds only this process's shards — use "
+                "device_params(shardings) for the global device tree")
         return self.layout.unflatten(self.master, dtype=dtype)
 
     # ------------------------------------------------------------------

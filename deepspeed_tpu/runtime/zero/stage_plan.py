@@ -219,6 +219,26 @@ class ZeroShardingPlan:
         return jax.tree_util.tree_map_with_path(wrap, tree)
 
 
+def device_put_global(tree, shardings):
+    """``jax.device_put`` that also works on multi-host meshes.
+
+    ``device_put`` refuses shardings with non-addressable devices; on a pod
+    every process holds the same host value (SPMD init), so the global
+    array is assembled per-device from the host copy
+    (``make_array_from_callback`` hands each local device its slice —
+    the single-controller path stays a plain device_put)."""
+    def put(x, sh):
+        if sh is None:
+            return x
+        if jax.process_count() == 1 or sh.is_fully_addressable:
+            return jax.device_put(x, sh)
+        host = np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) \
+            else np.asarray(x)
+        return jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx])
+    return jax.tree_util.tree_map(put, tree, shardings)
+
+
 def active_mesh():
     """The ambient mesh installed by ``with mesh:`` — None outside."""
     try:
