@@ -18,6 +18,7 @@ import re
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
@@ -46,7 +47,7 @@ def ds_to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None,
         fname = _safe(key) + ".npy"
         np.save(os.path.join(out_dir, fname),
                 np.asarray(leaf, np.float32)
-                if np.issubdtype(np.asarray(leaf).dtype, np.floating)
+                if jnp.issubdtype(np.asarray(leaf).dtype, jnp.floating)
                 else np.asarray(leaf))
         meta["keys"][key] = {"file": fname,
                              "shape": list(np.shape(leaf)),

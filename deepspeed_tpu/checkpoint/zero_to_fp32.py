@@ -18,6 +18,7 @@ import os
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
@@ -34,9 +35,11 @@ def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
                                         "zero_offload_rank*.npz")))
     state = load_checkpoint_tree(ckpt_dir, tag)
     params = state.get("params", state)
+    # jnp.issubdtype: bf16 is an ml_dtypes extension np.issubdtype
+    # does not classify as floating
     params = jax.tree_util.tree_map(
         lambda x: np.asarray(x, np.float32)
-        if np.issubdtype(np.asarray(x).dtype, np.floating)
+        if jnp.issubdtype(np.asarray(x).dtype, jnp.floating)
         else np.asarray(x), params)
     if off:
         from deepspeed_tpu.runtime.zero.offload import FlatLayout

@@ -118,8 +118,8 @@ class InferenceEngine:
 
         def host_cast(x):
             x = np.asarray(x)
-            return x.astype(np_dtype) if np.issubdtype(x.dtype, np.floating) \
-                else x
+            return x.astype(np_dtype) \
+                if jnp.issubdtype(x.dtype, jnp.floating) else x
 
         layers = params["layers"]
         assert not isinstance(layers, (list, tuple)), \

@@ -628,7 +628,7 @@ class CausalTransformerLM:
                   layer.get("attn_norm_b"))
         q, k, v = self._qkv(h, layer, B, T, positions)
         cache = update_cache(KVCache(k=cache_k, v=cache_v, length=length), k, v)
-        bias = self._cached_attn_bias(layer, T, cache.k.shape[1],
+        bias = self._cached_attn_bias(layer, T, cache.k.shape[2],
                                       cache.length)
         attn = decode_attention(q, cache, softmax_scale=c.attn_scale,
                                 bias=bias)

@@ -3,7 +3,8 @@
 Parity: reference ``csrc/transformer/inference`` ``softmax_context_fp16`` —
 the fused attention-with-KV-cache kernel behind ``DeepSpeedTransformerInference``.
 
-TPU design: the cache is a static-shape ring buffer [B, max_seq, Hkv, D]
+TPU design: the cache is a static-shape ring buffer [B, Hkv, max_seq, D] —
+seq on sublanes, head_dim on lanes, the layout Mosaic tiles natively —
 updated with ``lax.dynamic_update_slice`` (static shapes keep XLA happy in a
 decode loop); attention masks positions ≥ cur_len.  Two compute paths
 behind one API: the Pallas online-softmax kernel
@@ -39,25 +40,28 @@ def use_pallas(impl, seq_len=None, block_k=DEFAULT_BLOCK_K):
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # [B, S_max, Hkv, D]
-    v: jnp.ndarray  # [B, S_max, Hkv, D]
+    k: jnp.ndarray  # [B, Hkv, S_max, D]
+    v: jnp.ndarray  # [B, Hkv, S_max, D]
     length: jnp.ndarray  # i32 scalar: valid prefix length
 
 
 def init_cache(batch, max_seq, n_kv_heads, head_dim, dtype=jnp.bfloat16):
-    shape = (batch, max_seq, n_kv_heads, head_dim)
+    shape = (batch, n_kv_heads, max_seq, head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((), jnp.int32))
 
 
 def update_cache(cache: KVCache, k_new, v_new) -> KVCache:
-    """Append [B, T, Hkv, D] at position cache.length."""
+    """Append [B, T, Hkv, D] (model layout) at position cache.length —
+    only the new tokens are transposed into the cache layout."""
     start = cache.length
+    k_new = jnp.swapaxes(k_new, 1, 2)      # -> [B, Hkv, T, D]
+    v_new = jnp.swapaxes(v_new, 1, 2)
     k = jax.lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype),
-                                     (0, start, 0, 0))
+                                     (0, 0, start, 0))
     v = jax.lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype),
-                                     (0, start, 0, 0))
-    return KVCache(k=k, v=v, length=start + k_new.shape[1])
+                                     (0, 0, start, 0))
+    return KVCache(k=k, v=v, length=start + k_new.shape[2])
 
 
 def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
@@ -69,7 +73,7 @@ def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
     or "jnp".  ``bias``: additive logit bias broadcastable to [B, H, T, S]
     (ALiBi / local-window models); forces the jnp path."""
     B, T, H, D = q.shape
-    if bias is None and use_pallas(impl, cache.k.shape[1], block_k):
+    if bias is None and use_pallas(impl, cache.k.shape[2], block_k):
         from deepspeed_tpu.ops.pallas.decode_attention import \
             decode_attention_pallas
         lengths = jnp.broadcast_to(jnp.asarray(cache.length, jnp.int32), (B,))
@@ -77,15 +81,15 @@ def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
                                        softmax_scale=softmax_scale,
                                        block_k=block_k,
                                        interpret=interpret)
-    Hkv = cache.k.shape[2]
+    Hkv = cache.k.shape[1]
     k, v = cache.k, cache.v
     if Hkv != H:
         rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    S = cache.k.shape[1]
+    logits = jnp.einsum("bqhd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    S = cache.k.shape[2]
     kpos = jnp.arange(S)[None, :]
     qpos = cache.length - T + jnp.arange(T)[:, None]
     if bias is not None:
@@ -93,7 +97,7 @@ def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
     mask = kpos <= qpos  # causal within the valid prefix
     logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhqk,bhkd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
 
 

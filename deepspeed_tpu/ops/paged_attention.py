@@ -8,7 +8,8 @@ style, cf. PAPERS.md) — which removes max-length over-allocation and lets
 sequences of very different lengths batch together.
 
 Layout:
-  k_pages/v_pages: [num_pages, page_size, Hkv, D] — the physical pool
+  k_pages/v_pages: [num_pages, Hkv, page_size, D] — the physical pool
+  (seq on sublanes, D on lanes — the layout Mosaic tiles natively)
   block_tables:    [B, max_pages_per_seq] int32 — page ids per sequence
   lengths:         [B] int32 — tokens currently stored per sequence
 
@@ -29,13 +30,13 @@ import numpy as np
 
 
 class PagedKVCache(NamedTuple):
-    k_pages: jnp.ndarray   # [P, page, Hkv, D]
+    k_pages: jnp.ndarray   # [P, Hkv, page, D]
     v_pages: jnp.ndarray
 
 
 def init_paged_cache(num_pages, page_size, n_kv_heads, head_dim,
                      dtype=jnp.bfloat16) -> PagedKVCache:
-    shape = (num_pages, page_size, n_kv_heads, head_dim)
+    shape = (num_pages, n_kv_heads, page_size, head_dim)
     return PagedKVCache(k_pages=jnp.zeros(shape, dtype),
                         v_pages=jnp.zeros(shape, dtype))
 
@@ -48,13 +49,13 @@ def append_paged(cache: PagedKVCache, block_tables, lengths, k_new, v_new
     written must already be mapped in ``block_tables`` (allocator's job).
     """
     B = k_new.shape[0]
-    page_size = cache.k_pages.shape[1]
+    page_size = cache.k_pages.shape[2]
     page_idx = jnp.take_along_axis(
         block_tables, (lengths // page_size)[:, None], axis=1)[:, 0]
     offset = lengths % page_size
-    k = cache.k_pages.at[page_idx, offset].set(
+    k = cache.k_pages.at[page_idx, :, offset].set(
         k_new[:, 0].astype(cache.k_pages.dtype))
-    v = cache.v_pages.at[page_idx, offset].set(
+    v = cache.v_pages.at[page_idx, :, offset].set(
         v_new[:, 0].astype(cache.v_pages.dtype))
     return PagedKVCache(k_pages=k, v_pages=v), lengths + 1
 
@@ -64,13 +65,15 @@ def prefill_paged(cache: PagedKVCache, block_tables, lengths, k_new, v_new
     """Write a whole prompt [B, T, Hkv, D] starting at ``lengths`` (which is
     typically zero)."""
     B, T = k_new.shape[:2]
-    page_size = cache.k_pages.shape[1]
+    page_size = cache.k_pages.shape[2]
     pos = lengths[:, None] + jnp.arange(T)[None, :]          # [B, T]
     page_idx = jnp.take_along_axis(block_tables, pos // page_size, axis=1)
     offset = pos % page_size
-    k = cache.k_pages.at[page_idx, offset].set(
+    # advanced indices (page_idx, offset) around the ':' slice put their
+    # broadcast dims first: the set value is [B, T, Hkv, D] = k_new's layout
+    k = cache.k_pages.at[page_idx, :, offset].set(
         k_new.astype(cache.k_pages.dtype))
-    v = cache.v_pages.at[page_idx, offset].set(
+    v = cache.v_pages.at[page_idx, :, offset].set(
         v_new.astype(cache.v_pages.dtype))
     return PagedKVCache(k_pages=k, v_pages=v), lengths + T
 
@@ -93,26 +96,28 @@ def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
                                       softmax_scale=softmax_scale,
                                       interpret=interpret)
     B, T, H, D = q.shape
-    page_size = cache.k_pages.shape[1]
-    Hkv = cache.k_pages.shape[2]
+    Hkv = cache.k_pages.shape[1]
+    page_size = cache.k_pages.shape[2]
     max_pages = block_tables.shape[1]
     S = max_pages * page_size
 
-    # [B, max_pages, page, Hkv, D] → [B, S, Hkv, D]
-    k = cache.k_pages[block_tables].reshape(B, S, Hkv, D)
-    v = cache.v_pages[block_tables].reshape(B, S, Hkv, D)
+    # [B, max_pages, Hkv, page, D] → [B, Hkv, S, D]
+    k = jnp.swapaxes(cache.k_pages[block_tables], 1, 2) \
+        .reshape(B, Hkv, S, D)
+    v = jnp.swapaxes(cache.v_pages[block_tables], 1, 2) \
+        .reshape(B, Hkv, S, D)
     if Hkv != H:
         rep = H // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     kpos = jnp.arange(S)[None, None, :]                       # [1, 1, S]
     qpos = (lengths[:, None] - T + jnp.arange(T)[None, :])[..., None]
     mask = kpos <= qpos                                       # [B, T, S]
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bhqk,bhkd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)   # impl-independent output dtype
 
 

@@ -46,9 +46,10 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
                    group):
     """One (batch, kv-head, key-block) step of online-softmax attention.
 
-    q_ref: [1, T, group, D]; k_ref/v_ref: [1, block_k, 1, D];
-    o_ref: [1, T, group, D]; scratch acc/m/l persist across the key-block
-    grid dim (TPU grids are sequential)."""
+    q_ref: [1, T, 1, group, D]; k_ref/v_ref: [1, 1, block_k, D]
+    (cache layout [B, Hkv, S, D] — seq on sublanes, D on lanes);
+    o_ref: [1, T, 1, group, D]; scratch acc/m/l persist across the
+    key-block grid dim (TPU grids are sequential)."""
     b = pl.program_id(0)
     i = pl.program_id(2)
     n_blocks = pl.num_programs(2)
@@ -67,8 +68,8 @@ def _decode_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(i * block_k < length)
     def _compute():
         q = q_ref[0].reshape(rows, d).astype(jnp.float32) * scale
-        k = k_ref[0, :, 0, :].astype(jnp.float32)          # [BK, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)                # [BK, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [rows, BK]
@@ -107,11 +108,11 @@ def decode_attention_pallas(q, k, v, lengths, softmax_scale=None,
 
     q: [B, T, H, D] — the last T tokens of each sequence (T=1 decode,
     T>1 chunked prefill; they are already appended to the cache);
-    k/v: [B, S_max, Hkv, D]; lengths: [B] int32 valid prefix lengths.
+    k/v: [B, Hkv, S_max, D]; lengths: [B] int32 valid prefix lengths.
     """
     B, T, H, D = q.shape
-    S = k.shape[1]
-    Hkv = k.shape[2]
+    S = k.shape[2]
+    Hkv = k.shape[1]
     group = H // Hkv
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
     block_k = min(block_k, S)
@@ -127,7 +128,7 @@ def decode_attention_pallas(q, k, v, lengths, softmax_scale=None,
         # never fetch blocks past the valid length: clamp to the last
         # block containing valid keys (repeat index -> DMA skipped)
         last = jnp.maximum(pl.cdiv(lens[b], block_k) - 1, 0)
-        return (b, jnp.minimum(i, last), h, 0)
+        return (b, h, jnp.minimum(i, last), 0)
 
     grid = (B, Hkv, n_blocks)
     kernel = functools.partial(
@@ -141,8 +142,8 @@ def decode_attention_pallas(q, k, v, lengths, softmax_scale=None,
             in_specs=[
                 pl.BlockSpec((1, T, 1, group, D),
                              lambda b, h, i, lens: (b, 0, h, 0, 0)),
-                pl.BlockSpec((1, block_k, 1, D), k_map),
-                pl.BlockSpec((1, block_k, 1, D), k_map),
+                pl.BlockSpec((1, 1, block_k, D), k_map),
+                pl.BlockSpec((1, 1, block_k, D), k_map),
             ],
             out_specs=pl.BlockSpec((1, T, 1, group, D),
                                    lambda b, h, i, lens: (b, 0, h, 0, 0)),
@@ -162,13 +163,13 @@ def paged_attention_pallas(q, k_pages, v_pages, block_tables, lengths,
                            softmax_scale=None, interpret=False):
     """Ragged paged decode attention.
 
-    q: [B, T, H, D]; k_pages/v_pages: [P, page_size, Hkv, D];
+    q: [B, T, H, D]; k_pages/v_pages: [P, Hkv, page_size, D];
     block_tables: [B, max_pages] int32 page ids; lengths: [B] int32.
     The key-block index map reads the block table, so only each
     sequence's own pages are ever DMA'd.
     """
     B, T, H, D = q.shape
-    P, page_size, Hkv, _ = k_pages.shape
+    P, Hkv, page_size, _ = k_pages.shape
     group = H // Hkv
     max_pages = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
@@ -180,7 +181,7 @@ def paged_attention_pallas(q, k_pages, v_pages, block_tables, lengths,
     def k_map(b, h, i, lens, tables):
         last = jnp.maximum(pl.cdiv(lens[b], page_size) - 1, 0)
         page = tables[b, jnp.minimum(i, last)]
-        return (page, 0, h, 0)
+        return (page, h, 0, 0)
 
     def paged_kernel(lengths_ref, tables_ref, *refs, **kw):
         _decode_kernel(lengths_ref, *refs, **kw)
@@ -197,8 +198,8 @@ def paged_attention_pallas(q, k_pages, v_pages, block_tables, lengths,
             in_specs=[
                 pl.BlockSpec((1, T, 1, group, D),
                              lambda b, h, i, lens, tables: (b, 0, h, 0, 0)),
-                pl.BlockSpec((1, page_size, 1, D), k_map),
-                pl.BlockSpec((1, page_size, 1, D), k_map),
+                pl.BlockSpec((1, 1, page_size, D), k_map),
+                pl.BlockSpec((1, 1, page_size, D), k_map),
             ],
             out_specs=pl.BlockSpec((1, T, 1, group, D),
                                    lambda b, h, i, lens, tables:

@@ -130,8 +130,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
     do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
-    lse = lse_ref[0].reshape(block_q, 1)               # [BQ, 1]
-    delta = delta_ref[0].reshape(block_q, 1)           # [BQ, 1]
+    lse = lse_ref[0].reshape(block_q, 1)               # [BQ, 1, 1]→[BQ, 1]
+    delta = delta_ref[0].reshape(block_q, 1)
     d = q.shape[-1]
 
     num_k_blocks = seq_len // block_k
@@ -180,8 +180,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)].reshape(
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :].reshape(block_q, 1)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :].reshape(
             block_q, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -223,10 +223,13 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
     vr = jnp.swapaxes(v, 1, 2).reshape(B * Hkv, S, D)
     gr = jnp.swapaxes(g, 1, 2).reshape(B * H, S, D)
     of = jnp.swapaxes(out, 1, 2).reshape(B * H, S, D)
-    lser = lse.reshape(B * H, S)
+    # trailing singleton dim: mosaic requires the last two block dims to
+    # tile (8, 128) or equal the array dims — (block, 1) blocks of an
+    # [..., 1] array are legal where (1, block) blocks of a 2-D one aren't
+    lser = lse.reshape(B * H, S, 1)
     # delta_i = Σ_d dO_i · O_i  (the softmax-jacobian row term)
     delta = jnp.sum(gr.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1, keepdims=True)
 
     kv_spec = pl.BlockSpec((1, S, D), lambda bh, i, g=group: (bh // g, 0, 0))
     dq = pl.pallas_call(
@@ -238,8 +241,8 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             kv_spec,
             kv_spec,
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
@@ -258,8 +261,8 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             pl.BlockSpec((1, block_k, D),
                          lambda bh, ki, g=group: (bh // g, ki, 0)),
             full_spec,                                     # dO
-            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),  # lse
-            pl.BlockSpec((1, S), lambda bh, ki: (bh, 0)),  # delta
+            pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),  # lse
+            pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),  # delta
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),

@@ -360,7 +360,7 @@ class DeepSpeedEngine:
             def _host_fp32(x):
                 h = np.asarray(jax.device_get(x))
                 return h.astype(np.float32) \
-                    if np.issubdtype(h.dtype, np.floating) else h
+                    if jnp.issubdtype(h.dtype, jnp.floating) else h
             fp32 = jax.tree_util.tree_map(_host_fp32, params)
             grad_sh = self.plan._to_sharding(self.plan.grad_specs(fp32))
             with self.mesh:
@@ -374,7 +374,8 @@ class DeepSpeedEngine:
         else:
             host_params = jax.tree_util.tree_map(
                 lambda x: (np.asarray(x, np.float32)
-                           if np.issubdtype(np.asarray(x).dtype, np.floating)
+                           if jnp.issubdtype(np.asarray(x).dtype,
+                                             jnp.floating)
                            else np.asarray(x)), params)
             self._offload = HostOffloadOptimizer(
                 host_params, cfg.zero_config, opt_name=opt_name,
@@ -577,7 +578,7 @@ class DeepSpeedEngine:
                 new_params = jax.tree_util.tree_map(
                     lambda x: jnp.asarray(
                         x.astype(self.compute_dtype)
-                        if np.issubdtype(x.dtype, np.floating) else x),
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x),
                     self._offload.params_tree())
                 with self.mesh:
                     new_params = device_put_global(new_params,
@@ -993,7 +994,7 @@ class DeepSpeedEngine:
                             jax.tree_util.tree_map(
                                 lambda x: jnp.asarray(
                                     x.astype(self.compute_dtype)
-                                    if np.issubdtype(x.dtype, np.floating)
+                                    if jnp.issubdtype(x.dtype, jnp.floating)
                                     else x),
                                 self._offload.params_tree()),
                             self._offload_param_sh)

@@ -58,7 +58,9 @@ class FlatLayout:
 
     def __init__(self, tree):
         leaves, self.treedef = jax.tree_util.tree_flatten(tree)
-        self.is_float = [np.issubdtype(np.asarray(x).dtype, np.floating)
+        # jnp.issubdtype: bf16 is an ml_dtypes extension that
+        # np.issubdtype does NOT classify as floating
+        self.is_float = [jnp.issubdtype(np.asarray(x).dtype, jnp.floating)
                          for x in leaves]
         self.static_leaves = {i: np.asarray(x) for i, x in enumerate(leaves)
                               if not self.is_float[i]}
@@ -480,6 +482,14 @@ class HostOffloadOptimizer:
                 **{f"moment{i}": m for i, m in enumerate(moments)}}
 
     def load_state_dict(self, sd: Dict[str, Any]):
+        if sd["master"].shape != self.master.shape:
+            raise ValueError(
+                f"offload master size mismatch: checkpoint has "
+                f"{sd['master'].shape[0]} elements, this optimizer expects "
+                f"{self.master.shape[0]} — the checkpoint was saved with a "
+                "different param partition or an older flat layout (bf16 "
+                "leaves were once excluded); re-save from device state or "
+                "convert via checkpoint/zero_to_fp32")
         self.master[:] = sd["master"]
         self.step_count = int(sd["step"])
         moments = [sd[f"moment{i}"] for i in range(self.n_moments)]
@@ -539,7 +549,7 @@ class PartitionedParamSwapper:
 
     def swap_out(self, key: str, array, release: bool = True):
         arr = np.asarray(array)
-        if np.issubdtype(arr.dtype, np.floating):
+        if jnp.issubdtype(arr.dtype, jnp.floating):
             arr = arr.astype(self.dtype)
         self._meta[key] = (arr.shape, arr.dtype)
         self.handle.sync_pwrite(arr.reshape(-1), self._path(key))

@@ -17,9 +17,11 @@ from deepspeed_tpu.ops.pallas.decode_attention import (
 
 
 def _cache_inputs(B=3, S=64, H=4, Hkv=2, D=16, seed=0):
+    """Cache-layout [B, Hkv, S, D] arrays (+ model-layout views for
+    prefill inputs via swapaxes at the call sites)."""
     rng = np.random.default_rng(seed)
-    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
     lengths = jnp.asarray([5, 33, S], jnp.int32)[:B]
     return k, v, lengths, rng
 
@@ -47,8 +49,8 @@ def test_decode_dispatch_pallas_impl():
     """impl="pallas" through the public API (uniform length, interpret)."""
     B, S, H, Hkv, D = 2, 32, 4, 2, 16
     rng = np.random.default_rng(1)
-    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32)
     q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
     cache = KVCache(k=k, v=v, length=jnp.asarray(20, jnp.int32))
     ref = decode_attention(q, cache, impl="jnp")
@@ -67,7 +69,8 @@ def test_paged_kernel_matches_oracle(T):
     for b in range(B):
         alloc.allocate(b, int(lengths[b]))
     tables = jnp.asarray(alloc.block_table(range(B)))
-    cache, _ = prefill_paged(cache, tables, jnp.zeros((B,), jnp.int32), k, v)
+    cache, _ = prefill_paged(cache, tables, jnp.zeros((B,), jnp.int32),
+                             jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2))
     q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
 
     oracle = paged_decode_attention(q, cache, tables, lengths, impl="jnp")
