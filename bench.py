@@ -206,8 +206,12 @@ def _worker_params_probe(spec):
 
 def _run_worker(name, spec=None, timeout=600, cpu=False):
     # never let one worker spend past the global budget (the driver kills
-    # the whole run at its own deadline — a partial result beats rc=124)
-    timeout = max(30, min(timeout, _remaining() - 20))
+    # the whole run at its own deadline — a partial result beats rc=124);
+    # with the budget exhausted, don't launch at all: the max(...) floor
+    # would otherwise keep granting 30s slices past the deadline
+    if _remaining() < 45:
+        return None, "budget exhausted"
+    timeout = max(30, min(timeout, _remaining() - 15))
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", name]
     cmd.append(json.dumps(spec) if spec is not None else "null")
     if cpu:

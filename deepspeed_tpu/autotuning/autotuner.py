@@ -14,6 +14,7 @@ trial builds a real engine and measures steady-state samples/sec over
 """
 
 import itertools
+import json
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -108,35 +109,69 @@ class Autotuner:
 
         def run(exp: Experiment) -> Dict[str, Any]:
             import deepspeed_tpu
+            from deepspeed_tpu.autotuning.trial_worker import timed_trial
             from deepspeed_tpu.parallel import groups
             groups.reset_mesh()
             engine, *_ = deepspeed_tpu.initialize(
                 model=model,
                 model_parameters=jax.tree_util.tree_map(np.asarray, params),
                 config=exp.ds_config)
-            micro = exp.ds_config["train_micro_batch_size_per_gpu"]
-            batch = make_batch(engine.train_batch_size())
-            for _ in range(at.start_profile_step):   # warmup + compile
-                engine.train_batch(batch=batch)
-            steps = max(1, at.end_profile_step - at.start_profile_step)
-            t0 = time.time()
-            for _ in range(steps):
-                loss = engine.train_batch(batch=batch)
-            jax.block_until_ready(loss)
-            dt = time.time() - t0
-            samples = engine.train_batch_size() * steps
-            return {"throughput": samples / dt,
-                    "latency": dt / steps,
-                    "micro_batch": micro,
-                    "zero_stage": engine.zero_stage}
+            return timed_trial(
+                engine, lambda: make_batch(engine.train_batch_size()),
+                at.start_profile_step, at.end_profile_step)
+        return run
+
+    def _subprocess_runner(self, model_spec: Dict[str, Any], seq: int,
+                           timeout: float = 900.0,
+                           cpu: bool = False) -> Callable[[Experiment], Dict]:
+        """Each experiment as its OWN OS process (reference
+        ``autotuning/scheduler.py`` ``ResourceManager.run_job``: trials are
+        separate jobs, so one trial's OOM / allocator state / XLA live
+        buffers cannot distort the next trial's measurement)."""
+        import subprocess
+        import sys
+
+        at = self.at_config
+
+        def run(exp: Experiment) -> Dict[str, Any]:
+            spec = {"model": model_spec, "ds_config": exp.ds_config,
+                    "seq": seq, "cpu": cpu,
+                    "start_profile_step": at.start_profile_step,
+                    "end_profile_step": at.end_profile_step}
+            out = subprocess.run(
+                [sys.executable, "-m",
+                 "deepspeed_tpu.autotuning.trial_worker", json.dumps(spec)],
+                capture_output=True, text=True, timeout=timeout)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"trial {exp.name} failed (rc={out.returncode}): "
+                    f"{(out.stderr or '')[-800:]}")
+            for line in reversed(out.stdout.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(parsed, dict):   # stray scalar prints are
+                    return parsed              # not trial results
+            raise RuntimeError(f"trial {exp.name}: no JSON in worker output")
         return run
 
     def tune(self, model=None, params=None,
              make_batch: Optional[Callable[[int], Any]] = None,
-             run_fn: Optional[Callable[[Experiment], Dict]] = None
-             ) -> Dict[str, Any]:
-        """Run the search; returns the best ds_config."""
-        from deepspeed_tpu.parallel import groups
+             run_fn: Optional[Callable[[Experiment], Dict]] = None,
+             model_spec: Optional[Dict[str, Any]] = None,
+             seq: int = 256, trial_timeout: float = 900.0,
+             trial_cpu: bool = False) -> Dict[str, Any]:
+        """Run the search; returns the best ds_config.
+
+        Three trial modes, most isolated first:
+        * ``model_spec=`` — each trial in a fresh OS process (the
+          reference's separate-job semantics; required for trustworthy
+          OOM boundaries);
+        * ``model=/params=/make_batch=`` — in-process trials (arbitrary
+          non-serialisable models; measurements share one XLA heap);
+        * ``run_fn=`` — caller-supplied runner.
+        """
         dp = max(1, jax.device_count())
         space = self.tuning_space(dp)
         exps = [Experiment(
@@ -145,10 +180,14 @@ class Autotuner:
         logger.info(f"autotuning: {len(exps)} experiments "
                     f"(stages×micro-batches), metric={self.at_config.metric}")
         self.rm.schedule_experiments(exps)
+        if run_fn is None and model_spec is not None:
+            run_fn = self._subprocess_runner(model_spec, seq,
+                                             timeout=trial_timeout,
+                                             cpu=trial_cpu)
         if run_fn is None:
             assert model is not None and params is not None and \
                 make_batch is not None, \
-                "tune() needs model/params/make_batch or a custom run_fn"
+                "tune() needs model_spec, model/params/make_batch, or run_fn"
             run_fn = self._default_runner(make_batch, model, params)
         self.rm.run(run_fn)
         best = self.rm.best_experiment()

@@ -1,0 +1,92 @@
+"""Autotuning trial worker — one experiment in a fresh OS process.
+
+Parity: reference ``autotuning/scheduler.py`` launches each experiment as
+a separate DeepSpeed job so OOMs and allocator state can't leak between
+trials (``ResourceManager.run_job``).  This worker is that job: it builds
+the model from a serialisable spec, runs the timed trial, and prints ONE
+JSON line for the parent's journal.
+
+Usage (internal): python -m deepspeed_tpu.autotuning.trial_worker '<json>'
+
+Spec format::
+
+    {"model": {"kind": "causal_lm", "config": {...TransformerConfig}},
+     "ds_config": {...}, "seq": 256, "seed": 0,
+     "start_profile_step": 2, "end_profile_step": 5, "cpu": false}
+"""
+
+import json
+import sys
+import time
+
+
+def build_model(model_spec):
+    kind = model_spec.get("kind", "causal_lm")
+    if kind == "causal_lm":
+        from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                      TransformerConfig)
+        cfg = TransformerConfig(**model_spec["config"])
+        return CausalTransformerLM(cfg), cfg
+    if kind == "bert":
+        from deepspeed_tpu.models.bert import BertConfig, BertEncoder
+        cfg = BertConfig(**model_spec["config"])
+        return BertEncoder(cfg), cfg
+    raise ValueError(f"unknown model kind {kind!r}")
+
+
+def timed_trial(engine, make_batch, start_profile_step, end_profile_step):
+    """The measurement protocol shared by the in-process and subprocess
+    runners: ``start`` warmup steps (compile), then ``end - start`` timed
+    steps of samples/sec over fresh batches."""
+    import jax
+
+    for _ in range(start_profile_step):        # warmup + compile
+        engine.train_batch(batch=make_batch())
+    steps = max(1, end_profile_step - start_profile_step)
+    t0 = time.time()
+    loss = None
+    for _ in range(steps):
+        loss = engine.train_batch(batch=make_batch())
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+    return {
+        "throughput": engine.train_batch_size() * steps / dt,
+        "latency": dt / steps,
+        "micro_batch": engine.train_micro_batch_size_per_gpu(),
+        "zero_stage": engine.zero_stage,
+        "loss": float(loss),
+    }
+
+
+def run_trial(spec):
+    import jax
+    if spec.get("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu
+
+    model, cfg = build_model(spec["model"])
+    params = model.init(jax.random.key(spec.get("seed", 0)))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=spec["ds_config"])
+
+    rng = np.random.default_rng(spec.get("seed", 0))
+    seq = spec.get("seq", 256)
+
+    def make_batch():
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, (engine.train_batch_size(), seq))}
+
+    return timed_trial(engine, make_batch,
+                       spec.get("start_profile_step", 2),
+                       spec.get("end_profile_step", 5))
+
+
+def main():
+    spec = json.loads(sys.argv[1])
+    print(json.dumps(run_trial(spec)))
+
+
+if __name__ == "__main__":
+    main()

@@ -139,3 +139,55 @@ def test_end_to_end_tune_real_engine(tmp_path):
     # every experiment journaled a real throughput
     files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
     assert len(files) == 4
+
+
+def test_subprocess_trials_isolated(tmp_path):
+    """model_spec mode: every trial runs in its own OS process (reference
+    separate-job semantics), results journal to disk, a crashing config
+    is scored as an error and never wins."""
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "results_dir": str(tmp_path),
+                       "start_profile_step": 1, "end_profile_step": 2,
+                       "num_tuning_micro_batch_sizes": 2,
+                       "min_train_micro_batch_size_per_gpu": 2},
+    }
+    at = Autotuner(cfg)
+    at.feasible_stages = lambda dp: [0, 3]
+    model_spec = {"kind": "causal_lm",
+                  "config": dict(vocab_size=64, hidden_size=32, n_layers=1,
+                                 n_heads=2, max_seq_len=64, remat=False)}
+    best = at.tune(model_spec=model_spec, seq=32, trial_cpu=True,
+                   trial_timeout=300)
+    assert best["zero_optimization"]["stage"] in (0, 3)
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert len(files) == 4
+    for f in files:
+        with open(tmp_path / f) as fh:
+            rec = json.load(fh)
+        assert "error" in rec or rec["throughput"] > 0
+
+
+def test_subprocess_trial_crash_scored_as_error(tmp_path):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "results_dir": str(tmp_path),
+                       "start_profile_step": 1, "end_profile_step": 2,
+                       "num_tuning_micro_batch_sizes": 1},
+    }
+    at = Autotuner(cfg)
+    at.feasible_stages = lambda dp: [0]
+    # invalid model config -> the worker process dies; the scheduler must
+    # journal the failure rather than crash the tuner
+    bad_spec = {"kind": "causal_lm",
+                "config": dict(vocab_size=64, hidden_size=32, n_layers=1,
+                               n_heads=0, max_seq_len=64, remat=False)}
+    with pytest.raises(AssertionError, match="no experiment finished"):
+        at.tune(model_spec=bad_spec, seq=32, trial_cpu=True,
+                trial_timeout=300)
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert files, "failed trial was not journaled"
+    with open(tmp_path / files[0]) as fh:
+        assert "error" in json.load(fh)
