@@ -172,7 +172,22 @@ class Autotuner:
           non-serialisable models; measurements share one XLA heap);
         * ``run_fn=`` — caller-supplied runner.
         """
-        dp = max(1, jax.device_count())
+        if model_spec is not None and not trial_cpu:
+            # do NOT initialise the TPU backend in the parent: libtpu is
+            # exclusive per process, and a parent holding the device would
+            # starve every trial subprocess.  Probe the count out of line.
+            import subprocess
+            import sys
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-c",
+                     "import jax; print(jax.device_count())"],
+                    capture_output=True, text=True, timeout=180)
+                dp = max(1, int(out.stdout.strip().splitlines()[-1]))
+            except Exception:
+                dp = 1
+        else:
+            dp = max(1, jax.device_count())
         space = self.tuning_space(dp)
         exps = [Experiment(
             f"z{c['zero_optimization']['stage']}_"

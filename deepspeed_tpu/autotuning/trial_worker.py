@@ -36,17 +36,20 @@ def build_model(model_spec):
 
 def timed_trial(engine, make_batch, start_profile_step, end_profile_step):
     """The measurement protocol shared by the in-process and subprocess
-    runners: ``start`` warmup steps (compile), then ``end - start`` timed
-    steps of samples/sec over fresh batches."""
+    runners.  ``make_batch`` is called once per step (warmup + timed,
+    DISTINCT batches defeat result-memoising device tunnels) but all
+    batches are generated BEFORE the timed region so host-side data
+    generation never pollutes the throughput measurement."""
     import jax
 
-    for _ in range(start_profile_step):        # warmup + compile
-        engine.train_batch(batch=make_batch())
     steps = max(1, end_profile_step - start_profile_step)
+    batches = [make_batch() for _ in range(start_profile_step + steps)]
+    for b in batches[:start_profile_step]:     # warmup + compile
+        engine.train_batch(batch=b)
     t0 = time.time()
     loss = None
-    for _ in range(steps):
-        loss = engine.train_batch(batch=make_batch())
+    for b in batches[start_profile_step:]:
+        loss = engine.train_batch(batch=b)
     jax.block_until_ready(loss)
     dt = time.time() - t0
     return {

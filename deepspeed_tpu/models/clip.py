@@ -1,0 +1,171 @@
+"""CLIP text encoder — the conditioning tower of Stable Diffusion.
+
+Parity role: reference ``module_inject/containers/clip.py``
+(``HFCLIPLayerPolicy``: injects the fused inference transformer into the
+CLIP text encoder of a diffusers pipeline).  TPU design: the encoder is a
+small functional pre-LN causal transformer (CLIP text attention IS causal)
+built from the shared ``_norm``/``reference_attention`` primitives; one
+jit compiles the whole tower, which is the fusion the reference gets from
+its CUDA container.
+
+Quick-GELU (``x * sigmoid(1.702 x)``) is the OpenAI CLIP activation.
+"""
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import _norm
+from deepspeed_tpu.ops.attention import reference_attention
+
+
+def quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTS = {"quick_gelu": quick_gelu, "gelu": jax.nn.gelu}
+
+
+@dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 512
+    n_layers: int = 12
+    n_heads: int = 8
+    ffn_hidden_size: Optional[int] = None
+    max_seq_len: int = 77
+    norm_eps: float = 1e-5
+    activation: str = "quick_gelu"
+    eos_token_id: int = 2
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_heads
+
+    @property
+    def ffn_dim(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @staticmethod
+    def tiny(**kw):
+        base = CLIPTextConfig(vocab_size=96, hidden_size=32, n_layers=2,
+                              n_heads=4, max_seq_len=32)
+        return replace(base, **kw)
+
+
+class CLIPTextEncoder:
+    """Functional CLIP text tower: ``init`` → params; ``apply`` →
+    (last_hidden_state, pooled) where pooled is the EOS-position hidden
+    (what Stable Diffusion conditions on)."""
+
+    def __init__(self, config: CLIPTextConfig):
+        self.config = config
+
+    # ------------------------------------------------------------------
+    def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
+        c = self.config
+        d, f, L = c.hidden_size, c.ffn_dim, c.n_layers
+        keys = jax.random.split(rng, 8)
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32) /
+                    math.sqrt(fan_in)).astype(dtype)
+
+        layers = {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "attn_norm_b": jnp.zeros((L, d), dtype),
+            "wq": dense(keys[0], (L, d, d), d),
+            "wk": dense(keys[1], (L, d, d), d),
+            "wv": dense(keys[2], (L, d, d), d),
+            "wo": dense(keys[3], (L, d, d), d),
+            "wq_b": jnp.zeros((L, d), dtype),
+            "wk_b": jnp.zeros((L, d), dtype),
+            "wv_b": jnp.zeros((L, d), dtype),
+            "wo_b": jnp.zeros((L, d), dtype),
+            "mlp_norm": jnp.ones((L, d), dtype),
+            "mlp_norm_b": jnp.zeros((L, d), dtype),
+            "w_up": dense(keys[4], (L, d, f), d),
+            "w_up_b": jnp.zeros((L, f), dtype),
+            "w_down": dense(keys[5], (L, f, d), f),
+            "w_down_b": jnp.zeros((L, d), dtype),
+        }
+        return {
+            "tok_embed": dense(keys[6], (c.vocab_size, d), d),
+            "pos_embed": dense(keys[7], (c.max_seq_len, d), d),
+            "final_norm": jnp.ones((d,), dtype),
+            "final_norm_b": jnp.zeros((d,), dtype),
+            "layers": layers,
+        }
+
+    # ------------------------------------------------------------------
+    def tp_rules(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import TP_AXIS
+        return [
+            (r"wq_b|wk_b|wv_b|w_up_b", P(None, TP_AXIS)),
+            (r"wo_b|w_down_b|_norm", P()),
+            (r"wq|wk|wv|w_up", P(None, None, TP_AXIS)),
+            (r"wo|w_down", P(None, TP_AXIS, None)),
+        ]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _proj(h, layer, name):
+        return h @ layer[name] + layer[f"{name}_b"].astype(h.dtype)
+
+    def _layer(self, x, layer):
+        c = self.config
+        B, S, d = x.shape
+        H, dh = c.n_heads, c.head_dim
+        h = _norm(x, layer["attn_norm"], c.norm_eps, False,
+                  layer["attn_norm_b"])
+        q = self._proj(h, layer, "wq").reshape(B, S, H, dh)
+        k = self._proj(h, layer, "wk").reshape(B, S, H, dh)
+        v = self._proj(h, layer, "wv").reshape(B, S, H, dh)
+        attn = reference_attention(q, k, v, causal=True)
+        x = x + self._proj(attn.reshape(B, S, d), layer, "wo")
+        h = _norm(x, layer["mlp_norm"], c.norm_eps, False,
+                  layer["mlp_norm_b"])
+        act = _ACTS[c.activation]
+        return x + self._proj(act(self._proj(h, layer, "w_up")),
+                              layer, "w_down")
+
+    def apply(self, params, input_ids, train=True, rng=None):
+        c = self.config
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        x = params["tok_embed"][input_ids] + \
+            params["pos_embed"][positions].astype(params["tok_embed"].dtype)
+
+        def body(x, layer):
+            return self._layer(x, layer), None
+        body_fn = jax.checkpoint(body) if c.remat else body
+        x, _ = jax.lax.scan(body_fn, x, params["layers"])
+
+        x = _norm(x, params["final_norm"], c.norm_eps, False,
+                  params["final_norm_b"])
+        # pooled = EOT-position hidden.  HF quirk kept for parity: with the
+        # legacy eos_token_id==2 configs (OpenAI CLIP), the position is
+        # argmax(input_ids) — the EOT token is the highest vocab id — not
+        # the first eos match.
+        if c.eos_token_id == 2:
+            eos_pos = jnp.argmax(input_ids, axis=1)
+        else:
+            is_eos = (input_ids == c.eos_token_id).astype(jnp.int32)
+            has_eos = jnp.any(is_eos, axis=1)
+            eos_pos = jnp.where(has_eos, jnp.argmax(is_eos, axis=1), S - 1)
+        pooled = jnp.take_along_axis(
+            x, eos_pos[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return x, pooled
+
+    __call__ = apply
+
+    # encoder-model contract used by the inference engine's plain path
+    def loss(self, params, batch, rng=None):
+        hidden, _ = self.apply(params, batch["input_ids"], rng=rng)
+        return jnp.mean(jnp.square(hidden))

@@ -580,9 +580,157 @@ class DistilBertPolicy(InjectionPolicy):
         return cfg, params
 
 
+class CLIPPolicy(InjectionPolicy):
+    """HF ``CLIPTextModel`` (reference ``containers/clip.py``
+    ``HFCLIPLayerPolicy`` — the Stable Diffusion text tower).  Pre-LN
+    causal encoder with quick-GELU; maps onto ``CLIPTextEncoder``."""
+
+    model_types = ("clip_text_model", "clip")
+
+    @classmethod
+    def model_cls(cls):
+        from deepspeed_tpu.models.clip import CLIPTextEncoder
+        return CLIPTextEncoder
+
+    @classmethod
+    def build(cls, hf, sd):
+        from deepspeed_tpu.models.clip import CLIPTextConfig
+        if getattr(hf, "text_config", None) is not None:  # full CLIPConfig
+            hf = hf.text_config
+        d, L = hf.hidden_size, hf.num_hidden_layers
+        cfg = CLIPTextConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L,
+            n_heads=hf.num_attention_heads,
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            norm_eps=hf.layer_norm_eps,
+            activation=("quick_gelu" if hf.hidden_act == "quick_gelu"
+                        else "gelu"),
+            eos_token_id=getattr(hf, "eos_token_id", 2))
+
+        pre = "text_model.encoder.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "layer_norm1.weight", L),
+            "attn_norm_b": _stack(sd, pre + "layer_norm1.bias", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.out_proj.weight", L,
+                         transpose=True),
+            "wq_b": _stack(sd, pre + "self_attn.q_proj.bias", L),
+            "wk_b": _stack(sd, pre + "self_attn.k_proj.bias", L),
+            "wv_b": _stack(sd, pre + "self_attn.v_proj.bias", L),
+            "wo_b": _stack(sd, pre + "self_attn.out_proj.bias", L),
+            "mlp_norm": _stack(sd, pre + "layer_norm2.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "layer_norm2.bias", L),
+            "w_up": _stack(sd, pre + "mlp.fc1.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.fc1.bias", L),
+            "w_down": _stack(sd, pre + "mlp.fc2.weight", L, transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.fc2.bias", L),
+        }
+        params = {
+            "tok_embed": _np(
+                sd["text_model.embeddings.token_embedding.weight"]),
+            "pos_embed": _np(
+                sd["text_model.embeddings.position_embedding.weight"]),
+            "final_norm": _np(sd["text_model.final_layer_norm.weight"]),
+            "final_norm_b": _np(sd["text_model.final_layer_norm.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class MegatronGPTPolicy(InjectionPolicy):
+    """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py``
+    ``MegatronLayerPolicy``, whose ``version`` field selects the same two
+    QKV fusions; the MoE variant in ``megatron_gpt_moe.py``).
+
+    QKV layouts by ``checkpoint_version`` (hf config attr, default 2):
+    * >= 2: per-head ``[H, 3, dh]`` interleave (modern Megatron raw
+      layout — what HF's convert_megatron_gpt2_checkpoint.py un-scrambles)
+    * < 2 (v0/v1): ``[3, H*dh]`` row groups (all Q rows, then K, then V)
+
+    Learned positions, GELU, pre-LN, tied embeddings."""
+
+    model_types = ("megatron-lm", "megatron_gpt", "megatron")
+
+    @classmethod
+    def build(cls, hf, sd):
+        d = getattr(hf, "hidden_size")
+        L = getattr(hf, "num_layers", None) or hf.num_hidden_layers
+        H = getattr(hf, "num_attention_heads")
+        megatron_v2 = float(getattr(hf, "checkpoint_version", 2.0) or 0) >= 2
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            ffn_hidden_size=getattr(hf, "ffn_hidden_size", None) or 4 * d,
+            max_seq_len=getattr(hf, "max_position_embeddings", 1024),
+            norm_eps=getattr(hf, "layernorm_epsilon", 1e-5),
+            activation="gelu", use_rmsnorm=False, use_rope=False,
+            use_bias=True, norm_bias=True, tie_embeddings=True, remat=False)
+
+        pre = "language_model.transformer.layers.{}."
+        dh = d // H
+        wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
+        for i in range(L):
+            w = _np(sd[pre.format(i) + "attention.query_key_value.weight"])
+            b = _np(sd[pre.format(i) + "attention.query_key_value.bias"])
+            if megatron_v2:                  # [H, 3, dh, d] per-head
+                w = w.reshape(H, 3, dh, d)
+                b = b.reshape(H, 3, dh)
+                wq.append(w[:, 0].reshape(H * dh, d).T)
+                wk.append(w[:, 1].reshape(H * dh, d).T)
+                wv.append(w[:, 2].reshape(H * dh, d).T)
+                bq.append(b[:, 0].reshape(-1))
+                bk.append(b[:, 1].reshape(-1))
+                bv.append(b[:, 2].reshape(-1))
+            else:                            # [3, H*dh, d] row groups
+                w = w.reshape(3, d, d)
+                b = b.reshape(3, d)
+                wq.append(w[0].T)
+                wk.append(w[1].T)
+                wv.append(w[2].T)
+                bq.append(b[0])
+                bk.append(b[1])
+                bv.append(b[2])
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "input_layernorm.bias", L),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wq_b": np.stack(bq), "wk_b": np.stack(bk), "wv_b": np.stack(bv),
+            "wo": _stack(sd, pre + "attention.dense.weight", L,
+                         transpose=True),
+            "wo_b": _stack(sd, pre + "attention.dense.bias", L),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight",
+                               L),
+            "mlp_norm_b": _stack(sd, pre + "post_attention_layernorm.bias",
+                                 L),
+            "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.dense_h_to_4h.bias", L),
+            "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.dense_4h_to_h.bias", L),
+        }
+        emb = "language_model.embedding."
+        params = {
+            "tok_embed": _np(sd[emb + "word_embeddings.weight"]),
+            "pos_embed": _np(sd[emb + "position_embeddings.weight"]),
+            "final_norm": _np(
+                sd["language_model.transformer.final_layernorm.weight"]),
+            "final_norm_b": _np(
+                sd["language_model.transformer.final_layernorm.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
 REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTNeoXPolicy, BertPolicy, BloomPolicy,
-                                GPTJPolicy, GPTNeoPolicy, DistilBertPolicy]
+                                GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
+                                CLIPPolicy, MegatronGPTPolicy]
 
 
 def find_policy(hf_config) -> Optional[type]:

@@ -1,0 +1,144 @@
+"""Diffusion model family tests (UNet2D, VAEDecoder — reference
+``module_inject/containers/unet.py``/``vae.py`` role).
+
+The primitives are oracle-tested against torch (conv2d, group_norm); the
+towers are tested for shape, jit-compilability, conditioning sensitivity,
+and skip-connection correctness.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.diffusion import (UNet2D, UNetConfig, VAEDecoder,
+                                            VAEDecoderConfig, attn_block,
+                                            conv2d, group_norm,
+                                            init_attn_block,
+                                            init_resnet_block, resnet_block,
+                                            timestep_embedding)
+
+torch = pytest.importorskip("torch")
+
+
+def test_conv2d_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)       # NHWC
+    w = rng.normal(size=(3, 3, 3, 5)).astype(np.float32)       # HWIO
+    b = rng.normal(size=(5,)).astype(np.float32)
+    ours = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b)))
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x).permute(0, 3, 1, 2),
+        torch.tensor(w).permute(3, 2, 0, 1),
+        torch.tensor(b), padding=1).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+    # strided
+    ours = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                             stride=2))
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x).permute(0, 3, 1, 2),
+        torch.tensor(w).permute(3, 2, 0, 1),
+        torch.tensor(b), stride=2, padding=1).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_group_norm_matches_torch():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(2, 6, 6, 8)).astype(np.float32)
+    gamma = rng.normal(size=(8,)).astype(np.float32)
+    beta = rng.normal(size=(8,)).astype(np.float32)
+    ours = np.asarray(group_norm(jnp.asarray(x), jnp.asarray(gamma),
+                                 jnp.asarray(beta), groups=4))
+    ref = torch.nn.functional.group_norm(
+        torch.tensor(x).permute(0, 3, 1, 2), 4,
+        torch.tensor(gamma), torch.tensor(beta),
+        eps=1e-6).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_timestep_embedding_properties():
+    emb = timestep_embedding(jnp.asarray([0, 10, 500]), 32)
+    assert emb.shape == (3, 32)
+    # t=0 -> cos part all ones, sin part all zeros
+    np.testing.assert_allclose(np.asarray(emb[0, :16]), np.ones(16),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(emb[0, 16:]), np.zeros(16),
+                               atol=1e-6)
+    # distinct timesteps embed differently
+    assert not np.allclose(np.asarray(emb[1]), np.asarray(emb[2]))
+
+
+def test_resnet_block_identity_at_zero_weights():
+    """With conv2 zeroed the block must reduce to the skip path."""
+    p = init_resnet_block(jax.random.key(0), 8, 8, temb_dim=0)
+    p = dict(p, conv2=jnp.zeros_like(p["conv2"]),
+             conv2_b=jnp.zeros_like(p["conv2_b"]))
+    x = jax.random.normal(jax.random.key(1), (1, 6, 6, 8))
+    out = resnet_block(p, x, None, groups=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_attn_block_residual_and_permutation_equivariance():
+    """Spatial attention treats the H*W grid as a token set: permuting
+    pixels then attending == attending then permuting."""
+    p = init_attn_block(jax.random.key(0), 8)
+    x = jax.random.normal(jax.random.key(1), (1, 4, 4, 8))
+    out = attn_block(p, x, n_heads=2, groups=4)
+    assert out.shape == x.shape
+    seq = x.reshape(1, 16, 8)
+    perm = jax.random.permutation(jax.random.key(2), 16)
+    x_p = seq[:, perm].reshape(1, 4, 4, 8)
+    out_p = attn_block(p, x_p, n_heads=2, groups=4)
+    np.testing.assert_allclose(
+        np.asarray(out_p.reshape(1, 16, 8)),
+        np.asarray(out.reshape(1, 16, 8)[:, perm]), rtol=2e-4, atol=2e-5)
+
+
+def test_unet_shapes_and_conditioning():
+    cfg = UNetConfig.tiny()
+    model = UNet2D(cfg)
+    params = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 8, 8, 3))
+    f = jax.jit(model.apply)
+    out = f(params, x, jnp.asarray([0, 100]))
+    assert out.shape == (2, 8, 8, 3)
+    assert np.all(np.isfinite(np.asarray(out)))
+    # timestep conditioning must change the prediction
+    out2 = f(params, x, jnp.asarray([500, 900]))
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_unet_trains():
+    """One denoising step: predict noise, MSE falls under Adam."""
+    import optax
+    cfg = UNetConfig.tiny()
+    model = UNet2D(cfg)
+    params = model.init(jax.random.key(0))
+    x0 = jax.random.normal(jax.random.key(1), (4, 8, 8, 3))
+    noise = jax.random.normal(jax.random.key(2), (4, 8, 8, 3))
+    t = jnp.asarray([10, 200, 500, 900])
+    xt = 0.7 * x0 + 0.7 * noise
+
+    def loss_fn(p):
+        return jnp.mean((model.apply(p, xt, t) - noise) ** 2)
+
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    step = jax.jit(lambda p, o: (lambda g: tx.update(g, o, p))(
+        jax.grad(loss_fn)(p)))
+    l0 = float(loss_fn(params))
+    for _ in range(10):
+        updates, opt = step(params, opt)
+        params = optax.apply_updates(params, updates)
+    assert float(loss_fn(params)) < l0
+
+
+def test_vae_decoder_shapes():
+    cfg = VAEDecoderConfig.tiny()
+    dec = VAEDecoder(cfg)
+    params = dec.init(jax.random.key(0))
+    z = jax.random.normal(jax.random.key(1), (2, 4, 4, 4))
+    out = jax.jit(dec.apply)(params, z)
+    # one upsample level: 4x4 latents -> 8x8 RGB
+    assert out.shape == (2, 8, 8, 3)
+    assert np.all(np.isfinite(np.asarray(out)))

@@ -231,3 +231,106 @@ def test_converted_model_tp_inference():
         model=model, params=params, dtype="fp32", tensor_parallel={"tp_size": 2})
     logits, _ = engine.forward(ids)
     np.testing.assert_allclose(np.asarray(logits[:, :S]), ref, atol=2e-3)
+
+
+def test_clip_text_conversion_matches_hf():
+    """CLIP text tower (reference containers/clip.py): last_hidden_state
+    AND the EOS-pooled output must match HF."""
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=32, eos_token_id=2)
+    torch.manual_seed(0)
+    hf = transformers.CLIPTextModel(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 96, (2, 16))
+    ids[0, 9] = 2   # EOS mid-sequence; row 1 has no EOS
+    hf.eval()
+    with torch.no_grad():
+        out = hf(torch.tensor(ids))
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    hidden, pooled = model.apply(params, jnp.asarray(ids))
+    assert np.max(np.abs(np.asarray(hidden) -
+                         out.last_hidden_state.numpy())) < 2e-3
+    assert np.max(np.abs(np.asarray(pooled[0]) -
+                         out.pooler_output[0].numpy())) < 2e-3
+
+
+@pytest.mark.parametrize("ckpt_version", [0.0, 2.0])
+def test_megatron_conversion_matches_gpt2_oracle(ckpt_version):
+    """Megatron-GPT QKV fusions (reference containers/megatron_gpt.py
+    version switch): repackage a converted HF GPT-2 into each Megatron
+    layout — v0 [3, H*dh] row groups, v2 per-head [H, 3, dh] — convert
+    back through MegatronGPTPolicy, and the logits must be identical;
+    HF GPT-2 is the oracle for the de-fusing."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    ref_model, ref_params = replace_transformer_layer(hf)
+
+    # repackage: our [L, d, ...] stacks -> megatron per-layer keys
+    lp = ref_params["layers"]
+    L, d, H = hf_cfg.n_layer, hf_cfg.n_embd, hf_cfg.n_head
+    dh = d // H
+    sd = {
+        "language_model.embedding.word_embeddings.weight":
+            ref_params["tok_embed"],
+        "language_model.embedding.position_embeddings.weight":
+            ref_params["pos_embed"],
+        "language_model.transformer.final_layernorm.weight":
+            ref_params["final_norm"],
+        "language_model.transformer.final_layernorm.bias":
+            ref_params["final_norm_b"],
+    }
+    for i in range(L):
+        pre = f"language_model.transformer.layers.{i}."
+        if ckpt_version >= 2:
+            # per-head interleave: [H, 3, dh, d]
+            qkv_w = np.stack(
+                [lp["wq"][i].T.reshape(H, dh, d),
+                 lp["wk"][i].T.reshape(H, dh, d),
+                 lp["wv"][i].T.reshape(H, dh, d)],
+                axis=1).reshape(3 * d, d)
+            qkv_b = np.stack(
+                [lp["wq_b"][i].reshape(H, dh),
+                 lp["wk_b"][i].reshape(H, dh),
+                 lp["wv_b"][i].reshape(H, dh)],
+                axis=1).reshape(3 * d)
+        else:
+            qkv_w = np.stack([lp["wq"][i].T, lp["wk"][i].T,
+                              lp["wv"][i].T]).reshape(3 * d, d)
+            qkv_b = np.stack([lp["wq_b"][i], lp["wk_b"][i],
+                              lp["wv_b"][i]]).reshape(3 * d)
+        sd[pre + "attention.query_key_value.weight"] = qkv_w
+        sd[pre + "attention.query_key_value.bias"] = qkv_b
+        sd[pre + "attention.dense.weight"] = lp["wo"][i].T
+        sd[pre + "attention.dense.bias"] = lp["wo_b"][i]
+        sd[pre + "input_layernorm.weight"] = lp["attn_norm"][i]
+        sd[pre + "input_layernorm.bias"] = lp["attn_norm_b"][i]
+        sd[pre + "post_attention_layernorm.weight"] = lp["mlp_norm"][i]
+        sd[pre + "post_attention_layernorm.bias"] = lp["mlp_norm_b"][i]
+        sd[pre + "mlp.dense_h_to_4h.weight"] = lp["w_up"][i].T
+        sd[pre + "mlp.dense_h_to_4h.bias"] = lp["w_up_b"][i]
+        sd[pre + "mlp.dense_4h_to_h.weight"] = lp["w_down"][i].T
+        sd[pre + "mlp.dense_4h_to_h.bias"] = lp["w_down_b"][i]
+
+    class MegatronCfg:
+        model_type = "megatron-lm"
+        vocab_size = 96
+        hidden_size = d
+        num_layers = L
+        num_attention_heads = 4
+        ffn_hidden_size = 4 * d
+        max_position_embeddings = 64
+        checkpoint_version = ckpt_version
+
+    model, params = replace_transformer_layer(sd, hf_config=MegatronCfg())
+    ids = _ids(96)
+    got = _ours_logits(model, params, ids)
+    ref = _ours_logits(ref_model, ref_params, ids)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    # and transitively matches the HF torch oracle
+    _assert_close(got, _hf_logits(hf, ids))
