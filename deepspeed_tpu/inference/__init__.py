@@ -1,0 +1,7 @@
+"""Inference stack: engine, config, continuous-batching serving."""
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.serving import ServingEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "ServingEngine"]

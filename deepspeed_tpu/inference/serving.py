@@ -1,0 +1,216 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Parity role: the reference serves decode through a per-request contiguous
+KV workspace inside ``InferenceEngine`` (``inference_context.h`` workspace
+management) — every request pays max-length allocation and batches must
+line up.  The TPU-native upgrade is vLLM-style serving (PAPERS.md ragged
+paged attention): fixed-size pages shared across sequences through block
+tables, slot-based continuous batching (a finished request's pages free
+immediately and the next prompt is admitted mid-flight), and one jitted
+decode step for the whole active batch regardless of ragged lengths.
+
+Host/device split: page allocation, admission, sampling bookkeeping are
+host control flow (``PagedAllocator``); prefill and the batched decode
+step are jitted device programs over ``CausalTransformerLM.
+apply_with_paged_cache``.  Prefill lengths are bucketed to powers of two
+to bound recompilation.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.paged_attention import PagedAllocator
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class _Request:
+    req_id: Any
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    out: List[int] = field(default_factory=list)
+    last_token: Optional[int] = None
+
+
+class ServingEngine:
+    """``add_request`` → ``step`` until ``finished`` — or just
+    ``generate(prompts, max_new_tokens)``.
+
+    One decode ``step()`` advances EVERY active slot by one token; slots
+    free and refill from the queue as requests finish (continuous
+    batching).  Inactive slots point at the reserved scratch page and
+    their outputs are ignored.
+    """
+
+    def __init__(self, model, params, max_batch: int = 8,
+                 page_size: int = 128, num_pages: Optional[int] = None,
+                 max_seq: int = 2048, dtype=jnp.bfloat16,
+                 eos_token_id: Optional[int] = None):
+        self.model = model
+        self.config = model.config
+        self.params = params
+        self.max_batch = max_batch
+        self.page_size = page_size
+        self.max_pages_per_seq = -(-max_seq // page_size)
+        if num_pages is None:
+            num_pages = max_batch * self.max_pages_per_seq + 1
+        self.caches = model.init_paged_caches(num_pages, page_size,
+                                              dtype=dtype)
+        self.alloc = PagedAllocator(num_pages, page_size,
+                                    self.max_pages_per_seq,
+                                    reserve_scratch=True)
+        self.eos = eos_token_id
+        self.max_seq = max_seq
+
+        self.slots: List[Optional[_Request]] = [None] * max_batch
+        self.queue: List[_Request] = []
+        self.finished: Dict[Any, List[int]] = {}
+        self.lengths = np.zeros(max_batch, np.int32)
+        self.tables = np.zeros((max_batch, self.max_pages_per_seq), np.int32)
+        self._prefill_jit: Dict[int, Any] = {}
+        self._decode_jit = None
+        self._rng = {}
+
+    # -- host control flow ---------------------------------------------
+    def add_request(self, req_id, prompt_ids, max_new_tokens: int = 32,
+                    temperature: float = 0.0, seed: int = 0):
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        assert len(prompt) + max_new_tokens <= self.max_seq, \
+            f"request {req_id} exceeds max_seq {self.max_seq}"
+        self.queue.append(_Request(req_id, prompt, max_new_tokens,
+                                   temperature, seed))
+        self._admit()
+
+    def _bucket(self, n: int) -> int:
+        return 1 << max(3, math.ceil(math.log2(max(n, 1))))
+
+    def _admit(self):
+        for slot in range(self.max_batch):
+            if not self.queue or self.slots[slot] is not None:
+                continue
+            req = self.queue[0]
+            total = len(req.prompt) + req.max_new_tokens
+            bucket = min(self._bucket(len(req.prompt)), self.max_seq)
+            need_pages = -(-max(total, bucket) // self.page_size)
+            if not self.alloc.can_allocate(need_pages):
+                return          # head-of-line: keep FIFO order
+            self.queue.pop(0)
+            # full reservation (prompt + budget) at admission: an admitted
+            # request can NEVER deadlock on pages mid-flight (no vLLM-style
+            # preemption/recompute machinery needed); only bucket-padding
+            # surplus is returned after prefill
+            pages = self.alloc.allocate(req.req_id, max(total, bucket))
+            self.tables[slot, :] = 0
+            self.tables[slot, :len(pages)] = pages
+            self.lengths[slot] = 0
+            self.slots[slot] = req
+            self._prefill(slot, req, bucket)
+            if bucket > total:
+                self.alloc.shrink(req.req_id, total)
+                pages = self.alloc.seq_pages[req.req_id]
+                self.tables[slot, :] = 0
+                self.tables[slot, :len(pages)] = pages
+
+    def _prefill(self, slot: int, req: _Request, bucket: int):
+        T = bucket
+        ids = np.zeros((1, T), np.int32)
+        ids[0, :len(req.prompt)] = req.prompt
+        fn = self._prefill_jit.get(T)
+        if fn is None:
+            fn = jax.jit(self.model.apply_with_paged_cache,
+                         donate_argnums=(2,))
+            self._prefill_jit[T] = fn
+        logits, self.caches, _ = fn(
+            self.params, jnp.asarray(ids), self.caches,
+            jnp.asarray(self.tables[slot:slot + 1]),
+            jnp.zeros((1,), jnp.int32))
+        self.lengths[slot] = len(req.prompt)
+        req.last_token = self._sample(
+            req, np.asarray(logits[0, len(req.prompt) - 1]))
+
+    def _sample(self, req: _Request, logits: np.ndarray) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        rng = self._rng.setdefault(req.req_id,
+                                   np.random.default_rng(req.seed))
+        p = logits.astype(np.float64) / req.temperature
+        p = np.exp(p - p.max())
+        return int(rng.choice(len(p), p=p / p.sum()))
+
+    def _finish(self, slot: int):
+        req = self.slots[slot]
+        self.finished[req.req_id] = req.prompt + req.out
+        self.alloc.free_sequence(req.req_id)
+        self._rng.pop(req.req_id, None)
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        self.tables[slot, :] = 0
+        self._admit()
+
+    @property
+    def n_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    # -- the batched decode step ---------------------------------------
+    def step(self) -> Dict[Any, List[int]]:
+        """Advance every active request by one token; returns ONLY the
+        requests that finished during this step (req_id → full tokens)."""
+        self._admit()
+        if self.n_active == 0:
+            return {}
+        last = np.zeros((self.max_batch, 1), np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                last[slot, 0] = req.last_token
+        if self._decode_jit is None:
+            self._decode_jit = jax.jit(self.model.apply_with_paged_cache,
+                                       donate_argnums=(2,))
+        logits, self.caches, _ = self._decode_jit(
+            self.params, jnp.asarray(last), self.caches,
+            jnp.asarray(self.tables), jnp.asarray(self.lengths))
+        logits_np = np.asarray(logits[:, 0])
+
+        # finishing frees slots, which admits (and PREFILLS) queued
+        # requests — defer that until after the loop so a mid-loop
+        # admission is never mistaken for a slot this decode step served
+        done_slots = []
+        done_now = {}
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            # the token we just fed is now part of the sequence
+            req.out.append(req.last_token)
+            self.lengths[slot] += 1
+            ended = (self.eos is not None and req.last_token == self.eos)
+            if ended or len(req.out) >= req.max_new_tokens:
+                done_slots.append(slot)
+            else:
+                req.last_token = self._sample(req, logits_np[slot])
+        for slot in done_slots:
+            rid = self.slots[slot].req_id
+            self._finish(slot)
+            done_now[rid] = self.finished[rid]
+        return done_now
+
+    # -- convenience ----------------------------------------------------
+    def generate(self, prompts, max_new_tokens: int = 32,
+                 temperature: float = 0.0) -> List[List[int]]:
+        """Serve a list of prompts (continuous batching when
+        len(prompts) > max_batch); returns full token lists in order."""
+        for i, p in enumerate(prompts):
+            self.add_request(i, p, max_new_tokens, temperature)
+        steps = 0
+        limit = (max(len(p) for p in prompts) + max_new_tokens + 4) * \
+            (len(prompts) + 1)
+        while (self.queue or self.n_active) and steps < limit:
+            self.step()
+            steps += 1
+        assert not self.queue and self.n_active == 0, "serving stalled"
+        return [self.finished[i] for i in range(len(prompts))]

@@ -696,6 +696,86 @@ class CausalTransformerLM:
         return logits, out_caches
 
     # ------------------------------------------------------------------
+    # paged KV-cache path (continuous-batching serving engine)
+    # ------------------------------------------------------------------
+    def init_paged_caches(self, num_pages, page_size, dtype=jnp.bfloat16):
+        """Stacked per-layer page pools: leaves [L, P, Hkv, page, D] so the
+        forward stays one scan (MoE models are not yet served paged)."""
+        from deepspeed_tpu.ops.paged_attention import init_paged_cache
+        c = self.config
+        assert not c.is_moe, "paged serving currently requires a dense model"
+        assert not c.use_alibi and not c.local_attn_pattern, \
+            "paged serving does not support alibi/local-window models yet"
+        one = init_paged_cache(num_pages, page_size, c.kv_heads, c.head_dim,
+                               dtype)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(
+                x[None], (c.n_layers,) + x.shape).copy(), one)
+
+    def apply_with_paged_cache(self, params, input_ids, caches, block_tables,
+                               lengths):
+        """Forward over paged KV caches: appends the T new tokens of every
+        sequence at ``lengths`` (tables must already map the pages) and
+        attends over each sequence's ragged prefix.  Returns
+        (logits [B, T, V], new caches, lengths + T).
+
+        ``caches``: pytree from ``init_paged_caches``; ``block_tables``:
+        [B, max_pages] int32; ``lengths``: [B] int32.
+        """
+        from deepspeed_tpu.ops.paged_attention import (PagedKVCache,
+                                                       paged_decode_attention,
+                                                       prefill_paged)
+        c = self.config
+        B, T = input_ids.shape
+        positions = lengths[:, None] + jnp.broadcast_to(
+            jnp.arange(T)[None, :], (B, T))
+        x = params["tok_embed"][input_ids]
+        if not c.use_rope and not c.use_alibi:
+            x = x + params["pos_embed"][positions].astype(x.dtype)
+        if c.embed_norm:
+            x = _norm(x, params["embed_norm"], c.norm_eps, c.use_rmsnorm,
+                      params.get("embed_norm_b"))
+
+        H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
+
+        def body(x, inp):
+            layer, ck, cv = inp
+            h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
+                      layer.get("attn_norm_b"))
+            q, k, v = self._qkv(h, layer, B, T, positions)
+            cache, _ = prefill_paged(PagedKVCache(ck, cv), block_tables,
+                                     lengths, k, v)
+            # NOTE: ALiBi / local-window models are not yet served paged
+            # (their additive bias needs per-batch ragged positions the
+            # paged kernels don't take); init_paged_caches guards this
+            attn = paged_decode_attention(q, cache, block_tables,
+                                          lengths + T,
+                                          softmax_scale=c.attn_scale)
+            attn_delta = self._proj(attn.reshape(B, T, H * dh), layer, "wo")
+            if c.parallel_block:
+                hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
+                           layer.get("mlp_norm_b"))
+                mlp_delta, _ = self._mlp_delta(hm, layer, train=False)
+                x = x + attn_delta + mlp_delta
+            else:
+                x = x + attn_delta
+                x, _ = self._mlp_block(x, layer, train=False)
+            return x, (cache.k_pages, cache.v_pages)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], caches.k_pages, caches.v_pages))
+
+        x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
+                  params.get("final_norm_b"))
+        head = (params["tok_embed"].T if c.tie_embeddings
+                else params["lm_head"])
+        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
+        return logits, PagedKVCache(k_pages=new_k, v_pages=new_v), \
+            lengths + T
+
+    # ------------------------------------------------------------------
     def loss(self, params, batch, rng=None):
         """Next-token cross-entropy.  batch: dict with ``input_ids`` [B,S]
         (+ optional ``labels``, ``loss_mask``) or a raw [B,S] array."""

@@ -127,11 +127,15 @@ class PagedAllocator:
     reuse."""
 
     def __init__(self, num_pages: int, page_size: int,
-                 max_pages_per_seq: int):
+                 max_pages_per_seq: int, reserve_scratch: bool = False):
+        """``reserve_scratch``: keep page 0 out of the pool — serving
+        engines point INACTIVE batch slots' tables at page 0 so their
+        dummy-token writes land in a sacrificial page."""
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
-        self.free: List[int] = list(range(num_pages))
+        self.free: List[int] = list(range(1 if reserve_scratch else 0,
+                                          num_pages))
         self.seq_pages = {}
 
     def can_allocate(self, n_pages: int) -> bool:
@@ -157,6 +161,14 @@ class PagedAllocator:
             assert self.free, "out of KV pages"
             pages.append(self.free.pop())
         return pages
+
+    def shrink(self, seq_id, total_tokens: int):
+        """Release pages beyond what ``total_tokens`` needs (a bucketed
+        prefill over-allocates to the padded length, then trims)."""
+        pages = self.seq_pages[seq_id]
+        need = max(1, -(-total_tokens // self.page_size))
+        while len(pages) > need:
+            self.free.append(pages.pop())
 
     def free_sequence(self, seq_id):
         self.free.extend(self.seq_pages.pop(seq_id, []))
