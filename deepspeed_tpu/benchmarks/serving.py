@@ -1,0 +1,98 @@
+"""Serving benchmark: continuous batching vs sequential generation.
+
+Parity role: the reference's inference benchmarks report per-token latency
+for one stream (``benchmarks/inference/gpt-bench.py``); this adds the
+serving-throughput view — aggregate tokens/s over a request mix — where
+the paged continuous-batching engine earns its keep.
+
+Run:  python -m deepspeed_tpu.benchmarks.serving [--model gpt2_125m]
+      [--requests 16] [--max-batch 8] [--prompt-len 128] [--gen 64]
+Prints one JSON line per mode.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2_125m",
+                    choices=["tiny", "gpt2_125m", "gpt2_1_5b"])
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--gen", type=int, default=64)
+    ap.add_argument("--page-size", type=int, default=128)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+
+    cfg = getattr(TransformerConfig, args.model)() \
+        if args.model != "tiny" else TransformerConfig.tiny(hidden_size=64,
+                                                            n_heads=4)
+    cfg = type(cfg)(**{**cfg.__dict__, "remat": False})
+    model = CausalTransformerLM(cfg)
+    dtype = jnp.float32 if args.cpu else jnp.bfloat16
+    params = model.init(jax.random.key(0), dtype=dtype)
+
+    rng = np.random.default_rng(0)
+    # ragged prompts around the nominal length (realistic mix)
+    lens = rng.integers(max(4, args.prompt_len // 2), args.prompt_len + 1,
+                        args.requests)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).tolist() for n in lens]
+    max_seq = args.prompt_len + args.gen + args.page_size
+
+    # -- continuous batching -------------------------------------------
+    eng = ServingEngine(model, params, max_batch=args.max_batch,
+                        page_size=args.page_size, max_seq=max_seq,
+                        dtype=dtype)
+    # warmup compiles (prefill buckets + decode step) on a throwaway
+    eng.generate([prompts[0]], max_new_tokens=2)
+
+    t0 = time.perf_counter()
+    outs = eng.generate(prompts, max_new_tokens=args.gen)
+    dt = time.perf_counter() - t0
+    gen_tokens = sum(len(o) - n for o, n in zip(outs, lens))
+    print(json.dumps({
+        "mode": "continuous_batching",
+        "requests": args.requests, "max_batch": args.max_batch,
+        "gen_tokens": int(gen_tokens), "wall_s": round(dt, 3),
+        "tokens_per_sec": round(gen_tokens / dt, 1),
+    }))
+
+    # -- sequential single-stream baseline (reference-style) -----------
+    from deepspeed_tpu.parallel import groups
+    import deepspeed_tpu
+    groups.reset_mesh()
+    ie = deepspeed_tpu.init_inference(
+        model=model, params=params,
+        config={"dtype": "fp32" if args.cpu else "bf16",
+                "max_out_tokens": max_seq})
+    ie.generate(np.asarray(prompts[0])[None, :], max_new_tokens=2)  # warmup
+    t0 = time.perf_counter()
+    seq_tokens = 0
+    for p in prompts[: max(2, args.requests // 4)]:   # subset: it's slow
+        out = ie.generate(np.asarray(p)[None, :], max_new_tokens=args.gen)
+        seq_tokens += out.shape[1] - len(p)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "mode": "sequential_single_stream",
+        "requests_measured": max(2, args.requests // 4),
+        "gen_tokens": int(seq_tokens), "wall_s": round(dt, 3),
+        "tokens_per_sec": round(seq_tokens / dt, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

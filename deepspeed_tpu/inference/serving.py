@@ -67,6 +67,12 @@ class ServingEngine:
                                     self.max_pages_per_seq,
                                     reserve_scratch=True)
         self.eos = eos_token_id
+        if not self.config.use_rope and not self.config.use_alibi:
+            # learned positions: gathers past the table CLAMP under jit
+            # (silent garbage), so bound the serve length up front
+            assert max_seq <= self.config.max_seq_len, (
+                f"max_seq {max_seq} exceeds the model's position table "
+                f"({self.config.max_seq_len})")
         self.max_seq = max_seq
 
         self.slots: List[Optional[_Request]] = [None] * max_batch
@@ -74,8 +80,10 @@ class ServingEngine:
         self.finished: Dict[Any, List[int]] = {}
         self.lengths = np.zeros(max_batch, np.int32)
         self.tables = np.zeros((max_batch, self.max_pages_per_seq), np.int32)
-        self._prefill_jit: Dict[int, Any] = {}
-        self._decode_jit = None
+        # one jit serves prefill (B=1, bucketed T) and decode (B=max_batch,
+        # T=1) alike: jax.jit caches a compilation per input shape
+        self._step_fn = jax.jit(self.model.apply_with_paged_cache,
+                                donate_argnums=(2,))
         self._rng = {}
 
     # -- host control flow ---------------------------------------------
@@ -84,6 +92,17 @@ class ServingEngine:
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         assert len(prompt) + max_new_tokens <= self.max_seq, \
             f"request {req_id} exceeds max_seq {self.max_seq}"
+        total = len(prompt) + max_new_tokens
+        bucket = min(self._bucket(len(prompt)), self.max_seq)
+        need = -(-max(total, bucket) // self.page_size)
+        usable = self.alloc.num_pages - 1   # minus the scratch page
+        assert need <= usable, (
+            f"request {req_id} needs {need} pages but the pool only has "
+            f"{usable}; it would deadlock the queue head-of-line")
+        assert req_id not in self.alloc.seq_pages and \
+            req_id not in self.finished and \
+            all(r.req_id != req_id for r in self.queue), \
+            f"duplicate req_id {req_id!r}"
         self.queue.append(_Request(req_id, prompt, max_new_tokens,
                                    temperature, seed))
         self._admit()
@@ -122,12 +141,7 @@ class ServingEngine:
         T = bucket
         ids = np.zeros((1, T), np.int32)
         ids[0, :len(req.prompt)] = req.prompt
-        fn = self._prefill_jit.get(T)
-        if fn is None:
-            fn = jax.jit(self.model.apply_with_paged_cache,
-                         donate_argnums=(2,))
-            self._prefill_jit[T] = fn
-        logits, self.caches, _ = fn(
+        logits, self.caches, _ = self._step_fn(
             self.params, jnp.asarray(ids), self.caches,
             jnp.asarray(self.tables[slot:slot + 1]),
             jnp.zeros((1,), jnp.int32))
@@ -169,10 +183,7 @@ class ServingEngine:
         for slot, req in enumerate(self.slots):
             if req is not None:
                 last[slot, 0] = req.last_token
-        if self._decode_jit is None:
-            self._decode_jit = jax.jit(self.model.apply_with_paged_cache,
-                                       donate_argnums=(2,))
-        logits, self.caches, _ = self._decode_jit(
+        logits, self.caches, _ = self._step_fn(
             self.params, jnp.asarray(last), self.caches,
             jnp.asarray(self.tables), jnp.asarray(self.lengths))
         logits_np = np.asarray(logits[:, 0])
@@ -196,7 +207,9 @@ class ServingEngine:
         for slot in done_slots:
             rid = self.slots[slot].req_id
             self._finish(slot)
-            done_now[rid] = self.finished[rid]
+            # hand the result back ONCE and evict: a long-running server
+            # must not accumulate every finished token list forever
+            done_now[rid] = self.finished.pop(rid)
         return done_now
 
     # -- convenience ----------------------------------------------------
@@ -207,10 +220,11 @@ class ServingEngine:
         for i, p in enumerate(prompts):
             self.add_request(i, p, max_new_tokens, temperature)
         steps = 0
+        results: Dict[Any, List[int]] = {}
         limit = (max(len(p) for p in prompts) + max_new_tokens + 4) * \
             (len(prompts) + 1)
         while (self.queue or self.n_active) and steps < limit:
-            self.step()
+            results.update(self.step())
             steps += 1
         assert not self.queue and self.n_active == 0, "serving stalled"
-        return [self.finished[i] for i in range(len(prompts))]
+        return [results[i] for i in range(len(prompts))]

@@ -68,17 +68,19 @@ def test_varied_generation_lengths_and_midflight_admission(tiny):
     p2 = rng.integers(0, cfg.vocab_size, (8,)).tolist()
     eng = ServingEngine(model, params, max_batch=2, page_size=8,
                         max_seq=64, dtype=jnp.float32)
+    done = {}
     eng.add_request("a", p0, max_new_tokens=2)
     eng.add_request("b", p1, max_new_tokens=9)
-    eng.step()
+    done.update(eng.step())
     eng.add_request("c", p2, max_new_tokens=3)   # queued: slots busy
     for _ in range(30):
-        eng.step()
-        if len(eng.finished) == 3:
+        done.update(eng.step())
+        if len(done) == 3:
             break
-    assert eng.finished["a"] == _dense_greedy(model, params, p0, 2)
-    assert eng.finished["b"] == _dense_greedy(model, params, p1, 9)
-    assert eng.finished["c"] == _dense_greedy(model, params, p2, 3)
+    assert done["a"] == _dense_greedy(model, params, p0, 2)
+    assert done["b"] == _dense_greedy(model, params, p1, 9)
+    assert done["c"] == _dense_greedy(model, params, p2, 3)
+    assert not eng.finished            # results evicted once returned
 
 
 def test_eos_frees_slot_early(tiny):
@@ -92,11 +94,12 @@ def test_eos_frees_slot_early(tiny):
     eng = ServingEngine(model, params, max_batch=1, page_size=8,
                         max_seq=64, dtype=jnp.float32, eos_token_id=eos)
     eng.add_request("x", p, max_new_tokens=20)
+    done = {}
     for _ in range(30):
-        eng.step()
-        if "x" in eng.finished:
+        done.update(eng.step())
+        if "x" in done:
             break
-    got = eng.finished["x"]
+    got = done["x"]
     assert got[-1] == eos and len(got) == len(p) + 3
     assert got == ref[:len(p) + 3]
     # all pages back in the pool (minus the reserved scratch page)
@@ -114,15 +117,16 @@ def test_admission_during_finishing_step_not_corrupted(tiny):
     # 2 slots but pages for ~one active request: B waits until A frees
     eng = ServingEngine(model, params, max_batch=2, page_size=8,
                         max_seq=32, num_pages=3, dtype=jnp.float32)
+    done = {}
     eng.add_request("A", pa, max_new_tokens=3)
     eng.add_request("B", pb, max_new_tokens=4)
     assert eng.queue, "test needs B to be queued behind A"
     for _ in range(30):
-        eng.step()
-        if len(eng.finished) == 2:
+        done.update(eng.step())
+        if len(done) == 2:
             break
-    assert eng.finished["A"] == _dense_greedy(model, params, pa, 3)
-    assert eng.finished["B"] == _dense_greedy(model, params, pb, 4)
+    assert done["A"] == _dense_greedy(model, params, pa, 3)
+    assert done["B"] == _dense_greedy(model, params, pb, 4)
 
 
 def test_bucket_surplus_pages_returned_after_prefill(tiny):
@@ -138,11 +142,12 @@ def test_bucket_surplus_pages_returned_after_prefill(tiny):
                         max_seq=64, dtype=jnp.float32)
     eng.add_request("s", p, max_new_tokens=1)
     assert len(eng.alloc.seq_pages["s"]) == 3   # trimmed from 4
+    done = {}
     for _ in range(5):
-        eng.step()
-        if "s" in eng.finished:
+        done.update(eng.step())
+        if "s" in done:
             break
-    assert eng.finished["s"] == _dense_greedy(model, params, p, 1)
+    assert done["s"] == _dense_greedy(model, params, p, 1)
 
 
 def test_request_exceeding_max_seq_rejected(tiny):
@@ -162,10 +167,11 @@ def test_temperature_sampling_reproducible(tiny):
         eng = ServingEngine(model, params, max_batch=1, page_size=8,
                             max_seq=64, dtype=jnp.float32)
         eng.add_request("t", p, max_new_tokens=8, temperature=0.8, seed=7)
+        done = {}
         for _ in range(20):
-            eng.step()
-            if "t" in eng.finished:
+            done.update(eng.step())
+            if "t" in done:
                 break
-        outs.append(eng.finished["t"])
+        outs.append(done["t"])
     assert outs[0] == outs[1]                  # same seed → same sample
     assert len(outs[0]) == len(p) + 8
