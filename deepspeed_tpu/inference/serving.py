@@ -52,17 +52,38 @@ class ServingEngine:
     def __init__(self, model, params, max_batch: int = 8,
                  page_size: int = 128, num_pages: Optional[int] = None,
                  max_seq: int = 2048, dtype=jnp.bfloat16,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None, tp_size: int = 1):
         self.model = model
         self.config = model.config
-        self.params = params
         self.max_batch = max_batch
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_seq // page_size)
         if num_pages is None:
             num_pages = max_batch * self.max_pages_per_seq + 1
-        self.caches = model.init_paged_caches(num_pages, page_size,
-                                              dtype=dtype)
+        self.mesh = None
+        caches = model.init_paged_caches(num_pages, page_size, dtype=dtype)
+        if tp_size > 1:
+            # tensor-parallel serving: weights per the model's tp_rules,
+            # KV pages sharded over the kv-head dim ([L, P, Hkv, page, D])
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from deepspeed_tpu.parallel import groups
+            from deepspeed_tpu.parallel.topology import TopologyConfig
+            from deepspeed_tpu.runtime.zero.stage_plan import ZeroShardingPlan
+            assert self.config.kv_heads % tp_size == 0, \
+                "tp_size must divide the kv-head count for paged serving"
+            groups.reset_mesh()
+            self.mesh = groups.initialize_mesh(
+                TopologyConfig(tp=tp_size, fsdp=-1))
+            plan = ZeroShardingPlan(self.mesh, stage=0,
+                                    tp_rules=model.tp_rules())
+            with self.mesh:
+                params = jax.device_put(
+                    params, plan._to_sharding(plan.param_specs(params)))
+                caches = jax.device_put(
+                    caches, NamedSharding(self.mesh,
+                                          P(None, None, "tp", None, None)))
+        self.params = params
+        self.caches = caches
         self.alloc = PagedAllocator(num_pages, page_size,
                                     self.max_pages_per_seq,
                                     reserve_scratch=True)
@@ -137,12 +158,19 @@ class ServingEngine:
                 self.tables[slot, :] = 0
                 self.tables[slot, :len(pages)] = pages
 
+    def _run_step(self, ids, tables, lengths):
+        if self.mesh is not None:
+            with self.mesh:
+                return self._step_fn(self.params, ids, self.caches,
+                                     tables, lengths)
+        return self._step_fn(self.params, ids, self.caches, tables, lengths)
+
     def _prefill(self, slot: int, req: _Request, bucket: int):
         T = bucket
         ids = np.zeros((1, T), np.int32)
         ids[0, :len(req.prompt)] = req.prompt
-        logits, self.caches, _ = self._step_fn(
-            self.params, jnp.asarray(ids), self.caches,
+        logits, self.caches, _ = self._run_step(
+            jnp.asarray(ids),
             jnp.asarray(self.tables[slot:slot + 1]),
             jnp.zeros((1,), jnp.int32))
         self.lengths[slot] = len(req.prompt)
@@ -183,9 +211,9 @@ class ServingEngine:
         for slot, req in enumerate(self.slots):
             if req is not None:
                 last[slot, 0] = req.last_token
-        logits, self.caches, _ = self._step_fn(
-            self.params, jnp.asarray(last), self.caches,
-            jnp.asarray(self.tables), jnp.asarray(self.lengths))
+        logits, self.caches, _ = self._run_step(
+            jnp.asarray(last), jnp.asarray(self.tables),
+            jnp.asarray(self.lengths))
         logits_np = np.asarray(logits[:, 0])
 
         # finishing frees slots, which admits (and PREFILLS) queued

@@ -175,3 +175,23 @@ def test_temperature_sampling_reproducible(tiny):
         outs.append(done["t"])
     assert outs[0] == outs[1]                  # same seed → same sample
     assert len(outs[0]) == len(p) + 8
+
+
+def test_tensor_parallel_serving_exact(tiny):
+    """tp=2 serving: weights column/row-sharded, KV pages sharded over the
+    kv-head dim — outputs still token-exact vs the dense oracle."""
+    from deepspeed_tpu.parallel import groups
+    cfg, model, params = tiny
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 12, 8)]
+    groups.reset_mesh()
+    eng = ServingEngine(model, params, max_batch=3, page_size=8,
+                        max_seq=64, dtype=jnp.float32, tp_size=2)
+    assert "tp" in str(eng.caches.k_pages.sharding.spec)
+    wq = eng.params["layers"]["wq"]
+    assert "tp" in str(wq.sharding.spec)
+    outs = eng.generate(prompts, max_new_tokens=5)
+    for p, got in zip(prompts, outs):
+        assert got == _dense_greedy(model, params, p, 5), p
+    groups.reset_mesh()
