@@ -1,2 +1,3 @@
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.memory import memory_status, see_memory_usage
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer

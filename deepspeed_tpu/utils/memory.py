@@ -1,0 +1,60 @@
+"""Memory introspection (reference ``runtime/utils.py``
+``see_memory_usage:764`` / ``memory_status`` — the debug API sprinkled
+through DeepSpeed training scripts).
+
+TPU flavor: device numbers come from the backend's ``memory_stats()``
+(bytes_in_use / peak / limit); host numbers from ``/proc/self/status``
+(VmRSS) so there is no psutil dependency.
+"""
+
+import os
+from typing import Dict
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _host_rss_gb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / (1024 ** 2)  # kB → GB
+    except OSError:
+        pass
+    return 0.0
+
+
+def memory_status(device=None) -> Dict[str, float]:
+    """Device + host memory snapshot in GB (zeros where the backend does
+    not report stats, e.g. CPU)."""
+    if device is None:
+        device = jax.devices()[0]
+    stats = {}
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        pass
+    gb = 1024 ** 3
+    return {
+        "device_in_use_gb": stats.get("bytes_in_use", 0) / gb,
+        "device_peak_gb": stats.get("peak_bytes_in_use", 0) / gb,
+        "device_limit_gb": stats.get("bytes_limit", 0) / gb,
+        "host_rss_gb": _host_rss_gb(),
+    }
+
+
+def see_memory_usage(message: str, force: bool = False, ranks=(0,)):
+    """Log a memory snapshot (reference signature).  ``force=False`` is a
+    no-op, matching the reference's opt-in behaviour."""
+    if not force:
+        return
+    if jax.process_index() not in ranks:
+        return
+    m = memory_status()
+    logger.info(
+        f"{message} | device {m['device_in_use_gb']:.2f} GB "
+        f"(peak {m['device_peak_gb']:.2f}, limit {m['device_limit_gb']:.2f}) "
+        f"| host RSS {m['host_rss_gb']:.2f} GB")
+    return m

@@ -173,3 +173,14 @@ def test_spatial_bias_adds():
     np.testing.assert_allclose(
         np.asarray(nhwc_bias_add_bias_add(a, b, o, ob)),
         np.asarray(nhwc_bias_add_add(a, b, o)) + np.asarray(ob), rtol=1e-6)
+
+
+def test_see_memory_usage():
+    """Reference runtime/utils.py:764 parity: opt-in logging + a numeric
+    snapshot (host RSS always populated; device stats where reported)."""
+    from deepspeed_tpu.utils import memory_status, see_memory_usage
+    assert see_memory_usage("quiet") is None          # force=False no-op
+    m = see_memory_usage("probe", force=True)
+    assert m is not None and m["host_rss_gb"] > 0
+    assert set(memory_status()) == {"device_in_use_gb", "device_peak_gb",
+                                    "device_limit_gb", "host_rss_gb"}
