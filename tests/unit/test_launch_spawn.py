@@ -12,9 +12,13 @@ from deepspeed_tpu.launcher.runner import encode_world_info
 def test_launch_spawns_processes_with_env(tmp_path):
     script = tmp_path / "probe.py"
     script.write_text(
-        "import os\n"
-        "print('RANK', os.environ['RANK'], 'WS', os.environ['WORLD_SIZE'],\n"
-        "      'COORD', os.environ['JAX_COORDINATOR_ADDRESS'], flush=True)\n")
+        "import os, sys\n"
+        # ONE atomic write: concurrent children interleave multi-chunk
+        # prints mid-line ('RANKRANK 1 ...')
+        "sys.stdout.write('RANK %s WS %s COORD %s\\n' % (\n"
+        "    os.environ['RANK'], os.environ['WORLD_SIZE'],\n"
+        "    os.environ['JAX_COORDINATOR_ADDRESS']))\n"
+        "sys.stdout.flush()\n")
     world = encode_world_info({"localhost": [0, 1]})
     env = dict(os.environ)
     # keep the probe off the real TPU tunnel (single chip; a concurrent
