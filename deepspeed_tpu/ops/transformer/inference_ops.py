@@ -1,0 +1,200 @@
+"""Op-level inference surface (reference ``csrc/transformer/inference``
+``pt_binding.cpp:1714-1780`` — the ~40 fused ops behind
+``DeepSpeedTransformerInference``).
+
+TPU design: each op is a small jnp function with the REFERENCE's exact
+math (kernels read from ``gelu.cu``/``pt_binding.cpp``); under ``jit``
+XLA fuses the chains the reference fuses by hand, and the genuinely
+attention-shaped ops (``softmax_context``) dispatch to the Pallas decode
+kernels.  The surface exists so code written against the reference's op
+API ports one-import; the hot path in THIS framework is the jitted model
+(``models/transformer.py``), not op-by-op calls.
+
+Dtype-suffixed aliases (``*_fp16``/``*_fp32``) map to one dtype-generic
+function, as do the int8 variants after ``ops/quantizer`` dequant.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import _norm
+# softmax_context: attention over the KV cache — the Pallas-backed path
+from deepspeed_tpu.ops.decode_attention import softmax_context  # noqa: F401
+
+
+# ---------------------------------------------------------------------
+# elementwise fusions (gelu.cu)
+# ---------------------------------------------------------------------
+
+def bias_add(x, bias):
+    return x + bias.astype(x.dtype)
+
+
+def bias_gelu(x, bias):
+    return jax.nn.gelu(x + bias.astype(x.dtype))
+
+
+def bias_relu(x, bias):
+    return jax.nn.relu(x + bias.astype(x.dtype))
+
+
+def bias_geglu(x, bias):
+    """Gated GELU (diffusers FFNs): split the last dim in half,
+    ``a * gelu(b)``."""
+    y = x + bias.astype(x.dtype)
+    a, b = jnp.split(y, 2, axis=-1)
+    return a * jax.nn.gelu(b)
+
+
+def bias_residual(x, residual, bias):
+    return x + residual + bias.astype(x.dtype)
+
+
+def residual_add_bias(hidden_state, residual, attention_output,
+                      attention_bias, final_bias, mp_size: int = 1,
+                      mlp_after_attn: bool = True, add_bias: bool = True,
+                      preln: bool = True):
+    """Reference ``residual_add_bias`` (pt_binding.cpp:1580; kernels
+    ``fused_bias_residual`` / ``gptj_residual_add``, gelu.cu:120,267):
+
+    * mlp_after_attn and preln:
+      ``(residual + attn + final_bias + attn_bias) / mp_size + hidden``
+    * mlp_after_attn, not preln: ``residual + hidden + final_bias``
+    * parallel block (GPT-J; not mlp_after_attn):
+      ``hidden + attn + (residual [+ attn_bias] + final_bias) / mp_size``
+    """
+    scale = 1.0 / mp_size
+    if mlp_after_attn:
+        if preln:
+            return (residual + attention_output + final_bias +
+                    attention_bias) * scale + hidden_state
+        return residual + hidden_state + final_bias
+    r = residual + attention_bias if add_bias else residual
+    return hidden_state + attention_output + (r + final_bias) * scale
+
+
+def moe_res_matmul(moe_res, coef, mlp_out):
+    """Reference ``moe_res_matmul`` (gelu.cu:408): coef packs two [d]
+    vectors along the hidden dim; ``mlp_out * coef2 + moe_res * coef1``."""
+    d = moe_res.shape[-1]
+    coef1, coef2 = coef[..., :d], coef[..., d:2 * d]
+    return mlp_out * coef2 + moe_res * coef1
+
+
+# ---------------------------------------------------------------------
+# norms (layer_norm.cu)
+# ---------------------------------------------------------------------
+
+def layer_norm(x, gamma, beta, eps: float = 1e-5):
+    return _norm(x, gamma, eps, use_rms=False, bias=beta)
+
+
+def layer_norm_residual(x, bias, residual, gamma, beta, eps: float = 1e-5):
+    """``ln(x + bias + residual)`` (reference ``_layer_norm_residual``)."""
+    return layer_norm(x + residual + bias.astype(x.dtype), gamma, beta, eps)
+
+
+def layer_norm_residual_store_pre_ln_res(x, bias, residual, gamma, beta,
+                                         eps: float = 1e-5):
+    """Same, also returning the pre-LN sum (the next block's residual)."""
+    pre = x + residual + bias.astype(x.dtype)
+    return layer_norm(pre, gamma, beta, eps), pre
+
+
+# ---------------------------------------------------------------------
+# gemm fusions (pt_binding qkv_gemm / mlp_gemm / ...)
+# ---------------------------------------------------------------------
+
+def vector_matmul(x, w):
+    return x @ w
+
+
+def linear_layer(x, w, bias=None):
+    out = x @ w
+    return out if bias is None else out + bias.astype(out.dtype)
+
+
+def qkv_gemm(x, weight, bias, gamma, beta, eps: float = 1e-5,
+             add_bias: bool = True):
+    """Pre-LN fused QKV projection; returns ``(qkv, inp_norm)`` like the
+    reference (the normed input feeds the attention residual path)."""
+    inp_norm = layer_norm(x, gamma, beta, eps)
+    out = inp_norm @ weight
+    if add_bias:
+        out = out + bias.astype(out.dtype)
+    return out, inp_norm
+
+
+def mlp_gemm(x, residual, input_bias, weight_up, bias_up, weight_down,
+             gamma, beta, eps: float = 1e-5, preln: bool = True,
+             activation=jax.nn.gelu):
+    """Pre-LN MLP block: ``res_add = x + residual + input_bias``;
+    ``out = act(ln(res_add) @ W_up + b_up) @ W_down``.  Returns
+    ``(out, res_add)`` (reference mlp_gemm returns the residual sum for
+    the following residual_add_bias)."""
+    res_add = x + residual + input_bias.astype(x.dtype) if preln \
+        else layer_norm(x + residual + input_bias.astype(x.dtype),
+                        gamma, beta, eps)
+    h = layer_norm(res_add, gamma, beta, eps) if preln else res_add
+    h = activation(h @ weight_up + bias_up.astype(h.dtype))
+    return h @ weight_down, res_add
+
+
+def fused_gemm_gelu(x, weight_up, bias_up, weight_down):
+    return jax.nn.gelu(x @ weight_up + bias_up.astype(x.dtype)) @ weight_down
+
+
+# ---------------------------------------------------------------------
+# rotary (apply_rotary_pos_emb.cu)
+# ---------------------------------------------------------------------
+
+def apply_rotary_pos_emb(query, key, rotary_dim: int, offset: int = 0,
+                         rotate_every_two: bool = True,
+                         theta: float = 10000.0):
+    """q/k: [B, S, H, D]; rotates the leading ``rotary_dim`` of each head.
+    ``rotate_every_two=True`` is the GPT-J interleaved convention; False is
+    the NeoX half-split (reference's ``rotate_half``)."""
+    from deepspeed_tpu.models.transformer import _rope
+
+    B, S, H, D = query.shape
+    pos = offset + jnp.arange(S)
+    if not rotate_every_two:
+        # half-split IS the model's RoPE — delegate, don't duplicate
+        pos_b = jnp.broadcast_to(pos[None, :], (B, S))
+        return (_rope(query, pos_b, theta, rotary_dim),
+                _rope(key, pos_b, theta, rotary_dim))
+
+    # interleaved (GPT-J): pair (2j, 2j+1) rotates by freq j.  Tables are
+    # shared between query and key.
+    half = rotary_dim // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+
+    def rot(x):
+        r, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+        x1, x2 = r[..., 0::2], r[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                        axis=-1).reshape(r.shape)
+        return jnp.concatenate([out.astype(x.dtype), rest], axis=-1)
+
+    return rot(query), rot(key)
+
+
+# ---------------------------------------------------------------------
+# misc (einsum_sec_sm_ecm — the MoE gather einsum)
+# ---------------------------------------------------------------------
+
+def einsum_sec_sm_ecm(a, b):
+    return jnp.einsum("sec,sm->ecm", a, b)
+
+
+# dtype-suffixed parity aliases ----------------------------------------
+for _name in ("bias_gelu", "bias_add", "bias_relu", "bias_residual",
+              "qkv_gemm", "mlp_gemm", "vector_matmul", "linear_layer",
+              "fused_gemm_gelu", "residual_add_bias", "einsum_sec_sm_ecm"):
+    globals()[f"{_name}_fp32"] = globals()[_name]
+    globals()[f"{_name}_fp16"] = globals()[_name]

@@ -1,0 +1,178 @@
+"""Op-level inference surface tests (reference pt_binding.cpp:1714-1780).
+
+Oracles: torch for norms/activations, hand-written numpy for the fused
+residual formulas (transcribed from gelu.cu kernel math), and the model's
+RoPE for the rotary op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer import inference_ops as ops
+
+torch = pytest.importorskip("torch")
+
+
+def _r(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_layer_norm_matches_torch():
+    x, g, b = _r((2, 5, 16)), _r(16, 1), _r(16, 2)
+    got = np.asarray(ops.layer_norm(jnp.asarray(x), jnp.asarray(g),
+                                    jnp.asarray(b)))
+    ref = torch.nn.functional.layer_norm(
+        torch.tensor(x), (16,), torch.tensor(g), torch.tensor(b),
+        eps=1e-5).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_residual_and_store():
+    x, bias, res = _r((2, 4, 8)), _r(8, 1), _r((2, 4, 8), 2)
+    g, b = np.ones(8, np.float32), np.zeros(8, np.float32)
+    ln = np.asarray(ops.layer_norm_residual(
+        jnp.asarray(x), jnp.asarray(bias), jnp.asarray(res),
+        jnp.asarray(g), jnp.asarray(b)))
+    ln2, pre = ops.layer_norm_residual_store_pre_ln_res(
+        jnp.asarray(x), jnp.asarray(bias), jnp.asarray(res),
+        jnp.asarray(g), jnp.asarray(b))
+    np.testing.assert_allclose(ln, np.asarray(ln2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(pre), x + res + bias, rtol=1e-6)
+
+
+def test_bias_activations_match_torch():
+    x, bias = _r((3, 10)), _r(10, 1)
+    np.testing.assert_allclose(
+        np.asarray(ops.bias_gelu(jnp.asarray(x), jnp.asarray(bias))),
+        torch.nn.functional.gelu(torch.tensor(x + bias),
+                                 approximate="tanh").numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ops.bias_relu(jnp.asarray(x), jnp.asarray(bias))),
+        np.maximum(x + bias, 0), rtol=1e-6)
+    y = _r((3, 12), 3)
+    gb = _r(12, 4)
+    a, g_half = np.split(y + gb, 2, axis=-1)
+    ref = a * torch.nn.functional.gelu(torch.tensor(g_half),
+                                      approximate="tanh").numpy()
+    np.testing.assert_allclose(
+        np.asarray(ops.bias_geglu(jnp.asarray(y), jnp.asarray(gb))),
+        ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mp_size", [1, 4])
+def test_residual_add_bias_formulas(mp_size):
+    """Exact kernel math (gelu.cu fused_bias_residual / gptj_residual_add)."""
+    h, res, attn = _r((2, 3, 8)), _r((2, 3, 8), 1), _r((2, 3, 8), 2)
+    ab, fb = _r(8, 3), _r(8, 4)
+    scale = 1.0 / mp_size
+
+    got = np.asarray(ops.residual_add_bias(
+        jnp.asarray(h), jnp.asarray(res), jnp.asarray(attn),
+        jnp.asarray(ab), jnp.asarray(fb), mp_size, True, True, True))
+    np.testing.assert_allclose(got, (res + attn + fb + ab) * scale + h,
+                               rtol=1e-6)
+
+    got = np.asarray(ops.residual_add_bias(
+        jnp.asarray(h), jnp.asarray(res), jnp.asarray(attn),
+        jnp.asarray(ab), jnp.asarray(fb), mp_size, True, True, False))
+    np.testing.assert_allclose(got, res + h + fb, rtol=1e-6)
+
+    got = np.asarray(ops.residual_add_bias(
+        jnp.asarray(h), jnp.asarray(res), jnp.asarray(attn),
+        jnp.asarray(ab), jnp.asarray(fb), mp_size, False, True, True))
+    np.testing.assert_allclose(got, h + attn + (res + ab + fb) * scale,
+                               rtol=1e-6)
+
+
+def test_moe_res_matmul():
+    res, mlp = _r((2, 3, 8)), _r((2, 3, 8), 1)
+    coef = _r((2, 3, 16), 2)
+    got = np.asarray(ops.moe_res_matmul(jnp.asarray(res), jnp.asarray(coef),
+                                        jnp.asarray(mlp)))
+    np.testing.assert_allclose(
+        got, mlp * coef[..., 8:] + res * coef[..., :8], rtol=1e-6)
+
+
+def test_qkv_and_mlp_gemm_composition():
+    x, res = _r((2, 4, 8)), _r((2, 4, 8), 1)
+    w, b = _r((8, 24), 2), _r(24, 3)
+    g, be = _r(8, 4), _r(8, 5)
+    out, inp_norm = ops.qkv_gemm(jnp.asarray(x), jnp.asarray(w),
+                                 jnp.asarray(b), jnp.asarray(g),
+                                 jnp.asarray(be))
+    ref_norm = np.asarray(ops.layer_norm(jnp.asarray(x), jnp.asarray(g),
+                                         jnp.asarray(be)))
+    np.testing.assert_allclose(np.asarray(inp_norm), ref_norm, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), ref_norm @ w + b, rtol=1e-4)
+
+    w1, b1, w2 = _r((8, 16), 6), _r(16, 7), _r((16, 8), 8)
+    ib = _r(8, 9)
+    out, res_add = ops.mlp_gemm(jnp.asarray(x), jnp.asarray(res),
+                                jnp.asarray(ib), jnp.asarray(w1),
+                                jnp.asarray(b1), jnp.asarray(w2),
+                                jnp.asarray(g), jnp.asarray(be))
+    np.testing.assert_allclose(np.asarray(res_add), x + res + ib, rtol=1e-6)
+    h = np.asarray(ops.layer_norm(jnp.asarray(x + res + ib), jnp.asarray(g),
+                                  jnp.asarray(be)))
+    ref = np.asarray(jax.nn.gelu(jnp.asarray(h @ w1 + b1))) @ w2
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+    fg = np.asarray(ops.fused_gemm_gelu(jnp.asarray(x), jnp.asarray(w1),
+                                        jnp.asarray(b1), jnp.asarray(w2)))
+    ref = np.asarray(jax.nn.gelu(jnp.asarray(x @ w1 + b1))) @ w2
+    np.testing.assert_allclose(fg, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rotary_half_matches_model_rope():
+    """rotate_every_two=False == the model's half-split RoPE."""
+    from deepspeed_tpu.models.transformer import _rope
+    q, k = _r((2, 6, 4, 8)), _r((2, 6, 4, 8), 1)
+    pos = np.broadcast_to(np.arange(6)[None, :], (2, 6))
+    q2, k2 = ops.apply_rotary_pos_emb(jnp.asarray(q), jnp.asarray(k),
+                                      rotary_dim=8, offset=0,
+                                      rotate_every_two=False)
+    ref_q = np.asarray(_rope(jnp.asarray(q), jnp.asarray(pos), 10000.0))
+    np.testing.assert_allclose(np.asarray(q2), ref_q, rtol=1e-4, atol=1e-5)
+
+
+def test_rotary_interleaved_pairs():
+    """rotate_every_two=True rotates pairs (2j, 2j+1) by freq j."""
+    q = _r((1, 3, 1, 4))
+    k = np.zeros_like(q)
+    q2, _ = ops.apply_rotary_pos_emb(jnp.asarray(q), jnp.asarray(k),
+                                     rotary_dim=4, offset=2,
+                                     rotate_every_two=True)
+    got = np.asarray(q2)
+    for s in range(3):
+        pos = 2 + s
+        for j in range(2):
+            ang = pos * (10000.0 ** (-j / 2.0))
+            c, sn = np.cos(ang), np.sin(ang)
+            x1, x2 = q[0, s, 0, 2 * j], q[0, s, 0, 2 * j + 1]
+            np.testing.assert_allclose(got[0, s, 0, 2 * j],
+                                       x1 * c - x2 * sn, rtol=1e-4,
+                                       atol=1e-5)
+            np.testing.assert_allclose(got[0, s, 0, 2 * j + 1],
+                                       x1 * sn + x2 * c, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_partial_rotary_leaves_rest():
+    q = _r((1, 2, 1, 8))
+    q2, _ = ops.apply_rotary_pos_emb(jnp.asarray(q), jnp.asarray(q),
+                                     rotary_dim=4)
+    np.testing.assert_array_equal(np.asarray(q2)[..., 4:], q[..., 4:])
+
+
+def test_einsum_and_aliases():
+    a, b = _r((3, 2, 4)), _r((3, 5), 1)
+    np.testing.assert_allclose(
+        np.asarray(ops.einsum_sec_sm_ecm(jnp.asarray(a), jnp.asarray(b))),
+        np.einsum("sec,sm->ecm", a, b), rtol=1e-5)
+    assert ops.bias_gelu_fp16 is ops.bias_gelu
+    assert ops.mlp_gemm_fp32 is ops.mlp_gemm
+    from deepspeed_tpu.ops.transformer.inference_ops import softmax_context
+    assert callable(softmax_context)
