@@ -189,11 +189,28 @@ class DeepSpeedEngine:
         # compression (reference engine.py:1401 compression_scheduler hookup)
         self._compression = None
         self.compression_scheduler = None
+        # MoQ: step-time annealed weight quantization (reference
+        # engine.py:1319 _configure_quantization + :1799 quantize call)
+        self.quantizer = None
         if config.compression_config:
             from deepspeed_tpu.compression import (CompressionScheduler,
                                                    init_compression)
+            from deepspeed_tpu.runtime.quantize import \
+                build_quantizer_from_config
+            self.quantizer = build_quantizer_from_config(
+                config.compression_config)
+            if self.quantizer is not None:
+                self.quantizer.attach(self.state.params,
+                                      self.quantizer.groups_cfg or None)
             spec = init_compression(model, config)
-            if spec.config.enabled:
+            if self.quantizer is not None:
+                # MoQ owns weight quantization: drop it from the in-forward
+                # compression path so weights aren't quantized twice
+                from deepspeed_tpu.compression.config import \
+                    WEIGHT_QUANTIZATION
+                spec.groups = [g for g in spec.groups
+                               if g.method != WEIGHT_QUANTIZATION]
+            if spec.config.enabled and spec.groups:
                 self._compression = spec
                 self.compression_scheduler = CompressionScheduler(spec)
 
@@ -424,6 +441,18 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
             if self._compression is not None and step is not None:
                 p_c = self._compression.transform(p_c, step)
+            if self.quantizer is not None and step is not None:
+                # MoQ: forward sees Q(w) from the schedule_offset step on —
+                # the cast-site equivalent of the reference's post-step
+                # quantization of the fp16 weight copy (engine.py:1799).
+                # Straight-through: the reference evaluates grads at Q(w) but
+                # applies them to the unquantized master, i.e. identity
+                # backward — without this, d(round)/dx = 0 kills training.
+                q_c = self.quantizer.transform(
+                    p_c, step, rng=jax.random.fold_in(rng, 0x4D6F51),
+                    schedule_offset=self.quantizer.schedule_offset)
+                p_c = jax.tree_util.tree_map(
+                    lambda x, q: x + jax.lax.stop_gradient(q - x), p_c, q_c)
             loss = self.loss_fn(p_c, batch, rng)
             return (loss * loss_scale).astype(jnp.float32), loss
 
@@ -900,6 +929,31 @@ class DeepSpeedEngine:
 
     def gradient_accumulation_steps(self):
         return self._config.gradient_accumulation_steps
+
+    def quantize_training(self):
+        """MoQ config tuple (reference ``engine.py:698`` — in_forward,
+        enabled, groups, fp16_mixed, change_ratio, type, rounding, verbose,
+        kernel).  Reads the live Quantizer so the report can't drift from
+        what actually runs."""
+        wq = (self._config.compression_config or {}).get(
+            "weight_quantization", {})
+        shared = wq.get("shared_parameters", {})
+        in_forward = shared.get("quantize_weight_in_forward", False)
+        enabled = shared.get("quantize_enabled", False)
+        q = self.quantizer
+        if q is not None:
+            return (in_forward, enabled, q.q_groups, q.q_mixed_fp16,
+                    q.q_change_ratio, q.q_type, q.q_rounding, q.q_verbose,
+                    q.use_quantizer_kernel)
+        mixed = shared.get("fp16_mixed_quantize", {})
+        return (in_forward, enabled,
+                shared.get("quantize_groups", 1),
+                mixed.get("enabled", False),
+                mixed.get("quantize_change_ratio", 0.001),
+                shared.get("quantization_type", "symmetric"),
+                shared.get("rounding", "nearest"),
+                shared.get("quantize_verbose", False),
+                shared.get("quantizer_kernel", False))
 
     def zero_optimization_stage(self):
         return self.zero_stage

@@ -1,0 +1,356 @@
+"""Mixture-of-Quantization (MoQ): annealed weight quantization for training.
+
+Parity: reference ``deepspeed/runtime/quantize.py:11`` (``Quantizer``) wired
+at ``engine.py:1799`` — after each optimizer step the reference re-quantizes
+the fp16 weight copies in place, annealing per-parameter precision from
+``start_bits`` down to ``target_bits`` (halving-period schedule, optional
+eigenvalue-scaled periods), with an optional fp16/quantized blend
+(``fp16_mixed_quantize``) whose ratio decays each step.
+
+TPU-first redesign: our engine stores only fp32 master params and casts to
+the compute dtype inside the jitted step, so "quantize the fp16 copy after
+step k" becomes "quantize-dequantize the compute-dtype view at cast time in
+step k+1" — mathematically the same weights reach the forward pass, but the
+QDQ is one fused elementwise pass XLA schedules with the cast (no extra HBM
+round-trip, no in-place mutation).  The bit schedule is a pure function of
+the (traced) global step, so a single compiled program covers the whole
+anneal:
+
+* drop thresholds: bit drop ``k`` (1-indexed) happens when
+  ``qsteps >= period * 2**(k-1)`` — the closed form of the reference's
+  ``q_period <<= 1`` on every drop;
+* the mixed-fp16 ratio is ``max(0, 1 - change_ratio * (qsteps - t_last))``
+  where ``t_last`` is the most recent drop threshold — the closed form of
+  the reference's per-step decrement with reset-to-1.0 on each drop.
+
+The eigenvalue-scaled period factor (``factor = 1 + floor(ev * 4)``,
+reference ``quantize.py:71``) is inherently runtime-dynamic, so it is
+supported on the host-driven :meth:`Quantizer.step_quantize` surface (which
+mirrors the reference call signature) rather than inside jit.  The reference
+itself hard-asserts eigenvalue MoQ disabled in config parsing
+(``runtime/config.py:577`` area), so the in-jit path not supporting it drops
+nothing the reference ships.
+
+Config surface (same JSON): ``compression_training.weight_quantization.
+shared_parameters`` — ``quantize_enabled``, ``quantize_weight_in_forward``
+(False → this module owns quantization), ``quantize_groups``,
+``quantization_type`` (symmetric|asymmetric), ``rounding``
+(nearest|stochastic), ``fp16_mixed_quantize.{enabled,quantize_change_ratio}``,
+``schedule_offset``; per-group ``start_bits``/``target_bits``/
+``quantize_period`` in ``different_groups``.
+"""
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _glob_to_regex(pat: str) -> str:
+    if pat == "*":
+        return r".*"
+    return ".*".join(re.escape(p) for p in pat.split("*"))
+
+
+@dataclass
+class MoQSchedule:
+    """Per-parameter anneal plan (reference attaches these as tensor attrs
+    ``start_bits``/``target_bits``/``q_period``)."""
+    start_bits: int
+    target_bits: int
+    period: int              # initial period; doubles on every bit drop
+
+    def thresholds(self) -> List[int]:
+        """Steps at which drops 1..(start-target) fire, closed form."""
+        n = max(0, self.start_bits - self.target_bits)
+        return [self.period * (2 ** (k - 1)) for k in range(1, n + 1)]
+
+    def bits_at(self, qsteps: int) -> int:
+        drops = sum(1 for t in self.thresholds() if qsteps >= t)
+        return max(self.target_bits, self.start_bits - drops)
+
+
+# ---------------------------------------------------------------------------
+# groupwise quantize-dequantize math (jit-traceable; ``bits`` may be traced)
+# ---------------------------------------------------------------------------
+
+def _group_view(x, groups: int):
+    g = math.gcd(int(np.prod(x.shape)), max(1, int(groups)))
+    return x.reshape(g, -1), g
+
+
+def qdq_highbit(x, bits, groups: int = 1, q_type: str = "symmetric",
+                rng=None):
+    """>=3-bit groupwise quantize→dequantize (reference ``quantize_highbit``,
+    ``quantize.py:79``).  ``bits`` may be a traced scalar; ``rng`` enables
+    stochastic rounding (uniform [-0.5, 0.5) dither before round)."""
+    orig_dtype = x.dtype
+    flat, _ = _group_view(x.astype(jnp.float32), groups)
+    q_range = jnp.asarray(2.0, jnp.float32) ** bits
+    p = (jax.random.uniform(rng, flat.shape, jnp.float32, -0.5, 0.5)
+         if rng is not None else 0.0)
+    g_min = flat.min(axis=-1, keepdims=True)
+    g_max = flat.max(axis=-1, keepdims=True)
+    if q_type == "symmetric":
+        scale = 2.0 * jnp.maximum(jnp.abs(g_min), jnp.abs(g_max)) / q_range
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(flat / scale + p),
+                     -q_range / 2, q_range / 2 - 1) * scale
+    elif q_type == "asymmetric":
+        scale = (g_max - g_min) / q_range
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = jnp.round(g_min / scale) * scale
+        q = jnp.clip(jnp.round((flat - zero) / scale + p),
+                     0, q_range - 1) * scale + zero
+    else:
+        raise ValueError(f"unknown quantization_type '{q_type}'")
+    return q.reshape(x.shape).astype(orig_dtype)
+
+
+def qdq_ternary(x, groups: int = 1):
+    """2-bit symmetric ternary {-a, 0, +a} (reference ``quantize_tenary``)."""
+    orig_dtype = x.dtype
+    flat, _ = _group_view(x.astype(jnp.float32), groups)
+    thres = 0.7 * jnp.mean(jnp.abs(flat), axis=-1, keepdims=True)
+    mask = (jnp.abs(flat) > thres).astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(axis=-1, keepdims=True), 1.0)
+    alpha = (mask * jnp.abs(flat)).sum(axis=-1, keepdims=True) / denom
+    q = alpha * jnp.sign(flat) * mask
+    return q.reshape(x.shape).astype(orig_dtype)
+
+
+def qdq_binary(x, groups: int = 1):
+    """1-bit sign * mean|x| (reference ``quantize_binary``)."""
+    orig_dtype = x.dtype
+    flat, _ = _group_view(x.astype(jnp.float32), groups)
+    m = jnp.mean(jnp.abs(flat), axis=-1, keepdims=True)
+    q = jnp.sign(flat) * m
+    return q.reshape(x.shape).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Quantizer
+# ---------------------------------------------------------------------------
+
+class Quantizer:
+    """MoQ controller over a params pytree.
+
+    Reference surface: ``deepspeed/runtime/quantize.py:11``.  Construction
+    args keep the reference names; schedules are attached per-leaf from the
+    ``different_groups`` patterns via :meth:`attach`.
+    """
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.001, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_verbose: bool = False,
+                 q_eigenvalue: bool = False, use_quantizer_kernel: bool = False,
+                 layer_num: int = 0):
+        self.q_groups = max(1, int(q_groups))
+        self.q_mixed_fp16 = bool(q_mixed_fp16)
+        self.q_change_ratio = float(q_change_ratio)
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = bool(q_verbose)
+        self.q_eigenvalue = bool(q_eigenvalue)
+        self.use_quantizer_kernel = bool(use_quantizer_kernel)
+        self.layer_num = layer_num
+        # host-surface state (reference ``qsteps`` / ``quantize_real_ratio``)
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+        self.schedule_offset = 0
+        self.groups_cfg: Optional[List[Dict[str, Any]]] = None
+        # path -> MoQSchedule (static plan) and path -> [bits, period,
+        # last_drop] (host-mutable state for step_quantize)
+        self.schedules: Dict[str, MoQSchedule] = {}
+        self._host_state: Dict[str, List[int]] = {}
+
+    # -- schedule attachment -------------------------------------------
+    def attach(self, params, groups_cfg: Optional[List[Dict[str, Any]]] = None,
+               default_start_bits: int = 16, default_target_bits: int = 8,
+               default_period: int = 1000) -> "Quantizer":
+        """Match >=2-D leaves against ``different_groups`` module patterns
+        (reference: the compression wrapper sets ``start_bits`` etc. on each
+        matched parameter) and record an anneal plan for each."""
+        groups_cfg = groups_cfg or [{"modules": ["*"],
+                                     "start_bits": default_start_bits,
+                                     "target_bits": default_target_bits,
+                                     "quantize_period": default_period}]
+
+        def visit(path, leaf):
+            if np.ndim(leaf) < 2:
+                return leaf
+            key = jax.tree_util.keystr(path)
+            # the reference's ndim>1 test excludes torch's 1-D norm scales;
+            # in our stacked-layers layout norms/embeddings are 2-D ([L, d]),
+            # so the faithful exclusion is by name (same rule as the
+            # inference int8 path, ADVICE r1 finding 3)
+            lkey = key.lower()
+            if "norm" in lkey or "embed" in lkey or lkey.endswith("_b']"):
+                return leaf
+            for g in groups_cfg:
+                pats = g.get("modules", ["*"])
+                if any(re.search(_glob_to_regex(p), key) for p in pats):
+                    sched = MoQSchedule(
+                        start_bits=int(g.get("start_bits",
+                                             default_start_bits)),
+                        target_bits=int(g.get("target_bits",
+                                              default_target_bits)),
+                        period=max(1, int(g.get("quantize_period",
+                                                default_period))),
+                    )
+                    self.schedules[key] = sched
+                    self._host_state[key] = [sched.start_bits, sched.period, 0]
+                    break
+            return leaf
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        if self.q_verbose:
+            logger.info(f"MoQ: attached schedules to "
+                        f"{len(self.schedules)} parameter(s)")
+        return self
+
+    # -- in-jit surface (engine cast-site hook) -------------------------
+    def transform(self, params, step, rng=None, schedule_offset: int = 0):
+        """Quantize-dequantize the compute-dtype view of every scheduled
+        leaf.  ``step`` may be a traced scalar; one compiled program covers
+        warmup (< ``schedule_offset``: identity) and the entire anneal."""
+        step = jnp.asarray(step, jnp.int32)
+        qstep = step - int(schedule_offset)   # anneal clock starts at offset
+        use_sr = self.q_rounding == "stochastic"
+        leaf_keys = sorted(self.schedules)
+        rngs = {}
+        if use_sr and rng is not None:
+            for k, r in zip(leaf_keys,
+                            jax.random.split(rng, max(1, len(leaf_keys)))):
+                rngs[k] = r
+
+        def visit(path, leaf):
+            key = jax.tree_util.keystr(path)
+            sched = self.schedules.get(key)
+            if sched is None or np.ndim(leaf) < 2:
+                return leaf
+            thresholds = sched.thresholds()
+            if thresholds:
+                tarr = jnp.asarray(thresholds, jnp.int32)
+                fired = (qstep >= tarr)
+                drops = fired.sum()
+                t_last = jnp.max(jnp.where(fired, tarr, 0))
+            else:
+                drops = jnp.int32(0)
+                t_last = jnp.int32(0)
+            bits = jnp.maximum(sched.target_bits, sched.start_bits - drops)
+            q = qdq_highbit(leaf, bits, self.q_groups, self.q_type,
+                            rngs.get(key))
+            if sched.target_bits <= 2:
+                # low-bit endgame: select ternary/binary once bits anneal
+                # past 3 (reference compute_quantization dispatch)
+                q = jnp.where(bits >= 3, q,
+                              jnp.where(bits == 2,
+                                        qdq_ternary(leaf, self.q_groups),
+                                        qdq_binary(leaf, self.q_groups)))
+            if self.q_mixed_fp16:
+                ratio = jnp.clip(
+                    1.0 - self.q_change_ratio
+                    * (qstep - t_last).astype(jnp.float32), 0.0, 1.0)
+                blend = (ratio * leaf.astype(jnp.float32)
+                         + (1.0 - ratio) * q.astype(jnp.float32)
+                         ).astype(leaf.dtype)
+                q = jnp.where(bits >= sched.target_bits - 1, blend, q)
+            return jnp.where(qstep >= 0, q, leaf)
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    # -- host-driven surface (reference-shaped; eigenvalue-aware) -------
+    def step(self):
+        self.qsteps += 1
+
+    def update_fp16_ratio(self):
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def step_quantize(self, params, overflow: bool = False,
+                      eigenvalue_enabled: bool = False,
+                      block_eigenvalue: Optional[Dict[str, float]] = None,
+                      rng=None):
+        """Post-step quantization with host-side schedule bookkeeping —
+        the reference ``Quantizer.quantize`` call shape (``quantize.py:48``):
+        skips on overflow (unless eigenvalue-driven), advances ``qsteps``,
+        decays the mixed-fp16 ratio, and — when a drop fires — doubles the
+        period scaled by ``factor = 1 + floor(ev * 4)`` for leaves with a
+        block eigenvalue.  Returns the quantized tree."""
+        if overflow and not eigenvalue_enabled:
+            return params
+        self.step()
+        self.update_fp16_ratio()
+
+        def visit(path, leaf):
+            key = jax.tree_util.keystr(path)
+            st = self._host_state.get(key)
+            if st is None or np.ndim(leaf) < 2:
+                return leaf
+            sched = self.schedules[key]
+            ev = (block_eigenvalue or {}).get(key)
+            factor = 1 + math.floor(ev * 4) if ev is not None else 1
+            if st[0] > sched.target_bits and self.qsteps >= st[1]:
+                st[1] = st[1] * 2 * factor
+                st[0] -= 1
+                self.quantize_real_ratio = 1.0
+                if self.q_verbose:
+                    logger.info(f"MoQ: {key} -> {st[0]} bits at step "
+                                f"{self.qsteps}, next period {st[1]}")
+            bits = st[0]
+            if bits >= 3:
+                q = qdq_highbit(leaf, bits, self.q_groups, self.q_type, rng)
+            elif bits == 2:
+                q = qdq_ternary(leaf, self.q_groups)
+            else:
+                q = qdq_binary(leaf, self.q_groups)
+            if self.q_mixed_fp16 and bits >= sched.target_bits - 1:
+                r = self.quantize_real_ratio
+                q = (r * leaf.astype(jnp.float32)
+                     + (1.0 - r) * q.astype(jnp.float32)).astype(leaf.dtype)
+            return q
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    def any_precision_switch(self) -> bool:
+        """True while any leaf still has bits left to anneal."""
+        return any(st[0] > self.schedules[k].target_bits
+                   for k, st in self._host_state.items())
+
+
+def build_quantizer_from_config(compression_cfg: Dict[str, Any]
+                                ) -> Optional[Quantizer]:
+    """Engine hook: parse ``compression_training.weight_quantization``;
+    returns a Quantizer when MoQ (quantize in step, not in forward) is
+    enabled (reference ``engine._configure_quantization:1407``)."""
+    wq = (compression_cfg or {}).get("weight_quantization", {})
+    shared = wq.get("shared_parameters", {})
+    if not shared.get("quantize_enabled", False):
+        return None
+    if shared.get("quantize_weight_in_forward", False):
+        return None      # compression's in-forward STE path owns it
+    mixed = shared.get("fp16_mixed_quantize", {})
+    q = Quantizer(
+        q_groups=shared.get("quantize_groups", 1),
+        q_mixed_fp16=mixed.get("enabled", False),
+        q_change_ratio=mixed.get("quantize_change_ratio", 0.001),
+        q_type=shared.get("quantization_type", "symmetric"),
+        q_rounding=shared.get("rounding", "nearest"),
+        q_verbose=shared.get("quantize_verbose", False),
+        q_eigenvalue=shared.get("eigenvalue", {}).get("enabled", False),
+        use_quantizer_kernel=shared.get("quantizer_kernel", False),
+    )
+    q.schedule_offset = int(shared.get("schedule_offset", 0))
+    q.groups_cfg = [dict(g, name=name) for name, g in
+                    wq.get("different_groups", {}).items()
+                    for g in [dict(g.get("params", {}),
+                               modules=g.get("modules", ["*"]))]]
+    return q

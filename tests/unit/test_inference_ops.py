@@ -136,6 +136,8 @@ def test_rotary_half_matches_model_rope():
                                       rotate_every_two=False)
     ref_q = np.asarray(_rope(jnp.asarray(q), jnp.asarray(pos), 10000.0))
     np.testing.assert_allclose(np.asarray(q2), ref_q, rtol=1e-4, atol=1e-5)
+    ref_k = np.asarray(_rope(jnp.asarray(k), jnp.asarray(pos), 10000.0))
+    np.testing.assert_allclose(np.asarray(k2), ref_k, rtol=1e-4, atol=1e-5)
 
 
 def test_rotary_interleaved_pairs():

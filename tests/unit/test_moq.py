@@ -1,0 +1,288 @@
+"""MoQ (Mixture-of-Quantization) tests.
+
+Parity model: reference ``deepspeed/runtime/quantize.py`` (Quantizer bit
+anneal / mixed-fp16 blend / ternary-binary endgame) wired at
+``engine.py:1799`` — our engine applies the quantize-dequantize at the
+master→compute cast inside the jitted step (see
+``deepspeed_tpu/runtime/quantize.py`` module docstring).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.quantize import (MoQSchedule, Quantizer,
+                                            build_quantizer_from_config,
+                                            qdq_binary, qdq_highbit,
+                                            qdq_ternary)
+from unit.simple_model import SimpleModel, base_config, random_batch
+
+HIDDEN = 16
+
+
+# ----------------------------------------------------------------------
+# schedule closed form
+# ----------------------------------------------------------------------
+def test_schedule_thresholds_match_period_doubling():
+    # reference: drop when qsteps >= q_period, then q_period <<= 1
+    s = MoQSchedule(start_bits=12, target_bits=8, period=50)
+    assert s.thresholds() == [50, 100, 200, 400]
+    assert s.bits_at(0) == 12
+    assert s.bits_at(49) == 12
+    assert s.bits_at(50) == 11
+    assert s.bits_at(199) == 10
+    assert s.bits_at(200) == 9
+    assert s.bits_at(400) == 8
+    assert s.bits_at(10_000) == 8      # clamped at target
+
+
+def test_host_step_quantize_matches_schedule():
+    q = Quantizer(q_groups=1, q_type="symmetric")
+    params = {"w": jnp.asarray(np.random.default_rng(0).normal(
+        size=(8, 8)), jnp.float32)}
+    q.attach(params, [{"modules": ["*"], "start_bits": 6, "target_bits": 4,
+                       "quantize_period": 3}])
+    key = next(iter(q.schedules))
+    assert q.schedules[key].start_bits == 6
+    for _ in range(3):                 # qsteps reaches 3 → first drop
+        params_q = q.step_quantize(params)
+    assert q._host_state[key][0] == 5
+    assert q._host_state[key][1] == 6  # period doubled
+    # 5-bit symmetric: at most 32 distinct values
+    assert len(np.unique(np.asarray(params_q["w"]))) <= 32
+
+
+def test_eigenvalue_factor_scales_period():
+    q = Quantizer()
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    q.attach(params, [{"modules": ["*"], "start_bits": 8, "target_bits": 4,
+                       "quantize_period": 1}])
+    key = next(iter(q.schedules))
+    # factor = 1 + floor(ev*4) = 3 with ev=0.6 → period = 1*2*3 = 6
+    q.step_quantize(params, block_eigenvalue={key: 0.6})
+    assert q._host_state[key][1] == 6
+    assert q._host_state[key][0] == 7
+
+
+def test_overflow_skips_quantization():
+    q = Quantizer()
+    params = {"w": jnp.asarray(np.random.default_rng(1).normal(
+        size=(8, 8)), jnp.float32)}
+    q.attach(params, None)
+    out = q.step_quantize(params, overflow=True)
+    assert q.qsteps == 0
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(params["w"]))
+
+
+# ----------------------------------------------------------------------
+# quantization math
+# ----------------------------------------------------------------------
+def test_qdq_highbit_symmetric_grid():
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(4, 64)),
+                    jnp.float32)
+    q = np.asarray(qdq_highbit(w, bits=4, groups=4, q_type="symmetric"))
+    for g in q.reshape(4, -1):
+        assert len(np.unique(np.round(g, 6))) <= 16
+    # error bounded by one quantum per group (the extreme positive value is
+    # clipped to q_range/2 - 1, reference quantize_highbit semantics)
+    for row_w, row_q in zip(np.asarray(w).reshape(4, -1), q.reshape(4, -1)):
+        quantum = 2 * np.abs(row_w).max() / 16
+        assert np.abs(row_w - row_q).max() <= quantum + 1e-6
+
+
+def test_qdq_highbit_asymmetric_range():
+    w = jnp.asarray(np.linspace(0.0, 1.0, 128).reshape(2, 64), jnp.float32)
+    q = np.asarray(qdq_highbit(w, bits=8, groups=1, q_type="asymmetric"))
+    assert abs(q.min() - 0.0) < 1e-2 and abs(q.max() - 1.0) < 1e-2
+
+
+def test_qdq_highbit_traced_bits():
+    # bits as a traced scalar inside jit (the engine's anneal path)
+    w = jnp.asarray(np.random.default_rng(3).normal(size=(8, 8)),
+                    jnp.float32)
+    f = jax.jit(lambda x, b: qdq_highbit(x, b, 1, "symmetric"))
+    q8 = np.asarray(f(w, jnp.int32(8)))
+    q2e = np.asarray(f(w, jnp.int32(3)))
+    assert len(np.unique(q2e)) <= 8
+    assert np.abs(q8 - np.asarray(w)).max() < np.abs(
+        q2e - np.asarray(w)).max()
+
+
+def test_qdq_ternary_three_levels():
+    w = jnp.asarray(np.random.default_rng(4).normal(size=(1, 256)),
+                    jnp.float32)
+    q = np.asarray(qdq_ternary(w, groups=1))
+    levels = np.unique(q)
+    assert len(levels) <= 3
+    assert (levels >= 0).sum() >= 1 and np.allclose(levels, -levels[::-1])
+
+
+def test_qdq_binary_two_levels():
+    w = jnp.asarray(np.random.default_rng(5).normal(size=(1, 256)),
+                    jnp.float32)
+    q = np.asarray(qdq_binary(w, groups=1))
+    levels = np.unique(np.abs(q))
+    assert len(levels) == 1
+    np.testing.assert_allclose(levels[0], np.abs(np.asarray(w)).mean(),
+                               rtol=1e-5)
+
+
+def test_stochastic_rounding_unbiased():
+    # E[QDQ_sr(x)] ≈ x, unlike nearest rounding which is deterministic
+    w = jnp.full((1, 128), 0.3, jnp.float32)
+    w = w.at[0, 0].set(1.0)            # pin the scale
+    outs = [np.asarray(qdq_highbit(w, 3, 1, "symmetric",
+                                   rng=jax.random.key(i)))[0, 1]
+            for i in range(200)]
+    assert np.asarray(outs).std() > 0          # actually stochastic
+    assert abs(np.mean(outs) - 0.3) < 0.02     # and unbiased
+
+
+# ----------------------------------------------------------------------
+# in-jit transform (the engine path)
+# ----------------------------------------------------------------------
+def test_transform_anneals_with_traced_step():
+    rng = np.random.default_rng(6)
+    params = {"layer": {"w": jnp.asarray(rng.normal(size=(16, 16)),
+                                         jnp.float32),
+                        "b": jnp.zeros((16,), jnp.float32)}}
+    q = Quantizer(q_groups=2)
+    q.attach(params, [{"modules": ["*"], "start_bits": 8, "target_bits": 4,
+                       "quantize_period": 10}])
+    f = jax.jit(lambda p, s: q.transform(p, s))
+    w = np.asarray(params["layer"]["w"])
+
+    def n_levels(step):
+        out = np.asarray(f(params, jnp.int32(step))["layer"]["w"])
+        return max(len(np.unique(np.round(g, 6)))
+                   for g in out.reshape(2, -1))
+
+    assert n_levels(0) <= 256 and n_levels(0) > 16
+    assert n_levels(10) <= 128          # first drop at qstep 10
+    assert n_levels(70) <= 32           # three drops (thresholds 10/20/40)
+    assert n_levels(80) <= 16           # fully annealed at threshold 80
+    # 1-D leaves are untouched
+    out = f(params, jnp.int32(70))
+    np.testing.assert_array_equal(np.asarray(out["layer"]["b"]), 0.0)
+
+
+def test_engine_ste_gradients_flow_through_qdq():
+    """The engine wraps Q(w) as w + stop_grad(Q(w)-w): grads must be the
+    identity backward of the quantized forward, never round()'s zero."""
+    params = {"w": jnp.asarray(np.random.default_rng(9).normal(
+        size=(8, 8)), jnp.float32)}
+    q = Quantizer()
+    q.attach(params, [{"modules": ["*"], "start_bits": 4, "target_bits": 4,
+                       "quantize_period": 100}])
+
+    def loss(p):
+        qp = q.transform(p, 50)
+        ste = jax.tree_util.tree_map(
+            lambda x, qq: x + jax.lax.stop_gradient(qq - x), p, qp)
+        return jnp.sum(ste["w"] ** 2)
+
+    g = jax.grad(loss)(params)["w"]
+    # without STE this gradient is exactly 0 almost everywhere
+    assert np.count_nonzero(np.asarray(g)) > 50
+    qw = q.transform(params, 50)["w"]
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(qw), atol=1e-6)
+
+
+def test_transform_schedule_offset_gates():
+    params = {"w": jnp.asarray(np.random.default_rng(7).normal(
+        size=(8, 8)), jnp.float32)}
+    q = Quantizer()
+    q.attach(params, [{"modules": ["*"], "start_bits": 4, "target_bits": 4,
+                       "quantize_period": 100}])
+    before = np.asarray(q.transform(params, 5, schedule_offset=10)["w"])
+    np.testing.assert_array_equal(before, np.asarray(params["w"]))
+    after = np.asarray(q.transform(params, 10, schedule_offset=10)["w"])
+    assert len(np.unique(after)) <= 16
+
+
+def test_transform_mixed_fp16_blend_decays():
+    params = {"w": jnp.asarray(np.random.default_rng(8).normal(
+        size=(8, 8)), jnp.float32)}
+    q = Quantizer(q_mixed_fp16=True, q_change_ratio=0.01)
+    q.attach(params, [{"modules": ["*"], "start_bits": 4, "target_bits": 4,
+                       "quantize_period": 10_000}])
+    w = np.asarray(params["w"])
+    full_q = np.asarray(Quantizer().attach(
+        params, [{"modules": ["*"], "start_bits": 4, "target_bits": 4,
+                  "quantize_period": 10_000}]).transform(params, 0)["w"])
+    at0 = np.asarray(q.transform(params, 0)["w"])      # ratio 1 → identity
+    np.testing.assert_allclose(at0, w, atol=1e-6)
+    at50 = np.asarray(q.transform(params, 50)["w"])    # ratio 0.5
+    np.testing.assert_allclose(at50, 0.5 * w + 0.5 * full_q, atol=1e-5)
+    at200 = np.asarray(q.transform(params, 200)["w"])  # ratio 0 → full QDQ
+    np.testing.assert_allclose(at200, full_q, atol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# config + engine integration
+# ----------------------------------------------------------------------
+def _moq_config(**shared_over):
+    shared = {"quantize_enabled": True,
+              "quantize_weight_in_forward": False,
+              "quantize_groups": 2,
+              "quantization_type": "symmetric",
+              "rounding": "nearest",
+              "schedule_offset": 2}
+    shared.update(shared_over)
+    return {"compression_training": {"weight_quantization": {
+        "shared_parameters": shared,
+        "different_groups": {
+            "g0": {"params": {"start_bits": 8, "target_bits": 4,
+                              "quantize_period": 5},
+                   "modules": ["layer_*"]},
+        }}}}
+
+
+def test_build_quantizer_from_config():
+    cfg = _moq_config()["compression_training"]
+    q = build_quantizer_from_config(cfg)
+    assert q is not None and q.q_groups == 2 and q.schedule_offset == 2
+    assert q.groups_cfg and q.groups_cfg[0]["start_bits"] == 8
+    # in-forward mode → compression owns it, no MoQ quantizer
+    cfg_fwd = _moq_config(quantize_weight_in_forward=True)[
+        "compression_training"]
+    assert build_quantizer_from_config(cfg_fwd) is None
+
+
+def test_engine_moq_trains_and_quantizes():
+    model = SimpleModel(HIDDEN)
+    cfg = base_config(stage=0, **_moq_config())
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config=cfg)
+    assert engine.quantizer is not None
+    assert len(engine.quantizer.schedules) == 2      # two layer_* weights
+    in_fwd, enabled, groups, *_rest = engine.quantize_training()
+    assert enabled and not in_fwd and groups == 2
+    losses = [float(engine.train_batch(batch=random_batch(32, HIDDEN, seed=s)))
+              for s in range(8)]
+    assert all(np.isfinite(losses))
+    # the forward view of the weights is on the quantization grid now
+    view = engine.quantizer.transform(engine.state.params,
+                                      engine.global_steps,
+                                      schedule_offset=2)
+    for name in ("layer_0", "layer_1"):
+        w = np.asarray(view[name]["w"], np.float32)
+        for g in w.reshape(2, -1):
+            assert len(np.unique(np.round(g, 5))) <= 256
+    # loss still falls under quantized training
+    assert losses[-1] < losses[0]
+
+
+def test_engine_moq_excludes_weight_quant_from_compression():
+    model = SimpleModel(HIDDEN)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config=base_config(stage=0, **_moq_config()))
+    # MoQ owns weight quantization → no in-forward compression group left
+    assert engine._compression is None or all(
+        g.method != "weight_quantization"
+        for g in engine._compression.groups)
