@@ -804,6 +804,15 @@ class DeepSpeedEngine:
                     lambda x: x.astype(self.compute_dtype)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x,
                     state.params)
+                # eval must see the same weights the training forward sees
+                # (reference quantizes the fp16 copies in place, so its eval
+                # path is quantized/compressed too)
+                if self._compression is not None:
+                    p_c = self._compression.transform(p_c, state.global_step)
+                if self.quantizer is not None:
+                    p_c = self.quantizer.transform(
+                        p_c, state.global_step,
+                        schedule_offset=self.quantizer.schedule_offset)
                 return self.loss_fn(p_c, batch, state.rng)
             self._compiled_eval = jax.jit(ev)
         batch = self._prep_eval_batch(batch)
@@ -935,25 +944,17 @@ class DeepSpeedEngine:
         enabled, groups, fp16_mixed, change_ratio, type, rounding, verbose,
         kernel).  Reads the live Quantizer so the report can't drift from
         what actually runs."""
+        from deepspeed_tpu.runtime.quantize import quantizer_from_shared
         wq = (self._config.compression_config or {}).get(
             "weight_quantization", {})
         shared = wq.get("shared_parameters", {})
         in_forward = shared.get("quantize_weight_in_forward", False)
-        enabled = shared.get("quantize_enabled", False)
-        q = self.quantizer
-        if q is not None:
-            return (in_forward, enabled, q.q_groups, q.q_mixed_fp16,
-                    q.q_change_ratio, q.q_type, q.q_rounding, q.q_verbose,
-                    q.use_quantizer_kernel)
-        mixed = shared.get("fp16_mixed_quantize", {})
-        return (in_forward, enabled,
-                shared.get("quantize_groups", 1),
-                mixed.get("enabled", False),
-                mixed.get("quantize_change_ratio", 0.001),
-                shared.get("quantization_type", "symmetric"),
-                shared.get("rounding", "nearest"),
-                shared.get("quantize_verbose", False),
-                shared.get("quantizer_kernel", False))
+        enabled = bool(shared.get("enabled",
+                                  shared.get("quantize_enabled", False)))
+        q = self.quantizer or quantizer_from_shared(shared)
+        return (in_forward, enabled, q.q_groups, q.q_mixed_fp16,
+                q.q_change_ratio, q.q_type, q.q_rounding, q.q_verbose,
+                q.use_quantizer_kernel)
 
     def zero_optimization_stage(self):
         return self.zero_stage

@@ -49,13 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.compression.compress import _glob_to_regex
 from deepspeed_tpu.utils.logging import logger
-
-
-def _glob_to_regex(pat: str) -> str:
-    if pat == "*":
-        return r".*"
-    return ".*".join(re.escape(p) for p in pat.split("*"))
 
 
 @dataclass
@@ -333,10 +328,26 @@ def build_quantizer_from_config(compression_cfg: Dict[str, Any]
     enabled (reference ``engine._configure_quantization:1407``)."""
     wq = (compression_cfg or {}).get("weight_quantization", {})
     shared = wq.get("shared_parameters", {})
-    if not shared.get("quantize_enabled", False):
+    # reference spelling is "enabled" (WEIGHT_QUANTIZE_ENABLED =
+    # TECHNIQUE_ENABLED, compression/constants.py:10); accept
+    # "quantize_enabled" as a lenient alias
+    if not (shared.get("enabled", False)
+            or shared.get("quantize_enabled", False)):
         return None
     if shared.get("quantize_weight_in_forward", False):
         return None      # compression's in-forward STE path owns it
+    q = quantizer_from_shared(shared)
+    q.groups_cfg = [dict(g, name=name) for name, g in
+                    wq.get("different_groups", {}).items()
+                    for g in [dict(g.get("params", {}),
+                               modules=g.get("modules", ["*"]))]]
+    return q
+
+
+def quantizer_from_shared(shared: Dict[str, Any]) -> Quantizer:
+    """The single place the ``shared_parameters`` keys/defaults are read
+    (both the live builder and ``engine.quantize_training()`` use it, so the
+    two can't drift)."""
     mixed = shared.get("fp16_mixed_quantize", {})
     q = Quantizer(
         q_groups=shared.get("quantize_groups", 1),
@@ -349,8 +360,4 @@ def build_quantizer_from_config(compression_cfg: Dict[str, Any]
         use_quantizer_kernel=shared.get("quantizer_kernel", False),
     )
     q.schedule_offset = int(shared.get("schedule_offset", 0))
-    q.groups_cfg = [dict(g, name=name) for name, g in
-                    wq.get("different_groups", {}).items()
-                    for g in [dict(g.get("params", {}),
-                               modules=g.get("modules", ["*"]))]]
     return q

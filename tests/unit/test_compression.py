@@ -139,7 +139,11 @@ def test_engine_compressed_training():
         model=model, model_parameters=params,
         config=base_config(compression_training={
             "weight_quantization": {
+                # in-forward STE path (reference semantics: in_forward=False
+                # routes weight quantization to the step-time MoQ quantizer
+                # instead — covered by tests/unit/test_moq.py)
                 "shared_parameters": {"enabled": True,
+                                      "quantize_weight_in_forward": True,
                                       "schedule_offset": 1},
                 "different_groups": {
                     "all": {"params": {"target_bits": 8},

@@ -225,7 +225,9 @@ def test_transform_mixed_fp16_blend_decays():
 # config + engine integration
 # ----------------------------------------------------------------------
 def _moq_config(**shared_over):
-    shared = {"quantize_enabled": True,
+    # reference spelling: "enabled" (WEIGHT_QUANTIZE_ENABLED =
+    # TECHNIQUE_ENABLED, compression/constants.py:10)
+    shared = {"enabled": True,
               "quantize_weight_in_forward": False,
               "quantize_groups": 2,
               "quantization_type": "symmetric",
@@ -250,6 +252,31 @@ def test_build_quantizer_from_config():
     cfg_fwd = _moq_config(quantize_weight_in_forward=True)[
         "compression_training"]
     assert build_quantizer_from_config(cfg_fwd) is None
+    # the "quantize_enabled" alias spelling also works
+    cfg_alias = _moq_config()["compression_training"]
+    sp = cfg_alias["weight_quantization"]["shared_parameters"]
+    sp["quantize_enabled"] = sp.pop("enabled")
+    assert build_quantizer_from_config(cfg_alias) is not None
+
+
+def test_eval_batch_sees_quantized_weights():
+    """Parity: the reference quantizes the fp16 copies in place, so eval
+    runs on the same quantized weights as training forward."""
+    model = SimpleModel(HIDDEN)
+    cfg = base_config(stage=0, **_moq_config(schedule_offset=0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config=cfg)
+    batch = random_batch(32, HIDDEN, seed=3)
+    got = float(engine.eval_batch(batch))
+    p_c = jax.tree_util.tree_map(
+        lambda x: x.astype(engine.compute_dtype), engine.state.params)
+    qp = engine.quantizer.transform(p_c, engine.state.global_step,
+                                    schedule_offset=0)
+    want_q = float(model.loss(qp, engine._shard_batch(batch)))
+    want_fp = float(model.loss(p_c, engine._shard_batch(batch)))
+    assert abs(got - want_q) < 1e-5
+    assert abs(want_q - want_fp) > 1e-7   # quantization actually visible
 
 
 def test_engine_moq_trains_and_quantizes():
