@@ -949,8 +949,8 @@ class DeepSpeedEngine:
             "weight_quantization", {})
         shared = wq.get("shared_parameters", {})
         in_forward = shared.get("quantize_weight_in_forward", False)
-        enabled = bool(shared.get("enabled",
-                                  shared.get("quantize_enabled", False)))
+        enabled = bool(shared.get("enabled", False)
+                       or shared.get("quantize_enabled", False))
         q = self.quantizer or quantizer_from_shared(shared)
         return (in_forward, enabled, q.q_groups, q.q_mixed_fp16,
                 q.q_change_ratio, q.q_type, q.q_rounding, q.q_verbose,

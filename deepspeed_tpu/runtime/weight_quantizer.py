@@ -81,9 +81,15 @@ class WeightQuantization:
         q_scales = []
         for i, data in enumerate(value_list):
             q, scale = self.quantize_data(data, quantize_bits, groups, key)
-            q_scales.append(scale)
+            q_scales.append(scale.reshape(-1))
             value_list[i] = q
-        inv = 1.0 / np.concatenate(q_scales, axis=merge_dim).reshape(1, -1)
+        stacked = np.stack(q_scales)            # [shards, G]
+        if merge_dim == 1:
+            # row-parallel merges: the merged weight interleaves shards
+            # within each group span, so scales order group-major
+            # (reference cat(dim=1) on (G,1) scales)
+            stacked = stacked.T
+        inv = 1.0 / stacked.reshape(1, -1)
         if any(p in key for p in MLP_4HH_PATTERNS):
             self.mlp4hh_scales.append(inv)
         elif any(p in key for p in MLP_H4H_PATTERNS):
@@ -122,13 +128,15 @@ class WeightQuantization:
             parts = [np.array_split(s.reshape(-1), split_count)
                      for s in (qkv, dense, h4h, fhh)]
             for r in range(split_count):
-                qkv_r, dense_r, h4h_r, fhh_r = (p[r][None] for p in parts)
-                # qkv/dense have half the MLP group count: zero-pad so the
+                rows = [p[r][None] for p in parts]
+                # zero-pad narrower categories (qkv/dense when
+                # mlp_extra_grouping doubled the MLP group count) so the
                 # per-rank block is rectangular (reference merge_scales_split)
-                out[r].append(np.concatenate([
-                    np.concatenate([qkv_r, np.zeros_like(qkv_r)], axis=1),
-                    np.concatenate([dense_r, np.zeros_like(dense_r)], axis=1),
-                    h4h_r, fhh_r], axis=0))
+                width = max(x.shape[1] for x in rows)
+                rows = [np.concatenate(
+                    [x, np.zeros((1, width - x.shape[1]), x.dtype)], axis=1)
+                    if x.shape[1] < width else x for x in rows]
+                out[r].append(np.concatenate(rows, axis=0))
         return out
 
     # -- Megatron state-dict surface (reference :112) ------------------
@@ -145,9 +153,11 @@ class WeightQuantization:
         return sd, self.merge_scales()
 
     # -- pytree surface (reference model_quantize :124) ----------------
-    # our model layout: per-layer stacked weights; category by leaf name
-    _QKV_NAMES = ("wq", "wk", "wv", "qkv")
-    _DENSE_NAMES = ("wo", "dense")
+    # our model layout: per-layer stacked weights; category by leaf name.
+    # fused-QKV leaves get 3x groups (reference: BERT qkv, Q/K/V magnitude
+    # ranges differ so one scale across them is ~3x coarser); the separate
+    # wq/wk/wv leaves of our layout don't need it.
+    _QKV_NAMES = ("qkv", "query_key_value")
     _MLP_NAMES = ("w_up", "w_gate", "w_down", "h_to_4h", "4h_to_h",
                   "fc_in", "fc_out")
 
@@ -172,6 +182,9 @@ class WeightQuantization:
                     if re.search(pat, key):
                         return groups * int(mult)
             per_layer = leaf[0] if np.ndim(leaf) >= 3 else leaf
+            if any(n in name for n in self._QKV_NAMES) \
+                    or self.is_qkv(per_layer):
+                return groups * 3
             if self.mlp_extra_grouping and (
                     any(n in name for n in self._MLP_NAMES)
                     or self.is_mlp(per_layer)):

@@ -145,6 +145,54 @@ def test_model_quantize_pytree_emits_qleaf_records():
     assert np.abs(deq - params["lm_head"]).max() < 0.1
 
 
+def test_merge_scales_split_equal_widths_no_extra_grouping():
+    """mlp_extra_grouping=False → all categories same width; split must
+    not assume qkv/dense are narrower."""
+    rng = np.random.default_rng(5)
+    wq = WeightQuantization(mlp_extra_grouping=False)
+    wq.Quantize([rng.normal(size=(3 * H, H)).astype(np.float32)], 8, 4,
+                key="h.0.attention.query_key_value.weight")
+    wq.Quantize([rng.normal(size=(H, H)).astype(np.float32)], 8, 4,
+                key="h.0.attention.dense.weight")
+    wq.Quantize([rng.normal(size=(4 * H, H)).astype(np.float32)], 8, 4,
+                key="h.0.mlp.dense_h_to_4h.weight")
+    wq.Quantize([rng.normal(size=(H, 4 * H)).astype(np.float32)], 8, 4,
+                key="h.0.mlp.dense_4h_to_h.weight")
+    ranks = wq.merge_scales_split(2)
+    assert len(ranks) == 2
+    assert ranks[0][0].shape == (4, 2)     # 4 categories x half of 4 groups
+
+
+def test_quantize_merge_dim_interleaves_scales():
+    """merge_dim=1 (row-parallel merges): merged weight columns interleave
+    shards within each group span, so scales must order group-major."""
+    a = np.full((2, 4), 1.0, np.float32)   # shard scales will differ
+    b = np.full((2, 4), 4.0, np.float32)
+    wq0 = WeightQuantization(mlp_extra_grouping=False)
+    wq0.Quantize([a.copy(), b.copy()], 8, 2, key="x.attention.dense.weight",
+                 merge_dim=1)
+    row_major = 1.0 / wq0.dense_scales[0].reshape(-1)
+    wq1 = WeightQuantization(mlp_extra_grouping=False)
+    wq1.Quantize([a.copy(), b.copy()], 8, 2, key="y.attention.dense.weight",
+                 merge_dim=0)
+    shard_major = 1.0 / wq1.dense_scales[0].reshape(-1)
+    # same multiset, different order: [s0g0, s1g0, s0g1, s1g1] vs
+    # [s0g0, s0g1, s1g0, s1g1]
+    np.testing.assert_allclose(sorted(row_major), sorted(shard_major))
+    assert row_major[1] == shard_major[2]
+    assert row_major[1] != row_major[2] or row_major[0] != row_major[1]
+
+
+def test_model_quantize_qkv_triple_groups():
+    rng = np.random.default_rng(6)
+    params = {"qkv": rng.normal(size=(3 * H, H)).astype(np.float32),
+              "wo": rng.normal(size=(H, H)).astype(np.float32)}
+    wq = WeightQuantization(mlp_extra_grouping=False)
+    qp, _ = wq.model_quantize(params, quantize_bits=8, groups=2)
+    assert np.asarray(qp["qkv"]["qs"]).size == 6    # 3x for fused QKV
+    assert np.asarray(qp["wo"]["qs"]).size == 2
+
+
 def test_model_quantize_policy_override():
     rng = np.random.default_rng(4)
     params = {"special": rng.normal(size=(H, H)).astype(np.float32)}
