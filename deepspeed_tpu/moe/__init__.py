@@ -1,3 +1,10 @@
 from deepspeed_tpu.moe.layer import MoE
 from deepspeed_tpu.moe.sharded_moe import (TopKGate, top1gating, top2gating,
                                            moe_layer_forward)
+from deepspeed_tpu.moe.experts import Experts
+from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
+from deepspeed_tpu.moe.utils import (
+    has_moe_layers, is_moe_param,
+    split_params_grads_into_shared_and_expert_params,
+    split_params_into_different_moe_groups_for_optimizer,
+    split_params_into_shared_and_expert_params)

@@ -1,0 +1,54 @@
+"""Local expert bank.
+
+Parity: reference ``deepspeed/moe/experts.py`` — ``Experts`` holds
+``num_local_experts`` copies of an expert module and runs each on its chunk
+of the dispatched tokens, tagging every expert parameter with
+``allreduce=False`` / ``group_name`` so the engine reduces them over the
+expert-data-parallel group instead of the full DP group.
+
+TPU redesign: instead of a ModuleList loop (a trace-unrolled Python loop),
+the bank stores experts as ONE stacked pytree (leading ``[E_local, ...]``
+axis) and evaluates all of them with ``jax.vmap`` — one XLA program, batched
+matmuls on the MXU.  The reference's param tagging becomes a pytree-path
+property: everything under the ``"experts"`` key is an expert param (see
+``moe.utils.is_moe_param``), which is also how the engine's sharding plan
+assigns the ``ep`` axis.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class Experts:
+    """Stacked expert bank (reference ``Experts``, ``experts.py:9``)."""
+
+    def __init__(self, expert_init: Callable[[jax.Array], Any],
+                 expert_apply: Callable[[Any, jax.Array], jax.Array],
+                 num_local_experts: int = 1,
+                 expert_group_name: Optional[str] = None):
+        """``expert_init(rng) -> params`` builds ONE expert's params;
+        ``expert_apply(params, x) -> y`` runs one expert.  The bank stacks
+        ``num_local_experts`` independent inits."""
+        self.expert_init = expert_init
+        self.expert_apply = expert_apply
+        self.num_local_experts = int(num_local_experts)
+        self.expert_group_name = expert_group_name
+
+    def init(self, rng) -> Any:
+        keys = jax.random.split(rng, self.num_local_experts)
+        per_expert = [self.expert_init(k) for k in keys]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_expert)
+        return {"experts": stacked}
+
+    def __call__(self, params, inputs: jax.Array) -> jax.Array:
+        """``inputs``: [..., E_local, capacity, d] with the expert axis at
+        -3 (the reference chunks dim=1; our dispatch already groups tokens
+        per expert).  Returns the same shape."""
+        bank = params["experts"]
+        e_axis = inputs.ndim - 3
+        chunks = jnp.moveaxis(inputs, e_axis, 0)
+        out = jax.vmap(self.expert_apply)(bank, chunks)
+        return jnp.moveaxis(out, 0, e_axis)

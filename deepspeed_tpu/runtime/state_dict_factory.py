@@ -240,7 +240,6 @@ class MegatronSDLoader(SDLoaderBase):
         then re-stack Q|K|V.  1.0/2.0 store rank-contiguous rows: concat."""
         if ckpt_ver == 0:
             assert param_list[0].shape[0] % 3 == 0
-            size_qkv = param_list[0].shape[0] // 3
             blocks = [np.split(np.asarray(p), 3, axis=0) for p in param_list]
             return np.concatenate(
                 [np.concatenate([b[i] for b in blocks], axis=0)
@@ -289,9 +288,13 @@ class MegatronSDLoader(SDLoaderBase):
                 new_sd[key] = np.concatenate(
                     [np.asarray(v) for v in values], axis=1)
             elif QKV_KEY in key:
-                if quantize and key.endswith("weight"):
-                    values = quantizer.Quantize(values, quantize_bits,
-                                                groups, key=key)
+                if quantize:
+                    # quantized path plain-cats BOTH weight and bias
+                    # (reference merge_state_dict) so their row layouts
+                    # stay aligned even for v0 checkpoints
+                    if key.endswith("weight"):
+                        values = quantizer.Quantize(values, quantize_bits,
+                                                    groups, key=key)
                     new_sd[key] = np.concatenate(
                         [np.asarray(v) for v in values], axis=0)
                 else:

@@ -182,12 +182,14 @@ class WeightQuantization:
                     if re.search(pat, key):
                         return groups * int(mult)
             per_layer = leaf[0] if np.ndim(leaf) >= 3 else leaf
+            # explicit names win over shape heuristics: a 3x-FFN w_up must
+            # stay in the MLP category even though its ratio matches is_qkv
+            if any(n in name for n in self._MLP_NAMES):
+                return groups * 2 if self.mlp_extra_grouping else groups
             if any(n in name for n in self._QKV_NAMES) \
                     or self.is_qkv(per_layer):
                 return groups * 3
-            if self.mlp_extra_grouping and (
-                    any(n in name for n in self._MLP_NAMES)
-                    or self.is_mlp(per_layer)):
+            if self.mlp_extra_grouping and self.is_mlp(per_layer):
                 return groups * 2
             return groups
 
