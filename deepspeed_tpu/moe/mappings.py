@@ -36,6 +36,9 @@ def _gather(x, dim):
 def _drop(x, dim):
     rank = jax.lax.axis_index("tp")
     size = jax.lax.axis_size("tp")
+    assert x.shape[dim] % size == 0, (
+        f"drop_tokens: dimension {dim} ({x.shape[dim]}) is not divisible "
+        f"by tensor parallel world size ({size})")
     chunk = x.shape[dim] // size
     return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
 

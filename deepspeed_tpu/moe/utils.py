@@ -24,6 +24,10 @@ import numpy as np
 # expert subtrees in this repo: moe.layer.MoE uses "experts", the
 # transformer's fused MoE blocks use "moe" (models/transformer.py:340)
 _EXPERT_PATH_RE = re.compile(r"\['(experts|moe)'\]|(^|\.)(experts|moe)(\.|$)")
+# the router gate is a SHARED param (reduced over full DP, replicated by the
+# sharding plan — transformer tp_rules: "moe.*wg" -> P()) even though it
+# lives under the moe subtree
+_GATE_LEAF_RE = re.compile(r"\['(wg|gate|router)(_b)?'\]|(^|\.)(wg|gate|router)(_b)?($|\.)")
 
 
 def has_moe_layers(model_or_params) -> Tuple[bool, int]:
@@ -45,7 +49,11 @@ def has_moe_layers(model_or_params) -> Tuple[bool, int]:
     moe_paths = [p for p in paths if is_moe_param(p)]
     if not moe_paths:
         return False, 0
-    # expert count = leading axis of any stacked expert leaf
+    # expert count = leading axis of a stacked expert WEIGHT ([E, in, out],
+    # ndim>=3); gate/bias leaves under the moe subtree don't carry it
+    for (p, leaf) in jax.tree_util.tree_leaves_with_path(model_or_params):
+        if is_moe_param(jax.tree_util.keystr(p)) and np.ndim(leaf) >= 3:
+            return True, int(np.shape(leaf)[0])
     for (p, leaf) in jax.tree_util.tree_leaves_with_path(model_or_params):
         if is_moe_param(jax.tree_util.keystr(p)) and np.ndim(leaf) >= 1:
             return True, int(np.shape(leaf)[0])
@@ -57,7 +65,8 @@ def is_moe_param(path_or_key) -> bool:
     ``utils.py:20``)."""
     key = path_or_key if isinstance(path_or_key, str) \
         else jax.tree_util.keystr(path_or_key)
-    return _EXPERT_PATH_RE.search(key) is not None
+    return (_EXPERT_PATH_RE.search(key) is not None
+            and _GATE_LEAF_RE.search(key) is None)
 
 
 def split_params_into_shared_and_expert_params(params):

@@ -1,0 +1,5 @@
+"""Parity: reference ``deepspeed/utils/exceptions.py``."""
+
+
+class DeprecatedException(Exception):
+    pass

@@ -1,0 +1,124 @@
+"""Debug access to full fp32 master params / optimizer state / grads.
+
+Parity: reference ``deepspeed/utils/tensor_fragment.py`` — the
+``safe_get_full_fp32_param`` / ``safe_get_full_optimizer_state`` /
+``safe_get_full_grad`` user API that reads a ZeRO-partitioned parameter's
+full high-precision value during training (the reference reassembles it
+from per-rank ``tensor_fragment`` records linked onto each lp param by
+``mixed_precision_linkage.py``).
+
+TPU redesign: no fragment bookkeeping exists to mirror — the fp32 master
+is ``engine.state.params`` (sharded over the mesh by XLA), so "gather the
+fragments" is just a ``jax.device_get`` of the addressable global array.
+The functions take ``(engine, path)`` instead of a tagged tensor: paths
+are pytree paths (``("layers", "wq")`` tuples or ``"layers.wq"`` strings).
+Grads are transient in the fused jitted step, so ``safe_get_full_grad``
+returns the most recent step's gradients only when the engine ran a path
+that keeps them (the 3-call ``forward/backward/step`` API or the offload
+step) — otherwise None, matching the reference's None for
+not-yet-available grads.
+"""
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+PathLike = Union[str, Sequence[Any]]
+
+
+def _walk(tree, path: PathLike):
+    if tree is None:
+        return None
+    if isinstance(path, str):
+        parts = [p for p in path.replace("]", "").replace("[", ".")
+                 .replace("'", "").split(".") if p]
+    else:
+        parts = list(path)
+    node = tree
+    for p in parts:
+        if node is None:
+            return None
+        if isinstance(node, (list, tuple)):
+            node = node[int(p)]
+            continue
+        if isinstance(node, dict):
+            if p in node:
+                node = node[p]
+                continue
+            try:
+                node = node[int(p)]
+                continue
+            except (ValueError, KeyError, TypeError):
+                return None
+        else:
+            node = getattr(node, str(p), None)
+    return node
+
+
+def _to_host(x) -> Optional[np.ndarray]:
+    if x is None:
+        return None
+    return np.asarray(jax.device_get(x), np.float32)
+
+
+def safe_get_full_fp32_param(engine, path: PathLike) -> Optional[np.ndarray]:
+    """Full fp32 master value of the parameter at ``path`` (reference
+    ``safe_get_full_fp32_param``, ``tensor_fragment.py:100``)."""
+    leaf = _walk(getattr(engine, "state", None) and engine.state.params,
+                 path)
+    if leaf is None and getattr(engine, "_offload", None) is not None:
+        leaf = _walk(engine._offload.params_tree(), path)
+    return _to_host(leaf)
+
+
+def safe_get_full_optimizer_state(engine, path: PathLike,
+                                  optim_state_key: str
+                                  ) -> Optional[np.ndarray]:
+    """Full optimizer state (e.g. ``"exp_avg"``/``"exp_avg_sq"``) for the
+    parameter at ``path`` (reference ``tensor_fragment.py:116``).  Optax
+    spellings ``mu``/``nu`` are accepted as aliases."""
+    key_alias = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+    keys = [optim_state_key, key_alias.get(optim_state_key,
+                                           optim_state_key)]
+    opt_state = getattr(engine, "state", None) and engine.state.opt_state
+
+    def named_nodes(node, out):
+        if hasattr(node, "_fields"):
+            out.append(node)
+        if isinstance(node, (list, tuple)):
+            for c in node:
+                named_nodes(c, out)
+        return out
+
+    for state in named_nodes(opt_state, []):
+        for k in keys:
+            sub = getattr(state, k, None)
+            if sub is not None:
+                leaf = _walk(sub, path)
+                if leaf is not None:
+                    return _to_host(leaf)
+    # host-offloaded optimizer (ZeRO-Offload): moments live in the C++
+    # Adam's flat buffers
+    off = getattr(engine, "_offload", None)
+    if off is not None and hasattr(off, "optimizer_state_tree"):
+        tree = off.optimizer_state_tree()
+        for k in keys:
+            leaf = _walk(tree.get(k) if isinstance(tree, dict) else None,
+                         path)
+            if leaf is not None:
+                return _to_host(leaf)
+    return None
+
+
+def safe_get_full_grad(engine, path: PathLike) -> Optional[np.ndarray]:
+    """Most recent full fp32 gradient at ``path``, or None when the engine
+    path doesn't retain grads (reference ``tensor_fragment.py:133`` returns
+    None before backward has produced them)."""
+    grads = getattr(engine, "_accum_grads", None)   # after backward()
+    if grads is None:
+        cached = getattr(engine, "_cached", None)   # after forward() only:
+        grads = cached[1] if cached else None       # (loss, grads, overflow)
+    if grads is None:
+        return None
+    return _to_host(_walk(grads, path))

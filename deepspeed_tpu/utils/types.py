@@ -1,0 +1,9 @@
+"""Parity: reference ``deepspeed/utils/types.py``."""
+
+from enum import IntEnum
+
+
+class ActivationFuncType(IntEnum):
+    UNKNOWN = 0
+    GELU = 1
+    ReLU = 2

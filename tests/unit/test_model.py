@@ -110,3 +110,62 @@ def test_tied_embeddings():
     assert "lm_head" not in params
     logits = model.apply(params, jnp.zeros((1, 4), jnp.int32))
     assert logits.shape[-1] == cfg.vocab_size
+
+
+# ----------------------------------------------------------------------
+# chunked cross-entropy (streamed logits)
+# ----------------------------------------------------------------------
+def test_chunked_xent_matches_dense_loss():
+    """chunked_next_token_xent streams [chunk,V] logits under a remat'd
+    scan; per-token softmax is chunking-independent, so loss and grads
+    must match the dense path to fp32 noise (including ragged padding)."""
+    import dataclasses
+    from deepspeed_tpu.models.transformer import chunked_next_token_xent
+
+    cfg_d = dataclasses.replace(TransformerConfig.tiny(), loss_chunk_size=0)
+    cfg_c = dataclasses.replace(cfg_d, loss_chunk_size=7)  # ragged chunks
+    m_d, m_c = CausalTransformerLM(cfg_d), CausalTransformerLM(cfg_c)
+    params = m_d.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.integers(0, cfg_d.vocab_size, (3, 33)), jnp.int32),
+        "loss_mask": jnp.asarray(rng.random((3, 33)) > 0.3, jnp.float32),
+    }
+    l_d, l_c = float(m_d.loss(params, batch)), float(m_c.loss(params, batch))
+    assert abs(l_d - l_c) < 1e-5
+    g_d = jax.grad(lambda p: m_d.loss(p, batch))(params)
+    g_c = jax.grad(lambda p: m_c.loss(p, batch))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-5), g_d, g_c)
+
+
+def test_chunked_xent_explicit_labels():
+    from deepspeed_tpu.models.transformer import (chunked_next_token_xent,
+                                                  next_token_xent)
+    rng = np.random.default_rng(1)
+    B, S, d, V = 2, 9, 8, 32
+    x = jnp.asarray(rng.normal(size=(B, S, d)), jnp.float32)
+    head = jnp.asarray(rng.normal(size=(d, V)), jnp.float32)
+    head_b = jnp.asarray(rng.normal(size=(V,)), jnp.float32)
+    batch = {"input_ids": jnp.zeros((B, S), jnp.int32),
+             "labels": jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)}
+    logits = (x @ head) + head_b
+    want = float(next_token_xent(logits, batch))
+    got = float(chunked_next_token_xent(x, head, head_b, batch, 4))
+    assert abs(want - got) < 1e-5
+
+
+def test_bench_loss_chunk_matches_config():
+    """bench.py sizes the batch ladder with a mirrored constant (its parent
+    process must not import jax); keep it pinned to the model default."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "..",
+                              "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench.LOSS_CHUNK_TOKENS == \
+        TransformerConfig.__dataclass_fields__["loss_chunk_size"].default

@@ -59,9 +59,12 @@ def test_split_shared_and_expert_params():
     assert shared["layers"]["moe"]["w_up"] is None
     assert expert["layers"]["moe"]["w_up"] is not None
     assert expert["lm_head"] is None
-    # grads variant is the same split
+    # grads variant is the same split; the router gate is a SHARED param
+    # (replicated/full-DP-reduced) even though it lives under the moe key
     gs, ge = split_params_grads_into_shared_and_expert_params(p)
-    assert ge["layers"]["moe"]["wg"] is not None and gs["lm_head"] is not None
+    assert ge["layers"]["moe"]["wg"] is None
+    assert gs["layers"]["moe"]["wg"] is not None
+    assert gs["lm_head"] is not None
 
 
 def test_moe_param_labels_for_optax():
@@ -83,9 +86,10 @@ def test_split_param_groups_for_optimizer():
     assert all(is_moe_param(k) for k in moe_groups[0]["params"])
     assert not any(is_moe_param(k) for k in groups[0]["params"])
     # max_group_size chunking: tiny cap → one group per expert leaf
+    # (w_up only — the gate is shared)
     chunked = split_params_into_different_moe_groups_for_optimizer(
         {"name": "base", "params": flat}, max_group_size=1)
-    assert len([g for g in chunked if g.get("moe")]) == 2
+    assert len([g for g in chunked if g.get("moe")]) == 1
 
 
 def test_gather_drop_tokens_duals():
@@ -147,3 +151,34 @@ def test_experts_bank_vmap():
     want = np.stack([np.asarray(x[:, e]) @ np.asarray(
         params["experts"]["w"][e]) for e in range(3)], axis=1)
     np.testing.assert_allclose(np.asarray(out), want, rtol=2e-5, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# utils.tensor_fragment (debug access to master/opt/grads)
+# ----------------------------------------------------------------------
+def test_tensor_fragment_debug_access():
+    import deepspeed_tpu
+    from deepspeed_tpu.utils.tensor_fragment import (
+        safe_get_full_fp32_param, safe_get_full_grad,
+        safe_get_full_optimizer_state)
+    from unit.simple_model import SimpleModel, base_config, random_batch
+
+    model = SimpleModel(16)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config=base_config(stage=3))
+    # master param: full fp32 global value regardless of fsdp sharding
+    w = safe_get_full_fp32_param(engine, "layer_0.w")
+    assert w is not None and w.shape == (16, 16) and w.dtype == np.float32
+    assert safe_get_full_fp32_param(engine, "layer_9.w") is None
+    # grads: None before forward, populated by the 3-call API
+    assert safe_get_full_grad(engine, "layer_0.w") is None
+    batch = random_batch(32, 16)
+    engine.forward(batch)
+    engine.backward()
+    g = safe_get_full_grad(engine, "layer_0.w")
+    assert g is not None and g.shape == (16, 16)
+    engine.step()
+    m = safe_get_full_optimizer_state(engine, "layer_0.w", "exp_avg")
+    assert m is not None and m.shape == (16, 16)
+    assert np.abs(m).sum() > 0
