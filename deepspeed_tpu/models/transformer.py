@@ -66,6 +66,9 @@ class TransformerConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"
+    # Pallas flash-attention tile sizes (tunable per chip generation)
+    attn_block_q: int = 512
+    attn_block_k: int = 512
     # training loss: stream logits in chunks of this many tokens under a
     # remat'd scan so the full fp32 [B,S,V] tensor never hits HBM (the
     # logits buffer, not the model states, caps the trainable micro-batch
@@ -534,7 +537,8 @@ class CausalTransformerLM:
                 q, k, v, lambda q, k, v: attention(q, k, v, causal=True))
         elif c.attn_impl in ("auto", "pallas", "reference"):
             attn = attention(q, k, v, causal=True,
-                             softmax_scale=c.attn_scale, impl=c.attn_impl)
+                             softmax_scale=c.attn_scale, impl=c.attn_impl,
+                             block_q=c.attn_block_q, block_k=c.attn_block_k)
         else:
             raise ValueError(
                 f"unknown attn_impl '{c.attn_impl}'; expected one of "

@@ -56,8 +56,10 @@ reference_impl = reference_attention
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "softmax_scale", "impl"))
-def attention(q, k, v, causal=True, softmax_scale=None, impl="auto"):
-    """Dispatching attention entry point."""
+def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
+              block_q=None, block_k=None):
+    """Dispatching attention entry point.  ``block_q``/``block_k`` tune the
+    Pallas flash kernel's tiles (None = kernel defaults)."""
     use_pallas = False
     if impl == "pallas":
         use_pallas = True
@@ -65,9 +67,12 @@ def attention(q, k, v, causal=True, softmax_scale=None, impl="auto"):
         use_pallas = jax.default_backend() not in ("cpu",)
     if use_pallas:
         try:
-            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            from deepspeed_tpu.ops.pallas.flash_attention import (
+                DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention)
             return flash_attention(q, k, v, causal=causal,
-                                   softmax_scale=softmax_scale)
+                                   softmax_scale=softmax_scale,
+                                   block_q=block_q or DEFAULT_BLOCK_Q,
+                                   block_k=block_k or DEFAULT_BLOCK_K)
         except Exception:
             pass
     return reference_attention(q, k, v, causal=causal,
