@@ -1,0 +1,153 @@
+"""Training throughput benchmark (``ds_bench train``).
+
+Role: the training-side counterpart of the reference's benchmark harnesses
+(the reference ships comm + inference benches; its training numbers come
+from blog-post runs — BASELINE.md).  Measures tokens/s, model TFLOPs and
+MFU for a GPT shape under the engine's ZeRO/bf16/remat configuration.
+
+Timing rules for the tunneled-TPU environment (see .claude/skills/verify):
+fresh token batches every step (the tunnel memoizes repeated identical
+dispatches), `jax.block_until_ready` on the final loss, warmup step
+excluded.  Token ids are tiny (KBs) so H2D does not distort the numbers.
+
+Usage::
+
+    ds_bench train --model gpt_350m --batch 8 --gas 4 --seq 1024 \
+        --zero-stage 3 --steps 10 [--remat-policy dots_saveable]
+        [--attn-block-q 512 --attn-block-k 512] [--json]
+"""
+
+import argparse
+import json
+import time
+
+MODELS = {
+    "gpt2_125m": dict(hidden_size=768, n_layers=12, n_heads=12),
+    "gpt_350m": dict(hidden_size=1024, n_layers=24, n_heads=16),
+    "gpt_760m": dict(hidden_size=1536, n_layers=24, n_heads=16),
+    "gpt2_1_5b": dict(hidden_size=1600, n_layers=48, n_heads=25),
+    "gpt_2_7b": dict(hidden_size=2560, n_layers=32, n_heads=32),
+    "gpt_6_7b": dict(hidden_size=4096, n_layers=32, n_heads=32),
+}
+
+_PEAK_BF16 = (("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0),
+              ("v5e", 197.0), ("v5", 459.0), ("v4", 275.0), ("v3", 61.5))
+
+
+def _peak_tflops(kind: str):
+    k = (kind or "").lower()
+    for sub, val in _PEAK_BF16:
+        if sub in k:
+            return val
+    return None
+
+
+def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
+                  zero_stage=3, offload=None, remat=True,
+                  remat_policy="dots_saveable", attn_block_q=None,
+                  attn_block_k=None, dtype="bf16", vocab_size=50304):
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.parallel import groups
+
+    groups.reset_mesh()
+    ndev = jax.device_count()
+    if batch % ndev:
+        batch = ndev * max(1, round(batch / ndev))   # global batch must
+        print(f"# batch rounded to {batch} (divisible by {ndev} devices)")
+    shape = MODELS[model] if isinstance(model, str) else dict(model)
+    over = {}
+    if attn_block_q:
+        over["attn_block_q"] = attn_block_q
+    if attn_block_k:
+        over["attn_block_k"] = attn_block_k
+    cfg = TransformerConfig(
+        vocab_size=vocab_size, max_seq_len=seq, activation="gelu",
+        use_rmsnorm=False, use_rope=False, tie_embeddings=True,
+        remat=remat, remat_policy=remat_policy, **shape, **over)
+    model_obj = CausalTransformerLM(cfg)
+
+    zero = {"stage": zero_stage}
+    if offload:
+        zero["offload_optimizer"] = {"device": offload}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model_obj, model_parameters=model_obj.init(jax.random.key(0)),
+        config={"train_micro_batch_size_per_gpu": batch // ndev,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                dtype: {"enabled": True},
+                "zero_optimization": zero})
+
+    rng = np.random.default_rng(0)
+    bshape = (gas, batch, seq) if gas > 1 else (batch, seq)
+
+    def make_batch():
+        return {"input_ids": rng.integers(0, cfg.vocab_size, bshape)}
+
+    loss = engine.train_batch(batch=make_batch())          # compile+warmup
+    jax.block_until_ready(loss)
+    t0 = time.time()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=make_batch())
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    n_chips = max(1, engine.mesh.size)
+    tokens = gas * batch * seq * steps
+    tps = tokens / dt
+    tflops = 6.0 * cfg.num_params() * tps / 1e12 / n_chips
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    peak = _peak_tflops(kind)
+    out = {
+        "model": model if isinstance(model, str) else "custom",
+        "n_params": cfg.num_params(),
+        "batch": batch, "gas": gas, "seq": seq, "zero_stage": zero_stage,
+        "steps": steps,
+        "tokens_per_sec_per_chip": round(tps / n_chips, 1),
+        "model_tflops_per_chip": round(tflops, 2),
+        "loss": float(loss),
+        "device_kind": kind, "n_chips": n_chips,
+    }
+    if peak:
+        out["mfu"] = round(tflops / peak, 4)
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ds_bench train", description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="gpt_350m", choices=sorted(MODELS))
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--gas", type=int, default=1)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--zero-stage", type=int, default=3)
+    p.add_argument("--offload", choices=["cpu", "nvme"], default=None)
+    p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--remat-policy", default="dots_saveable")
+    p.add_argument("--attn-block-q", type=int, default=None)
+    p.add_argument("--attn-block-k", type=int, default=None)
+    p.add_argument("--dtype", choices=["bf16", "fp16"], default="bf16")
+    p.add_argument("--json", action="store_true",
+                   help="print one JSON line instead of a table")
+    a = p.parse_args(argv)
+    out = run_benchmark(
+        model=a.model, batch=a.batch, gas=a.gas, seq=a.seq, steps=a.steps,
+        zero_stage=a.zero_stage, offload=a.offload, remat=not a.no_remat,
+        remat_policy=a.remat_policy, attn_block_q=a.attn_block_q,
+        attn_block_k=a.attn_block_k, dtype=a.dtype)
+    if a.json:
+        print(json.dumps(out))
+    else:
+        width = max(len(k) for k in out)
+        for k, v in out.items():
+            print(f"  {k:<{width}}  {v}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

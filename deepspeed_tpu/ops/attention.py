@@ -55,11 +55,15 @@ def reference_attention(q, k, v, causal=True, bias=None, segment_ids=None,
 reference_impl = reference_attention
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "softmax_scale", "impl"))
+@functools.partial(jax.jit, static_argnames=("causal", "softmax_scale",
+                                             "impl", "block_q", "block_k"))
 def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
               block_q=None, block_k=None):
     """Dispatching attention entry point.  ``block_q``/``block_k`` tune the
-    Pallas flash kernel's tiles (None = kernel defaults)."""
+    Pallas flash kernel's tiles (None = kernel defaults).  They MUST be
+    static (they pick the Pallas grid) — a traced value here would poison
+    the `or` below with a TracerBoolConversionError that the fallback
+    except would silently turn into the jnp path."""
     use_pallas = False
     if impl == "pallas":
         use_pallas = True
@@ -73,7 +77,16 @@ def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
                                    softmax_scale=softmax_scale,
                                    block_q=block_q or DEFAULT_BLOCK_Q,
                                    block_k=block_k or DEFAULT_BLOCK_K)
-        except Exception:
-            pass
+        except Exception as e:                      # pragma: no cover
+            _warn_fallback(f"{type(e).__name__}: {e}")
     return reference_attention(q, k, v, causal=causal,
                                softmax_scale=softmax_scale)
+
+
+@functools.lru_cache(maxsize=8)
+def _warn_fallback(reason: str):
+    """A silent fallback once hid a tracer bug that disabled the flash
+    kernel entirely (-30% train throughput); never swallow quietly."""
+    from deepspeed_tpu.utils.logging import logger
+    logger.warning(f"flash attention unavailable, using jnp reference "
+                   f"attention: {reason}")

@@ -1,0 +1,20 @@
+"""ds_bench train suite tests (benchmarks/training.py)."""
+
+import numpy as np
+
+from deepspeed_tpu.benchmarks.training import run_benchmark
+
+
+def test_train_bench_smoke_tiny():
+    out = run_benchmark(model=dict(hidden_size=32, n_layers=2, n_heads=4),
+                        batch=8, gas=1, seq=32, steps=1, vocab_size=64)
+    assert out["tokens_per_sec_per_chip"] > 0
+    assert np.isfinite(out["loss"])
+    assert out["n_chips"] >= 1
+
+
+def test_train_bench_gas_and_blocks():
+    out = run_benchmark(model=dict(hidden_size=32, n_layers=2, n_heads=4),
+                        batch=8, gas=2, seq=32, steps=1, vocab_size=64,
+                        attn_block_q=16, attn_block_k=16)
+    assert np.isfinite(out["loss"])
