@@ -57,8 +57,10 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
     groups.reset_mesh()
     ndev = jax.device_count()
     if batch % ndev:
+        import sys
         batch = ndev * max(1, round(batch / ndev))   # global batch must
-        print(f"# batch rounded to {batch} (divisible by {ndev} devices)")
+        print(f"# batch rounded to {batch} (divisible by {ndev} devices)",
+              file=sys.stderr)
     shape = MODELS[model] if isinstance(model, str) else dict(model)
     over = {}
     if attn_block_q:
