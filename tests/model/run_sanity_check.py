@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""End-to-end model sanity runner (reference ``tests/model/run_sanity_check.py``
+role): runs the convergence suite that the default unit run excludes.
+
+Usage::
+
+    python tests/model/run_sanity_check.py          # all model sanity tests
+"""
+
+import os
+import subprocess
+import sys
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", here, "-m", "nightly", "-v"]
+        + sys.argv[1:],
+        cwd=os.path.dirname(here))
+    print("SANITY CHECK " + ("PASSED" if rc == 0 else "FAILED"))
+    sys.exit(rc)

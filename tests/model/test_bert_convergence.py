@@ -1,0 +1,38 @@
+"""BERT MLM convergence sanity (reference ``tests/model/BingBertSquad``
+role: an encoder fine-tuning-style task must converge end-to-end).
+Run explicitly with ``pytest tests/model -m nightly``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+
+pytestmark = pytest.mark.nightly
+
+
+def test_tiny_bert_mlm_memorizes():
+    from deepspeed_tpu.models.bert import BertConfig, BertEncoder
+
+    cfg = BertConfig(vocab_size=64, hidden_size=64, n_layers=2, n_heads=4,
+                     max_seq_len=32)
+    model = BertEncoder(cfg)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2}})
+    dp = engine._config.data_parallel_size
+    rng = np.random.default_rng(0)
+    B = max(4, dp)
+    ids = rng.integers(4, 64, (B, 32))
+    masked = ids.copy()
+    mask_pos = rng.random((B, 32)) < 0.3
+    masked[mask_pos] = 3                      # [MASK]
+    labels = np.where(mask_pos, ids, -100)    # only masked positions count
+    batch = {"input_ids": masked, "labels": labels}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(60)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.2, f"MLM did not converge: {losses[::10]}"

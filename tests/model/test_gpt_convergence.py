@@ -1,0 +1,69 @@
+"""End-to-end convergence sanity (reference ``tests/model/Megatron_GPT2``
+``run_sanity_check.py`` role): a small GPT must actually CONVERGE — drive
+the loss below an absolute threshold on a memorizable corpus — not merely
+"loss went down".  Run explicitly with ``pytest tests/model -m nightly``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+
+pytestmark = pytest.mark.nightly
+
+
+def _corpus(vocab, batch, seq, seed=0):
+    """A fixed periodic corpus: predictable continuation, memorizable."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, vocab, seq + 1)
+    rows = [np.roll(base, r)[:seq] for r in range(batch)]
+    return {"input_ids": np.stack(rows)}
+
+
+@pytest.mark.parametrize("ds_over", [
+    {"zero_optimization": {"stage": 0}},
+    {"zero_optimization": {"stage": 3}, "mesh": {"tp": 2, "fsdp": 4}},
+])
+def test_tiny_gpt_memorizes(ds_over):
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_layers=2,
+                                 vocab_size=64)
+    model = CausalTransformerLM(cfg)
+    ds = {"train_micro_batch_size_per_gpu": 1,
+          "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+          "bf16": {"enabled": True},
+          **ds_over}
+    kw = {}
+    if "mesh" in ds_over:
+        kw["tp_rules"] = model.tp_rules()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config=ds, **kw)
+    dp = engine._config.data_parallel_size
+    batch = _corpus(64, max(4, dp), 32)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(60)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.15, f"did not converge: {losses[::10]}"
+
+
+def test_fp16_loss_scale_survives_convergence():
+    """Dynamic loss scaling must not prevent convergence (overflow steps
+    skip, scale adapts — the reference's fp16 sanity path)."""
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_layers=2,
+                                 vocab_size=64)
+    model = CausalTransformerLM(cfg)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                "fp16": {"enabled": True, "initial_scale_power": 24},
+                "zero_optimization": {"stage": 1}})
+    dp = engine._config.data_parallel_size
+    batch = _corpus(64, max(4, dp), 32, seed=1)
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(60)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.3, f"fp16 did not converge: {losses[::10]}"
+    # the loss-scale automaton actually engaged (scale is finite, > 0)
+    assert float(engine.state.loss_scale.cur_scale) > 0
