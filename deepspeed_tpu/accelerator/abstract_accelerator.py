@@ -156,9 +156,60 @@ class DeepSpeedAccelerator(ABC):
     def total_memory(self, device_index=None):
         ...
 
+    # cached-memory trio (reference :127-:139 — CUDA's caching-allocator
+    # view; XLA backends alias these to the reserved numbers)
+    def memory_cached(self, device_index=None):
+        return self.memory_reserved(device_index)
+
+    def max_memory_cached(self, device_index=None):
+        return self.max_memory_reserved(device_index)
+
+    def reset_max_memory_cached(self, device_index=None):
+        return self.reset_peak_memory_stats(device_index)
+
     # ------------------------------------------------------------------
     # Dtype / capability probes (reference :171-:210)
     # ------------------------------------------------------------------
+    # tensor-type factories (reference :173-:196: torch.cuda.FloatTensor
+    # etc.).  JAX has no typed constructors — each property returns a
+    # callable building a device array of that dtype, covering the factory
+    # call shapes ``FloatTensor(data)`` and ``FloatTensor(n, m)``.  NB:
+    # without ``jax_enable_x64``, JAX canonicalizes int64→int32 and
+    # float64→float32, so LongTensor/DoubleTensor yield the canonical
+    # (32-bit) dtype on default configs — same widths every other array in
+    # the program has.
+    def _tensor_factory(self, dtype_name):
+        import numbers
+
+        import jax.numpy as jnp
+        dtype = jnp.dtype(dtype_name)
+
+        def make(*args):
+            sizes = all(isinstance(a, numbers.Integral)
+                        and not isinstance(a, bool) for a in args)
+            if len(args) == 1 and not sizes:
+                return jnp.asarray(args[0], dtype)
+            return jnp.zeros(tuple(int(a) for a in args) or (0,), dtype)
+        return make
+
+    for _name, _dtype in (("BFloat16Tensor", "bfloat16"),
+                          ("ByteTensor", "uint8"),
+                          ("DoubleTensor", "float64"),
+                          ("FloatTensor", "float32"),
+                          ("HalfTensor", "float16"),
+                          ("IntTensor", "int32"),
+                          ("LongTensor", "int64")):
+        locals()[_name] = property(
+            lambda self, _dt=_dtype: self._tensor_factory(_dt))
+    del _name, _dtype
+
+    def amp(self):
+        """Reference :153 returns torch.cuda.amp; XLA's compiler owns mixed
+        precision (params cast at the jit boundary), so there is no autocast
+        module — None signals 'not applicable' as the reference does on
+        platforms without amp."""
+        return None
+
     @abc.abstractmethod
     def is_bf16_supported(self):
         ...

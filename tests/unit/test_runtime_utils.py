@@ -82,3 +82,23 @@ def test_misc_helpers(tmp_path):
     assert aligned[0].shape == (2,) and aligned[1].shape == (1,)
     assert aligned[2].shape == (1,) and float(aligned[2][0]) == 0.0
     U.empty_cache()     # no-op, must not raise
+
+
+def test_accelerator_tensor_factories_and_cached_memory():
+    """Reference abstract_accelerator surface: typed tensor factories,
+    amp probe, and the cached-memory trio."""
+    from deepspeed_tpu.accelerator import get_accelerator
+    acc = get_accelerator()
+    t = acc.FloatTensor([1.0, 2.0])
+    assert t.dtype == jnp.float32 and t.shape == (2,)
+    assert acc.BFloat16Tensor([1.0]).dtype == jnp.bfloat16
+    assert acc.IntTensor([1]).dtype == jnp.int32
+    assert acc.ByteTensor(3).shape == (3,)     # size-style call
+    assert acc.ByteTensor(np.int64(3)).shape == (3,)   # numpy size scalars
+    assert acc.FloatTensor(2, 4).shape == (2, 4)
+    # x64 canonicalization: Long/Double resolve to jnp's canonical widths
+    assert acc.LongTensor([1]).dtype in (jnp.int64, jnp.int32)
+    assert acc.DoubleTensor([0.5]).dtype in (jnp.float64, jnp.float32)
+    assert acc.amp() is None
+    assert acc.memory_cached() == acc.memory_reserved()
+    acc.reset_max_memory_cached()              # must not raise
