@@ -520,7 +520,8 @@ class CausalTransformerLM:
                                        softmax_scale=c.attn_scale)
         elif c.attn_impl == "ring":
             from deepspeed_tpu.ops.ring_attention import ring_attention
-            attn = ring_attention(q, k, v, causal=True)
+            attn = ring_attention(q, k, v, causal=True,
+                                  softmax_scale=c.attn_scale)
         elif c.attn_impl == "ulysses":
             from deepspeed_tpu.ops.ulysses import ulysses_attention, sp_degree
             sp = sp_degree()

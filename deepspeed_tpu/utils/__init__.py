@@ -1,3 +1,7 @@
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.memory import memory_status, see_memory_usage
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils.tensor_fragment import (safe_get_full_fp32_param,
+                                                 safe_get_full_grad,
+                                                 safe_get_full_optimizer_state)
+from deepspeed_tpu.utils.init_on_device import OnDevice
