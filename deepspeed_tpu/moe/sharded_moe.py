@@ -30,9 +30,14 @@ DISPATCH_SPEC = P(EP_AXIS, None, None)                      # [e, c, d]
 
 class GateOutput(NamedTuple):
     l_aux: jnp.ndarray            # load-balancing loss (scalar)
-    combine_weights: jnp.ndarray  # [tokens, E, C] fp32
-    dispatch_mask: jnp.ndarray    # [tokens, E, C] bool
+    combine_weights: jnp.ndarray  # [tokens, E, C] fp32 (None in compact mode)
+    dispatch_mask: jnp.ndarray    # [tokens, E, C] bool (None in compact mode)
     exp_counts: jnp.ndarray       # [E] tokens routed per expert (pre-capacity)
+    # compact routing (scatter dispatch): flat slot e*C + c per assignment,
+    # E*C for dropped; gate weight per assignment
+    slots: jnp.ndarray = None       # [tokens, k] int32
+    gate_vals: jnp.ndarray = None   # [tokens, k] fp32
+    capacity: int = 0
 
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
@@ -47,12 +52,15 @@ def _one_hot(idx, n):
 
 def top1gating(logits, capacity_factor=1.0, min_capacity=4,
                noisy_gate_policy: Optional[str] = None, rng=None,
-               drop_tokens=True, used_token_mask=None) -> GateOutput:
+               drop_tokens=True, used_token_mask=None,
+               build_dense=True) -> GateOutput:
     """Top-1 gating (Switch). logits: [tokens, E] fp32.
 
     Mirrors reference ``top1gating``: optional jitter/RSample noise, position
     within expert via masked cumsum, tokens beyond capacity dropped, aux loss
-    = E * mean(me·ce).
+    = E * mean(me·ce).  ``build_dense=False`` skips materializing the
+    [tokens, E, C] combine/dispatch tensors and returns only the compact
+    (slots, gate_vals) routing the scatter dispatch consumes.
     """
     tokens, E = logits.shape
     C = _capacity(tokens, E, capacity_factor, min_capacity)
@@ -86,15 +94,24 @@ def top1gating(logits, capacity_factor=1.0, min_capacity=4,
     keep = (pos < C)[:, None] * mask1                        # drop overflow
 
     gate_val = jnp.sum(gates * keep, axis=-1)               # [tokens]
+    kept = jnp.sum(keep, axis=-1) > 0                       # [tokens]
+    slots = jnp.where(kept, idx.astype(jnp.int32) * C
+                      + pos.astype(jnp.int32), E * C)[:, None]
+    gate_vals = (gate_val * kept)[:, None]
+    if not build_dense:
+        return GateOutput(l_aux=l_aux, combine_weights=None,
+                          dispatch_mask=None, exp_counts=exp_counts,
+                          slots=slots, gate_vals=gate_vals, capacity=C)
     loc = _one_hot(pos.astype(jnp.int32), C)                # [tokens, C]
     combine = gate_val[:, None, None] * keep[:, :, None] * loc[:, None, :]
     dispatch = combine > 0
     return GateOutput(l_aux=l_aux, combine_weights=combine,
-                      dispatch_mask=dispatch, exp_counts=exp_counts)
+                      dispatch_mask=dispatch, exp_counts=exp_counts,
+                      slots=slots, gate_vals=gate_vals, capacity=C)
 
 
 def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
-               second_policy="Rsample") -> GateOutput:
+               second_policy="Rsample", build_dense=True) -> GateOutput:
     """Top-2 gating (GShard).  Capacity doubles (2 slots per token)."""
     tokens, E = logits.shape
     C = _capacity(tokens, E, capacity_factor * 2.0, min_capacity)
@@ -127,13 +144,26 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
+    kept1 = jnp.sum(keep1, axis=-1) > 0
+    kept2 = jnp.sum(keep2, axis=-1) > 0
+    s1 = jnp.where(kept1, idx1.astype(jnp.int32) * C
+                   + p1.astype(jnp.int32), E * C)
+    s2 = jnp.where(kept2, idx2.astype(jnp.int32) * C
+                   + p2.astype(jnp.int32), E * C)
+    slots = jnp.stack([s1, s2], axis=1)
+    gate_vals = jnp.stack([g1 * kept1, g2 * kept2], axis=1)
+    if not build_dense:
+        return GateOutput(l_aux=l_aux, combine_weights=None,
+                          dispatch_mask=None, exp_counts=exp_counts,
+                          slots=slots, gate_vals=gate_vals, capacity=C)
     loc1 = _one_hot(p1.astype(jnp.int32), C)
     loc2 = _one_hot(p2.astype(jnp.int32), C)
     combine = (g1[:, None, None] * keep1[:, :, None] * loc1[:, None, :] +
                g2[:, None, None] * keep2[:, :, None] * loc2[:, None, :])
     dispatch = combine > 0
     return GateOutput(l_aux=l_aux, combine_weights=combine,
-                      dispatch_mask=dispatch, exp_counts=exp_counts)
+                      dispatch_mask=dispatch, exp_counts=exp_counts,
+                      slots=slots, gate_vals=gate_vals, capacity=C)
 
 
 class TopKGate:
@@ -157,45 +187,80 @@ class TopKGate:
         return {"wg": jax.random.normal(
             rng, (self.model_dim, self.num_experts), jnp.float32) * scale}
 
-    def __call__(self, gate_params, x, train=True, rng=None) -> GateOutput:
+    def __call__(self, gate_params, x, train=True, rng=None,
+                 build_dense=True) -> GateOutput:
         logits = x.astype(jnp.float32) @ gate_params["wg"]
         cf = self.capacity_factor if train else self.eval_capacity_factor
         if self.k == 1:
             return top1gating(logits, cf, self.min_capacity,
                               self.noisy_gate_policy if train else None,
-                              rng=rng, drop_tokens=self.drop_tokens)
+                              rng=rng, drop_tokens=self.drop_tokens,
+                              build_dense=build_dense)
         # second-expert sampling noise only during training (eval must be
         # deterministic, matching the top-1 path)
         return top2gating(logits, cf, self.min_capacity,
-                          rng=rng if train else None)
+                          rng=rng if train else None,
+                          build_dense=build_dense)
 
 
 def moe_layer_forward(gate: TopKGate, gate_params, expert_params, expert_fn,
-                      x, train=True, rng=None):
+                      x, train=True, rng=None, dispatch_impl="scatter"):
     """The MOELayer hot path (reference ``MOELayer.forward:439``).
 
     x: [B, S, D] → tokens [B*S, D]; expert_params leaves have leading E dim
     sharded over ``ep``; returns (out [B,S,D], l_aux, exp_counts).
 
-    The two sharding constraints around the einsums reproduce the reference's
-    explicit all-to-alls: tokens are sharded over the batch axes, the
-    dispatched tensor over ``ep`` — the transition is an all-to-all over ICI.
+    The sharding constraints around dispatch/combine reproduce the
+    reference's explicit all-to-alls: tokens are sharded over the batch
+    axes, the dispatched tensor over ``ep`` — the transition is an
+    all-to-all over ICI.
+
+    ``dispatch_impl``:
+
+    * ``"scatter"`` (default) — compact routing: each kept assignment
+      scatter-adds its token into slot ``e*C + c`` of the [E·C, D] buffer
+      and combine gathers back with the gate weight.  O(T·k·D) work; the
+      dense [T, E, C] tensors are never built.
+    * ``"einsum"`` — the GShard-style one-hot einsums (O(T·E·C·D) FLOPs,
+      quadratic in tokens at fixed capacity factor).  Kept as the oracle:
+      both paths produce identical outputs (same cumsum slot priority).
     """
     B, S, D = x.shape
     tokens = x.reshape(B * S, D)
     tokens = maybe_constrain(tokens, TOKENS_SPEC)
 
-    out = gate(gate_params, tokens, train=train, rng=rng)
-    # dispatch: [tokens, E, C] × [tokens, D] → [E, C, D]  (all-to-all #1)
-    dispatched = jnp.einsum("tec,td->ecd",
-                            out.dispatch_mask.astype(x.dtype), tokens)
-    dispatched = maybe_constrain(dispatched, DISPATCH_SPEC)
+    out = gate(gate_params, tokens, train=train, rng=rng,
+               build_dense=dispatch_impl == "einsum")
+    if dispatch_impl == "einsum":
+        # dispatch: [tokens, E, C] × [tokens, D] → [E, C, D] (all-to-all #1)
+        dispatched = jnp.einsum("tec,td->ecd",
+                                out.dispatch_mask.astype(x.dtype), tokens)
+    else:
+        C, E, k = out.capacity, out.exp_counts.shape[0], out.slots.shape[1]
+        flat_slots = out.slots.reshape(-1)                 # [T*k]
+        tokens_k = jnp.broadcast_to(
+            tokens[:, None, :], (tokens.shape[0], k, D)).reshape(-1, D)
+        # row E*C absorbs dropped assignments; distinct slots → no collide
+        buf = jnp.zeros((E * C + 1, D), x.dtype)
+        buf = buf.at[flat_slots].add(tokens_k)             # all-to-all #1
+        dispatched = buf[:E * C].reshape(E, C, D)
 
-    expert_out = expert_fn(expert_params, dispatched)  # [E, C, D]
+    dispatched = maybe_constrain(dispatched, DISPATCH_SPEC)
+    expert_out = expert_fn(expert_params, dispatched)      # [E, C, D]
     expert_out = maybe_constrain(expert_out, DISPATCH_SPEC)
 
-    # combine: [tokens, E, C] × [E, C, D] → [tokens, D]  (all-to-all #2)
-    combined = jnp.einsum("tec,ecd->td",
-                          out.combine_weights.astype(x.dtype), expert_out)
+    if dispatch_impl == "einsum":
+        # combine: [tokens, E, C] × [E, C, D] → [tokens, D] (all-to-all #2)
+        combined = jnp.einsum("tec,ecd->td",
+                              out.combine_weights.astype(x.dtype),
+                              expert_out)
+    else:
+        eo = jnp.concatenate(
+            [expert_out.reshape(E * C, D),
+             jnp.zeros((1, D), expert_out.dtype)])         # dropped read 0
+        gathered = eo[out.slots]                           # [T, k, D]
+        combined = jnp.sum(
+            gathered * out.gate_vals[..., None].astype(x.dtype),
+            axis=1)                                        # all-to-all #2
     combined = maybe_constrain(combined, TOKENS_SPEC)
     return combined.reshape(B, S, D), out.l_aux, out.exp_counts

@@ -127,3 +127,62 @@ def test_moe_generate():
         model=model, config={"dtype": "float32"}, params=params)
     out = engine.generate(np.zeros((1, 4), np.int32), max_new_tokens=4)
     assert out.shape == (1, 8)
+
+
+# ----------------------------------------------------------------------
+# scatter dispatch == einsum dispatch (the compact fast path)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("k", [1, 2])
+def test_scatter_dispatch_matches_einsum(k):
+    """Both dispatch implementations share the cumsum slot priority, so
+    outputs must be IDENTICAL in fp32 (including dropped tokens)."""
+    from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_forward
+
+    rng = np.random.default_rng(0)
+    D, E = 16, 4
+    gate = TopKGate(D, E, k=k, capacity_factor=0.7, min_capacity=2)
+    gate_params = {"wg": jnp.asarray(rng.normal(size=(D, E)), jnp.float32)}
+    expert_params = {"w": jnp.asarray(rng.normal(size=(E, D, D)),
+                                      jnp.float32)}
+
+    def expert_fn(p, dispatched):        # [E, C, D] -> [E, C, D]
+        return jnp.einsum("ecd,edf->ecf", dispatched, p["w"])
+
+    x = jnp.asarray(rng.normal(size=(2, 8, D)), jnp.float32)
+    out_e, aux_e, cnt_e = moe_layer_forward(
+        gate, gate_params, expert_params, expert_fn, x,
+        train=False, dispatch_impl="einsum")
+    out_s, aux_s, cnt_s = moe_layer_forward(
+        gate, gate_params, expert_params, expert_fn, x,
+        train=False, dispatch_impl="scatter")
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_e),
+                               rtol=1e-6, atol=1e-6)
+    assert float(aux_s) == float(aux_e)
+    np.testing.assert_array_equal(np.asarray(cnt_s), np.asarray(cnt_e))
+    # gradients agree too (scatter/gather transpose == einsum transpose)
+    def loss(fn_impl):
+        def f(xx):
+            o, aux, _ = moe_layer_forward(gate, gate_params, expert_params,
+                                          expert_fn, xx, train=False,
+                                          dispatch_impl=fn_impl)
+            return jnp.sum(o ** 2) + aux
+        return jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(loss("scatter")),
+                               np.asarray(loss("einsum")),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_compact_gating_slots_consistent_with_dense():
+    from deepspeed_tpu.moe.sharded_moe import top1gating
+
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    dense = top1gating(logits, capacity_factor=0.6, min_capacity=1)
+    C = dense.capacity
+    # every kept slot in the dense mask appears exactly once in `slots`
+    mask = np.asarray(dense.dispatch_mask)      # [T, E, C]
+    t_idx, e_idx, c_idx = np.nonzero(mask)
+    dense_slots = sorted(e_idx * C + c_idx)
+    compact = np.asarray(dense.slots).reshape(-1)
+    kept = sorted(s for s in compact if s < mask.shape[1] * C)
+    assert kept == dense_slots
