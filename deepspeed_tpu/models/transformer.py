@@ -485,20 +485,11 @@ class CausalTransformerLM:
         layers; ``layer['attn_window']`` is a traced scalar, 0 = global).
         Returns None when neither applies so the flash path stays usable."""
         c = self.config
-        bias = None
-        if c.use_alibi:
-            # slopes * key position; softmax row-shift invariance makes
-            # this equal to slopes * (k - q) on the causal support
-            bias = (alibi_slopes(c.n_heads)[None, :, None, None] *
-                    jnp.arange(Sk, dtype=jnp.float32)[None, None, None, :])
-        if "attn_window" in layer:
-            w = layer["attn_window"]   # per-layer scalar, traced under scan
-            delta = (jnp.arange(Sq, dtype=jnp.int32)[:, None] + (Sk - Sq) -
-                     jnp.arange(Sk, dtype=jnp.int32)[None, :])
-            allowed = (delta < w) | (w <= 0)
-            wbias = jnp.where(allowed, 0.0, -1e30).astype(jnp.float32)
-            bias = wbias if bias is None else bias + wbias
-        return bias
+        from deepspeed_tpu.ops.attention import alibi_window_bias
+        return alibi_window_bias(
+            Sq, Sk,
+            slopes=alibi_slopes(c.n_heads) if c.use_alibi else None,
+            window=layer.get("attn_window"))
 
     def _attn_block(self, x, layer, positions):
         h = _norm(x, layer["attn_norm"], self.config.norm_eps,
@@ -512,12 +503,35 @@ class CausalTransformerLM:
         B, S, d = h.shape
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
         q, k, v = self._qkv(h, layer, B, S, positions)
-        bias = self._attn_bias(layer, S, S)
-        if bias is not None:
-            # additive-bias attention rides the jnp path (the Pallas flash
-            # kernel has no bias operand yet); XLA still fuses the chain
-            attn = reference_attention(q, k, v, causal=True, bias=bias,
-                                       softmax_scale=c.attn_scale)
+        has_alibi = c.use_alibi
+        has_window = "attn_window" in layer
+        on_cpu = jax.default_backend() in ("cpu",)
+        if has_alibi or has_window:
+            attn = None
+            if c.attn_impl == "pallas" or (c.attn_impl == "auto"
+                                           and not on_cpu):
+                # ALiBi / sliding-window ride the flash kernel's in-kernel
+                # bias (slope·kpos + window mask; far-past K blocks
+                # skipped), so Bloom / GPT-Neo / Mistral stay on the fast
+                # path; same guarded fallback as ops/attention.attention()
+                # — a lowering failure must degrade loudly to the jnp
+                # path, never crash or go silent
+                try:
+                    from deepspeed_tpu.ops.pallas.flash_attention import \
+                        flash_attention as _flash
+                    attn = _flash(
+                        q, k, v, causal=True, softmax_scale=c.attn_scale,
+                        block_q=c.attn_block_q, block_k=c.attn_block_k,
+                        interpret=on_cpu,
+                        alibi_slopes=alibi_slopes(H) if has_alibi else None,
+                        window=layer["attn_window"] if has_window else None)
+                except Exception as e:
+                    from deepspeed_tpu.ops.attention import _warn_fallback
+                    _warn_fallback(f"{type(e).__name__}: {e}")
+            if attn is None:
+                bias = self._attn_bias(layer, S, S)
+                attn = reference_attention(q, k, v, causal=True, bias=bias,
+                                           softmax_scale=c.attn_scale)
         elif c.attn_impl == "ring":
             from deepspeed_tpu.ops.ring_attention import ring_attention
             attn = ring_attention(q, k, v, causal=True,

@@ -55,6 +55,27 @@ def reference_attention(q, k, v, causal=True, bias=None, segment_ids=None,
 reference_impl = reference_attention
 
 
+def alibi_window_bias(Sq, Sk, slopes=None, window=None):
+    """Additive attention bias for ALiBi slopes and/or a sliding window —
+    THE shared construction (model `_attn_bias`, flash fallback): ALiBi is
+    ``slope * kpos`` (row-constant part cancels in softmax) and the window
+    allows ``qpos - kpos < w`` with ``w <= 0`` meaning unlimited.  Query
+    rows are aligned to the END of the key range (``Sq != Sk`` decode)."""
+    import jax.numpy as jnp
+    bias = None
+    if slopes is not None:
+        bias = (jnp.asarray(slopes, jnp.float32)[None, :, None, None]
+                * jnp.arange(Sk, dtype=jnp.float32)[None, None, None, :])
+    if window is not None:
+        qpos = jnp.arange(Sq, dtype=jnp.int32)[:, None] + (Sk - Sq)
+        kpos = jnp.arange(Sk, dtype=jnp.int32)[None, :]
+        w = jnp.asarray(window).astype(jnp.int32)
+        wbias = jnp.where((qpos - kpos < w) | (w <= 0), 0.0,
+                          -1e30).astype(jnp.float32)[None, None]
+        bias = wbias if bias is None else bias + wbias
+    return bias
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "softmax_scale",
                                              "impl", "block_q", "block_k"))
 def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",

@@ -39,31 +39,83 @@ DEFAULT_BLOCK_K = 512
 _NEG = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_len):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BLK_Q, D]
-    d = q.shape[-1]
+def _tile_positions(q_base, k_base, block_q, block_k):
+    qpos = q_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = k_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qpos, kpos
 
+
+def _mask_bias(s, qpos, kpos, causal, slope, window):
+    """Shared score-tile transform: ALiBi bias (``slope * kpos`` — the
+    row-constant part cancels in softmax, matching the model's
+    ``_attn_bias``) then causal / sliding-window masking.  ``slope`` and
+    ``window`` are traced scalars (0 disables)."""
+    if slope is not None:
+        s = s + slope * kpos.astype(jnp.float32)
+    allowed = None
+    if causal:
+        allowed = qpos >= kpos
+    if window is not None:
+        in_win = (qpos - kpos < window) | (window <= 0)
+        allowed = in_win if allowed is None else (allowed & in_win)
+    if allowed is not None:
+        s = jnp.where(allowed, s, _NEG)
+    return s
+
+
+def _k_range(qi, block_q, block_k, seq_len, causal, window):
+    """[lo, hi) K-block range visible to q-block ``qi``; with a window the
+    far-past blocks are skipped (true sliding-window FLOPs)."""
     num_k_blocks = seq_len // block_k
     if causal:
-        # last K block that intersects the causal triangle for this Q block
         hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
         hi = jnp.minimum(hi, num_k_blocks)
     else:
         hi = num_k_blocks
+    lo = 0
+    if window is not None:
+        lo_w = jax.lax.div(qi * block_q - (window - 1), block_k)
+        lo = jnp.where(window > 0, jnp.maximum(0, lo_w), 0)
+    return lo, hi
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len):
+    _fwd_impl(q_ref, k_ref, v_ref, None, None, o_ref, lse_ref, scale=scale,
+              causal=causal, block_q=block_q, block_k=block_k,
+              seq_len=seq_len)
+
+
+def _fwd_kernel_biased(q_ref, k_ref, v_ref, slope_ref, window_ref, o_ref,
+                       lse_ref, *, scale, causal, block_q, block_k,
+                       seq_len, use_slope=True, use_window=True):
+    _fwd_impl(q_ref, k_ref, v_ref, slope_ref if use_slope else None,
+              window_ref if use_window else None, o_ref, lse_ref,
+              scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              seq_len=seq_len)
+
+
+def _fwd_impl(q_ref, k_ref, v_ref, slope_ref, window_ref, o_ref, lse_ref,
+              *, scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BLK_Q, D]
+    d = q.shape[-1]
+
+    slope = slope_ref[0, 0] if slope_ref is not None else None
+    window = window_ref[0, 0] if window_ref is not None else None
+    lo, hi = _k_range(qi, block_q, block_k, seq_len, causal, window)
 
     def body(kb, carry):
         acc, m, l = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T                                    # [BLK_Q, BLK_K]
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG)
+        if causal or slope is not None or window is not None:
+            qpos, kpos = _tile_positions(qi * block_q, kb * block_k,
+                                         block_q, block_k)
+            s = _mask_bias(s, qpos, kpos, causal, slope, window)
         bm = jnp.max(s, axis=-1, keepdims=True)        # [BLK_Q, 1]
         new_m = jnp.maximum(m, bm)
         p = jnp.exp(s - new_m)
@@ -77,14 +129,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, (acc0, m0, l0))
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False):
+def _bias_inputs(alibi_slopes, window, B, H):
+    """Per-(batch·head) ALiBi slope and window scalars as [B*H, 1] arrays
+    (None, None when the no-bias fast path applies)."""
+    if alibi_slopes is None and window is None:
+        return None, None
+    slopes = (jnp.zeros((H,), jnp.float32) if alibi_slopes is None
+              else jnp.asarray(alibi_slopes, jnp.float32))
+    slopes_bh = jnp.tile(slopes, B).reshape(B * H, 1)
+    w = jnp.asarray(0 if window is None else window).astype(jnp.int32)
+    w_bh = jnp.broadcast_to(w, (B * H,)).reshape(B * H, 1)
+    return slopes_bh, w_bh
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False,
+               alibi_slopes=None, window=None):
     B, S, H, D = q.shape
     Hkv = k.shape[2]
     group = H // Hkv
@@ -96,18 +162,31 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False):
     block_k = min(block_k, S)
     grid = (B * H, S // block_q)
 
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=S)
+    slopes_bh, w_bh = _bias_inputs(alibi_slopes, window, B, H)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0)),
+        pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0)),
+    ]
+    args = [qr, kr, vr]
+    if slopes_bh is None:
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, seq_len=S)
+    else:
+        kernel = functools.partial(
+            _fwd_kernel_biased, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, seq_len=S,
+            use_slope=alibi_slopes is not None,
+            use_window=window is not None)
+        in_specs += [pl.BlockSpec((1, 1), lambda bh, qi: (bh, 0)),
+                     pl.BlockSpec((1, 1), lambda bh, qi: (bh, 0))]
+        args += [slopes_bh, w_bh]
 
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi, g=group: (bh // g, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
@@ -117,7 +196,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False):
             jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*args)
 
     out = jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
     return out, lse.reshape(B, H, S)
@@ -125,6 +204,25 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale, causal, block_q, block_k, seq_len):
+    _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+                 None, dq_ref, scale=scale, causal=causal, block_q=block_q,
+                 block_k=block_k, seq_len=seq_len)
+
+
+def _bwd_dq_kernel_biased(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          slope_ref, window_ref, dq_ref, *, scale, causal,
+                          block_q, block_k, seq_len, use_slope=True,
+                          use_window=True):
+    _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 slope_ref if use_slope else None,
+                 window_ref if use_window else None, dq_ref, scale=scale,
+                 causal=causal, block_q=block_q, block_k=block_k,
+                 seq_len=seq_len)
+
+
+def _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slope_ref,
+                 window_ref, dq_ref, *, scale, causal, block_q, block_k,
+                 seq_len):
     """dQ for one (batch·head, q-block): stream K/V blocks, recompute P
     from the saved LSE, accumulate dq = Σ_kb dS @ K."""
     qi = pl.program_id(1)
@@ -134,24 +232,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     delta = delta_ref[0].reshape(block_q, 1)
     d = q.shape[-1]
 
-    num_k_blocks = seq_len // block_k
-    if causal:
-        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_k_blocks)
-    else:
-        hi = num_k_blocks
+    slope = slope_ref[0, 0] if slope_ref is not None else None
+    window = window_ref[0, 0] if window_ref is not None else None
+    lo, hi = _k_range(qi, block_q, block_k, seq_len, causal, window)
 
     def body(kb, dq):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG)
+        if causal or slope is not None or window is not None:
+            qpos, kpos = _tile_positions(qi * block_q, kb * block_k,
+                                         block_q, block_k)
+            s = _mask_bias(s, qpos, kpos, causal, slope, window)
         p = jnp.exp(s - lse)
         p = jnp.where(s <= _NEG / 2, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -159,13 +252,33 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         ds = p * (dp - delta) * scale
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq = jax.lax.fori_loop(lo, hi, body,
+                           jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q, block_k,
                     seq_len):
+    _bwd_dkv_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
+                  None, dk_ref, dv_ref, scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, seq_len=seq_len)
+
+
+def _bwd_dkv_kernel_biased(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           slope_ref, window_ref, dk_ref, dv_ref, *, scale,
+                           causal, block_q, block_k, seq_len,
+                           use_slope=True, use_window=True):
+    _bwd_dkv_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  slope_ref if use_slope else None,
+                  window_ref if use_window else None, dk_ref, dv_ref,
+                  scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_len=seq_len)
+
+
+def _bwd_dkv_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  slope_ref, window_ref, dk_ref, dv_ref, *, scale, causal,
+                  block_q, block_k, seq_len):
     """dK/dV for one (batch·head, k-block): stream Q/dO blocks.
     dv = Σ_qb Pᵀ @ dO;  dk = Σ_qb dSᵀ @ Q."""
     ki = pl.program_id(1)
@@ -173,8 +286,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[0].astype(jnp.float32)
     d = k.shape[-1]
 
+    slope = slope_ref[0, 0] if slope_ref is not None else None
+    window = window_ref[0, 0] if window_ref is not None else None
     num_q_blocks = seq_len // block_q
     lo = (ki * block_k) // block_q if causal else 0
+    hi = num_q_blocks
+    if window is not None:
+        # last q block that can see this k block: qpos < kpos + window
+        hi_w = jax.lax.div((ki + 1) * block_k + window - 2, block_q) + 1
+        hi = jnp.where(window > 0,
+                       jnp.minimum(num_q_blocks, hi_w), num_q_blocks)
 
     def body(qb, carry):
         dk, dv = carry
@@ -185,12 +306,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             block_q, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG)
+        if causal or slope is not None or window is not None:
+            qpos, kpos = _tile_positions(qb * block_q, ki * block_k,
+                                         block_q, block_k)
+            s = _mask_bias(s, qpos, kpos, causal, slope, window)
         p = jnp.exp(s - lse)
         p = jnp.where(s <= _NEG / 2, 0.0, p)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -204,13 +323,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(lo, hi, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
-                      interpret=False):
+                      interpret=False, alibi_slopes=None, window=None):
     """O(S)-memory flash backward: recompute P per tile from the saved LSE.
     Returns (dq, dk, dv) with GQA group reduction."""
     q, k, v, out, lse = res
@@ -231,9 +350,17 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
     delta = jnp.sum(gr.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
+    slopes_bh, w_bh = _bias_inputs(alibi_slopes, window, B, H)
+    scalar_specs = [pl.BlockSpec((1, 1), lambda bh, i: (bh, 0)),
+                    pl.BlockSpec((1, 1), lambda bh, i: (bh, 0))]
+    scalar_args = [] if slopes_bh is None else [slopes_bh, w_bh]
+
     kv_spec = pl.BlockSpec((1, S, D), lambda bh, i, g=group: (bh // g, 0, 0))
+    dq_kernel = _bwd_dq_kernel if slopes_bh is None else functools.partial(
+        _bwd_dq_kernel_biased, use_slope=alibi_slopes is not None,
+        use_window=window is not None)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+        functools.partial(dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=S),
         grid=(B * H, S // block_q),
         in_specs=[
@@ -243,15 +370,20 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-        ],
+        ] + (scalar_specs if scalar_args else []),
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, gr, lser, delta)
+    )(qr, kr, vr, gr, lser, delta, *scalar_args)
 
     full_spec = pl.BlockSpec((1, S, D), lambda bh, ki: (bh, 0, 0))
+    dkv_kernel = (_bwd_dkv_kernel if slopes_bh is None
+                  else functools.partial(
+                      _bwd_dkv_kernel_biased,
+                      use_slope=alibi_slopes is not None,
+                      use_window=window is not None))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=S),
         grid=(B * H, S // block_k),
         in_specs=[
@@ -263,7 +395,7 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             full_spec,                                     # dO
             pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),  # lse
             pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),  # delta
-        ],
+        ] + (scalar_specs if scalar_args else []),
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
@@ -273,7 +405,7 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             jax.ShapeDtypeStruct((B * H, S, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr, gr, lser, delta)
+    )(qr, kr, vr, gr, lser, delta, *scalar_args)
 
     dq = jnp.swapaxes(dq.reshape(B, H, S, D), 1, 2)
     dk = dk.reshape(B, Hkv, group, S, D).sum(axis=2)     # GQA group reduce
@@ -322,24 +454,35 @@ def _flash_bwd(scale, causal, res, g):
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, scale, causal, block_q, block_k,
-                     interpret=False):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention(q, k, v, alibi_slopes, window, scale, causal, block_q,
+                     block_k, interpret=False):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                        alibi_slopes=alibi_slopes, window=window)
     return out
 
 
-def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k,
-                         interpret=False):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_attention_fwd(q, k, v, alibi_slopes, window, scale, causal,
+                         block_q, block_k, interpret=False):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret, alibi_slopes=alibi_slopes,
+                          window=window)
+    return out, (q, k, v, alibi_slopes, window, out, lse)
 
 
-def _flash_attention_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_attention_bwd(scale, causal, block_q, block_k, interpret,
+                         res, g):
     # the forward only runs the kernel on tiling shapes, so the tiled
     # backward applies whenever this VJP is reached
-    return _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
-                             interpret)
+    q, k, v, alibi_slopes, window, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(scale, causal, (q, k, v, out, lse), g,
+                                   block_q, block_k, interpret,
+                                   alibi_slopes=alibi_slopes, window=window)
+    dslopes = (None if alibi_slopes is None
+               else jnp.zeros_like(jnp.asarray(alibi_slopes, jnp.float32)))
+    dwindow = (None if window is None
+               else jnp.zeros_like(jnp.asarray(window, jnp.float32)))
+    return dq, dk, dv, dslopes, dwindow
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
@@ -347,17 +490,28 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 def flash_attention(q, k, v, causal=True, softmax_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=False):
+                    interpret=False, alibi_slopes=None, window=None):
     """q: [B, S, H, D]; k/v: [B, S, Hkv, D].  Falls back to the jnp reference
     when the shape doesn't tile (S not divisible by the block size).
-    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI)."""
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI).
+
+    ``alibi_slopes`` ([H] fp32) adds the Bloom-style per-head ALiBi bias
+    ``slope * kpos`` in-kernel; ``window`` (traced int scalar, 0/None =
+    unlimited) applies a sliding-window mask AND skips K blocks wholly
+    outside the window, so GPT-Neo/Mistral local attention gets its
+    asymptotics (role of the reference's local-attention inference kernels,
+    ``csrc/transformer/inference``)."""
     B, S, H, D = q.shape
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     if S % block_q or S % block_k or H % k.shape[2]:
-        from deepspeed_tpu.ops.attention import reference_attention
+        from deepspeed_tpu.ops.attention import (alibi_window_bias,
+                                                 reference_attention)
+        bias = alibi_window_bias(S, S, slopes=alibi_slopes, window=window)
         return reference_attention(q, k, v, causal=causal,
-                                   softmax_scale=softmax_scale)
-    return _flash_attention(q, k, v, scale, causal, block_q, block_k,
-                            interpret)
+                                   softmax_scale=softmax_scale, bias=bias)
+    window_f = (None if window is None
+                else jnp.asarray(window, jnp.float32))
+    return _flash_attention(q, k, v, alibi_slopes, window_f, scale, causal,
+                            block_q, block_k, interpret)

@@ -124,3 +124,105 @@ def test_flash_bwd_long_sequence_vs_autodiff():
     for a, b in zip(gf, gr):
         rel = float(jnp.abs(a - b).max()) / (float(jnp.abs(b).max()) + 1e-9)
         assert rel < 2e-3
+
+
+# ----------------------------------------------------------------------
+# ALiBi + sliding-window kernel variants
+# ----------------------------------------------------------------------
+def _bias_for(S, H=None, slopes=None, window=None):
+    import jax.numpy as jnp
+    bias = None
+    if slopes is not None:
+        bias = (jnp.asarray(slopes, jnp.float32)[None, :, None, None]
+                * jnp.arange(S, dtype=jnp.float32)[None, None, None, :])
+    if window is not None:
+        qpos = jnp.arange(S)[:, None]
+        kpos = jnp.arange(S)[None, :]
+        wb = jnp.where((qpos - kpos < window) | (window <= 0), 0.0,
+                       -1e30)[None, None]
+        bias = wb if bias is None else bias + wb
+    return bias
+
+
+def test_flash_alibi_matches_reference():
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    rng = np.random.default_rng(10)
+    B, S, H, D = 2, 256, 4, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    slopes = alibi_slopes(H)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True, alibi_slopes=slopes)
+    want = reference_attention(q, k, v, causal=True,
+                               bias=_bias_for(S, slopes=slopes))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_window_matches_reference_and_skips_blocks():
+    rng = np.random.default_rng(11)
+    B, S, H, D = 1, 256, 2, 32
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    for w in (32, 100, 0):
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              interpret=True, window=w)
+        want = reference_attention(q, k, v, causal=True,
+                                   bias=_bias_for(S, window=w))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"window={w}")
+
+
+def test_flash_alibi_window_gradients_match():
+    rng = np.random.default_rng(12)
+    B, S, H, D = 1, 128, 4, 16
+    Hkv = 2                                       # GQA too
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    slopes = alibi_slopes(H)
+    bias = _bias_for(S, slopes=slopes, window=48)
+    kr = jnp.repeat(k, H // Hkv, axis=2)
+    vr = jnp.repeat(v, H // Hkv, axis=2)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                            interpret=True, alibi_slopes=slopes, window=48)
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, kf, vf):
+        o = reference_attention(q, kf, vf, causal=True, bias=bias)
+        return jnp.sum(o ** 2)
+
+    gq, gk, gv = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    rq, rkf, rvf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, kr, vr)
+    rk = rkf.reshape(B, S, Hkv, H // Hkv, D).sum(axis=3)
+    rv = rvf.reshape(B, S, Hkv, H // Hkv, D).sum(axis=3)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_flash_window_traced_per_layer():
+    """window may be a traced scalar (the model scans over per-layer
+    windows) — one compiled program covers all layers."""
+    rng = np.random.default_rng(13)
+    B, S, H, D = 1, 128, 2, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+               for _ in range(3))
+
+    @jax.jit
+    def f(w):
+        return flash_attention(q, k, v, causal=True, block_q=32,
+                               block_k=32, interpret=True, window=w)
+
+    for w in (16, 0):
+        want = reference_attention(q, k, v, causal=True,
+                                   bias=_bias_for(S, window=w))
+        np.testing.assert_allclose(np.asarray(f(jnp.int32(w))),
+                                   np.asarray(want), rtol=2e-4, atol=2e-4)
