@@ -507,31 +507,21 @@ class CausalTransformerLM:
         has_window = "attn_window" in layer
         on_cpu = jax.default_backend() in ("cpu",)
         if has_alibi or has_window:
-            attn = None
-            if c.attn_impl == "pallas" or (c.attn_impl == "auto"
-                                           and not on_cpu):
-                # ALiBi / sliding-window ride the flash kernel's in-kernel
-                # bias (slope·kpos + window mask; far-past K blocks
-                # skipped), so Bloom / GPT-Neo / Mistral stay on the fast
-                # path; same guarded fallback as ops/attention.attention()
-                # — a lowering failure must degrade loudly to the jnp
-                # path, never crash or go silent
-                try:
-                    from deepspeed_tpu.ops.pallas.flash_attention import \
-                        flash_attention as _flash
-                    attn = _flash(
-                        q, k, v, causal=True, softmax_scale=c.attn_scale,
-                        block_q=c.attn_block_q, block_k=c.attn_block_k,
-                        interpret=on_cpu,
-                        alibi_slopes=alibi_slopes(H) if has_alibi else None,
-                        window=layer["attn_window"] if has_window else None)
-                except Exception as e:
-                    from deepspeed_tpu.ops.attention import _warn_fallback
-                    _warn_fallback(f"{type(e).__name__}: {e}")
-            if attn is None:
-                bias = self._attn_bias(layer, S, S)
-                attn = reference_attention(q, k, v, causal=True, bias=bias,
-                                           softmax_scale=c.attn_scale)
+            # ALiBi / sliding-window ride the flash kernel's in-kernel bias
+            # (slope·kpos + window mask; far-past K blocks skipped), so
+            # Bloom / GPT-Neo / Mistral stay on the fast path.  attention()
+            # owns the pallas-vs-reference policy and its loud fallback;
+            # ring/ulysses don't take a bias, so those impls serve the
+            # biased layers via the reference path as before
+            impl = (c.attn_impl if c.attn_impl in ("auto", "pallas",
+                                                   "reference")
+                    else "reference")
+            attn = attention(
+                q, k, v, causal=True, softmax_scale=c.attn_scale,
+                impl=impl, block_q=c.attn_block_q, block_k=c.attn_block_k,
+                alibi_slopes=alibi_slopes(H) if has_alibi else None,
+                window=layer["attn_window"] if has_window else None,
+                interpret=on_cpu and impl == "pallas")
         elif c.attn_impl == "ring":
             from deepspeed_tpu.ops.ring_attention import ring_attention
             attn = ring_attention(q, k, v, causal=True,

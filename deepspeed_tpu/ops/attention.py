@@ -77,14 +77,23 @@ def alibi_window_bias(Sq, Sk, slopes=None, window=None):
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "softmax_scale",
-                                             "impl", "block_q", "block_k"))
+                                             "impl", "block_q", "block_k",
+                                             "interpret"))
 def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
-              block_q=None, block_k=None):
-    """Dispatching attention entry point.  ``block_q``/``block_k`` tune the
-    Pallas flash kernel's tiles (None = kernel defaults).  They MUST be
-    static (they pick the Pallas grid) — a traced value here would poison
-    the `or` below with a TracerBoolConversionError that the fallback
-    except would silently turn into the jnp path."""
+              block_q=None, block_k=None, alibi_slopes=None, window=None,
+              interpret=False):
+    """Dispatching attention entry point — the ONE place the
+    pallas-vs-reference policy (and its loud fallback) lives.
+
+    ``block_q``/``block_k`` tune the Pallas flash tiles (None = kernel
+    defaults).  They MUST be static (they pick the Pallas grid) — a traced
+    value here would poison the `or` below with a
+    TracerBoolConversionError that the fallback except would silently turn
+    into the jnp path.  ``alibi_slopes`` ([H]) and ``window`` (traced
+    scalar, 0/None = unlimited) ride the flash kernel's in-kernel bias on
+    the Pallas path and a materialized :func:`alibi_window_bias` on the
+    reference path.  ``interpret`` (static) runs the kernel in the Pallas
+    interpreter (CPU CI)."""
     use_pallas = False
     if impl == "pallas":
         use_pallas = True
@@ -97,11 +106,17 @@ def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
             return flash_attention(q, k, v, causal=causal,
                                    softmax_scale=softmax_scale,
                                    block_q=block_q or DEFAULT_BLOCK_Q,
-                                   block_k=block_k or DEFAULT_BLOCK_K)
+                                   block_k=block_k or DEFAULT_BLOCK_K,
+                                   alibi_slopes=alibi_slopes, window=window,
+                                   interpret=interpret)
         except Exception as e:                      # pragma: no cover
             _warn_fallback(f"{type(e).__name__}: {e}")
+    bias = None
+    if alibi_slopes is not None or window is not None:
+        bias = alibi_window_bias(q.shape[1], k.shape[1],
+                                 slopes=alibi_slopes, window=window)
     return reference_attention(q, k, v, causal=causal,
-                               softmax_scale=softmax_scale)
+                               softmax_scale=softmax_scale, bias=bias)
 
 
 @functools.lru_cache(maxsize=8)

@@ -495,8 +495,9 @@ def flash_attention(q, k, v, causal=True, softmax_scale=None,
     when the shape doesn't tile (S not divisible by the block size).
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU CI).
 
-    ``alibi_slopes`` ([H] fp32) adds the Bloom-style per-head ALiBi bias
-    ``slope * kpos`` in-kernel; ``window`` (traced int scalar, 0/None =
+    ``alibi_slopes`` ([H] fp32, treated as CONSTANT — stop_gradient; ALiBi
+    slopes are a deterministic function of the head count, never learned)
+    adds the Bloom-style per-head bias ``slope * kpos`` in-kernel; ``window`` (traced int scalar, 0/None =
     unlimited) applies a sliding-window mask AND skips K blocks wholly
     outside the window, so GPT-Neo/Mistral local attention gets its
     asymptotics (role of the reference's local-attention inference kernels,
@@ -513,5 +514,11 @@ def flash_attention(q, k, v, causal=True, softmax_scale=None,
                                    softmax_scale=softmax_scale, bias=bias)
     window_f = (None if window is None
                 else jnp.asarray(window, jnp.float32))
+    if alibi_slopes is not None:
+        # slopes are a deterministic function of the head count, not a
+        # learned parameter: declare them constant so the custom VJP's
+        # zero cotangent is stop_gradient semantics, not a silent grad loss
+        alibi_slopes = jax.lax.stop_gradient(
+            jnp.asarray(alibi_slopes, jnp.float32))
     return _flash_attention(q, k, v, alibi_slopes, window_f, scale, causal,
                             block_q, block_k, interpret)

@@ -113,6 +113,72 @@ def compressed_allreduce(grad: jnp.ndarray, worker_error: jnp.ndarray,
     return reduced, new_worker_error, new_server_error
 
 
+def quantized_allreduce(x: jnp.ndarray, axis_name: str, bits: int = 8,
+                        group_size: int = 256):
+    """EQuARX-style quantized allreduce (PAPERS.md: "Efficient Quantized
+    AllReduce in XLA"; SURVEY §5 names it as the quantized-collectives
+    analogue of the reference's 1-bit backends).
+
+    Both wire phases of a ring allreduce carry intN + per-group fp32 scales
+    instead of fp32 — ~``32/bits``x less traffic where bandwidth (DCN
+    between pod slices) dominates:
+
+    1. each worker groupwise-quantizes its local vector, ``all_to_all``
+       scatters per-peer chunks (the reduce-scatter wire phase),
+    2. every worker dequantizes the ``world`` versions of its chunk, sums
+       in fp32, requantizes, and ``all_gather``s the result.
+
+    Must run inside ``shard_map`` with ``axis_name`` bound; ``x`` is flat
+    fp32 with ``numel`` divisible by ``world * group_size`` (pad upstream
+    with :func:`pad_to_multiple`).  Returns the SUM-reduced vector (divide
+    by world for a mean), identical on every worker, with two rounds of
+    intN quantization error and no error feedback (at 8 bits the error is
+    ~1e-2 relative — the EF machinery the 1-bit path needs is unnecessary).
+    """
+    assert 2 <= bits <= 8, f"int8 storage caps bits at 8, got {bits}"
+    world = lax.psum(1, axis_name)
+
+    def q(v):
+        g = v.reshape(-1, group_size)
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) \
+            / float(2 ** (bits - 1) - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        codes = jnp.clip(jnp.round(g / scale), -(2 ** (bits - 1)),
+                         2 ** (bits - 1) - 1).astype(jnp.int8)
+        return codes, scale.astype(jnp.float32)
+
+    def dq(codes, scale):
+        return (codes.astype(jnp.float32) * scale).reshape(-1)
+
+    n = x.shape[0]
+    chunk = n // world
+    # phase 1: quantize, scatter chunks (worker i keeps chunk i)
+    codes, scales = q(x)
+    codes = codes.reshape(world, chunk // group_size, group_size)
+    scales = scales.reshape(world, chunk // group_size, 1)
+    recv_c = lax.all_to_all(codes, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)              # [world, groups, gs]
+    recv_s = lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+    mine = jax.vmap(dq)(recv_c.reshape(world, -1, group_size),
+                        recv_s.reshape(world, -1, 1)).sum(axis=0)
+
+    # phase 2: requantize the reduced chunk, allgather
+    out_c, out_s = q(mine)
+    all_c = lax.all_gather(out_c, axis_name)          # [world, groups, gs]
+    all_s = lax.all_gather(out_s, axis_name)
+    return jax.vmap(dq)(all_c, all_s).reshape(-1)
+
+
+def quantized_allreduce_bytes(numel: int, world: int, bits: int = 8,
+                              group_size: int = 256) -> int:
+    """Wire bytes per worker for :func:`quantized_allreduce` (both phases:
+    intN payload + fp32 group scales)."""
+    payload = numel * bits // 8
+    scales = numel // group_size * 4
+    return payload + scales + (payload // world + scales // world) * world
+
+
 def compressed_allreduce_bytes(numel: int, world: int) -> int:
     """Traffic per worker in bytes (both phases) — for comms logging; the
     fp32 ring-allreduce equivalent is ``~2 * 4 * numel``."""

@@ -151,3 +151,35 @@ def test_engine_onebit_adam_trains():
     losses = [float(engine.train_batch(batch=random_batch(8, HIDDEN, seed=0)))
               for _ in range(8)]
     assert losses[-1] < losses[0]
+
+
+# ----------------------------------------------------------------------
+# EQuARX-style int8 quantized allreduce
+# ----------------------------------------------------------------------
+def test_quantized_allreduce_close_to_exact():
+    from deepspeed_tpu.runtime.comm_compression import (
+        quantized_allreduce, quantized_allreduce_bytes)
+
+    world = 4
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+    rng = np.random.default_rng(0)
+    n = world * 256 * 4
+    locals_ = jnp.asarray(rng.normal(size=(world, n)), jnp.float32)
+
+    @jax.jit
+    def run(xs):
+        def f(x):
+            return quantized_allreduce(x[0], "dp", bits=8)[None]
+        return shard_map(f, mesh=mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(xs)
+
+    out = np.asarray(run(locals_))
+    exact = np.asarray(locals_.sum(axis=0))
+    # every worker holds the same reduced vector
+    for r in range(1, world):
+        np.testing.assert_array_equal(out[r], out[0])
+    # ~8-bit accurate (two quantization rounds)
+    rel = np.abs(out[0] - exact).max() / np.abs(exact).max()
+    assert rel < 0.02, rel
+    # and 3-4x cheaper on the wire than fp32
+    assert quantized_allreduce_bytes(n, world) < n * 4 * 2 * 0.3
