@@ -17,6 +17,17 @@ from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError  
 from deepspeed_tpu.runtime import zero  # noqa: F401
 from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: F401
 from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
+from deepspeed_tpu import module_inject, ops  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: F401
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine  # noqa: F401
+from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig  # noqa: F401
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments  # noqa: F401
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: F401
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,  # noqa: F401
+                                           DeepSpeedTransformerConfig)
+from deepspeed_tpu.module_inject import (replace_transformer_layer,  # noqa: F401
+                                         revert_transformer_layer)
 
 
 def initialize(args=None,

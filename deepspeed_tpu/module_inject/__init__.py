@@ -7,10 +7,12 @@ from deepspeed_tpu.module_inject.policies import (GPT2Policy, GPTNeoXPolicy,
                                                   find_policy)
 from deepspeed_tpu.module_inject.replace_module import (convert_hf_model,
                                                         is_hf_model,
-                                                        replace_transformer_layer)
+                                                        replace_transformer_layer,
+                                                        revert_transformer_layer)
 
 __all__ = [
     "AutoTP", "get_tp_rules", "InjectionPolicy", "GPT2Policy", "LlamaPolicy",
     "OPTPolicy", "GPTNeoXPolicy", "REPLACE_POLICIES", "find_policy",
     "convert_hf_model", "is_hf_model", "replace_transformer_layer",
+    "revert_transformer_layer",
 ]

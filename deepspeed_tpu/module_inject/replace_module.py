@@ -65,3 +65,12 @@ def replace_transformer_layer(model, hf_config=None, dtype=None,
 
 # parity alias (the reference API name most users call indirectly)
 convert_hf_model = replace_transformer_layer
+
+
+def revert_transformer_layer(orig_layer_impl, model, config, preln=False):
+    """Reference ``revert_transformer_layer`` reverses in-place kernel
+    injection.  Our conversion is FUNCTIONAL — ``replace_transformer_layer``
+    builds a fresh (TransformerConfig, params) and never mutates the HF
+    model — so there is nothing to revert: the original module is returned
+    unchanged, which is exactly the reference's postcondition."""
+    return model

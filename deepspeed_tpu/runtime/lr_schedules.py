@@ -25,11 +25,42 @@ WARMUP_DECAY_LR = "WarmupDecayLR"
 VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
 
 
+# one source of truth for schedule-parameter defaults: the schedule
+# builders AND the add_tuning_arguments CLI table both read this, so a
+# config-dict setup and a parsed-args setup cannot drift
+TUNING_DEFAULTS: Dict[str, Any] = {
+    "lr_range_test_min_lr": 1e-3,
+    "lr_range_test_step_size": 2000,
+    "lr_range_test_step_rate": 1.0,
+    "lr_range_test_staircase": False,
+    "cycle_min_lr": 1e-3,
+    "cycle_max_lr": 1e-2,
+    "decay_lr_rate": 0.0,
+    "cycle_first_step_size": 2000,
+    "cycle_second_step_size": None,   # None -> mirror first_step_size
+    "cycle_first_stair_count": 1,
+    "cycle_second_stair_count": None,
+    "decay_step_size": 0,
+    "cycle_min_mom": 0.8,
+    "cycle_max_mom": 0.9,
+    "decay_mom_rate": 0.0,
+    "warmup_min_lr": 0.0,
+    "warmup_max_lr": 0.001,
+    "warmup_num_steps": 1000,
+    "warmup_type": "log",
+}
+
+
+def _param(params: Dict[str, Any], key: str):
+    v = params.get(key, TUNING_DEFAULTS.get(key))
+    return TUNING_DEFAULTS.get(key) if v is None else v
+
+
 def lr_range_test(params: Dict[str, Any]) -> Callable:
-    min_lr = params.get("lr_range_test_min_lr", 1e-3)
-    step_size = params.get("lr_range_test_step_size", 2000)
-    step_rate = params.get("lr_range_test_step_rate", 1.0)
-    staircase = params.get("lr_range_test_staircase", False)
+    min_lr = _param(params, "lr_range_test_min_lr")
+    step_size = _param(params, "lr_range_test_step_size")
+    step_rate = _param(params, "lr_range_test_step_rate")
+    staircase = _param(params, "lr_range_test_staircase")
 
     def schedule(step):
         interval = jnp.asarray(step, jnp.float32) / step_size
@@ -40,13 +71,14 @@ def lr_range_test(params: Dict[str, Any]) -> Callable:
 
 
 def one_cycle(params: Dict[str, Any]) -> Callable:
-    cycle_min_lr = params.get("cycle_min_lr", 1e-3)
-    cycle_max_lr = params.get("cycle_max_lr", 1e-2)
-    decay_lr_rate = params.get("decay_lr_rate", 0.0)
-    cycle_first_step_size = params.get("cycle_first_step_size", 2000)
-    cycle_second_step_size = params.get("cycle_second_step_size",
-                                        cycle_first_step_size)
-    decay_step_size = params.get("decay_step_size", 0)
+    cycle_min_lr = _param(params, "cycle_min_lr")
+    cycle_max_lr = _param(params, "cycle_max_lr")
+    decay_lr_rate = _param(params, "decay_lr_rate")
+    cycle_first_step_size = _param(params, "cycle_first_step_size")
+    cycle_second_step_size = params.get("cycle_second_step_size")
+    if cycle_second_step_size is None:
+        cycle_second_step_size = cycle_first_step_size
+    decay_step_size = _param(params, "decay_step_size")
     total_cycle = cycle_first_step_size + cycle_second_step_size
 
     def schedule(step):
@@ -66,10 +98,10 @@ def one_cycle(params: Dict[str, Any]) -> Callable:
 
 
 def warmup_lr(params: Dict[str, Any]) -> Callable:
-    warmup_min_lr = params.get("warmup_min_lr", 0.0)
-    warmup_max_lr = params.get("warmup_max_lr", 0.001)
-    warmup_num_steps = max(1, params.get("warmup_num_steps", 1000))
-    warmup_type = params.get("warmup_type", "log")
+    warmup_min_lr = _param(params, "warmup_min_lr")
+    warmup_max_lr = _param(params, "warmup_max_lr")
+    warmup_num_steps = max(1, _param(params, "warmup_num_steps"))
+    warmup_type = _param(params, "warmup_type")
 
     def schedule(step):
         step = jnp.asarray(step, jnp.float32)
@@ -139,3 +171,38 @@ class LRScheduler:
 
     def load_state_dict(self, sd):
         self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+def _str2bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if str(v).lower() in ("yes", "true", "t", "1"):
+        return True
+    if str(v).lower() in ("no", "false", "f", "0"):
+        return False
+    raise ValueError(f"boolean flag got {v!r}")
+
+
+def add_tuning_arguments(parser):
+    """CLI args for schedule tuning (reference ``lr_schedules.py``
+    ``add_tuning_arguments`` — exported at the package top level).  One
+    ``--<key>`` flag per TUNING_DEFAULTS entry, so CLI defaults are the
+    schedule builders' defaults by construction."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    for key, default in TUNING_DEFAULTS.items():
+        if isinstance(default, bool):
+            typ = _str2bool
+        elif isinstance(default, int):
+            typ = int
+        elif isinstance(default, float):
+            typ = float
+        elif default is None:
+            typ = int          # the None-defaulted step sizes
+        else:
+            typ = str
+        group.add_argument(f"--{key}", type=typ, default=default,
+                           help=f"{key} (default {default})")
+    return parser

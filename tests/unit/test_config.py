@@ -131,3 +131,27 @@ def test_legacy_cpu_offload_bool():
         "zero_optimization": {"stage": 2, "cpu_offload": True},
     }, world_size=8)
     assert cfg.zero_config.offload_optimizer_device == "cpu"
+
+
+def test_top_level_api_surface():
+    """Reference deepspeed/__init__.py exports (SURVEY 2.1 top-level API):
+    every name a user imports from `deepspeed` resolves here too."""
+    import argparse
+
+    import deepspeed_tpu as d
+    for name in ("initialize", "init_inference", "init_distributed",
+                 "add_config_arguments", "add_tuning_arguments",
+                 "DeepSpeedEngine", "PipelineEngine", "InferenceEngine",
+                 "DeepSpeedInferenceConfig", "DeepSpeedConfig",
+                 "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+                 "replace_transformer_layer", "revert_transformer_layer",
+                 "checkpointing", "zero", "OnDevice", "module_inject",
+                 "ops", "comm", "get_accelerator"):
+        assert hasattr(d, name), name
+    p = argparse.ArgumentParser()
+    d.add_tuning_arguments(p)
+    args = p.parse_args(["--warmup_num_steps", "7"])
+    assert args.warmup_num_steps == 7
+    # revert is the identity on our functional conversion
+    sentinel = object()
+    assert d.revert_transformer_layer(None, sentinel, None) is sentinel
