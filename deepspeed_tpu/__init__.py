@@ -18,6 +18,7 @@ from deepspeed_tpu.runtime import zero  # noqa: F401
 from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: F401
 from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
 from deepspeed_tpu import module_inject, ops  # noqa: F401
+from deepspeed_tpu.runtime import DeepSpeedOptimizer, ZeROOptimizer  # noqa: F401
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: F401
 from deepspeed_tpu.runtime.pipe.engine import PipelineEngine  # noqa: F401
 from deepspeed_tpu.inference.engine import InferenceEngine  # noqa: F401

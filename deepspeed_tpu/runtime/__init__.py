@@ -1,0 +1,10 @@
+"""Runtime package (reference ``deepspeed/runtime/__init__.py`` defines the
+optimizer marker base classes used for isinstance checks)."""
+
+
+class DeepSpeedOptimizer:
+    pass
+
+
+class ZeROOptimizer(DeepSpeedOptimizer):
+    pass
