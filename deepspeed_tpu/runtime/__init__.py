@@ -1,5 +1,9 @@
 """Runtime package (reference ``deepspeed/runtime/__init__.py`` defines the
-optimizer marker base classes used for isinstance checks)."""
+optimizer marker base classes used for isinstance checks).  The host
+offload optimizer subclasses ZeROOptimizer, so reference-style
+``isinstance(opt, ZeROOptimizer)`` gates work for the one optimizer
+OBJECT this engine has; the optax transforms of the dense path are
+functions, not classes, so the markers are inert there by design."""
 
 
 class DeepSpeedOptimizer:

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.ops import cpu_adam
+from deepspeed_tpu.runtime import ZeROOptimizer
 from deepspeed_tpu.ops.aio import AsyncIOHandle
 from deepspeed_tpu.utils.logging import logger
 
@@ -321,7 +322,7 @@ class OptimizerStateSwapper:
         self._holds = [-1] * self.buffer_count
 
 
-class HostOffloadOptimizer:
+class HostOffloadOptimizer(ZeROOptimizer):
     """The offloaded optimizer: flat fp32 master + host Adam/Adagrad moments,
     optionally NVMe-swapped per sub-group.
 
