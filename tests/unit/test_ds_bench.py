@@ -18,3 +18,20 @@ def test_train_bench_gas_and_blocks():
                         batch=8, gas=2, seq=32, steps=1, vocab_size=64,
                         attn_block_q=16, attn_block_k=16)
     assert np.isfinite(out["loss"])
+
+
+def test_comm_bench_smoke():
+    """ds_bench comm (the reference's default ds_bench role) runs a small
+    collective sweep on the virtual mesh and reports algbw/busbw."""
+    from deepspeed_tpu.benchmarks.communication import main
+    res = main(["--collective", "all_reduce", "--size", "4096",
+                "--trials", "2", "--warmups", "1"])
+    assert res, "no results returned"
+
+
+def test_aio_bench_smoke(tmp_path):
+    """ds_bench aio: file round-trip throughput via the aio engine."""
+    from deepspeed_tpu.benchmarks.aio import main
+    res = main(["--file", str(tmp_path / "aio_bench.bin"),
+                "--size-mb", "2", "--reps", "1"])
+    assert res, "no results returned"
