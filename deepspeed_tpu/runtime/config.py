@@ -136,6 +136,7 @@ class DeepSpeedConfig:
                 f"Expected a dict or json path, got {type(config)}")
 
         pd = self._param_dict
+        self._warn_unknown_keys(pd)
         self.mesh_config = self._parse_mesh(pd.get(C.MESH, {}))
 
         if world_size is None:
@@ -204,6 +205,45 @@ class DeepSpeedConfig:
         self.pipeline_config = pd.get(C.PIPELINE, {})
 
         self._do_sanity_check()
+
+
+    # every top-level key this config understands; a typo like
+    # "zero_optimisation" silently no-ops otherwise (the reference ignores
+    # unknown keys too — warning is strictly more helpful)
+    _KNOWN_TOP_LEVEL_KEYS = frozenset({
+        C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+        C.GRADIENT_ACCUMULATION_STEPS, C.OPTIMIZER, C.SCHEDULER, C.FP16,
+        C.BFLOAT16, C.BFLOAT16_OLD, C.AMP, C.GRADIENT_CLIPPING,
+        C.PRESCALE_GRADIENTS, C.GRADIENT_PREDIVIDE_FACTOR,
+        C.STEPS_PER_PRINT, C.WALL_CLOCK_BREAKDOWN, C.DUMP_STATE,
+        C.SPARSE_GRADIENTS, C.ZERO_OPTIMIZATION, C.COMMS_LOGGER, C.MESH,
+        C.ACTIVATION_CHECKPOINTING, C.FLOPS_PROFILER,
+        C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV,
+        C.DATA_EFFICIENCY, C.CURRICULUM_LEARNING_LEGACY, C.CHECKPOINT,
+        C.ELASTICITY, C.COMPRESSION_TRAINING,
+        C.PIPELINE, C.SEED, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+        "eigenvalue", "progressive_layer_drop", "autotuning",
+        # reference top-level keys accepted for config portability but
+        # intentionally inert here (amp -> XLA owns mixed precision, the
+        # dtype/memory knobs have no TPU analogue); listed so ported
+        # configs don't warn
+        "gradient_accumulation_dtype", "communication_data_type",
+        "memory_breakdown",
+    })
+
+    def _warn_unknown_keys(self, pd):
+        from deepspeed_tpu.utils.logging import logger
+        unknown = sorted(k for k in pd if k not in
+                         self._KNOWN_TOP_LEVEL_KEYS)
+        if unknown:
+            import difflib
+            for k in unknown:
+                close = difflib.get_close_matches(
+                    k, self._KNOWN_TOP_LEVEL_KEYS, n=1)
+                hint = f" (did you mean '{close[0]}'?)" if close else ""
+                logger.warning(
+                    f"config key '{k}' is not recognized and will be "
+                    f"ignored{hint}")
 
     @staticmethod
     def _parse_mesh(mesh_dict) -> TopologyConfig:

@@ -155,3 +155,43 @@ def test_top_level_api_surface():
     # revert is the identity on our functional conversion
     sentinel = object()
     assert d.revert_transformer_layer(None, sentinel, None) is sentinel
+
+
+def test_unknown_config_key_warns_with_suggestion():
+    import io
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    old_level = ds_logger.level
+    ds_logger.setLevel(logging.WARNING)   # env-independent (DSTPU_LOG_LEVEL)
+    ds_logger.addHandler(handler)
+    try:
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "zero_optimisation": {"stage": 3}}, world_size=1)
+    finally:
+        ds_logger.removeHandler(handler)
+        ds_logger.setLevel(old_level)
+    text = buf.getvalue()
+    assert "zero_optimisation" in text
+    assert "zero_optimization" in text     # did-you-mean suggestion
+
+
+def test_known_key_whitelist_covers_all_reads():
+    """Every top-level key __init__ reads must be whitelisted, or valid
+    configs would produce false 'not recognized' warnings."""
+    import inspect
+    import re
+
+    from deepspeed_tpu.runtime import constants as C
+
+    src = inspect.getsource(DeepSpeedConfig.__init__)
+    read = set()
+    for m in re.finditer(r"pd\.get\(C\.([A-Z_0-9]+)", src):
+        read.add(getattr(C, m.group(1)))
+    for m in re.finditer(r"pd\.get\(\"([a-z_0-9]+)\"", src):
+        read.add(m.group(1))
+    missing = read - set(DeepSpeedConfig._KNOWN_TOP_LEVEL_KEYS)
+    assert not missing, f"keys read but not whitelisted: {missing}"
