@@ -216,14 +216,21 @@ def _worker_params_probe(spec):
 # parent orchestration
 # ---------------------------------------------------------------------------
 
-def _run_worker(name, spec=None, timeout=600, cpu=False):
+def _run_worker(name, spec=None, timeout=600, cpu=False, reserve=45):
     # never let one worker spend past the global budget (the driver kills
     # the whole run at its own deadline — a partial result beats rc=124);
     # with the budget exhausted, don't launch at all: the max(...) floor
-    # would otherwise keep granting 30s slices past the deadline
-    if _remaining() < 45:
+    # would otherwise keep granting 30s slices past the deadline.
+    # ``reserve``: callers of cheap must-run steps (the CPU fallback probe
+    # takes ~3s) pass a small reserve so three exhausted 150s TPU probe
+    # attempts can't starve them out of the budget entirely
+    if _remaining() < reserve:
         return None, "budget exhausted"
-    timeout = max(30, min(timeout, _remaining() - 15))
+    # never grant a slice that outlives the budget: below 35s remaining the
+    # 30s floor would push a hung subprocess past the global deadline
+    timeout = min(timeout, max(5, _remaining() - 5))
+    if _remaining() >= 35:
+        timeout = max(30, timeout)
     cmd = [sys.executable, os.path.abspath(__file__), "--worker", name]
     cmd.append(json.dumps(spec) if spec is not None else "null")
     if cpu:
@@ -253,16 +260,19 @@ def main():
     # leaves budget for the train run when a later attempt succeeds.
     probe = None
     for attempt in range(3):
-        probe, err = _run_worker("probe", timeout=150)
+        # a hung first attempt already diagnoses the tunnel: keep retries
+        # short so the CPU train fallback still fits in the budget
+        probe, err = _run_worker("probe", timeout=150 if attempt == 0 else 60)
         if probe:
             break
         errors[f"probe_attempt{attempt}"] = err
         time.sleep(10)
     if not probe:
-        probe, err = _run_worker("probe", timeout=150, cpu=True)
+        probe, err = _run_worker("probe", timeout=150, cpu=True, reserve=8)
         if probe:
             probe["fallback"] = "cpu"
         else:
+            errors["probe_cpu"] = err
             print(json.dumps({
                 "metric": "train_tokens_per_sec_per_chip",
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,

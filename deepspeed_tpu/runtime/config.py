@@ -232,7 +232,6 @@ class DeepSpeedConfig:
     })
 
     def _warn_unknown_keys(self, pd):
-        from deepspeed_tpu.utils.logging import logger
         unknown = sorted(k for k in pd if k not in
                          self._KNOWN_TOP_LEVEL_KEYS)
         if unknown:

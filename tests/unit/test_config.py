@@ -189,9 +189,11 @@ def test_known_key_whitelist_covers_all_reads():
 
     src = inspect.getsource(DeepSpeedConfig.__init__)
     read = set()
-    for m in re.finditer(r"pd\.get\(C\.([A-Z_0-9]+)", src):
+    for m in re.finditer(r"(?:pd\.get|get_scalar_param)\(\s*(?:pd,\s*)?"
+                         r"C\.([A-Z_0-9]+)", src):
         read.add(getattr(C, m.group(1)))
-    for m in re.finditer(r"pd\.get\(\"([a-z_0-9]+)\"", src):
+    for m in re.finditer(r"pd\.get\(\s*\"([a-z_0-9]+)\"", src):
         read.add(m.group(1))
+    assert len(read) > 25, f"source scan looks broken: {sorted(read)}"
     missing = read - set(DeepSpeedConfig._KNOWN_TOP_LEVEL_KEYS)
     assert not missing, f"keys read but not whitelisted: {missing}"
