@@ -50,7 +50,8 @@ from deepspeed_tpu.runtime.loss_scaler import (LossScaleState,
                                                has_inf_or_nan,
                                                static_loss_scale_state,
                                                update_scale)
-from deepspeed_tpu.runtime.lr_schedules import LRScheduler, build_schedule
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, build_schedule,
+                                                one_cycle_mom)
 from deepspeed_tpu.runtime.optimizers import build_optimizer
 from deepspeed_tpu.runtime.zero.stage_plan import (ZeroShardingPlan,
                                                    constrain,
@@ -272,6 +273,14 @@ class DeepSpeedEngine:
             base_lr = opt_params.get("lr", 1e-3)
             if schedule_fn is not None:
                 opt_params["lr"] = schedule_fn
+            # 1Cycle momentum cycling (reference OneCycle cycles optimizer
+            # momentum inversely to lr) — adam-family only
+            if (cfg.scheduler_config and cfg.scheduler_config.type ==
+                    "OneCycle" and config_opt_name.lower() in
+                    ("adam", "adamw", "fusedadam", "cpuadam")):
+                mom_fn = one_cycle_mom(cfg.scheduler_config.params)
+                if mom_fn is not None:
+                    opt_params["_b1_schedule"] = mom_fn
             try:
                 tx = build_optimizer(config_opt_name, opt_params)
             except ValueError:

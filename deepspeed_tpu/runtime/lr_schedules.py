@@ -70,30 +70,61 @@ def lr_range_test(params: Dict[str, Any]) -> Callable:
     return schedule
 
 
+def _cycle_phase(params: Dict[str, Any]):
+    """Shared 1Cycle geometry: returns ``phase(step) -> (scale, in_cycle,
+    decay_intervals)`` where ``scale`` is the up/down triangle in [0, 1]
+    (both the lr and the momentum schedule ride the same triangle, so the
+    two can't desynchronize)."""
+    first = _param(params, "cycle_first_step_size")
+    second = params.get("cycle_second_step_size")
+    if second is None:
+        second = first
+    decay_step = _param(params, "decay_step_size")
+    total = first + second
+
+    def phase(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = jnp.clip(step / first, 0.0, 1.0)
+        down = jnp.clip((step - first) / second, 0.0, 1.0)
+        past = jnp.maximum(step - total, 0.0)
+        intervals = past / decay_step if decay_step > 0 else past
+        return up - down, step <= total, intervals
+    return phase
+
+
 def one_cycle(params: Dict[str, Any]) -> Callable:
     cycle_min_lr = _param(params, "cycle_min_lr")
     cycle_max_lr = _param(params, "cycle_max_lr")
     decay_lr_rate = _param(params, "decay_lr_rate")
-    cycle_first_step_size = _param(params, "cycle_first_step_size")
-    cycle_second_step_size = params.get("cycle_second_step_size")
-    if cycle_second_step_size is None:
-        cycle_second_step_size = cycle_first_step_size
-    decay_step_size = _param(params, "decay_step_size")
-    total_cycle = cycle_first_step_size + cycle_second_step_size
+    phase = _cycle_phase(params)
 
     def schedule(step):
-        step = jnp.asarray(step, jnp.float32)
-        up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
-        down = jnp.clip((step - cycle_first_step_size) / cycle_second_step_size,
-                        0.0, 1.0)
-        in_cycle_lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * (up - down)
-        past = jnp.maximum(step - total_cycle, 0.0)
-        if decay_step_size > 0:
-            decay_intervals = past / decay_step_size
-        else:
-            decay_intervals = past
-        decayed = cycle_min_lr / (1.0 + decay_lr_rate * decay_intervals)
-        return jnp.where(step <= total_cycle, in_cycle_lr, decayed)
+        scale, in_cycle, intervals = phase(step)
+        in_cycle_lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * scale
+        decayed = cycle_min_lr / (1.0 + decay_lr_rate * intervals)
+        return jnp.where(in_cycle, in_cycle_lr, decayed)
+    return schedule
+
+
+def one_cycle_mom(params: Dict[str, Any]):
+    """Momentum schedule of the 1Cycle policy (reference ``OneCycle``:
+    momentum cycles INVERSELY to lr — ``mom = max - (max-min)*scale`` over
+    the same up/down triangle, then ``max * (1 + decay_mom_rate * t)``
+    after the cycle).  ``cycle_momentum`` defaults ON like the reference
+    (bounds default 0.8/0.9 from TUNING_DEFAULTS when not given); returns
+    None only when explicitly disabled."""
+    if not params.get("cycle_momentum", True):
+        return None
+    min_mom = _param(params, "cycle_min_mom")
+    max_mom = _param(params, "cycle_max_mom")
+    decay_mom_rate = _param(params, "decay_mom_rate")
+    phase = _cycle_phase(params)
+
+    def schedule(step):
+        scale, in_cycle, intervals = phase(step)
+        in_cycle_mom = max_mom - (max_mom - min_mom) * scale
+        decayed = max_mom * (1.0 + decay_mom_rate * intervals)
+        return jnp.where(in_cycle, in_cycle_mom, decayed)
     return schedule
 
 

@@ -39,6 +39,19 @@ def _adam(params: Dict[str, Any], adamw_mode=True) -> optax.GradientTransformati
     betas = params.get("betas", (0.9, 0.999))
     eps = params.get("eps", 1e-8)
     wd = params.get("weight_decay", 0.01 if adamw_mode else 0.0)
+    b1_schedule = params.get("_b1_schedule")   # 1Cycle momentum cycling
+    if b1_schedule is not None:
+        # inject_hyperparams lets b1 follow a schedule (the reference's
+        # OneCycle sets optimizer momentum per step); lr may itself be a
+        # schedule — both are resolved per step
+        base = optax.adamw if adamw_mode else optax.adam
+        kw = dict(learning_rate=lr, b1=b1_schedule, b2=betas[1], eps=eps)
+        if adamw_mode:
+            kw["weight_decay"] = wd
+        tx = optax.inject_hyperparams(base)(**kw)
+        if not adamw_mode and wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
     if adamw_mode:
         return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
     tx = optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)

@@ -66,3 +66,68 @@ def test_stateful_wrapper():
     sched2 = LRScheduler(s)
     sched2.load_state_dict(sd)
     assert sched2.get_lr() == sched.get_lr()
+
+
+# ----------------------------------------------------------------------
+# 1Cycle momentum cycling
+# ----------------------------------------------------------------------
+def test_one_cycle_mom_schedule_shape():
+    from deepspeed_tpu.runtime.lr_schedules import one_cycle, one_cycle_mom
+
+    params = {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1,
+              "cycle_first_step_size": 100,
+              "cycle_min_mom": 0.85, "cycle_max_mom": 0.95,
+              "decay_mom_rate": 0.0}
+    lr = one_cycle(params)
+    mom = one_cycle_mom(params)
+    # momentum mirrors lr: lr up <-> mom down (reference _get_cycle_mom)
+    assert abs(float(mom(0)) - 0.95) < 1e-6
+    assert abs(float(mom(100)) - 0.85) < 1e-6   # lr peak, mom trough
+    assert abs(float(mom(200)) - 0.95) < 1e-6
+    assert float(lr(100)) > float(lr(0))
+    # post-cycle decay grows momentum by decay_mom_rate per interval
+    params2 = dict(params, decay_mom_rate=0.1, decay_step_size=10)
+    mom2 = one_cycle_mom(params2)
+    assert float(mom2(210)) > 0.95
+    # reference parity: cycling defaults ON (0.8/0.9 bounds); only an
+    # explicit cycle_momentum=False disables it
+    assert one_cycle_mom({"cycle_momentum": False}) is None
+    default_mom = one_cycle_mom({})
+    assert default_mom is not None
+    assert abs(float(default_mom(0)) - 0.9) < 1e-6
+
+
+def test_engine_one_cycle_cycles_optimizer_momentum():
+    import jax
+
+    import deepspeed_tpu
+    from unit.simple_model import SimpleModel, base_config, random_batch
+
+    model = SimpleModel(16)
+    cfg = base_config(stage=0)
+    cfg["scheduler"] = {"type": "OneCycle", "params": {
+        "cycle_min_lr": 1e-3, "cycle_max_lr": 1e-2,
+        "cycle_first_step_size": 4,
+        "cycle_min_mom": 0.85, "cycle_max_mom": 0.95}}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.init(jax.random.key(0)),
+        config=cfg)
+
+    def find_b1(opt_state):
+        found = []
+
+        def visit(node):
+            if hasattr(node, "hyperparams") and "b1" in node.hyperparams:
+                found.append(float(node.hyperparams["b1"]))
+            if isinstance(node, (list, tuple)):
+                for c in node:
+                    visit(c)
+        visit(opt_state)
+        return found
+
+    b1_start = find_b1(engine.state.opt_state)
+    assert b1_start and abs(b1_start[0] - 0.95) < 1e-5
+    for s in range(4):
+        engine.train_batch(batch=random_batch(32, 16, seed=s))
+    b1_mid = find_b1(engine.state.opt_state)
+    assert b1_mid and b1_mid[0] < 0.90     # momentum followed the cycle
