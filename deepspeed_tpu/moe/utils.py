@@ -49,8 +49,12 @@ def has_moe_layers(model_or_params) -> Tuple[bool, int]:
     moe_paths = [p for p in paths if is_moe_param(p)]
     if not moe_paths:
         return False, 0
-    # expert count = leading axis of a stacked expert WEIGHT ([E, in, out],
-    # ndim>=3); gate/bias leaves under the moe subtree don't carry it
+    # expert count = LEADING axis of a stacked expert WEIGHT (ndim>=3):
+    # the model zoo's per-layer moe leaves are [E, in, out] and an Experts
+    # bank stacks [E_local, ...] (moe/experts.py:10) — both put the expert
+    # axis first.  Models that also carry a layers axis expose
+    # moe_num_experts via config, which the attribute path above prefers,
+    # so no [L, E, ...] leaf reaches this fallback.
     for (p, leaf) in jax.tree_util.tree_leaves_with_path(model_or_params):
         if is_moe_param(jax.tree_util.keystr(p)) and np.ndim(leaf) >= 3:
             return True, int(np.shape(leaf)[0])

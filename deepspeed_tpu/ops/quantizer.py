@@ -37,6 +37,10 @@ def quantize(x, groups=1, num_bits=8, symmetric=True, stochastic=False,
     asymmetric: scale = (max-min)/(2^bits-1), zero = min (``_asym`` variants)
     stochastic: stochastic rounding (``ds_sr_quantize``)
     """
+    if num_bits > 8:
+        raise ValueError(
+            f"num_bits={num_bits}: int8 storage holds at most 8 bits; a "
+            "wider cast would silently wrap")
     orig_shape = x.shape
     g = _grouped(x.astype(jnp.float32), groups)
     qmax = 2.0 ** (num_bits - 1) - 1

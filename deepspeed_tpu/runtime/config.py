@@ -137,6 +137,7 @@ class DeepSpeedConfig:
 
         pd = self._param_dict
         self._warn_unknown_keys(pd)
+        self._note_inert_sparse_attention(pd)
         self.mesh_config = self._parse_mesh(pd.get(C.MESH, {}))
 
         if world_size is None:
@@ -229,11 +230,31 @@ class DeepSpeedConfig:
         # configs don't warn
         "gradient_accumulation_dtype", "communication_data_type",
         "memory_breakdown",
+        # more reference top-level keys (reference runtime/config.py reads
+        # data_types at :943, nebula at :954; disable_allgather/
+        # zero_force_ds_cpu_optimizer are ZeRO-impl knobs with no TPU
+        # analogue) — accepted so ported configs don't warn
+        "data_types", "nebula", "disable_allgather",
+        "zero_force_ds_cpu_optimizer",
     })
+
+    def _note_inert_sparse_attention(self, pd):
+        # 'sparse_attention' names functionality this repo DOES ship
+        # (ops/sparse_attention, reference runtime/config.py:918) but the
+        # engine config doesn't wire it — models opt in via the ops API.
+        # One explicit line, not a silent swallow and not a scary
+        # unknown-key warning.
+        if "sparse_attention" in pd:
+            logger.info(
+                "config key 'sparse_attention' is accepted for "
+                "portability but not engine-wired; enable sparsity via "
+                "the model config / deepspeed_tpu.ops.sparse_attention "
+                "(SparseSelfAttention / sparsity configs)")
 
     def _warn_unknown_keys(self, pd):
         unknown = sorted(k for k in pd if k not in
-                         self._KNOWN_TOP_LEVEL_KEYS)
+                         self._KNOWN_TOP_LEVEL_KEYS
+                         and k != "sparse_attention")
         if unknown:
             import difflib
             for k in unknown:

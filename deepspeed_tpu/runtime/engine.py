@@ -88,6 +88,14 @@ class StepMetrics:
     overflow: jnp.ndarray
 
 
+def moq_anneal_step(state: "TrainState") -> jnp.ndarray:
+    """The MoQ anneal clock: the *successful*-step counter.  The reference
+    Quantizer only advances qsteps/ratio on non-overflow steps; every
+    quantizer.transform call site (train, eval, pipeline) must use this one
+    definition or their quantization bits desynchronize."""
+    return state.global_step - state.skipped_steps
+
+
 class DeepSpeedEngine:
 
     def __init__(self,
@@ -442,8 +450,18 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # the compiled step
     # ------------------------------------------------------------------
-    def _loss_and_grads(self, params, loss_scale, batch, rng, step=None):
-        """value_and_grad of the (possibly loss-scaled) compute-dtype loss."""
+    def _loss_and_grads(self, params, loss_scale, batch, rng, step=None,
+                        qstep=None):
+        """value_and_grad of the (possibly loss-scaled) compute-dtype loss.
+
+        ``qstep`` is the MoQ anneal clock — the *successful*-step counter
+        (global_step - skipped_steps), because the reference Quantizer skips
+        qsteps/ratio advancement on fp16 overflow steps (its quantize() is
+        only called from a non-overflow step path).  Compression scheduling
+        stays on the raw global step like the reference scheduler."""
+        if qstep is None:
+            qstep = step
+
         def scaled_loss(p):
             p_c = jax.tree_util.tree_map(
                 lambda x: x.astype(self.compute_dtype)
@@ -458,7 +476,7 @@ class DeepSpeedEngine:
                 # applies them to the unquantized master, i.e. identity
                 # backward — without this, d(round)/dx = 0 kills training.
                 q_c = self.quantizer.transform(
-                    p_c, step, rng=jax.random.fold_in(rng, 0x4D6F51),
+                    p_c, qstep, rng=jax.random.fold_in(rng, 0x4D6F51),
                     schedule_offset=self.quantizer.schedule_offset)
                 p_c = jax.tree_util.tree_map(
                     lambda x, q: x + jax.lax.stop_gradient(q - x), p_c, q_c)
@@ -521,7 +539,7 @@ class DeepSpeedEngine:
         return new_state, metrics
 
     def _forward_grads(self, params, scale, step_rng, batch, gas: int,
-                       step=None):
+                       step=None, qstep=None):
         """GAS microbatch accumulation (``lax.scan``) shared by the fused and
         the offload step builders (reference: one grad-accumulation semantic,
         ``backward:1931`` scaling by 1/GAS)."""
@@ -531,7 +549,7 @@ class DeepSpeedEngine:
                 acc, rloss = carry
                 mb_rng = jax.random.fold_in(step_rng, idx)
                 loss, grads = self._loss_and_grads(params, scale, mb, mb_rng,
-                                                   step=step)
+                                                   step=step, qstep=qstep)
                 acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 return (acc, rloss + loss), None
 
@@ -542,7 +560,8 @@ class DeepSpeedEngine:
                 (jnp.arange(gas), batch))
             grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
             return lsum / gas, grads
-        return self._loss_and_grads(params, scale, batch, step_rng, step=step)
+        return self._loss_and_grads(params, scale, batch, step_rng, step=step,
+                                    qstep=qstep)
 
     def _build_train_step(self, gas: int):
         cfg = self._config
@@ -551,9 +570,10 @@ class DeepSpeedEngine:
         def train_step(state: TrainState, batch):
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             rng, step_rng = jax.random.split(state.rng)
-            loss, grads = self._forward_grads(state.params, scale, step_rng,
-                                              batch, gas,
-                                              step=state.global_step)
+            loss, grads = self._forward_grads(
+                state.params, scale, step_rng, batch, gas,
+                step=state.global_step,
+                qstep=moq_anneal_step(state))
             # ZeRO grad placement: stage>=2 spec is fsdp-sharded → XLA lowers
             # the DP reduction as reduce-scatter (reference average_tensor /
             # __reduce_and_partition_ipg_grads)
@@ -577,9 +597,10 @@ class DeepSpeedEngine:
             def grad_step(state: TrainState, batch):
                 scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
                 rng, step_rng = jax.random.split(state.rng)
-                loss, grads = self._forward_grads(state.params, scale,
-                                                  step_rng, batch, gas,
-                                                  step=state.global_step)
+                loss, grads = self._forward_grads(
+                    state.params, scale, step_rng, batch, gas,
+                    step=state.global_step,
+                    qstep=moq_anneal_step(state))
                 grads = constrain(grads, self.plan.grad_specs(state.params),
                                   self.mesh)
                 overflow = (has_inf_or_nan(grads) if fp16
@@ -646,9 +667,10 @@ class DeepSpeedEngine:
                 scale = (state.loss_scale.cur_scale
                          if self._config.fp16_enabled else jnp.float32(1.0))
                 rng, step_rng = jax.random.split(state.rng)
-                loss, grads = self._loss_and_grads(state.params, scale, batch,
-                                                   step_rng,
-                                                   step=state.global_step)
+                loss, grads = self._loss_and_grads(
+                    state.params, scale, batch, step_rng,
+                    step=state.global_step,
+                    qstep=moq_anneal_step(state))
                 grads = constrain(grads, self.plan.grad_specs(state.params),
                                   self.mesh)
                 overflow = (has_inf_or_nan(grads)
@@ -819,8 +841,11 @@ class DeepSpeedEngine:
                 if self._compression is not None:
                     p_c = self._compression.transform(p_c, state.global_step)
                 if self.quantizer is not None:
+                    # same successful-step anneal clock as the training
+                    # forward, or eval sees further-annealed bits after
+                    # any overflow step
                     p_c = self.quantizer.transform(
-                        p_c, state.global_step,
+                        p_c, moq_anneal_step(state),
                         schedule_offset=self.quantizer.schedule_offset)
                 return self.loss_fn(p_c, batch, state.rng)
             self._compiled_eval = jax.jit(ev)

@@ -87,7 +87,11 @@ def _cycle_phase(params: Dict[str, Any]):
         up = jnp.clip(step / first, 0.0, 1.0)
         down = jnp.clip((step - first) / second, 0.0, 1.0)
         past = jnp.maximum(step - total, 0.0)
-        intervals = past / decay_step if decay_step > 0 else past
+        # reference OneCycle sets skip_lr_decay/skip_mom_decay when
+        # decay_step_size==0 (the default): lr/momentum hold constant after
+        # the cycle.  intervals=0 reproduces that; a per-step interval here
+        # would grow momentum past 1.0 and diverge Adam.
+        intervals = past / decay_step if decay_step > 0 else jnp.zeros_like(past)
         return up - down, step <= total, intervals
     return phase
 
@@ -123,7 +127,10 @@ def one_cycle_mom(params: Dict[str, Any]):
     def schedule(step):
         scale, in_cycle, intervals = phase(step)
         in_cycle_mom = max_mom - (max_mom - min_mom) * scale
-        decayed = max_mom * (1.0 + decay_mom_rate * intervals)
+        # post-cycle growth only: Adam's (1-b1) weighting must stay
+        # positive (user-configured cycle bounds are not clamped)
+        decayed = jnp.minimum(
+            max_mom * (1.0 + decay_mom_rate * intervals), 0.999)
         return jnp.where(in_cycle, in_cycle_mom, decayed)
     return schedule
 

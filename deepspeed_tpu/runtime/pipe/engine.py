@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.runtime.engine import DeepSpeedEngine, TrainState
+from deepspeed_tpu.runtime.engine import (DeepSpeedEngine, TrainState,
+                                          moq_anneal_step)
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
 from deepspeed_tpu.utils.logging import log_dist
@@ -67,7 +68,9 @@ class PipelineEngine(DeepSpeedEngine):
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             rng, step_rng = jax.random.split(state.rng)
             loss, grads = self._loss_and_grads(
-                state.params, scale, batch, step_rng)
+                state.params, scale, batch, step_rng,
+                step=state.global_step,
+                qstep=moq_anneal_step(state))
             return self._finish_step(state, loss, grads, rng)
 
         return train_step

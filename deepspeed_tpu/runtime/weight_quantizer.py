@@ -47,6 +47,10 @@ class WeightQuantization:
                       ) -> Tuple[np.ndarray, np.ndarray]:
         """Flat groupwise symmetric intN: scale = 2^bits / (2*max|g|), int
         values clamped to the signed range (reference ``quantize_data``)."""
+        if quantize_bits > 8:
+            raise ValueError(
+                f"quantize_bits={quantize_bits}: int8 storage holds at most "
+                "8 bits; a wider cast would silently wrap")
         arr = np.asarray(data, np.float32)
         groups = max(1, int(np.gcd(arr.size, max(1, int(groups)))))
         flat = arr.reshape(groups, -1)

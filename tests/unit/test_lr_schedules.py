@@ -97,6 +97,24 @@ def test_one_cycle_mom_schedule_shape():
     assert abs(float(default_mom(0)) - 0.9) < 1e-6
 
 
+def test_one_cycle_no_decay_holds_after_cycle():
+    """decay_step_size==0 (default) => skip_lr_decay/skip_mom_decay like
+    the reference: lr and momentum hold constant past the cycle instead of
+    decaying every step (momentum must never reach 1.0 or Adam diverges)."""
+    from deepspeed_tpu.runtime.lr_schedules import one_cycle, one_cycle_mom
+
+    params = {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1,
+              "cycle_first_step_size": 100,
+              "decay_lr_rate": 0.5, "decay_mom_rate": 0.5}  # no decay_step_size
+    lr, mom = one_cycle(params), one_cycle_mom(params)
+    assert abs(float(lr(201)) - float(lr(10_000))) < 1e-7
+    assert abs(float(mom(201)) - float(mom(10_000))) < 1e-7
+    assert float(mom(1_000_000)) < 1.0
+    # and with decay_step_size set, momentum decay still caps below 1.0
+    mom2 = one_cycle_mom(dict(params, decay_step_size=10))
+    assert float(mom2(1_000_000)) < 1.0
+
+
 def test_engine_one_cycle_cycles_optimizer_momentum():
     import jax
 

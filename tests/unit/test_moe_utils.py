@@ -52,6 +52,20 @@ def test_has_moe_layers_on_params_and_model():
     assert has_moe_layers(M()) == (True, 8)
 
 
+def test_has_moe_layers_expert_bank_4d_leaf():
+    # an Experts bank stacks [E_local, ...] on the LEADING axis even when
+    # the per-expert weight is itself >=3-D (e.g. per-head [H, dh, d]);
+    # the expert count must come from axis 0, not an inner axis
+    p = {"experts": np.zeros((4, 2, D, D), np.float32)}
+    assert has_moe_layers(p) == (True, 4)
+    # a model carrying a layers axis reports experts via config, not shapes
+
+    class M:
+        class config:
+            moe_num_experts = 4
+    assert has_moe_layers(M()) == (True, 4)
+
+
 def test_split_shared_and_expert_params():
     p = _params()
     shared, expert = split_params_into_shared_and_expert_params(p)
