@@ -236,6 +236,8 @@ class DeepSpeedConfig:
         # analogue) — accepted so ported configs don't warn
         "data_types", "nebula", "disable_allgather",
         "zero_force_ds_cpu_optimizer",
+        # sparse_attention gets its own notice (_note_inert_sparse_attention)
+        "sparse_attention",
     })
 
     def _note_inert_sparse_attention(self, pd):
@@ -253,8 +255,7 @@ class DeepSpeedConfig:
 
     def _warn_unknown_keys(self, pd):
         unknown = sorted(k for k in pd if k not in
-                         self._KNOWN_TOP_LEVEL_KEYS
-                         and k != "sparse_attention")
+                         self._KNOWN_TOP_LEVEL_KEYS)
         if unknown:
             import difflib
             for k in unknown:

@@ -463,31 +463,44 @@ class DeepSpeedEngine:
             qstep = step
 
         def scaled_loss(p):
-            p_c = jax.tree_util.tree_map(
-                lambda x: x.astype(self.compute_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
-            if self._compression is not None and step is not None:
-                p_c = self._compression.transform(p_c, step)
-            if self.quantizer is not None and step is not None:
-                # MoQ: forward sees Q(w) from the schedule_offset step on —
-                # the cast-site equivalent of the reference's post-step
-                # quantization of the fp16 weight copy (engine.py:1799).
-                # Straight-through: the reference evaluates grads at Q(w) but
-                # applies them to the unquantized master, i.e. identity
-                # backward — without this, d(round)/dx = 0 kills training.
-                q_c = self.quantizer.transform(
-                    p_c, qstep, rng=jax.random.fold_in(rng, 0x4D6F51),
-                    schedule_offset=self.quantizer.schedule_offset)
-                p_c = jax.tree_util.tree_map(
-                    lambda x, q: x + jax.lax.stop_gradient(q - x), p_c, q_c)
-            loss = self.loss_fn(p_c, batch, rng)
-            return (loss * loss_scale).astype(jnp.float32), loss
+            p_c = self._transformed_compute_params(p, rng, step, qstep)
+            return self._model_scaled_loss(p_c, batch, rng, loss_scale)
 
         (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
         # unscale in fp32
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32) / loss_scale, grads)
         return loss, grads
+
+    def _transformed_compute_params(self, p, rng, step, qstep):
+        """Compute-dtype view of the params with the cast-site transforms
+        (compression STE, MoQ straight-through) applied."""
+        p_c = jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
+        if self._compression is not None and step is not None:
+            p_c = self._compression.transform(p_c, step)
+        if self.quantizer is not None and step is not None:
+            # MoQ: forward sees Q(w) from the schedule_offset step on —
+            # the cast-site equivalent of the reference's post-step
+            # quantization of the fp16 weight copy (engine.py:1799).
+            # Straight-through: the reference evaluates grads at Q(w) but
+            # applies them to the unquantized master, i.e. identity
+            # backward — without this, d(round)/dx = 0 kills training.
+            q_c = self.quantizer.transform(
+                p_c, qstep, rng=jax.random.fold_in(rng, 0x4D6F51),
+                schedule_offset=self.quantizer.schedule_offset)
+            p_c = jax.tree_util.tree_map(
+                lambda x, q: x + jax.lax.stop_gradient(q - x), p_c, q_c)
+        return p_c
+
+    def _model_scaled_loss(self, p_c, batch, rng, loss_scale):
+        """Hook: (scaled fp32 loss, unscaled loss).  PipelineEngine
+        overrides this to scale AT THE SOURCE inside the interleaved 1F1B
+        backward — fp16 cotangents must ride the pipe pre-amplified, like
+        the reference scales the loss before backward."""
+        loss = self.loss_fn(p_c, batch, rng)
+        return (loss * loss_scale).astype(jnp.float32), loss
 
     def _apply_update(self, state: TrainState, grads, overflow):
         """Shared optimizer-update tail: clip (inside tx), skip-on-overflow,

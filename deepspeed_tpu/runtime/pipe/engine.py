@@ -75,6 +75,15 @@ class PipelineEngine(DeepSpeedEngine):
 
         return train_step
 
+    def _model_scaled_loss(self, p_c, batch, rng, loss_scale):
+        """Scale AT THE SOURCE: the interleaved 1F1B backward runs inside
+        module.loss — fp16 cotangents must enter the pipe pre-amplified
+        (reference scales the loss before backward; multiplying afterwards
+        in the outer vjp would let small fp16 cotangents flush to zero
+        inside the scan)."""
+        scaled = self.module.loss(p_c, batch, rng, loss_scale=loss_scale)
+        return scaled.astype(jnp.float32), scaled / loss_scale
+
     # the 3-call API is train-schedule-incompatible with pipelining
     # (reference PipelineEngine raises the same way)
     def forward(self, *args, **kwargs):

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.models.transformer import CausalTransformerLM, TransformerConfig
 from deepspeed_tpu.parallel.topology import PP_AXIS, TP_AXIS
 from deepspeed_tpu.runtime.pipe.pipeline import (pipeline_spmd,
+                                                 pipeline_train_1f1b,
                                                  stack_stage_params)
 from deepspeed_tpu.utils.logging import logger
 
@@ -290,8 +291,10 @@ class PipelineModule:
         # is exported for grid-planning parity)
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
-        # "1f1b" caps in-flight activation residuals at ~P microbatches
-        # (reference TrainSchedule memory behaviour); "gpipe" stores all M
+        # "1f1b" = TRUE interleaved fwd/bwd (reference TrainSchedule): O(P)
+        # in-flight residuals, no recompute.  "1f1b-remat" = GPipe order
+        # with chunked remat (O(P) residuals bought with one fwd replay).
+        # "gpipe" stores all M.
         self.schedule = schedule
 
         self._specs = list(layers)
@@ -432,9 +435,14 @@ class PipelineModule:
         # lax.map bounds logits memory to one microbatch at a time
         return jax.lax.map(post_fn, x)
 
-    def loss(self, params, batch, rng=None):
+    def loss(self, params, batch, rng=None, loss_scale=None):
         """Pipelined loss.  ``batch`` MUST carry a leading microbatch dim
-        (the engine stacks GAS microbatches; M is the pipeline clock)."""
+        (the engine stacks GAS microbatches; M is the pipeline clock).
+
+        ``loss_scale``: when given, the returned loss is PRE-scaled and the
+        1f1b schedule seeds its interleaved backward with the scale, so
+        fp16 cotangents ride the pipe amplified (reference semantics:
+        scale before backward, not after)."""
         assert self._split is not None, "call init() first"
         start, end = self._split
         tied = params["tied"]
@@ -452,6 +460,25 @@ class PipelineModule:
         # _stage_fn already checkpoints per layer when activation
         # checkpointing is on — no second stage-level remat wrap
         stage_params = stack_stage_params(params["body"], self.num_stages)
+
+        if self.schedule == "1f1b" and self.num_stages > 1:
+            # TRUE 1F1B: the loss head runs inside the interleaved scan so
+            # each microbatch's backward starts the tick its forward exits
+            # (reference TrainSchedule, runtime/pipe/schedule.py:184) —
+            # O(P) live residuals, no recompute
+            post_params, n_layers, end_ = params["post"], len(self._layers), end
+
+            def head_fn(head_params, h, mb):
+                post, tied_hp = head_params
+                for j in range(end_, n_layers):
+                    h = self._call_layer(j, post[j - end_], h, tied_hp)
+                return self.loss_fn(h, mb)
+
+            return pipeline_train_1f1b(
+                self._stage_fn(), head_fn, self.num_stages,
+                stage_params, (post_params, tied), x, inputs,
+                loss_ct=loss_scale)
+
         x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages,
                           schedule=self.schedule)
 
@@ -461,7 +488,8 @@ class PipelineModule:
                 h = self._call_layer(j, params["post"][j - end], h, tied)
             return self.loss_fn(h, mb)
         losses = jax.lax.map(mb_loss, (x, inputs))
-        return jnp.mean(losses)
+        mean = jnp.mean(losses)
+        return mean if loss_scale is None else mean * loss_scale
 
     def partition_layers(self):
         """Report layer→stage assignment (reference logs the same at

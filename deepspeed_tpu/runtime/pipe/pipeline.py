@@ -20,20 +20,28 @@ TPU-first redesign: the WHOLE pipelined step is one jitted SPMD program.
   yields the reverse-clocked pipeline (grad ticks flow last-stage→first),
   which is exactly the reference's BackwardPass/SendGrad/RecvGrad stream.
 
-Schedules (both have bubble fraction ``(P-1)/(M+P-1)``; they differ in
-peak activation memory, exactly like the reference's ``InferenceSchedule``
+Schedules (all have bubble fraction ``O(P/M)``; they differ in peak
+activation memory and recompute, like the reference's ``InferenceSchedule``
 vs ``TrainSchedule``):
 
 * ``"gpipe"`` — one flat scan over the T clock ticks.  Scan autodiff saves
-  every tick's [P, ...] stage-input buffer: O(M) residuals per device.
-* ``"1f1b"`` (default) — the T ticks run as an outer scan over chunks of P
-  ticks with the chunk body rematerialised (``jax.checkpoint``).  Autodiff
-  then saves only the [P, ...] carry at each chunk boundary and replays a
-  chunk's ticks during backward: O(M/P + P) residuals per device — the
-  1F1B operating point (peak ≈ P in-flight microbatches), bought with one
-  forward recompute, the same price the reference pays for
-  activation-checkpointed 1F1B (``runtime/pipe/schedule.py:184``
-  ``TrainSchedule`` + activation checkpointing).
+  every tick's residuals: O(M) in-flight microbatches per device.
+* ``"1f1b"`` (default) — TRUE interleaved 1F1B
+  (:func:`pipeline_train_1f1b`): one scan whose every tick runs a forward
+  sub-tick AND a backward sub-tick, with each stage keeping the VJP
+  residuals of its in-flight microbatches in a ring buffer of ``2P-1``
+  slots.  Peak residual memory is O(P) in-flight microbatches per device —
+  independent of M — with NO forward recompute, matching the reference's
+  ``TrainSchedule`` (``runtime/pipe/schedule.py:184``) which interleaves
+  fwd/bwd so peak in-flight activations stay ≈P without checkpointing.
+  (The lockstep SPMD formulation holds ≤2P-1 in-flight at stage 0 vs the
+  reference's P — same asymptotics, a constant-factor trade for running
+  every stage's fwd+bwd in one compiled program.)
+* ``"1f1b-remat"`` — the previous round's schedule: GPipe ordering with the
+  tick scan rematerialised in chunks of P.  Same O(P) residual cap, bought
+  with one extra forward recompute per chunk — the price the reference
+  pays for activation-checkpointed 1F1B.  Kept for models whose stage
+  functions defeat the residual-threading of true 1F1B.
 """
 
 from functools import partial
@@ -41,6 +49,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import BATCH_AXES, PP_AXIS
@@ -68,15 +77,24 @@ def pipeline_spmd(stage_fn: Callable,
         the ``pp`` mesh axis).
       x_mbs: ``[M, ...]`` microbatched activations entering stage 0.
       remat: rematerialise the stage body itself (intra-stage activations).
-      schedule: ``"1f1b"`` (chunked remat over ticks — peak activation
-        residuals capped at ~P in-flight microbatches) or ``"gpipe"``
-        (flat scan — O(M) residuals, no tick recompute).
+      schedule: ``"1f1b-remat"`` (chunked remat over ticks — peak
+        activation residuals capped at ~P in-flight microbatches, one fwd
+        replay), ``"gpipe"`` (flat scan — O(M) residuals, no recompute), or
+        ``"1f1b"`` (alias for ``"1f1b-remat"`` here: TRUE interleaved 1F1B
+        training lives in :func:`pipeline_train_1f1b`; this function is the
+        forward pipeline only).
 
     Returns: ``[M, ...]`` outputs of the last stage.
     """
-    if schedule not in ("1f1b", "gpipe"):
+    if schedule not in ("1f1b", "1f1b-remat", "gpipe"):
         raise ValueError(f"unknown pipeline schedule '{schedule}' "
-                         "(1f1b|gpipe)")
+                         "(1f1b|1f1b-remat|gpipe)")
+    if schedule == "1f1b":
+        # training goes through pipeline_train_1f1b (interleaved backward);
+        # a direct caller differentiating THIS function still deserves the
+        # O(P) residual cap, so map to the chunked-remat scan — on a
+        # forward-only path jax.checkpoint costs nothing
+        schedule = "1f1b-remat"
     M = x_mbs.shape[0]
     Pn = num_stages
     T = M + Pn - 1
@@ -132,6 +150,332 @@ def pipeline_spmd(stage_fn: Callable,
     out = jax.lax.slice_in_dim(ys, Pn - 1, Pn - 1 + M, axis=0)
     entries = [None, tuple(BATCH_AXES)] + [None] * (out.ndim - 2)
     return maybe_constrain(out, P(*entries))
+
+
+# ----------------------------------------------------------------------
+# True interleaved 1F1B (reference runtime/pipe/schedule.py:184
+# TrainSchedule): every tick runs one forward AND one backward sub-tick,
+# so backward for microbatch m starts the tick after its forward exits and
+# each stage's live residual count is bounded by the ring size 2P-1 —
+# independent of M, with no forward recompute.
+#
+# The stage backward is hand-threaded: jax.vjp's pullback closure is
+# converted to a pure function + explicit residual arrays
+# (jax.closure_convert); residuals that depend on the stage INPUT are
+# carried per-(stage, in-flight microbatch) in ring buffers, while
+# residuals that depend only on the stage params (weight matrices saved
+# for matmul transposes) are computed once and shared across ticks — the
+# same storage split torch autograd gets implicitly (shared weight refs +
+# per-microbatch activation residuals).
+# ----------------------------------------------------------------------
+
+def _ring_spec(ndim: int) -> P:
+    """[K, P, ...]: ring dim replicated, stage dim over pp."""
+    return P(*([None, PP_AXIS] + [None] * (ndim - 2)))
+
+
+def _x_dependence(fn, sp_slice, x_slice):
+    """For ``fn(sp, x) -> (y, c0, c1, ...)`` return a bool per output:
+    does it depend (conservatively) on ``x``?  Walks the jaxpr dataflow;
+    any equation touching an x-descendant marks all its outputs."""
+    jpr = jax.make_jaxpr(fn)(sp_slice, x_slice)
+    jaxpr = jpr.jaxpr
+    n_sp = len(jax.tree_util.tree_leaves(sp_slice))
+    Var = type(jaxpr.invars[0])
+    dep = set(jaxpr.invars[n_sp:])
+    for eqn in jaxpr.eqns:
+        if any(isinstance(v, Var) and v in dep for v in eqn.invars):
+            dep.update(eqn.outvars)
+    return [isinstance(v, Var) and v in dep for v in jaxpr.outvars], \
+        [(v.aval.shape, v.aval.dtype) for v in jaxpr.outvars]
+
+
+def pipeline_train_1f1b(stage_fn: Callable,
+                        head_fn: Callable,
+                        num_stages: int,
+                        stage_params: Any,
+                        head_params: Any,
+                        x_mbs: jax.Array,
+                        batch_mbs: Any,
+                        loss_ct=None):
+    """Pipelined ``mean_m head_fn(head_params, pipe(x_m), batch_m)`` with a
+    true-1F1B gradient schedule.
+
+    Differentiable wrt ``stage_params``, ``head_params``, ``x_mbs`` and the
+    floating leaves of ``batch_mbs`` (``jax.custom_vjp``: the interleaved
+    scan computes the gradients itself; the outer autodiff only chain-rules
+    through them, so embedding/pre layers and ZeRO machinery compose
+    unchanged).
+
+    Args:
+      stage_fn: ``(stage_params_slice, x) -> y`` (shape-preserving).
+      head_fn: ``(head_params, y_exit, microbatch) -> scalar loss`` — the
+        post-pipeline layers + loss, applied per microbatch at exit time
+        (1F1B needs the exit cotangent while later microbatches are still
+        in the forward pipe, so the loss head must live inside).
+      num_stages: P.
+      x_mbs: ``[M, ...]`` activations entering stage 0.
+      batch_mbs: pytree with leading microbatch dim M (loss targets).
+      loss_ct: optional loss-scale seed.  fp16 cotangents must ride the
+        pipe PRE-amplified (the reference scales the loss before backward;
+        applying the scale afterwards in the vjp would let small fp16
+        cotangents flush to zero inside the scan).  When given, the return
+        value is ``loss * loss_ct`` and internal gradients carry the scale.
+
+    Returns: scalar loss (× ``loss_ct`` if given), mean over microbatches.
+    """
+    if loss_ct is None:
+        loss_ct = jnp.float32(1.0)
+    return _pipeline_1f1b_vjp(stage_fn, head_fn, num_stages)(
+        stage_params, head_params, x_mbs, batch_mbs, loss_ct)
+
+
+def _pipeline_1f1b_vjp(stage_fn, head_fn, num_stages):
+    """Build the custom-vjp'd closure for one (stage_fn, head_fn, P)."""
+
+    @jax.custom_vjp
+    def run(stage_params, head_params, x_mbs, batch_mbs, loss_ct):
+        # primal-only path (no grad requested): plain forward pipeline
+        ys = pipeline_spmd(stage_fn, stage_params, x_mbs, num_stages,
+                           schedule="gpipe")
+        M = x_mbs.shape[0]
+
+        def mb_loss(i, acc):
+            y = jax.tree_util.tree_map(lambda l: l[i], ys)
+            mb = jax.tree_util.tree_map(lambda l: l[i], batch_mbs)
+            return acc + head_fn(head_params, y, mb)
+        total = jax.lax.fori_loop(0, M, mb_loss, jnp.float32(0.0))
+        return total / M * loss_ct
+
+    # bwd rebuilds the batch cotangent structure (float0 for integer
+    # leaves); the structure is captured at fwd trace time — a trace-time
+    # constant, never a runtime value
+    batch_struct = [None]
+
+    def fwd(stage_params, head_params, x_mbs, batch_mbs, loss_ct):
+        batch_struct[0] = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), batch_mbs)
+        loss, grads = _interleaved_1f1b(stage_fn, head_fn, num_stages,
+                                        stage_params, head_params,
+                                        x_mbs, batch_mbs, loss_ct)
+        return loss, grads
+
+    def bwd(grads, ct):
+        # grads already carry loss_ct; ct is the OUTER cotangent (1.0 when
+        # the engine consumes the pre-scaled loss directly)
+        gstage, ghead, gx, gmb_f = grads
+
+        def scale_leaf(l):
+            if l.dtype == jax.dtypes.float0:
+                return l
+            return (l * ct).astype(l.dtype)
+
+        scale = lambda g: jax.tree_util.tree_map(scale_leaf, g)
+        b_leaves, b_treedef = jax.tree_util.tree_flatten(batch_struct[0])
+        it_f = iter(gmb_f)
+        gbatch = jax.tree_util.tree_unflatten(b_treedef, [
+            scale_leaf(next(it_f)) if jnp.issubdtype(l.dtype, jnp.inexact)
+            else np.zeros(l.shape, jax.dtypes.float0) for l in b_leaves])
+        return (scale(gstage), scale(ghead), scale(gx), gbatch,
+                jnp.zeros((), jnp.float32))  # d/d(loss_scale) is never used
+
+    run.defvjp(fwd, bwd)
+    return run
+
+
+def _interleaved_1f1b(stage_fn, head_fn, num_stages, stage_params,
+                      head_params, x_mbs, batch_mbs, loss_ct):
+    """The interleaved scan.  Returns
+    ``(loss, (gstage, ghead, gx_mbs, gbatch))``.
+
+    Clock bookkeeping (tick t, stage s, microbatch m):
+      fwd   of m at stage s:     t = m + s
+      exit + head vjp of m:      t = m + P - 1
+      bwd   of m at stage s:     t = m + 2(P-1) - s
+      dx of m exits stage 0:     t = m + 2(P-1)
+    so T = M + 2P - 2 ticks; the residual for (s, m) lives 2(P-1-s) ticks
+    and a ring of K = 2P-1 slots never collides.
+    """
+    M = x_mbs.shape[0]
+    Pn = int(num_stages)
+    K = 2 * Pn - 1
+    T = M + 2 * Pn - 2
+    feat_shape = x_mbs.shape[1:]
+
+    # batch partition: floating leaves get real gradients (soft labels,
+    # loss masks); integer leaves (token ids) get float0 cotangents
+    b_leaves, b_treedef = jax.tree_util.tree_flatten(batch_mbs)
+    b_is_float = [jnp.issubdtype(l.dtype, jnp.inexact) for l in b_leaves]
+
+    def fwd_parts(sp_slice, x):
+        """(y, *input-dependent-or-not residual consts) for ONE stage."""
+        y, pullback = jax.vjp(stage_fn, sp_slice, x)
+        _, consts = jax.closure_convert(pullback, y)
+        return (y, *consts)
+
+    sp_slice_aval = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), stage_params)
+    x_aval = jax.ShapeDtypeStruct(feat_shape, x_mbs.dtype)
+    xdep, out_avals = _x_dependence(fwd_parts, sp_slice_aval, x_aval)
+    # output 0 is y itself
+    xdep_consts = xdep[1:]
+    const_avals = out_avals[1:]
+    n_consts = len(const_avals)
+
+    fbuf0 = jnp.zeros((Pn,) + feat_shape, x_mbs.dtype)
+    fbuf0 = maybe_constrain(fbuf0, _buf_spec(fbuf0.ndim))
+
+    # params-only residuals: computed once, shared by every tick (these are
+    # the weight matrices the matmul transposes read — one copy, not K)
+    vparts = jax.vmap(fwd_parts)
+    warm = jax.jit(vparts)(stage_params, fbuf0)
+    shared_consts = [warm[1 + i] for i in range(n_consts)
+                     if not xdep_consts[i]]
+
+    # ring buffers for input-dependent residuals: [K, P, ...]
+    rings = [jnp.zeros((K, Pn) + tuple(shape), dtype)
+             for (shape, dtype), dep in zip(const_avals, xdep_consts) if dep]
+    rings = [maybe_constrain(r, _ring_spec(r.ndim)) for r in rings]
+
+    stage_ids = jnp.arange(Pn)
+
+    def head_vjp(y_exit, mb_leaves, ct):
+        mb_float = [l for l, f in zip(mb_leaves, b_is_float) if f]
+
+        def head_of(hp, y, *mbf):
+            it_f = iter(mbf)
+            leaves = [next(it_f) if f else l
+                      for l, f in zip(mb_leaves, b_is_float)]
+            return head_fn(hp, y, jax.tree_util.tree_unflatten(
+                b_treedef, leaves))
+        loss_m, pb = jax.vjp(head_of, head_params, y_exit, *mb_float)
+        ghead_m, gy, *gmb_float = pb(ct)
+        return loss_m, ghead_m, gy, tuple(gmb_float)
+
+    gstage0 = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, jnp.float32), stage_params)
+    ghead0 = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, jnp.float32) if
+        jnp.issubdtype(l.dtype, jnp.inexact) else jnp.zeros((), jnp.float32),
+        head_params)
+
+    def tick(carry, t):
+        fbuf, bshift, rings, gstage, ghead, loss_acc = carry
+
+        # ---- forward sub-tick ---------------------------------------
+        inp = jax.lax.dynamic_index_in_dim(
+            x_mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        slot0 = jnp.where(t < M, inp, fbuf[0])
+        fbuf = jax.lax.dynamic_update_index_in_dim(fbuf, slot0, 0, 0)
+        fbuf = maybe_constrain(fbuf, _buf_spec(fbuf.ndim))
+        parts = vparts(stage_params, fbuf)
+        y = parts[0]
+        y = maybe_constrain(y, _buf_spec(y.ndim))
+        new_consts = list(parts[1:])
+        if len(new_consts) != n_consts or any(
+                tuple(c.shape[1:]) != tuple(a[0])
+                for c, a in zip(new_consts, const_avals)):
+            raise RuntimeError(
+                "1f1b residual structure diverged between discovery and "
+                "scan traces; use schedule='1f1b-remat'")
+
+        # write input-dependent residuals at ring slot t mod K
+        w_idx = jnp.mod(t, K)
+        rings = [jax.lax.dynamic_update_index_in_dim(
+                    r, c, w_idx, 0)
+                 for r, c in zip(rings,
+                                 [c for c, d in zip(new_consts, xdep_consts)
+                                  if d])]
+        rings = [maybe_constrain(r, _ring_spec(r.ndim)) for r in rings]
+
+        # ---- exit + loss head ---------------------------------------
+        me = t - (Pn - 1)
+        head_valid = (me >= 0) & (me < M)
+        mb_leaves = [jax.lax.dynamic_index_in_dim(
+            l, jnp.clip(me, 0, M - 1), 0, keepdims=False) for l in b_leaves]
+        # seed the backward with the loss scale: fp16 cotangents must be
+        # amplified BEFORE they enter the pipe, not after (reference
+        # scales the loss pre-backward)
+        loss_m, ghead_m, gy, gmb_f = head_vjp(
+            y[Pn - 1], mb_leaves, jnp.asarray(loss_ct, jnp.float32))
+        gy = jnp.where(head_valid, gy, jnp.zeros_like(gy))
+        gmb_f = tuple(jnp.where(head_valid, g, jnp.zeros_like(g))
+                      for g in gmb_f)
+        loss_acc = loss_acc + jnp.where(head_valid, loss_m, 0.0)
+        ghead = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(
+                head_valid, g.astype(jnp.float32), 0.0)
+            if jnp.issubdtype(g.dtype, jnp.inexact) else a,
+            ghead, ghead_m)
+
+        # ---- backward sub-tick --------------------------------------
+        bct = bshift.at[Pn - 1].set(gy.astype(bshift.dtype))
+        bct = maybe_constrain(bct, _buf_spec(bct.ndim))
+        # stage s reads the residual written at tick t - 2(P-1) + 2s
+        r_idx = jnp.mod(t - 2 * (Pn - 1) + 2 * stage_ids, K)
+        old_xdep = [
+            jax.vmap(lambda rs, i: jax.lax.dynamic_index_in_dim(
+                rs, i, 0, keepdims=False), in_axes=(1, 0))(r, r_idx)
+            for r in rings]
+        # reassemble the full const list in discovery order
+        consts_now, xi, si = [], 0, 0
+        for dep in xdep_consts:
+            if dep:
+                consts_now.append(old_xdep[xi]); xi += 1
+            else:
+                consts_now.append(shared_consts[si]); si += 1
+
+        def stage_bwd(sp_slice, x, ct, *consts):
+            _, pullback = jax.vjp(stage_fn, sp_slice, x)
+            conv, _ = jax.closure_convert(pullback, ct)
+            return conv(ct, *consts)
+        # NB: conv is a PURE function of its consts — re-deriving it per
+        # body trace just rebuilds the same jaxpr; the x passed here only
+        # shapes the trace and is never read by conv
+        gsp_t, gx_t = jax.vmap(stage_bwd)(stage_params, fbuf, bct,
+                                          *consts_now)
+        gx_t = maybe_constrain(gx_t, _buf_spec(gx_t.ndim))
+
+        mb_b = t - 2 * (Pn - 1) + stage_ids
+        bwd_valid = (mb_b >= 0) & (mb_b < M)
+
+        def acc_gstage(a, g):
+            mask = bwd_valid.reshape((Pn,) + (1,) * (g.ndim - 1))
+            return a + jnp.where(mask, g.astype(jnp.float32), 0.0)
+        gstage = jax.tree_util.tree_map(acc_gstage, gstage, gsp_t)
+
+        gx_exit = jnp.where(bwd_valid[0], gx_t[0], jnp.zeros_like(gx_t[0]))
+        bshift = jnp.roll(gx_t, -1, axis=0)
+        bshift = maybe_constrain(bshift, _buf_spec(bshift.ndim))
+
+        fbuf = jnp.roll(y, 1, axis=0)
+        return ((fbuf, bshift, rings, gstage, ghead, loss_acc),
+                (gx_exit, gmb_f))
+
+    bshift0 = jnp.zeros((Pn,) + feat_shape, x_mbs.dtype)
+    carry0 = (fbuf0, bshift0, rings, gstage0, ghead0, jnp.float32(0.0))
+    (_, _, _, gstage, ghead, loss_acc), (gx_ticks, gmb_ticks) = jax.lax.scan(
+        tick, carry0, jnp.arange(T))
+
+    inv_m = 1.0 / M
+    # dx of microbatch m exits at tick m + 2(P-1)
+    gx_mbs = jax.lax.slice_in_dim(gx_ticks, 2 * (Pn - 1), 2 * (Pn - 1) + M,
+                                  axis=0)
+    gx_mbs = (gx_mbs * inv_m).astype(x_mbs.dtype)
+    # float-batch grads for microbatch m were emitted at tick m + P - 1
+    gmb_f = [(jax.lax.slice_in_dim(g, Pn - 1, Pn - 1 + M, axis=0)
+              * inv_m).astype(d)
+             for g, d in zip(gmb_ticks,
+                             [l.dtype for l, f in zip(b_leaves, b_is_float)
+                              if f])]
+    gstage = jax.tree_util.tree_map(
+        lambda g, p: (g * inv_m).astype(p.dtype), gstage, stage_params)
+    ghead = jax.tree_util.tree_map(
+        lambda g, p: (g * inv_m).astype(p.dtype)
+        if jnp.issubdtype(p.dtype, jnp.inexact) else
+        np.zeros(p.shape, jax.dtypes.float0),
+        ghead, head_params)
+    return loss_acc / M * loss_ct, (gstage, ghead, gx_mbs, tuple(gmb_f))
 
 
 def stack_stage_params(body_params: Any, num_stages: int) -> Any:

@@ -1,0 +1,62 @@
+"""bench.py outage resistance: on-chip results persist to a committed
+artifact (BENCH_onchip_latest.json) and resurface as ``last_known_onchip``
+when the TPU tunnel is down (round-2 verdict, weak #1 / next-round 1c)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_onchip_cache_roundtrip(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_ONCHIP_CACHE",
+                        str(tmp_path / "BENCH_onchip_latest.json"))
+    result = {"metric": "m", "value": 1.0, "vs_baseline": 1.5,
+              "device_kind": "TPU v5e"}
+    bench._save_onchip(result)
+    cached = bench._load_onchip()
+    assert cached["value"] == 1.0
+    assert cached["vs_baseline"] == 1.5
+    # the cache stamps capture time so a stale artifact is visibly dated
+    assert "captured_utc" in cached and "captured_unix" in cached
+
+
+def test_load_onchip_missing_or_corrupt(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_ONCHIP_CACHE", str(tmp_path / "nope.json"))
+    assert bench._load_onchip() is None
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    monkeypatch.setattr(bench, "_ONCHIP_CACHE", str(p))
+    assert bench._load_onchip() is None
+
+
+def test_exhausted_budget_reports_last_known_onchip():
+    """With zero budget (all probes skipped) the output line still carries
+    the cached on-chip artifact and its vs_baseline."""
+    if not os.path.exists(os.path.join(REPO, "BENCH_onchip_latest.json")):
+        import pytest
+        pytest.skip("no committed on-chip artifact")
+    out = subprocess.run([sys.executable, BENCH], capture_output=True,
+                         text=True, timeout=120,
+                         env=dict(os.environ, BENCH_BUDGET_S="1"))
+    assert out.returncode == 0
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "last_known_onchip" in line
+    assert "captured_utc" in line["last_known_onchip"]
+    # a failed run must NOT be scored with the cached on-chip ratio: the
+    # top-level vs_baseline stays this run's own (0.0 — nothing measured)
+    assert line["vs_baseline"] == 0.0
