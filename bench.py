@@ -50,6 +50,16 @@ def _save_onchip(result):
         pass
 
 
+def _attach_cached(out):
+    """Ride the dated on-chip record along as a sub-object.  The top-level
+    vs_baseline always reflects THIS run (0.0 / CPU ratio on fallback), so
+    a degraded run can never be scored as an on-chip result."""
+    cached = _load_onchip()
+    if cached:
+        out["last_known_onchip"] = cached
+    return out
+
+
 def _load_onchip():
     try:
         with open(_ONCHIP_CACHE) as f:
@@ -305,12 +315,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            cached = _load_onchip()
-            if cached:
-                # clearly-dated sub-object only; this RUN's vs_baseline
-                # stays 0.0 — nothing was measured
-                out["last_known_onchip"] = cached
-            print(json.dumps(out))
+            print(json.dumps(_attach_cached(out)))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -373,11 +378,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        cached = _load_onchip()
-        if cached:
-            # clearly-dated sub-object only; vs_baseline stays 0.0
-            out["last_known_onchip"] = cached
-        print(json.dumps(out))
+        print(json.dumps(_attach_cached(out)))
         return
 
     tps = train["tokens_per_sec"]
@@ -438,12 +439,7 @@ def main():
         result["max_params_kind"] = max_params_kind
     if not on_tpu:
         result["fallback_platform"] = "cpu"
-        cached = _load_onchip()
-        if cached:
-            # the dated on-chip record rides along as a sub-object; the
-            # top-level vs_baseline stays this run's own (CPU) ratio so a
-            # fallback can never be scored as an on-chip result
-            result["last_known_onchip"] = cached
+        _attach_cached(result)
     else:
         _save_onchip(result)
     if errors:

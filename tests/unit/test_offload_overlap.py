@@ -1,0 +1,134 @@
+"""Timing-asserted offload overlap (round-2 verdict, weak #5 / next #7).
+
+``test_offload.py`` proves the streamed step is numerically equal to the
+serial one; THIS file proves it is *faster* — the entire point of the
+swap state machine (reference ``swap_tensor/partitioned_optimizer_swapper``).
+A synthetic slow store with a deterministic per-op delay makes the
+assertion robust: the pipelined step hides the store latency behind the
+host Adam compute, the serialised baseline pays it in full.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import cpu_adam
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+
+class SlowHandle:
+    """AsyncIOHandle stand-in: every read/write sleeps ``delay`` seconds.
+    Async ops run in a thread (sleep + file I/O both release the GIL, as
+    io_uring submissions would be off-CPU)."""
+
+    def __init__(self, delay):
+        self.delay = delay
+        self._pending = []
+
+    def new_cpu_locked_tensor(self, n, dtype=np.float32):
+        return np.zeros(n, dtype)
+
+    def _read(self, buf, path):
+        time.sleep(self.delay)
+        if os.path.exists(path):
+            buf[:] = np.fromfile(path, dtype=buf.dtype, count=buf.size)
+
+    def _write(self, buf, path):
+        time.sleep(self.delay)
+        buf.tofile(path)
+
+    def async_pread(self, buf, path):
+        t = threading.Thread(target=self._read, args=(buf, path))
+        t.start()
+        self._pending.append(t)
+
+    def sync_pread(self, buf, path):
+        self._read(buf, path)
+
+    def async_pwrite(self, buf, path):
+        t = threading.Thread(target=self._write, args=(np.copy(buf), path))
+        t.start()
+        self._pending.append(t)
+
+    def sync_pwrite(self, buf, path):
+        self._write(buf, path)
+
+    def wait(self):
+        for t in self._pending:
+            t.join()
+        self._pending = []
+
+
+def _build_opt(tmp_path, numel, sub, pipelined, delay):
+    params = {"w": np.zeros(numel, np.float32)}
+    zc = DeepSpeedZeroConfig({
+        "stage": 3, "sub_group_size": sub,
+        "offload_optimizer": {"device": "nvme",
+                              "nvme_path": str(tmp_path)},
+    })
+    opt = HostOffloadOptimizer(params, zc, opt_name="adamw",
+                               opt_params={"lr": 1e-4})
+    sw = opt.swapper
+    sw.pipelined = pipelined
+    sw._reader = SlowHandle(delay)
+    sw._writer = SlowHandle(delay)
+    # rebuild buffers from the fake handle (plain numpy, no pinning)
+    bufsize = max(sw.sizes)
+    sw._buffers = [[sw._reader.new_cpu_locked_tensor(bufsize)
+                    for _ in range(sw.n_tensors)]
+                   for _ in range(sw.buffer_count)]
+    return opt
+
+
+def _calibrate_update(numel):
+    """Seconds for one fused Adam pass at this size on this machine."""
+    p = np.zeros(numel, np.float32)
+    g = np.ones(numel, np.float32)
+    st = cpu_adam.init_state(numel)
+    st = cpu_adam.adam_update(p, g, st)          # warm
+    t0 = time.perf_counter()
+    cpu_adam.adam_update(p, g, st)
+    return time.perf_counter() - t0
+
+
+def _time_step(opt, numel):
+    rng = np.random.default_rng(0)
+    grads = {"w": rng.normal(size=numel).astype(np.float32)}
+    opt.step(grads)                               # warm: init swap files
+    t0 = time.perf_counter()
+    opt.step(grads)
+    return time.perf_counter() - t0
+
+
+@pytest.mark.parametrize("subgroups", [4])
+def test_pipelined_offload_step_beats_serial(tmp_path, subgroups):
+    numel = 4_000_000
+    sub = numel // subgroups
+    # pick the store delay ≈ the update cost so there is real work to hide
+    delay = float(np.clip(_calibrate_update(sub), 0.02, 0.2))
+
+    t_serial = _time_step(
+        _build_opt(tmp_path / "s", numel, sub, False, delay), numel)
+    t_piped = _time_step(
+        _build_opt(tmp_path / "p", numel, sub, True, delay), numel)
+
+    # serial pays (read + update + write) per sub-group; the pipeline hides
+    # reads behind updates and writes behind everything.  Expected ratio
+    # ~2-3x; assert a loose 1.25x so CI scheduling jitter can't flake it.
+    assert t_serial > 1.25 * t_piped, (t_serial, t_piped, delay)
+
+
+def test_pipelined_and_serial_agree_numerically(tmp_path):
+    numel, sub = 1_000_000, 250_000
+    opts = {}
+    for name, piped in (("s", False), ("p", True)):
+        opt = _build_opt(tmp_path / name, numel, sub, piped, 0.001)
+        rng = np.random.default_rng(1)
+        for _ in range(3):
+            opt.step({"w": rng.normal(size=numel).astype(np.float32)})
+        opts[name] = opt.master
+    np.testing.assert_allclose(opts["s"], opts["p"], rtol=0, atol=0)
