@@ -37,17 +37,65 @@ def _glob_to_regex(pat: str) -> str:
 
 
 class CompressionSpec:
-    """Compiled compression plan over a params pytree."""
+    """Compiled compression plan over a params pytree.
 
-    def __init__(self, config: CompressionConfig, num_heads: Optional[int] = None):
+    Mesh-aware (reference ``ColumnParallelLinear_Compress`` /
+    ``RowParallelLinear_Compress``, ``compression/basic_layer.py:836,879``):
+    when ``tp_rules``/``mesh`` are given, structured pruning of a
+    tp-sharded axis ranks per contiguous shard block so every tp rank
+    keeps the same survivor count, and the compressed leaf is constrained
+    back onto its sharding spec."""
+
+    def __init__(self, config: CompressionConfig,
+                 num_heads: Optional[int] = None,
+                 tp_rules=None, mesh=None):
         self.config = config
         self.num_heads = num_heads
         self.groups = config.groups
+        # (compiled_regex, PartitionSpec) pairs — the same rule table the
+        # ZeRO plan applies (stage_plan.ZeroShardingPlan.tp_rules)
+        self.tp_rules = [
+            (pat if hasattr(pat, "search") else re.compile(pat), spec)
+            for pat, spec in (tp_rules or [])]
+        self.mesh = mesh
 
     # ------------------------------------------------------------------
-    def _leaf_transform(self, group, leaf, step):
+    def _spec_for(self, path: str):
+        for pat, spec in self.tp_rules:
+            if pat.search(path):
+                return spec
+        return None
+
+    def _axis_shard_degree(self, spec, shape, axis: int) -> int:
+        """How many ways ``axis`` is sharded under ``spec`` on the mesh.
+        Returns 1 (global ranking) when the axis length doesn't divide the
+        shard degree — GSPMD pads such shardings, so per-block ranking
+        would mis-assign the padded tail."""
+        if spec is None or self.mesh is None:
+            return 1
+        ndim = len(shape)
+        axis %= ndim
+        entries = tuple(spec)
+        if axis >= len(entries):
+            return 1
+        e = entries[axis]
+        names = e if isinstance(e, tuple) else ((e,) if e else ())
+        d = 1
+        for n in names:
+            d *= dict(self.mesh.shape).get(n, 1)
+        if d > 1 and shape[axis] % d:
+            logger.warning(
+                f"structured pruning: axis {axis} of shape {tuple(shape)} "
+                f"does not divide its shard degree {d}; falling back to "
+                "global ranking (survivors may be shard-unbalanced)")
+            return 1
+        return d
+
+    # ------------------------------------------------------------------
+    def _leaf_transform(self, group, leaf, step, path=""):
         m, p = group.method, group.params
         enabled = step >= group.schedule_offset
+        spec = self._spec_for(path)
         if m == WEIGHT_QUANTIZATION:
             bits = int(p.get("target_bits", p.get("bits", 8)))
             out = T.quantize_weight(
@@ -59,19 +107,31 @@ class CompressionSpec:
             out = T.sparse_prune(leaf, float(p.get("dense_ratio", 0.5)),
                                  method=group.shared.get("method", "l1"))
         elif m == ROW_PRUNING:
-            out = T.row_prune(leaf, float(p.get("dense_ratio", 0.5)))
+            out = T.row_prune(leaf, float(p.get("dense_ratio", 0.5)),
+                              tp_degree=self._axis_shard_degree(
+                                  spec, leaf.shape, -1))
         elif m == HEAD_PRUNING:
             heads = int(p.get("num_heads",
                               group.shared.get("num_heads",
                                                self.num_heads or 0)))
             if heads <= 1 or leaf.ndim < 2 or leaf.shape[-2] % heads:
                 return leaf
-            out = T.head_prune(leaf, heads, float(p.get("dense_ratio", 0.5)))
+            tp = self._axis_shard_degree(spec, leaf.shape, leaf.ndim - 2)
+            if tp > 1 and heads % tp:
+                tp = 1          # heads don't divide over shards: global rank
+            out = T.head_prune(leaf, heads, float(p.get("dense_ratio", 0.5)),
+                               tp_degree=tp)
         elif m == CHANNEL_PRUNING:
-            out = T.channel_prune(leaf, float(p.get("dense_ratio", 0.5)))
+            out = T.channel_prune(leaf, float(p.get("dense_ratio", 0.5)),
+                                  tp_degree=self._axis_shard_degree(
+                                      spec, leaf.shape, 0))
         else:
             return leaf
-        return jnp.where(enabled, out, leaf)
+        out = jnp.where(enabled, out, leaf)
+        if spec is not None:
+            from deepspeed_tpu.runtime.zero.stage_plan import maybe_constrain
+            out = maybe_constrain(out, spec)
+        return out
 
     def _matches(self, group, path: str, leaf) -> bool:
         if np.ndim(leaf) < 2:
@@ -89,7 +149,7 @@ class CompressionSpec:
                 if group.method == ACTIVATION_QUANTIZATION:
                     continue       # handled at activation sites, not params
                 if self._matches(group, key, leaf):
-                    leaf = self._leaf_transform(group, leaf, step)
+                    leaf = self._leaf_transform(group, leaf, step, path=key)
             return leaf
 
         return jax.tree_util.tree_map_with_path(visit, params)
@@ -103,16 +163,19 @@ class CompressionSpec:
 
 
 def init_compression(model_or_params, deepspeed_config,
-                     teacher_model=None, mpu=None) -> CompressionSpec:
+                     teacher_model=None, mpu=None,
+                     tp_rules=None, mesh=None) -> CompressionSpec:
     """Parity: reference ``init_compression(model, deepspeed_config)``.
     Accepts the engine's parsed config, a raw ``compression_training`` dict,
-    or a JSON path."""
+    or a JSON path.  ``tp_rules``/``mesh``: the ZeRO plan's sharding rule
+    table — makes structured pruning shard-balanced (see CompressionSpec)."""
     cfg = _coerce_config(deepspeed_config)
     num_heads = None
     model_cfg = getattr(model_or_params, "config", None)
     if model_cfg is not None:
         num_heads = getattr(model_cfg, "n_heads", None)
-    spec = CompressionSpec(cfg, num_heads=num_heads)
+    spec = CompressionSpec(cfg, num_heads=num_heads,
+                           tp_rules=tp_rules, mesh=mesh)
     if cfg.enabled:
         logger.info(f"compression enabled: {len(cfg.groups)} group(s), "
                     f"layer_reduction={cfg.layer_reduction.enabled}")

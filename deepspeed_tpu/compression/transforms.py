@@ -88,12 +88,32 @@ def quantize_activation(x, bits: int = 8, symmetric: bool = False,
 # ----------------------------------------------------------------------
 # pruning (reference TopKBinarizer + *_pruning in LinearLayer_Compress)
 # ----------------------------------------------------------------------
-def _topk_mask(scores, dense_ratio):
-    """1.0 for the top ``dense_ratio`` fraction by score, else 0.0."""
+def _topk_mask(scores, dense_ratio, num_blocks: int = 1):
+    """1.0 for the top ``dense_ratio`` fraction by score, else 0.0.
+
+    ``num_blocks > 1``: rank WITHIN each of ``num_blocks`` contiguous
+    blocks instead of globally — the mesh-aware mode.  When the structural
+    axis is tp-sharded, each tp shard owns one contiguous block, and
+    per-block ranking guarantees every shard keeps the same survivor count
+    (reference ``ColumnParallelLinear_Compress``/``RowParallelLinear_Compress``,
+    ``compression/basic_layer.py:836,879``: each parallel rank prunes
+    ``dense_ratio`` of its OWN slice).  A global top-k could strand all
+    survivors on one shard, unbalancing tp compute and making physical
+    removal shard-inhomogeneous."""
     flat = scores.reshape(-1)
-    k = jnp.maximum(1, jnp.round(dense_ratio * flat.shape[0])).astype(jnp.int32)
+    n = flat.shape[0]
+    if num_blocks > 1 and n % num_blocks == 0:
+        per = n // num_blocks
+        blocks = flat.reshape(num_blocks, per)
+        k = jnp.maximum(1, jnp.round(dense_ratio * per)).astype(jnp.int32)
+        order = jnp.argsort(blocks, axis=1)[:, ::-1]
+        ranks = jnp.zeros_like(order).at[
+            jnp.arange(num_blocks)[:, None], order].set(
+            jnp.broadcast_to(jnp.arange(per), (num_blocks, per)))
+        return (ranks < k).astype(scores.dtype).reshape(scores.shape)
+    k = jnp.maximum(1, jnp.round(dense_ratio * n)).astype(jnp.int32)
     order = jnp.argsort(flat)[::-1]
-    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(flat.shape[0]))
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(n))
     return (ranks < k).astype(scores.dtype).reshape(scores.shape)
 
 
@@ -105,37 +125,45 @@ def sparse_prune(w, dense_ratio: float = 0.5, method: str = "l1"):
     return _ste(w, w * mask)
 
 
-def row_prune(w, dense_ratio: float = 0.5, axis: int = -1):
+def row_prune(w, dense_ratio: float = 0.5, axis: int = -1,
+              tp_degree: int = 1):
     """Structured output-row pruning: ranks rows (slices of ``axis``) by L1
-    norm (reference row_pruning on nn.Linear output rows)."""
+    norm (reference row_pruning on nn.Linear output rows).  ``tp_degree>1``:
+    the row axis is tensor-parallel-sharded — prune per contiguous shard
+    block so every tp rank keeps the same row count."""
     reduce_axes = tuple(a for a in range(w.ndim) if a != axis % w.ndim)
     scores = jnp.sum(jnp.abs(w), axis=reduce_axes, keepdims=False)
-    mask1d = _topk_mask(scores, dense_ratio)
+    mask1d = _topk_mask(scores, dense_ratio, num_blocks=tp_degree)
     shape = [1] * w.ndim
     shape[axis % w.ndim] = w.shape[axis % w.ndim]
     return _ste(w, w * mask1d.reshape(shape))
 
 
-def head_prune(w, num_heads: int, dense_ratio: float = 0.5):
+def head_prune(w, num_heads: int, dense_ratio: float = 0.5,
+               tp_degree: int = 1):
     """Attention head pruning: ranks head blocks of the output projection's
     input dim by L1 norm (reference head_pruning on attention.output.dense).
-    ``w``: [..., H*dh, d]."""
+    ``w``: [..., H*dh, d].  ``tp_degree>1``: the H*dh axis is tp-sharded —
+    heads are ranked per contiguous shard block (H/tp heads each) so every
+    tp rank keeps the same head count (reference
+    ``RowParallelLinear_Compress.head_pruning_*``)."""
     in_dim = w.shape[-2]
     dh = in_dim // num_heads
     blocks = w.reshape(w.shape[:-2] + (num_heads, dh, w.shape[-1]))
     reduce_axes = tuple(a for a in range(blocks.ndim)
                         if a != blocks.ndim - 3)
     scores = jnp.sum(jnp.abs(blocks), axis=reduce_axes)
-    mask = _topk_mask(scores, dense_ratio)          # [H]
+    mask = _topk_mask(scores, dense_ratio,
+                      num_blocks=tp_degree)          # [H]
     shape = [1] * blocks.ndim
     shape[blocks.ndim - 3] = num_heads
     masked = blocks * mask.reshape(shape)
     return _ste(w, masked.reshape(w.shape))
 
 
-def channel_prune(w, dense_ratio: float = 0.5):
+def channel_prune(w, dense_ratio: float = 0.5, tp_degree: int = 1):
     """Conv-style channel pruning: ranks output channels (dim 0)."""
-    return row_prune(w, dense_ratio, axis=0)
+    return row_prune(w, dense_ratio, axis=0, tp_degree=tp_degree)
 
 
 def embedding_quantize(e, bits: int = 8):

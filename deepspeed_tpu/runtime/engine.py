@@ -211,7 +211,9 @@ class DeepSpeedEngine:
             if self.quantizer is not None:
                 self.quantizer.attach(self.state.params,
                                       self.quantizer.groups_cfg or None)
-            spec = init_compression(model, config)
+            spec = init_compression(model, config,
+                                    tp_rules=self.plan.tp_rules,
+                                    mesh=self.mesh)
             if self.quantizer is not None:
                 # MoQ owns weight quantization: drop it from the in-forward
                 # compression path so weights aren't quantized twice

@@ -152,3 +152,105 @@ def test_engine_compressed_training():
     losses = [float(engine.train_batch(batch=random_batch(8, HIDDEN, seed=0)))
               for _ in range(6)]
     assert losses[-1] < losses[0]  # trains through the phase flip
+
+
+# ----------------------------------------------------------------------
+# mesh-aware structured pruning (reference Column/RowParallelLinear_Compress,
+# compression/basic_layer.py:836,879 — each tp rank prunes dense_ratio of
+# its OWN slice, so shards stay balanced)
+# ----------------------------------------------------------------------
+def test_block_topk_mask_balanced_per_shard():
+    rng = np.random.default_rng(0)
+    scores = jnp.asarray(rng.normal(size=(16,)) ** 2)
+    # global top-k may unbalance; per-block keeps 4 of 8 in EACH half
+    mask = np.asarray(T._topk_mask(scores, 0.5, num_blocks=2))
+    assert mask.sum() == 8
+    assert mask[:8].sum() == 4 and mask[8:].sum() == 4
+
+
+def test_head_prune_tp_balanced():
+    H, dh, d = 8, 4, 16
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(H * dh, d)).astype(np.float32)
+    # make the 4 largest-magnitude heads all live in the FIRST tp half
+    w[: 4 * dh] *= 10.0
+    pruned_global = np.asarray(T.head_prune(jnp.asarray(w), H, 0.5))
+    pruned_tp = np.asarray(T.head_prune(jnp.asarray(w), H, 0.5, tp_degree=2))
+
+    def live_heads(p):
+        return [int(np.abs(p[i * dh:(i + 1) * dh]).sum() > 0)
+                for i in range(H)]
+    lg, lt = live_heads(pruned_global), live_heads(pruned_tp)
+    assert sum(lg) == 4 and sum(lg[:4]) == 4      # global: all on shard 0
+    assert sum(lt) == 4 and sum(lt[:4]) == 2 and sum(lt[4:]) == 2  # balanced
+
+
+def test_compression_spec_consumes_tp_rules():
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.compression.compress import CompressionSpec
+    from deepspeed_tpu.parallel import groups
+    from deepspeed_tpu.parallel.topology import TP_AXIS, TopologyConfig
+
+    groups.reset_mesh()
+    mesh = groups.initialize_mesh(TopologyConfig(tp=2, fsdp=4))
+    try:
+        cfg = CompressionConfig({
+            "head_pruning": {
+                "shared_parameters": {"enabled": True, "num_heads": 8,
+                                      "schedule_offset": 0},
+                "different_groups": {
+                    "att": {"params": {"dense_ratio": 0.5},
+                            "modules": ["wo"]}}}})
+        spec = CompressionSpec(cfg, num_heads=8,
+                               tp_rules=[(r"wo", P(TP_AXIS, None))],
+                               mesh=mesh)
+        H, dh, d = 8, 4, 16
+        rng = np.random.default_rng(2)
+        w = rng.normal(size=(H * dh, d)).astype(np.float32)
+        w[: 4 * dh] *= 10.0     # biggest heads all in shard 0
+        out = spec.transform({"wo": jnp.asarray(w)}, step=1)
+        p = np.asarray(out["wo"])
+        live = [int(np.abs(p[i * dh:(i + 1) * dh]).sum() > 0)
+                for i in range(H)]
+        # tp=2 over the H*dh axis → 2 heads survive in each shard half
+        assert sum(live[:4]) == 2 and sum(live[4:]) == 2, live
+        # unsharded leaf (no rule match) keeps global ranking
+        out2 = spec.transform({"other": jnp.asarray(w)}, step=1)
+        assert np.abs(np.asarray(out2["other"])).sum() > 0
+    finally:
+        groups.reset_mesh()
+
+
+def test_engine_mesh_aware_head_pruning_trains():
+    """End-to-end on a tp=2 mesh: the engine passes its tp rule table into
+    the compression spec and compressed training still descends."""
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.parallel import groups
+    groups.reset_mesh()
+    cfg = TransformerConfig.tiny(hidden_size=32, n_heads=4, vocab_size=128)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"tp": 2, "fsdp": 4},
+            "zero_optimization": {"stage": 2},
+            "compression_training": {
+                "head_pruning": {
+                    "shared_parameters": {"enabled": True,
+                                          "num_heads": cfg.n_heads,
+                                          "schedule_offset": 2},
+                    "different_groups": {
+                        "att": {"params": {"dense_ratio": 0.5},
+                                "modules": ["wo"]}}}},
+        })
+    assert engine._compression is not None
+    assert engine._compression.tp_rules, "engine must pass tp rules"
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, (4, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(8)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    groups.reset_mesh()
