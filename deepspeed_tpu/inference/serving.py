@@ -52,7 +52,8 @@ class ServingEngine:
     def __init__(self, model, params, max_batch: int = 8,
                  page_size: int = 128, num_pages: Optional[int] = None,
                  max_seq: int = 2048, dtype=jnp.bfloat16,
-                 eos_token_id: Optional[int] = None, tp_size: int = 1):
+                 eos_token_id: Optional[int] = None, tp_size: int = 1,
+                 ep_size: int = 1):
         self.model = model
         self.config = model.config
         self.max_batch = max_batch
@@ -62,9 +63,16 @@ class ServingEngine:
             num_pages = max_batch * self.max_pages_per_seq + 1
         self.mesh = None
         caches = model.init_paged_caches(num_pages, page_size, dtype=dtype)
-        if tp_size > 1:
-            # tensor-parallel serving: weights per the model's tp_rules,
-            # KV pages sharded over the kv-head dim ([L, P, Hkv, page, D])
+        if ep_size > 1:
+            assert getattr(self.config, "is_moe", False), \
+                "ep_size > 1 needs an MoE model"
+            assert self.config.moe_num_experts % ep_size == 0, \
+                "ep_size must divide the expert count"
+        if tp_size > 1 or ep_size > 1:
+            # tensor/expert-parallel serving: weights per the model's
+            # tp_rules (expert leaves carry the ep axis on their leading
+            # [E, ...] dim — reference megatron_gpt_moe EP containers), KV
+            # pages sharded over the kv-head dim ([L, P, Hkv, page, D])
             from jax.sharding import NamedSharding, PartitionSpec as P
             from deepspeed_tpu.parallel import groups
             from deepspeed_tpu.parallel.topology import TopologyConfig
@@ -73,7 +81,7 @@ class ServingEngine:
                 "tp_size must divide the kv-head count for paged serving"
             groups.reset_mesh()
             self.mesh = groups.initialize_mesh(
-                TopologyConfig(tp=tp_size, fsdp=-1))
+                TopologyConfig(tp=tp_size, ep=ep_size, fsdp=-1))
             plan = ZeroShardingPlan(self.mesh, stage=0,
                                     tp_rules=model.tp_rules())
             with self.mesh:

@@ -442,10 +442,16 @@ class CausalTransformerLM:
         if self.config.is_moe:
             from deepspeed_tpu.parallel.topology import EP_AXIS
             return [
+                # expert biases first (the weight patterns would match them)
+                (r"moe.*w_up_b", P(EP_AXIS, TP_AXIS)),
+                (r"moe.*w_down_b", P(EP_AXIS, None)),
                 # expert weights: expert dim over ep, ffn dim over tp
                 (r"moe.*w_up", P(EP_AXIS, None, TP_AXIS)),
                 (r"moe.*w_down", P(EP_AXIS, TP_AXIS, None)),
                 (r"moe.*wg", P()),
+                # per-layer dense biases / norms
+                (r"wq_b|wk_b|wv_b|w_up_b|w_gate_b", P(TP_AXIS)),
+                (r"wo_b|w_down_b|_norm", P()),
                 # per-layer dense weights are 2-D in the MoE layout
                 (r"wq|wk|wv|w_up|w_gate", P(None, TP_AXIS)),
                 (r"\bwo|w_down", P(TP_AXIS, None)),
@@ -567,10 +573,16 @@ class CausalTransformerLM:
 
             def expert_fn(ep, dispatched):
                 # gateless 2-layer expert FFN (reference Experts module);
-                # activation follows the model config
-                inner = act(jnp.einsum("ecd,edf->ecf", dispatched,
-                                       ep["w_up"]))
-                return jnp.einsum("ecf,efd->ecd", inner, ep["w_down"])
+                # activation follows the model config; optional per-expert
+                # biases for Megatron-MoE checkpoints
+                inner = jnp.einsum("ecd,edf->ecf", dispatched, ep["w_up"])
+                if "w_up_b" in ep:
+                    inner = inner + ep["w_up_b"][:, None, :]
+                inner = act(inner)
+                out = jnp.einsum("ecf,efd->ecd", inner, ep["w_down"])
+                if "w_down_b" in ep:
+                    out = out + ep["w_down_b"][:, None, :]
+                return out
 
             moe_out, l_aux, _ = moe_layer_forward(
                 self.gate, {"wg": layer["moe"]["wg"]}, layer["moe"],
@@ -781,11 +793,11 @@ class CausalTransformerLM:
     # paged KV-cache path (continuous-batching serving engine)
     # ------------------------------------------------------------------
     def init_paged_caches(self, num_pages, page_size, dtype=jnp.bfloat16):
-        """Stacked per-layer page pools: leaves [L, P, Hkv, page, D] so the
-        forward stays one scan (MoE models are not yet served paged)."""
+        """Stacked per-layer page pools: leaves [L, P, Hkv, page, D] — one
+        scan for homogeneous stacks; MoE / heterogeneous models index the
+        same pools per layer in a static loop."""
         from deepspeed_tpu.ops.paged_attention import init_paged_cache
         c = self.config
-        assert not c.is_moe, "paged serving currently requires a dense model"
         assert not c.use_alibi and not c.local_attn_pattern, \
             "paged serving does not support alibi/local-window models yet"
         one = init_paged_cache(num_pages, page_size, c.kv_heads, c.head_dim,
@@ -844,8 +856,21 @@ class CausalTransformerLM:
                 x, _ = self._mlp_block(x, layer, train=False)
             return x, (cache.k_pages, cache.v_pages)
 
-        x, (new_k, new_v) = jax.lax.scan(
-            body, x, (params["layers"], caches.k_pages, caches.v_pages))
+        if isinstance(params["layers"], (list, tuple)):
+            # MoE / heterogeneous stack: static per-layer loop (expert
+            # leaves carry an [E, ...] dim sharded over ep at serve time —
+            # the MoE dispatch inside _mlp_block lowers to the same
+            # all-to-alls as training, reference megatron_gpt_moe serving)
+            nk, nv = [], []
+            for i, layer in enumerate(params["layers"]):
+                x, (k_i, v_i) = body(x, (layer, caches.k_pages[i],
+                                         caches.v_pages[i]))
+                nk.append(k_i)
+                nv.append(v_i)
+            new_k, new_v = jnp.stack(nk), jnp.stack(nv)
+        else:
+            x, (new_k, new_v) = jax.lax.scan(
+                body, x, (params["layers"], caches.k_pages, caches.v_pages))
 
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,
                   params.get("final_norm_b"))

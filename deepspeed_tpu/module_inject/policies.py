@@ -727,10 +727,143 @@ class MegatronGPTPolicy(InjectionPolicy):
         return cfg, params
 
 
+class MegatronGPTMoEPolicy(InjectionPolicy):
+    """Megatron-DeepSpeed MoE checkpoints (reference
+    ``containers/megatron_gpt_moe.py`` ``MegatronMoELayerPolicy``): GPT
+    attention blocks + ``mlp.deepspeed_moe`` expert FFNs on a subset of
+    layers.
+
+    Checkpoint keys per MoE layer ``i`` (reference MoE param naming):
+      ``...layers.{i}.mlp.deepspeed_moe.gate.wg.weight``          [E, d]
+      ``...layers.{i}.mlp.deepspeed_moe.experts.deepspeed_experts.{e}.
+         dense_h_to_4h.{weight,bias}``                            [f, d]/[f]
+      ``...dense_4h_to_h.{weight,bias}``                          [d, f]/[d]
+    Dense layers keep plain ``mlp.dense_h_to_4h``/``dense_4h_to_h``.
+
+    Emits the MoE params layout (``layers`` = LIST of per-layer dicts,
+    expert leaves stacked to [E, ...] — the ep-sharded serve/train layout).
+    """
+
+    model_types = ("megatron-moe", "megatron_gpt_moe", "megatron-deepspeed-moe")
+
+    @staticmethod
+    def _num_experts(hf_config) -> int:
+        # Megatron-DeepSpeed stores num_experts as a per-layer-group LIST
+        # (e.g. [8]); configs/shims may also carry a plain int
+        n = getattr(hf_config, "num_experts", 0) or 0
+        if isinstance(n, (list, tuple)):
+            n = n[0] if n else 0
+        return int(n)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        mt = (getattr(hf_config, "model_type", "") or "").lower()
+        return mt in cls.model_types or (
+            "megatron" in mt and cls._num_experts(hf_config) > 1)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d = getattr(hf, "hidden_size")
+        L = getattr(hf, "num_layers", None) or hf.num_hidden_layers
+        H = getattr(hf, "num_attention_heads")
+        E = cls._num_experts(hf)
+        f = getattr(hf, "ffn_hidden_size", None) or 4 * d
+        megatron_v2 = float(getattr(hf, "checkpoint_version", 2.0) or 0) >= 2
+        dh = d // H
+        pre = "language_model.transformer.layers.{}."
+
+        moe_flags = [
+            pre.format(i) + "mlp.deepspeed_moe.gate.wg.weight" in sd
+            for i in range(L)]
+        assert any(moe_flags), "no deepspeed_moe layers found in state dict"
+        # infer the layer frequency our config encodes (reference models
+        # place experts every Nth layer, MoE on the LAST of each group)
+        first = moe_flags.index(True)
+        freq = first + 1
+        assert all(moe_flags[i] == (i % freq == freq - 1)
+                   for i in range(L)), \
+            f"MoE layer pattern {moe_flags} is not an every-Nth-layer grid"
+
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            ffn_hidden_size=f,
+            max_seq_len=getattr(hf, "max_position_embeddings", 1024),
+            norm_eps=getattr(hf, "layernorm_epsilon", 1e-5),
+            activation="gelu", use_rmsnorm=False, use_rope=False,
+            use_bias=True, norm_bias=True, tie_embeddings=True, remat=False,
+            moe_num_experts=E, moe_layer_freq=freq,
+            moe_top_k=int(getattr(hf, "moe_top_k", 1) or 1))
+
+        def qkv(i):
+            w = _np(sd[pre.format(i) + "attention.query_key_value.weight"])
+            b = _np(sd[pre.format(i) + "attention.query_key_value.bias"])
+            if megatron_v2:
+                w = w.reshape(H, 3, dh, d)
+                b = b.reshape(H, 3, dh)
+                return ([w[:, j].reshape(H * dh, d).T for j in range(3)],
+                        [b[:, j].reshape(-1) for j in range(3)])
+            w = w.reshape(3, d, d)
+            b = b.reshape(3, d)
+            return [w[j].T for j in range(3)], [b[j] for j in range(3)]
+
+        layers = []
+        for i in range(L):
+            p = pre.format(i)
+            (wq, wk, wv), (bq, bk, bv) = qkv(i)
+            layer = {
+                "attn_norm": _np(sd[p + "input_layernorm.weight"]),
+                "attn_norm_b": _np(sd[p + "input_layernorm.bias"]),
+                "wq": wq, "wk": wk, "wv": wv,
+                "wq_b": bq, "wk_b": bk, "wv_b": bv,
+                "wo": _np(sd[p + "attention.dense.weight"]).T,
+                "wo_b": _np(sd[p + "attention.dense.bias"]),
+                "mlp_norm": _np(sd[p + "post_attention_layernorm.weight"]),
+                "mlp_norm_b": _np(sd[p + "post_attention_layernorm.bias"]),
+            }
+            if moe_flags[i]:
+                ex = p + "mlp.deepspeed_moe.experts.deepspeed_experts.{}."
+                layer["moe"] = {
+                    # gate stays fp32 (reference casts gate input to fp32)
+                    "wg": _np(sd[p + "mlp.deepspeed_moe.gate.wg.weight"])
+                    .T.astype(np.float32),
+                    "w_up": np.stack([
+                        _np(sd[ex.format(e) + "dense_h_to_4h.weight"]).T
+                        for e in range(E)]),
+                    "w_up_b": np.stack([
+                        _np(sd[ex.format(e) + "dense_h_to_4h.bias"])
+                        for e in range(E)]),
+                    "w_down": np.stack([
+                        _np(sd[ex.format(e) + "dense_4h_to_h.weight"]).T
+                        for e in range(E)]),
+                    "w_down_b": np.stack([
+                        _np(sd[ex.format(e) + "dense_4h_to_h.bias"])
+                        for e in range(E)]),
+                }
+            else:
+                layer["w_up"] = _np(sd[p + "mlp.dense_h_to_4h.weight"]).T
+                layer["w_up_b"] = _np(sd[p + "mlp.dense_h_to_4h.bias"])
+                layer["w_down"] = _np(sd[p + "mlp.dense_4h_to_h.weight"]).T
+                layer["w_down_b"] = _np(sd[p + "mlp.dense_4h_to_h.bias"])
+            layers.append(layer)
+
+        emb = "language_model.embedding."
+        params = {
+            "tok_embed": _np(sd[emb + "word_embeddings.weight"]),
+            "pos_embed": _np(sd[emb + "position_embeddings.weight"]),
+            "final_norm": _np(
+                sd["language_model.transformer.final_layernorm.weight"]),
+            "final_norm_b": _np(
+                sd["language_model.transformer.final_layernorm.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
 REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTNeoXPolicy, BertPolicy, BloomPolicy,
                                 GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
-                                CLIPPolicy, MegatronGPTPolicy]
+                                CLIPPolicy, MegatronGPTMoEPolicy,
+                                MegatronGPTPolicy]
 
 
 def find_policy(hf_config) -> Optional[type]:

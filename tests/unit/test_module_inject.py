@@ -334,3 +334,85 @@ def test_megatron_conversion_matches_gpt2_oracle(ckpt_version):
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
     # and transitively matches the HF torch oracle
     _assert_close(got, _hf_logits(hf, ids))
+
+
+def test_megatron_moe_conversion_matches_oracle():
+    """Megatron-DeepSpeed MoE checkpoints (reference
+    containers/megatron_gpt_moe.py): repackage a random MoE model of ours
+    into the ``mlp.deepspeed_moe`` key layout, convert back through
+    MegatronGPTMoEPolicy, and the logits must match exactly (biases in the
+    checkpoint are zero, ours has none)."""
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    d, L, H, E, V = 32, 4, 4, 4, 96
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=d, n_layers=L, n_heads=H,
+        max_seq_len=64, activation="gelu", use_rmsnorm=False,
+        use_rope=False, tie_embeddings=True, remat=False,
+        moe_num_experts=E, moe_layer_freq=2, moe_top_k=1)
+    oracle = CausalTransformerLM(cfg)
+    oparams = oracle.init(jax.random.key(5))
+    dh = d // H
+
+    sd = {
+        "language_model.embedding.word_embeddings.weight":
+            np.asarray(oparams["tok_embed"]),
+        "language_model.embedding.position_embeddings.weight":
+            np.asarray(oparams["pos_embed"]),
+        "language_model.transformer.final_layernorm.weight":
+            np.asarray(oparams["final_norm"]),
+        "language_model.transformer.final_layernorm.bias": np.zeros(d),
+    }
+    for i, lp in enumerate(oparams["layers"]):
+        pre = f"language_model.transformer.layers.{i}."
+        qkv_w = np.stack(                     # v2 per-head [H, 3, dh, d]
+            [np.asarray(lp["wq"]).T.reshape(H, dh, d),
+             np.asarray(lp["wk"]).T.reshape(H, dh, d),
+             np.asarray(lp["wv"]).T.reshape(H, dh, d)],
+            axis=1).reshape(3 * d, d)
+        sd[pre + "attention.query_key_value.weight"] = qkv_w
+        sd[pre + "attention.query_key_value.bias"] = np.zeros(3 * d)
+        sd[pre + "attention.dense.weight"] = np.asarray(lp["wo"]).T
+        sd[pre + "attention.dense.bias"] = np.zeros(d)
+        sd[pre + "input_layernorm.weight"] = np.asarray(lp["attn_norm"])
+        sd[pre + "input_layernorm.bias"] = np.zeros(d)
+        sd[pre + "post_attention_layernorm.weight"] = \
+            np.asarray(lp["mlp_norm"])
+        sd[pre + "post_attention_layernorm.bias"] = np.zeros(d)
+        if "moe" in lp:
+            sd[pre + "mlp.deepspeed_moe.gate.wg.weight"] = \
+                np.asarray(lp["moe"]["wg"]).T
+            ex = pre + "mlp.deepspeed_moe.experts.deepspeed_experts.{}."
+            for e in range(E):
+                sd[ex.format(e) + "dense_h_to_4h.weight"] = \
+                    np.asarray(lp["moe"]["w_up"][e]).T
+                sd[ex.format(e) + "dense_h_to_4h.bias"] = \
+                    np.zeros(cfg.ffn_dim)
+                sd[ex.format(e) + "dense_4h_to_h.weight"] = \
+                    np.asarray(lp["moe"]["w_down"][e]).T
+                sd[ex.format(e) + "dense_4h_to_h.bias"] = np.zeros(d)
+        else:
+            sd[pre + "mlp.dense_h_to_4h.weight"] = np.asarray(lp["w_up"]).T
+            sd[pre + "mlp.dense_h_to_4h.bias"] = np.zeros(cfg.ffn_dim)
+            sd[pre + "mlp.dense_4h_to_h.weight"] = \
+                np.asarray(lp["w_down"]).T
+            sd[pre + "mlp.dense_4h_to_h.bias"] = np.zeros(d)
+
+    class MoECfg:
+        model_type = "megatron_gpt_moe"
+        vocab_size = V
+        hidden_size = d
+        num_layers = L
+        num_attention_heads = H
+        ffn_hidden_size = 4 * d
+        max_position_embeddings = 64
+        num_experts = E
+        moe_top_k = 1
+        checkpoint_version = 2
+
+    model, params = replace_transformer_layer(sd, hf_config=MoECfg())
+    assert model.config.is_moe and model.config.moe_layer_freq == 2
+    ids = _ids(V)
+    got = _ours_logits(model, params, ids)
+    ref = _ours_logits(oracle, oparams, ids)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
