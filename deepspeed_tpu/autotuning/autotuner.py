@@ -6,10 +6,15 @@ micro-batch size per stage from measured short runs, write
 ``autotuning_results/`` and report the best config; entered from the
 launcher ``runner.py:351``).
 
-TPU design: the tuning space is (zero stage × micro-batch size); memory
-feasibility uses the ZeRO memory model (params/grads/optimizer bytes per
-chip given the fsdp degree) against the accelerator's reported HBM; each
-trial builds a real engine and measures steady-state samples/sec over
+TPU design: phase 1 searches (zero stage × micro-batch size); phase 2
+runs coordinate descent over the winning stage's template knobs
+(``config_templates.py``: gradient-accumulation steps, optimizer offload
+device, remat policy, Pallas attention tile sizes — the knobs round-2's
+hand tuning actually moved).  Memory feasibility uses the ZeRO memory
+model (params/grads/optimizer bytes per chip given the fsdp degree)
+against the accelerator's reported HBM, seeded by the phase-1 winner's
+measured ``n_params`` (the reference's model-info run); each trial builds
+a real engine and measures steady-state samples/sec over
 ``end_profile_step - start_profile_step`` fused steps.
 """
 
@@ -53,7 +58,23 @@ class Autotuner:
 
     def __init__(self, ds_config: Dict[str, Any],
                  model_num_params: Optional[int] = None,
-                 hbm_bytes: Optional[int] = None):
+                 hbm_bytes: Optional[int] = None,
+                 active_resources: Optional[Dict[str, Any]] = None):
+        if not isinstance(ds_config, dict):
+            # launcher entry (runner.py): an argparse Namespace carrying
+            # --deepspeed_config; reference Autotuner(args, resource_pool)
+            path = getattr(ds_config, "deepspeed_config", None) or \
+                getattr(ds_config, "ds_config", None)
+            if isinstance(path, str):
+                with open(path) as f:
+                    ds_config = json.load(f)
+            elif isinstance(path, dict):
+                ds_config = path
+            else:
+                raise ValueError(
+                    "Autotuner needs a ds_config dict or an args namespace "
+                    "with --deepspeed_config")
+        self.active_resources = active_resources
         self.base_config = {k: v for k, v in ds_config.items()
                             if k != AUTOTUNING}
         self.at_config = AutotuningConfig(ds_config.get(AUTOTUNING, {}))
@@ -116,9 +137,17 @@ class Autotuner:
                 model=model,
                 model_parameters=jax.tree_util.tree_map(np.asarray, params),
                 config=exp.ds_config)
-            return timed_trial(
-                engine, lambda: make_batch(engine.train_batch_size()),
-                at.start_profile_step, at.end_profile_step)
+            gas = engine.gradient_accumulation_steps_
+
+            def batch():
+                b = make_batch(engine.train_batch_size())
+                if gas > 1:   # fused GAS steps consume [gas, micro*dp, ...]
+                    b = jax.tree_util.tree_map(
+                        lambda x: np.asarray(x).reshape(
+                            (gas, -1) + np.shape(x)[1:]), b)
+                return b
+            return timed_trial(engine, batch,
+                               at.start_profile_step, at.end_profile_step)
         return run
 
     def _subprocess_runner(self, model_spec: Dict[str, Any], seq: int,
@@ -135,6 +164,7 @@ class Autotuner:
 
         def run(exp: Experiment) -> Dict[str, Any]:
             spec = {"model": model_spec, "ds_config": exp.ds_config,
+                    "model_overrides": exp.model_overrides,
                     "seq": seq, "cpu": cpu,
                     "start_profile_step": at.start_profile_step,
                     "end_profile_step": at.end_profile_step}
@@ -172,6 +202,13 @@ class Autotuner:
           non-serialisable models; measurements share one XLA heap);
         * ``run_fn=`` — caller-supplied runner.
         """
+        if model_spec is None and run_fn is None and model is None:
+            # launcher-driven tuning: the model spec rides in the
+            # autotuning config ("model_spec": {"kind": ..., "config": ...}).
+            # Resolved FIRST so the dp probe below sees subprocess mode.
+            spec_cfg = getattr(self.at_config, "model_spec", None)
+            if spec_cfg:
+                model_spec = dict(spec_cfg)
         if model_spec is not None and not trial_cpu:
             # do NOT initialise the TPU backend in the parent: libtpu is
             # exclusive per process, and a parent holding the device would
@@ -188,29 +225,140 @@ class Autotuner:
                 dp = 1
         else:
             dp = max(1, jax.device_count())
-        space = self.tuning_space(dp)
-        exps = [Experiment(
-            f"z{c['zero_optimization']['stage']}_"
-            f"mbs{c['train_micro_batch_size_per_gpu']}", c) for c in space]
-        logger.info(f"autotuning: {len(exps)} experiments "
-                    f"(stages×micro-batches), metric={self.at_config.metric}")
-        self.rm.schedule_experiments(exps)
+        # only the in-process default runner cannot apply model-knob
+        # overrides (its model object is fixed); subprocess AND caller
+        # run_fn modes both see exp.model_overrides
+        model_knobs = True
         if run_fn is None and model_spec is not None:
             run_fn = self._subprocess_runner(model_spec, seq,
                                              timeout=trial_timeout,
                                              cpu=trial_cpu)
         if run_fn is None:
-            assert model is not None and params is not None and \
-                make_batch is not None, \
-                "tune() needs model_spec, model/params/make_batch, or run_fn"
+            if model is None or params is None or make_batch is None:
+                raise ValueError(
+                    "tune() needs model_spec=, model/params/make_batch, "
+                    "run_fn=, or an autotuning.model_spec config entry")
             run_fn = self._default_runner(make_batch, model, params)
+            model_knobs = False
+
+        # ---- model info (reference autotuner.py:707) -----------------
+        # seeds the memory model BEFORE the space is built, so stage
+        # pruning can actually prune.  In-process: count the params pytree
+        # directly (free).  Subprocess: one profiled trial at the most-
+        # sharded stage (the worker reports n_params).  Caller run_fn:
+        # skipped — the runner may not know the model at all.
+        info_exp = None
+        if self.model_num_params is None and params is not None:
+            leaves = jax.tree_util.tree_leaves(params)
+            self.model_num_params = int(sum(np.size(l) for l in leaves))
+        if self.model_num_params is None and model_spec is not None:
+            micro = self.candidate_micro_batches()[0]
+            cfg = dict(self.base_config)
+            cfg["zero_optimization"] = dict(
+                cfg.get("zero_optimization", {}), stage=3)
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg.pop("train_batch_size", None)
+            info_exp = Experiment(f"z3_mbs{micro}", cfg)
+            self.rm.schedule_experiments([info_exp])
+            self.rm.run(run_fn)
+            if info_exp.done() and info_exp.result.get("n_params"):
+                self.model_num_params = int(info_exp.result["n_params"])
+
+        # ---- phase 1: ZeRO stage × micro-batch ------------------------
+        space = self.tuning_space(dp)
+        exps = []
+        for c in space:
+            name = (f"z{c['zero_optimization']['stage']}_"
+                    f"mbs{c['train_micro_batch_size_per_gpu']}")
+            if info_exp is not None and name == info_exp.name:
+                # the model-info run already measured this point: it joins
+                # the space instead of re-running.  (Outside the space it
+                # stays a profile-only run and does NOT compete for best.)
+                exps.append(info_exp)
+                continue
+            exps.append(Experiment(name, c))
+        logger.info(f"autotuning: phase 1 — {len(exps)} experiments "
+                    f"(stages×micro-batches), metric={self.at_config.metric}")
+        self.rm.schedule_experiments(
+            [e for e in exps if e is not info_exp])
         self.rm.run(run_fn)
-        best = self.rm.best_experiment()
-        assert best is not None, "no experiment finished"
+        sign = -1 if self.at_config.metric == "latency" else 1
+        done = [e for e in exps if e.done() and "error" not in e.result]
+        assert done, "no experiment finished"
+        best = max(done, key=lambda e: sign * float(
+            e.result.get(self.at_config.metric, 0.0)))
+
+        # ---- phase 2: per-stage template knobs around the winner ------
+        # (reference config_templates/template_zero*.json; coordinate
+        # descent — one knob at a time — keeps trials linear)
+        if self.at_config.template_tuning:
+            best = self._tune_templates(best, run_fn,
+                                        model_knobs=model_knobs,
+                                        model_spec=model_spec)
         logger.info(f"autotuning: best = {best.name} "
                     f"({self.at_config.metric}="
                     f"{best.result.get(self.at_config.metric):.2f})")
-        return best.ds_config
+        out = dict(best.ds_config)
+        if best.model_overrides:
+            # surfaced so callers can apply the model-side winners too
+            out["autotuning_model_overrides"] = dict(best.model_overrides)
+        return out
+
+    def _tune_templates(self, best: Experiment, run_fn,
+                        model_knobs: bool = True,
+                        model_spec=None) -> Experiment:
+        """Coordinate descent over the winning stage's template knobs."""
+        from deepspeed_tpu.autotuning.config_templates import (
+            KNOB_DEFAULTS, TEMPLATES, get_ds_path, model_overrides_for,
+            set_ds_path)
+        stage = int(best.ds_config.get("zero_optimization", {})
+                    .get("stage", 0))
+        tmpl = TEMPLATES.get(stage, {"ds": {}, "model": {}})
+        sign = -1 if self.at_config.metric == "latency" else 1
+        spec_cfg = (model_spec or {}).get("config", {})
+
+        def score(e: Experiment) -> float:
+            if not e.done() or "error" in e.result:
+                return float("-inf")
+            return sign * float(e.result.get(self.at_config.metric, 0.0))
+
+        for path, candidates in tmpl["ds"].items():
+            exps = []
+            for v in candidates:
+                if v == get_ds_path(best.ds_config, path):
+                    continue      # the incumbent value: already measured
+                cfg = set_ds_path(best.ds_config, path, v)
+                tag = (str(v).replace(" ", "").replace("'", "")
+                       .replace("{", "").replace("}", "").replace(":", "-"))
+                exps.append(Experiment(
+                    f"{best.name}_{path.split('/')[-1]}-{tag}", cfg,
+                    model_overrides=best.model_overrides))
+            self.rm.schedule_experiments(exps)
+            self.rm.run(run_fn)
+            best = max([best] + exps, key=score)
+        if model_knobs:
+            for knob, candidates in tmpl["model"].items():
+                exps = []
+                for v in candidates:
+                    delta = model_overrides_for(knob, v)
+                    current = {
+                        k: best.model_overrides.get(
+                            k, spec_cfg.get(
+                                k, model_overrides_for(
+                                    knob, KNOB_DEFAULTS.get(knob)).get(k)))
+                        for k in delta}
+                    if delta == current:
+                        continue   # effective incumbent: already measured
+                    ov = dict(best.model_overrides, **delta)
+                    tag = str(v).replace(" ", "").replace("(", "") \
+                        .replace(")", "").replace(",", "x")
+                    exps.append(Experiment(f"{best.name}_{knob}-{tag}",
+                                           best.ds_config,
+                                           model_overrides=ov))
+                self.rm.schedule_experiments(exps)
+                self.rm.run(run_fn)
+                best = max([best] + exps, key=score)
+        return best
 
     # parity aliases ----------------------------------------------------
     def run_autotuning(self, *a, **kw):

@@ -37,3 +37,10 @@ class AutotuningConfig(DeepSpeedConfigModel):
     min_train_micro_batch_size_per_gpu = 1
     num_tuning_micro_batch_sizes = 3
     mp_size = 1
+    # phase-2 coordinate descent over per-stage template knobs (gas,
+    # offload device, remat policy, attention tile sizes — reference
+    # config_templates/); False = stage×micro-batch only
+    template_tuning = True
+    # launcher-driven tuning: a serialisable trial model
+    # {"kind": "causal_lm", "config": {...TransformerConfig kwargs}}
+    model_spec = None

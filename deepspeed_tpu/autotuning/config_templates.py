@@ -1,0 +1,91 @@
+"""Per-stage tuning templates (reference ``autotuning/config_templates/``
+``template_zero0.json`` … ``template_zero3.json``).
+
+The reference seeds each ZeRO stage's search with a JSON template whose
+tunable keys carry candidate lists; the tuner expands them per stage.
+Here the templates are Python dicts with two sections:
+
+* ``ds``: ds_config knob → candidate values (merged into the experiment
+  config; nested keys use ``/`` paths, e.g. ``zero_optimization/
+  offload_optimizer``)
+* ``model``: TransformerConfig knob → candidate values (merged into the
+  trial worker's model spec — TPU-specific knobs like remat policy and
+  Pallas attention tile sizes have no reference analogue but were this
+  round's main hand-tuned wins, so the tuner must search them)
+
+Knobs are searched by coordinate descent around the stage×micro-batch
+winner (the reference's fast mode tunes one dimension at a time too),
+keeping the trial count linear instead of combinatorial.
+"""
+
+from typing import Any, Dict, List
+
+# ds-config knobs common to every stage
+_COMMON_DS: Dict[str, List[Any]] = {
+    "gradient_accumulation_steps": [1, 2, 4, 8],
+}
+
+# model-config knobs common to every stage (TPU-native)
+_COMMON_MODEL: Dict[str, List[Any]] = {
+    "remat_policy": ["nothing_saveable", "dots_saveable"],
+    # Pallas flash-attention tile sizes: (block_q, block_k) pairs are a
+    # single knob so the two dims move together
+    "attn_blocks": [(512, 512), (256, 512), (256, 256), (128, 512)],
+}
+
+TEMPLATES: Dict[int, Dict[str, Dict[str, List[Any]]]] = {
+    0: {"ds": dict(_COMMON_DS), "model": dict(_COMMON_MODEL)},
+    1: {"ds": dict(_COMMON_DS), "model": dict(_COMMON_MODEL)},
+    2: {"ds": {**_COMMON_DS,
+               "zero_optimization/offload_optimizer": [
+                   None, {"device": "cpu"}]},
+        "model": dict(_COMMON_MODEL)},
+    3: {"ds": {**_COMMON_DS,
+               "zero_optimization/offload_optimizer": [
+                   None, {"device": "cpu"}]},
+        "model": dict(_COMMON_MODEL)},
+}
+
+
+# effective default per knob when the key is absent from the config/spec —
+# used for semantic incumbent-skipping (a candidate equal to the current
+# effective value must not burn a trial re-measuring the winner)
+KNOB_DEFAULTS: Dict[str, Any] = {
+    "gradient_accumulation_steps": 1,
+    "zero_optimization/offload_optimizer": None,
+    "remat_policy": "nothing_saveable",   # TransformerConfig defaults
+    "attn_blocks": (512, 512),
+}
+
+
+def get_ds_path(cfg: Dict[str, Any], path: str) -> Any:
+    """Effective value of ``a/b/c`` in ``cfg`` (KNOB_DEFAULTS when absent)."""
+    node: Any = cfg
+    for k in path.split("/"):
+        if not isinstance(node, dict) or k not in node:
+            return KNOB_DEFAULTS.get(path)
+        node = node[k]
+    return node
+
+
+def set_ds_path(cfg: Dict[str, Any], path: str, value: Any) -> Dict[str, Any]:
+    """Return a copy of ``cfg`` with ``a/b/c`` set to ``value`` (None pops)."""
+    cfg = dict(cfg)
+    keys = path.split("/")
+    node = cfg
+    for k in keys[:-1]:
+        node[k] = dict(node.get(k, {}))
+        node = node[k]
+    if value is None:
+        node.pop(keys[-1], None)
+    else:
+        node[keys[-1]] = value
+    return cfg
+
+
+def model_overrides_for(knob: str, value: Any) -> Dict[str, Any]:
+    """Translate a template model knob into TransformerConfig overrides."""
+    if knob == "attn_blocks":
+        bq, bk = value
+        return {"attn_block_q": bq, "attn_block_k": bk}
+    return {knob: value}

@@ -17,9 +17,14 @@ from deepspeed_tpu.utils.logging import logger
 
 class Experiment:
 
-    def __init__(self, name: str, ds_config: Dict[str, Any]):
+    def __init__(self, name: str, ds_config: Dict[str, Any],
+                 model_overrides: Optional[Dict[str, Any]] = None):
         self.name = name
         self.ds_config = ds_config
+        # TransformerConfig knob overrides for this trial (remat policy,
+        # attention tile sizes — template model knobs the reference has no
+        # analogue for); merged into the trial worker's model spec
+        self.model_overrides = dict(model_overrides or {})
         self.result: Optional[Dict[str, Any]] = None
 
     def done(self) -> bool:
@@ -56,7 +61,9 @@ class ResourceManager:
                 with open(path) as f:
                     journaled = json.load(f)
                 if journaled.get("ds_config") == json.loads(
-                        json.dumps(exp.ds_config, default=str)):
+                        json.dumps(exp.ds_config, default=str)) and \
+                        journaled.get("model_overrides", {}) == json.loads(
+                            json.dumps(exp.model_overrides, default=str)):
                     exp.result = journaled
                     logger.info(f"autotuning: reusing journaled {exp.name}")
                     continue
@@ -72,6 +79,8 @@ class ResourceManager:
                 metrics = {self.metric: 0.0, "error": str(e)}
             metrics["wall_s"] = time.time() - t0
             metrics["ds_config"] = exp.ds_config
+            if exp.model_overrides:
+                metrics["model_overrides"] = exp.model_overrides
             exp.result = metrics
             with open(path, "w") as f:
                 json.dump(metrics, f, indent=1, default=str)

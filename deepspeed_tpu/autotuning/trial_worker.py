@@ -20,16 +20,18 @@ import sys
 import time
 
 
-def build_model(model_spec):
+def build_model(model_spec, overrides=None):
     kind = model_spec.get("kind", "causal_lm")
+    cfg_kw = dict(model_spec["config"])
+    cfg_kw.update(overrides or {})   # per-trial template model knobs
     if kind == "causal_lm":
         from deepspeed_tpu.models.transformer import (CausalTransformerLM,
                                                       TransformerConfig)
-        cfg = TransformerConfig(**model_spec["config"])
+        cfg = TransformerConfig(**cfg_kw)
         return CausalTransformerLM(cfg), cfg
     if kind == "bert":
         from deepspeed_tpu.models.bert import BertConfig, BertEncoder
-        cfg = BertConfig(**model_spec["config"])
+        cfg = BertConfig(**cfg_kw)
         return BertEncoder(cfg), cfg
     raise ValueError(f"unknown model kind {kind!r}")
 
@@ -69,21 +71,31 @@ def run_trial(spec):
 
     import deepspeed_tpu
 
-    model, cfg = build_model(spec["model"])
+    model, cfg = build_model(spec["model"], spec.get("model_overrides"))
     params = model.init(jax.random.key(spec.get("seed", 0)))
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=spec["ds_config"])
 
     rng = np.random.default_rng(spec.get("seed", 0))
     seq = spec.get("seq", 256)
+    gas = engine.gradient_accumulation_steps_
 
     def make_batch():
-        return {"input_ids": rng.integers(
-            0, cfg.vocab_size, (engine.train_batch_size(), seq))}
+        # gas>1 steps consume [gas, micro*dp, S] stacks (the fused GAS scan)
+        micro_total = engine.train_batch_size() // max(1, gas)
+        shape = (gas, micro_total, seq) if gas > 1 else (
+            engine.train_batch_size(), seq)
+        return {"input_ids": rng.integers(0, cfg.vocab_size, shape)}
 
-    return timed_trial(engine, make_batch,
-                       spec.get("start_profile_step", 2),
-                       spec.get("end_profile_step", 5))
+    out = timed_trial(engine, make_batch,
+                      spec.get("start_profile_step", 2),
+                      spec.get("end_profile_step", 5))
+    if hasattr(cfg, "num_params"):
+        # model-info for the stage-feasibility memory model (reference
+        # autotuner.py:707 model-info run)
+        out["n_params"] = int(cfg.num_params())
+    out["gradient_accumulation_steps"] = gas
+    return out
 
 
 def main():

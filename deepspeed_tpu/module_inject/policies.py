@@ -643,6 +643,22 @@ class CLIPPolicy(InjectionPolicy):
         return cfg, params
 
 
+def _megatron_qkv(sd, key_w, key_b, H, dh, d, v2):
+    """Un-scramble one layer's fused Megatron QKV (both checkpoint
+    layouts): v2 per-head ``[H, 3, dh, d]`` interleave, v0/v1 ``[3, H*dh]``
+    row groups.  Returns ([wq, wk, wv] as [d, H*dh], [bq, bk, bv])."""
+    w = _np(sd[key_w])
+    b = _np(sd[key_b])
+    if v2:
+        w = w.reshape(H, 3, dh, d)
+        b = b.reshape(H, 3, dh)
+        return ([w[:, j].reshape(H * dh, d).T for j in range(3)],
+                [b[:, j].reshape(-1) for j in range(3)])
+    w = w.reshape(3, H * dh, d)
+    b = b.reshape(3, H * dh)
+    return [w[j].T for j in range(3)], [b[j] for j in range(3)]
+
+
 class MegatronGPTPolicy(InjectionPolicy):
     """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py``
     ``MegatronLayerPolicy``, whose ``version`` field selects the same two
@@ -675,26 +691,12 @@ class MegatronGPTPolicy(InjectionPolicy):
         dh = d // H
         wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
         for i in range(L):
-            w = _np(sd[pre.format(i) + "attention.query_key_value.weight"])
-            b = _np(sd[pre.format(i) + "attention.query_key_value.bias"])
-            if megatron_v2:                  # [H, 3, dh, d] per-head
-                w = w.reshape(H, 3, dh, d)
-                b = b.reshape(H, 3, dh)
-                wq.append(w[:, 0].reshape(H * dh, d).T)
-                wk.append(w[:, 1].reshape(H * dh, d).T)
-                wv.append(w[:, 2].reshape(H * dh, d).T)
-                bq.append(b[:, 0].reshape(-1))
-                bk.append(b[:, 1].reshape(-1))
-                bv.append(b[:, 2].reshape(-1))
-            else:                            # [3, H*dh, d] row groups
-                w = w.reshape(3, d, d)
-                b = b.reshape(3, d)
-                wq.append(w[0].T)
-                wk.append(w[1].T)
-                wv.append(w[2].T)
-                bq.append(b[0])
-                bk.append(b[1])
-                bv.append(b[2])
+            (q, k, v), (qb, kb, vb) = _megatron_qkv(
+                sd, pre.format(i) + "attention.query_key_value.weight",
+                pre.format(i) + "attention.query_key_value.bias",
+                H, dh, d, megatron_v2)
+            wq.append(q); wk.append(k); wv.append(v)
+            bq.append(qb); bk.append(kb); bv.append(vb)
         layers = {
             "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
             "attn_norm_b": _stack(sd, pre + "input_layernorm.bias", L),
@@ -795,16 +797,10 @@ class MegatronGPTMoEPolicy(InjectionPolicy):
             moe_top_k=int(getattr(hf, "moe_top_k", 1) or 1))
 
         def qkv(i):
-            w = _np(sd[pre.format(i) + "attention.query_key_value.weight"])
-            b = _np(sd[pre.format(i) + "attention.query_key_value.bias"])
-            if megatron_v2:
-                w = w.reshape(H, 3, dh, d)
-                b = b.reshape(H, 3, dh)
-                return ([w[:, j].reshape(H * dh, d).T for j in range(3)],
-                        [b[:, j].reshape(-1) for j in range(3)])
-            w = w.reshape(3, d, d)
-            b = b.reshape(3, d)
-            return [w[j].T for j in range(3)], [b[j] for j in range(3)]
+            return _megatron_qkv(
+                sd, pre.format(i) + "attention.query_key_value.weight",
+                pre.format(i) + "attention.query_key_value.bias",
+                H, dh, d, megatron_v2)
 
         layers = []
         for i in range(L):

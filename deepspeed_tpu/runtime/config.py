@@ -238,6 +238,10 @@ class DeepSpeedConfig:
         "zero_force_ds_cpu_optimizer",
         # sparse_attention gets its own notice (_note_inert_sparse_attention)
         "sparse_attention",
+        # emitted by Autotuner.tune(): model-side knob winners (remat
+        # policy, attention tile sizes) for the CALLER to apply when
+        # rebuilding the model; informational for the engine itself
+        "autotuning_model_overrides",
     })
 
     def _note_inert_sparse_attention(self, pd):
