@@ -20,6 +20,7 @@ a real engine and measures steady-state samples/sec over
 
 import itertools
 import json
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -89,6 +90,9 @@ class Autotuner:
         self.rm = ResourceManager(self.at_config.results_dir,
                                   metric=self.at_config.metric,
                                   overwrite=self.at_config.overwrite)
+        # set by tune(): path of the persisted best config (reference
+        # ds_config_optimal.json; consumed by `deepspeed --autotuning run`)
+        self.optimal_config_path: Optional[str] = None
 
     # ------------------------------------------------------------------
     def feasible_stages(self, dp: int) -> List[int]:
@@ -282,11 +286,8 @@ class Autotuner:
         self.rm.schedule_experiments(
             [e for e in exps if e is not info_exp])
         self.rm.run(run_fn)
-        sign = -1 if self.at_config.metric == "latency" else 1
-        done = [e for e in exps if e.done() and "error" not in e.result]
-        assert done, "no experiment finished"
-        best = max(done, key=lambda e: sign * float(
-            e.result.get(self.at_config.metric, 0.0)))
+        best = ResourceManager.best_of(exps, self.at_config.metric)
+        assert best is not None, "no experiment finished"
 
         # ---- phase 2: per-stage template knobs around the winner ------
         # (reference config_templates/template_zero*.json; coordinate
@@ -302,6 +303,11 @@ class Autotuner:
         if best.model_overrides:
             # surfaced so callers can apply the model-side winners too
             out["autotuning_model_overrides"] = dict(best.model_overrides)
+        # persist for --autotuning run (reference ds_config_optimal.json)
+        self.optimal_config_path = os.path.join(
+            self.at_config.results_dir, "ds_config_optimal.json")
+        with open(self.optimal_config_path, "w") as f:
+            json.dump(out, f, indent=1)
         return out
 
     def _tune_templates(self, best: Experiment, run_fn,
@@ -314,13 +320,11 @@ class Autotuner:
         stage = int(best.ds_config.get("zero_optimization", {})
                     .get("stage", 0))
         tmpl = TEMPLATES.get(stage, {"ds": {}, "model": {}})
-        sign = -1 if self.at_config.metric == "latency" else 1
         spec_cfg = (model_spec or {}).get("config", {})
 
-        def score(e: Experiment) -> float:
-            if not e.done() or "error" in e.result:
-                return float("-inf")
-            return sign * float(e.result.get(self.at_config.metric, 0.0))
+        def pick(best, exps):
+            return ResourceManager.best_of([best] + exps,
+                                           self.at_config.metric) or best
 
         for path, candidates in tmpl["ds"].items():
             exps = []
@@ -335,8 +339,12 @@ class Autotuner:
                     model_overrides=best.model_overrides))
             self.rm.schedule_experiments(exps)
             self.rm.run(run_fn)
-            best = max([best] + exps, key=score)
-        if model_knobs:
+            best = pick(best, exps)
+        if model_knobs and (model_spec is None or
+                            model_spec.get("kind", "causal_lm")
+                            == "causal_lm"):
+            # the template model knobs are TransformerConfig fields; other
+            # model kinds (bert, ...) would TypeError in every trial
             for knob, candidates in tmpl["model"].items():
                 exps = []
                 for v in candidates:
@@ -357,7 +365,7 @@ class Autotuner:
                                            model_overrides=ov))
                 self.rm.schedule_experiments(exps)
                 self.rm.run(run_fn)
-                best = max([best] + exps, key=score)
+                best = pick(best, exps)
         return best
 
     # parity aliases ----------------------------------------------------

@@ -85,13 +85,18 @@ class ResourceManager:
             with open(path, "w") as f:
                 json.dump(metrics, f, indent=1, default=str)
 
-    def best_experiment(self) -> Optional[Experiment]:
-        # failed experiments (crash/OOM) must never win — a {metric: 0.0}
-        # sentinel would rank first under minimize metrics like latency
-        done = [e for e in self.experiments
-                if e.done() and "error" not in e.result]
+    @staticmethod
+    def best_of(exps: List[Experiment],
+                metric: str) -> Optional[Experiment]:
+        """THE ranking rule (one definition for every phase): failed
+        experiments (crash/OOM) never win — a {metric: 0.0} sentinel would
+        rank first under minimize metrics like latency."""
+        done = [e for e in exps if e.done() and "error" not in e.result]
         if not done:
             return None
-        sign = -1 if self.metric == "latency" else 1
+        sign = -1 if metric == "latency" else 1
         return max(done, key=lambda e: sign * float(
-            e.result.get(self.metric, 0.0)))
+            e.result.get(metric, 0.0)))
+
+    def best_experiment(self) -> Optional[Experiment]:
+        return self.best_of(self.experiments, self.metric)

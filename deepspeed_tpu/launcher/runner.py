@@ -192,16 +192,50 @@ def main(args=None):
 
     if args.autotuning:
         from deepspeed_tpu.autotuning.autotuner import Autotuner
+        # the config path travels in the user script's own args
+        # (REMAINDER): surface it for the tuner
+        if getattr(args, "deepspeed_config", None) is None:
+            args.deepspeed_config = _find_user_arg(
+                args.user_args, ("--deepspeed_config", "--ds_config"))
         tuner = Autotuner(args, active_resources=resource_pool)
         tuner.tune()
         if args.autotuning == "tune":
             return 0
-        # "run": continue with the best config the tuner wrote
+        # "run": swap the user script's config for the best one the tuner
+        # wrote (reference: ds_config_optimal.json under the results dir)
+        if tuner.optimal_config_path and args.user_args:
+            args.user_args = _replace_user_arg(
+                args.user_args, ("--deepspeed_config", "--ds_config"),
+                tuner.optimal_config_path)
 
     if resource_pool is None or (len(resource_pool) == 1
                                  and not args.force_multi):
         return _launch_single_node(args, resource_pool)
     return _launch_multi_node(args, resource_pool)
+
+
+def _find_user_arg(user_args, names):
+    """Value of ``--flag v`` / ``--flag=v`` inside the REMAINDER args."""
+    for i, a in enumerate(user_args):
+        for n in names:
+            if a == n and i + 1 < len(user_args):
+                return user_args[i + 1]
+            if a.startswith(n + "="):
+                return a.split("=", 1)[1]
+    return None
+
+
+def _replace_user_arg(user_args, names, value):
+    out = list(user_args)
+    for i, a in enumerate(out):
+        for n in names:
+            if a == n and i + 1 < len(out):
+                out[i + 1] = value
+                return out
+            if a.startswith(n + "="):
+                out[i] = f"{n}={value}"
+                return out
+    return out
 
 
 def _nproc_for(args, resource_pool):

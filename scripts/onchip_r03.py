@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Round-3 on-chip measurement program — one shot, fully journaled.
+
+The TPU tunnel has been flaky for two rounds; this script exists so that
+ANY window of tunnel uptime converts into committed artifacts.  Run it the
+moment a probe succeeds:
+
+    python scripts/onchip_r03.py            # everything
+    python scripts/onchip_r03.py --only kernels,sweep,bench
+
+Each step runs in a subprocess with its own timeout; failures journal and
+the program continues.  Results land in ``ONCHIP_r03/`` (JSON per step +
+``journal.jsonl``) — commit that directory.
+
+Steps:
+  probe    — device sanity (platform, kind, tiny matmul)
+  kernels  — Pallas flash alibi/sliding-window fwd+bwd vs jnp oracle with
+             interpret=False (round-2: interpret-green != Mosaic-green)
+  sweep    — attn_block_q/k sweep on gpt_350m (the queued round-2 sweep)
+  bench    — bench.py (headline; persists BENCH_onchip_latest.json)
+  serving  — ds_bench inference (p50/p90/p99) + serving throughput
+  big      — gpt2_1_5b ZeRO-3 + host-offload Adam + remat (MFU at >=1B)
+  tune     — short on-chip autotune (phase 1+2, tight budget)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "ONCHIP_r03")
+JOURNAL = os.path.join(OUT, "journal.jsonl")
+
+
+def log(step, **kw):
+    os.makedirs(OUT, exist_ok=True)
+    rec = {"step": step, "t": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()), **kw}
+    with open(JOURNAL, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"[onchip] {step}: {kw.get('status', '')}", flush=True)
+
+
+def run(step, cmd, timeout, env=None):
+    t0 = time.time()
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, cwd=REPO,
+                             env={**os.environ, **(env or {})})
+    except subprocess.TimeoutExpired:
+        log(step, status="timeout", timeout_s=timeout, cmd=" ".join(cmd))
+        return None
+    dt = time.time() - t0
+    tail = (out.stdout or "")[-4000:]
+    if out.returncode != 0:
+        log(step, status="failed", rc=out.returncode, wall_s=round(dt, 1),
+            stdout=tail, stderr=(out.stderr or "")[-2000:])
+        return None
+    # journal every JSON line the step printed
+    jsons = []
+    for line in (out.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                jsons.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    log(step, status="ok", wall_s=round(dt, 1), results=jsons,
+        stdout=None if jsons else tail)
+    with open(os.path.join(OUT, f"{step}.json"), "w") as f:
+        json.dump({"wall_s": round(dt, 1), "results": jsons,
+                   "stdout_tail": tail}, f, indent=1)
+    return jsons
+
+
+_KERNEL_CHECK = r'''
+import json, time
+import jax, jax.numpy as jnp
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.attention import alibi_window_bias, reference_attention
+from deepspeed_tpu.models.transformer import alibi_slopes
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+rng = jax.random.PRNGKey(0)
+B, H, S, D = 2, 8, 2048, 64
+q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (B, S, H, D),
+                             jnp.bfloat16) for i in range(3))
+
+def check(name, slopes=None, window=None):
+    bias = alibi_window_bias(S, S, slopes=slopes, window=window)
+
+    def f(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False,
+                               alibi_slopes=slopes,
+                               window=window).astype(jnp.float32).sum()
+
+    def r(q, k, v):
+        return reference_attention(q, k, v, causal=True,
+                                   bias=bias).astype(jnp.float32).sum()
+    t0 = time.time()
+    fv, fg = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(fg)
+    rv, rg = jax.jit(jax.value_and_grad(r, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(rg)
+    rel = max(float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                    b.astype(jnp.float32))) /
+                    (jnp.max(jnp.abs(b.astype(jnp.float32))) + 1e-6))
+              for a, b in zip(fg, rg))
+    out = {"variant": name,
+           "val_rel": abs(float(fv - rv)) / (abs(float(rv)) + 1e-6),
+           "grad_rel_max": rel, "ok": rel < 0.05,
+           "wall_s": round(time.time() - t0, 1)}
+    print(json.dumps(out))
+    return out["ok"]
+
+oks = [check("causal"),
+       check("alibi", slopes=alibi_slopes(H)),
+       check("window", window=256)]
+print(json.dumps({"all_ok": all(oks)}))
+'''
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="")
+    args = ap.parse_args()
+    steps = [s for s in args.only.split(",") if s] or [
+        "probe", "kernels", "sweep", "bench", "serving", "big", "tune"]
+    py = sys.executable
+
+    if "probe" in steps:
+        ok = run("probe", [py, "-c",
+                           "import jax; d=jax.devices()[0]; "
+                           "import jax.numpy as jnp; "
+                           "x=jnp.ones((256,256),jnp.bfloat16); "
+                           "print((x@x).sum()); "
+                           "import json; "
+                           "print(json.dumps({'platform': d.platform, "
+                           "'kind': getattr(d,'device_kind','')}))"],
+                 timeout=240)
+        if ok is None:
+            log("abort", status="no device")
+            return 1
+
+    if "kernels" in steps:
+        run("kernels", [py, "-c", _KERNEL_CHECK], timeout=1200)
+
+    if "sweep" in steps:
+        for bq, bk in ((512, 512), (256, 512), (256, 256), (128, 512)):
+            run(f"sweep_b{bq}x{bk}",
+                [py, "bin/ds_bench", "train", "--model", "gpt_350m",
+                 "--batch", "8", "--gas", "4", "--seq", "1024",
+                 "--steps", "8", "--attn-block-q", str(bq),
+                 "--attn-block-k", str(bk), "--json"], timeout=1500)
+
+    if "bench" in steps:
+        run("bench", [py, "bench.py"], timeout=900,
+            env={"BENCH_BUDGET_S": "840"})
+
+    if "serving" in steps:
+        run("inference_latency",
+            [py, "bin/ds_bench", "inference", "--model", "gpt2-125m",
+             "--batch", "1", "--prompt-len", "128", "--max-new-tokens",
+             "64", "--trials", "10"], timeout=1500)
+        run("serving_throughput",
+            [py, "bin/ds_bench", "serving", "--model", "gpt2_125m",
+             "--requests", "16", "--max-batch", "8", "--prompt-len", "128",
+             "--gen", "64"], timeout=1500)
+
+    if "big" in steps:
+        # 1.5B params: 18 B/param doesn't fit 16 GB; host-offload Adam
+        # leaves bf16 params+grads (~6 GB) + remat activations on chip
+        for batch in (8, 4):
+            got = run(f"big_1_5b_b{batch}",
+                      [py, "bin/ds_bench", "train", "--model", "gpt2_1_5b",
+                       "--batch", str(batch), "--gas", "1", "--seq", "1024",
+                       "--steps", "4", "--offload", "cpu", "--json"],
+                      timeout=2400)
+            if got:
+                break
+
+    if "tune" in steps:
+        spec = {"kind": "causal_lm",
+                "config": dict(vocab_size=50304, hidden_size=1024,
+                               n_layers=24, n_heads=16, max_seq_len=1024,
+                               activation="gelu", use_rmsnorm=False,
+                               use_rope=False, tie_embeddings=True,
+                               remat=True)}
+        code = (
+            "import json\n"
+            "from deepspeed_tpu.autotuning.autotuner import Autotuner\n"
+            "at = Autotuner({'train_micro_batch_size_per_gpu': 8,\n"
+            "  'optimizer': {'type': 'AdamW', 'params': {'lr': 1e-4}},\n"
+            "  'bf16': {'enabled': True},\n"
+            "  'autotuning': {'enabled': True,\n"
+            "    'results_dir': 'ONCHIP_r03/autotuning_results',\n"
+            "    'start_profile_step': 2, 'end_profile_step': 5,\n"
+            "    'num_tuning_micro_batch_sizes': 2,\n"
+            "    'min_train_micro_batch_size_per_gpu': 8}})\n"
+            "at.feasible_stages = lambda dp: [3]\n"
+            f"best = at.tune(model_spec={spec!r}, seq=1024,\n"
+            "               trial_timeout=1200)\n"
+            "print(json.dumps({'best': best}))\n")
+        run("tune", [py, "-c", code], timeout=7200)
+
+    log("done", status="complete")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

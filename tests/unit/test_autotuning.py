@@ -138,9 +138,11 @@ def test_end_to_end_tune_real_engine(tmp_path):
     assert best["zero_optimization"]["stage"] in (0, 2)
     assert best["train_micro_batch_size_per_gpu"] in (8, 16)
     # every experiment journaled a real throughput (in-process mode
-    # counts n_params from the params pytree — no model-info trial)
+    # counts n_params from the params pytree — no model-info trial), plus
+    # the persisted best config
     files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
-    assert len(files) == 4
+    assert sorted(files).count("ds_config_optimal.json") == 1
+    assert len(files) == 5
 
 
 def test_subprocess_trials_isolated(tmp_path):
@@ -165,8 +167,11 @@ def test_subprocess_trials_isolated(tmp_path):
                    trial_timeout=300)
     assert best["zero_optimization"]["stage"] in (0, 3)
     files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
-    assert len(files) == 4
+    assert "ds_config_optimal.json" in files
+    assert len(files) == 5
     for f in files:
+        if f == "ds_config_optimal.json":
+            continue
         with open(tmp_path / f) as fh:
             rec = json.load(fh)
         assert "error" in rec or rec["throughput"] > 0

@@ -205,3 +205,20 @@ def test_comm_bench_smoke(mesh_1d):
                  "all_to_all", "pt2pt"):
         r = run_collective(coll, 1 << 12, mesh, trials=2, warmups=1)
         assert r["latency_us"] > 0 and r["busbw_GBps"] > 0, coll
+
+
+def test_runner_user_arg_config_helpers():
+    """--deepspeed_config travels in the user script's REMAINDER args; the
+    autotuning entry must find it and --autotuning run must swap it for
+    the tuner's ds_config_optimal.json."""
+    from deepspeed_tpu.launcher.runner import (_find_user_arg,
+                                               _replace_user_arg)
+    ua = ["train.py", "--deepspeed_config", "ds.json", "--lr", "3e-4"]
+    names = ("--deepspeed_config", "--ds_config")
+    assert _find_user_arg(ua[1:], names) == "ds.json"
+    assert _find_user_arg(["--ds_config=x.json"], names) == "x.json"
+    assert _find_user_arg(["--other", "v"], names) is None
+    out = _replace_user_arg(ua[1:], names, "best.json")
+    assert out[1] == "best.json" and out[0] == "--deepspeed_config"
+    out2 = _replace_user_arg(["--ds_config=x.json"], names, "best.json")
+    assert out2 == ["--ds_config=best.json"]
