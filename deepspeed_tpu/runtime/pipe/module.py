@@ -29,8 +29,10 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.transformer import CausalTransformerLM, TransformerConfig
 from deepspeed_tpu.parallel.topology import PP_AXIS, TP_AXIS
-from deepspeed_tpu.runtime.pipe.pipeline import (pipeline_spmd,
+from deepspeed_tpu.runtime.pipe.pipeline import (pipeline_interleaved,
+                                                 pipeline_spmd,
                                                  pipeline_train_1f1b,
+                                                 stack_interleaved_params,
                                                  stack_stage_params)
 from deepspeed_tpu.utils.logging import logger
 
@@ -273,7 +275,8 @@ class PipelineModule:
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
                  seed_layers: bool = False,
-                 schedule: str = "1f1b"):
+                 schedule: str = "1f1b",
+                 num_virtual_stages: int = 1):
         if topology is not None and num_stages is None:
             num_stages = topology.get_dim("pipe") or topology.get_dim("pp")
         # num_stages=None resolves lazily from the active mesh's pp axis.
@@ -294,8 +297,17 @@ class PipelineModule:
         # "1f1b" = TRUE interleaved fwd/bwd (reference TrainSchedule): O(P)
         # in-flight residuals, no recompute.  "1f1b-remat" = GPipe order
         # with chunked remat (O(P) residuals bought with one fwd replay).
-        # "gpipe" stores all M.
+        # "gpipe" stores all M.  "interleaved" = Megatron virtual stages
+        # (num_virtual_stages chunks per device, ~V x smaller bubble;
+        # autodiff backward).
         self.schedule = schedule
+        self.num_virtual_stages = int(num_virtual_stages)
+        if schedule == "interleaved" and self.num_virtual_stages < 2:
+            raise ValueError(
+                "schedule='interleaved' needs num_virtual_stages >= 2")
+        if schedule != "interleaved" and self.num_virtual_stages > 1:
+            raise ValueError(
+                "num_virtual_stages > 1 needs schedule='interleaved'")
 
         self._specs = list(layers)
         self._layers = [s.build() if isinstance(s, LayerSpec) else s
@@ -350,6 +362,13 @@ class PipelineModule:
     def init(self, rng, dtype=jnp.float32) -> Dict[str, Any]:
         self._split = self._find_body(rng)
         start, end = self._split
+        if self.schedule == "interleaved":
+            n = end - start
+            pv = self.num_stages * self.num_virtual_stages
+            if n % pv:
+                raise ValueError(
+                    f"interleaved schedule: {n} body layers not divisible "
+                    f"by num_stages*num_virtual_stages = {pv}")
         keys = jax.random.split(rng, len(self._layers) + 1)
         tied: Dict[str, Any] = {}
         pre, post = [], []
@@ -424,9 +443,17 @@ class PipelineModule:
             return x
 
         x = jax.vmap(pre_fn)(batch_mbs)
-        stage_params = stack_stage_params(params["body"], self.num_stages)
-        x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages,
-                          schedule=self.schedule)
+        if self.schedule == "interleaved":
+            x = pipeline_interleaved(
+                self._stage_fn(),
+                stack_interleaved_params(params["body"], self.num_stages,
+                                         self.num_virtual_stages),
+                x, self.num_stages, self.num_virtual_stages)
+        else:
+            stage_params = stack_stage_params(params["body"],
+                                              self.num_stages)
+            x = pipeline_spmd(self._stage_fn(), stage_params, x,
+                              self.num_stages, schedule=self.schedule)
 
         def post_fn(h):
             for j in range(end, len(self._layers)):
@@ -459,6 +486,15 @@ class PipelineModule:
 
         # _stage_fn already checkpoints per layer when activation
         # checkpointing is on — no second stage-level remat wrap
+        if self.schedule == "interleaved":
+            x = pipeline_interleaved(
+                self._stage_fn(),
+                stack_interleaved_params(params["body"], self.num_stages,
+                                         self.num_virtual_stages),
+                x, self.num_stages, self.num_virtual_stages)
+            return self._post_loss_tail(params, x, inputs, tied, end,
+                                        loss_scale)
+
         stage_params = stack_stage_params(params["body"], self.num_stages)
 
         if self.schedule == "1f1b" and self.num_stages > 1:
@@ -481,14 +517,17 @@ class PipelineModule:
 
         x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages,
                           schedule=self.schedule)
+        return self._post_loss_tail(params, x, inputs, tied, end, loss_scale)
 
+    def _post_loss_tail(self, params, x, inputs, tied, end, loss_scale):
+        """Shared post-layers + loss over pipelined outputs (one
+        definition for every autodiff schedule)."""
         def mb_loss(args):
             h, mb = args
             for j in range(end, len(self._layers)):
                 h = self._call_layer(j, params["post"][j - end], h, tied)
             return self.loss_fn(h, mb)
-        losses = jax.lax.map(mb_loss, (x, inputs))
-        mean = jnp.mean(losses)
+        mean = jnp.mean(jax.lax.map(mb_loss, (x, inputs)))
         return mean if loss_scale is None else mean * loss_scale
 
     def partition_layers(self):
@@ -496,8 +535,21 @@ class PipelineModule:
         construction).  Pre/post layers are 'replicated'."""
         start, end = self._split if self._split else self._find_body(
             jax.random.key(0))
-        per = (end - start) // self.num_stages
         out = []
+        if self.schedule == "interleaved":
+            # round-robin chunks: global chunk c lives on stage c mod P
+            k = (end - start) // (self.num_stages * self.num_virtual_stages)
+            for i in range(len(self._layers)):
+                if i < start or i >= end:
+                    out.append((i, type(self._layers[i]).__name__,
+                                "replicated"))
+                else:
+                    chunk = (i - start) // k
+                    out.append((i, type(self._layers[i]).__name__,
+                                f"stage{chunk % self.num_stages}"
+                                f"v{chunk // self.num_stages}"))
+            return out
+        per = (end - start) // self.num_stages
         for i in range(len(self._layers)):
             if i < start or i >= end:
                 out.append((i, type(self._layers[i]).__name__, "replicated"))
@@ -511,7 +563,8 @@ def transformer_pipeline(config: TransformerConfig,
                          num_stages: Optional[int] = None,
                          loss_fn: Optional[Callable] = None,
                          activation_checkpoint_interval: int = 0,
-                         schedule: str = "1f1b") -> PipelineModule:
+                         schedule: str = "1f1b",
+                         num_virtual_stages: int = 1) -> PipelineModule:
     """GPT2ModelPipe-style convenience: embedding → N blocks → norm+head
     (parity: Megatron-DeepSpeed ``GPT2ModelPipe`` construction)."""
     specs: List[LayerSpec] = []
@@ -528,4 +581,4 @@ def transformer_pipeline(config: TransformerConfig,
     return PipelineModule(
         specs, num_stages=num_stages, loss_fn=loss_fn,
         activation_checkpoint_interval=activation_checkpoint_interval,
-        schedule=schedule)
+        schedule=schedule, num_virtual_stages=num_virtual_stages)

@@ -478,6 +478,96 @@ def _interleaved_1f1b(stage_fn, head_fn, num_stages, stage_params,
     return loss_acc / M * loss_ct, (gstage, ghead, gx_mbs, tuple(gmb_f))
 
 
+# ----------------------------------------------------------------------
+# Interleaved virtual stages (Megatron-style; the reference's interleaved
+# TrainSchedule assigns each device V non-contiguous layer chunks —
+# device s hosts global chunks s, s+P, ..., s+(V-1)P — cutting the
+# pipeline bubble from (P-1)/(M+P-1) to roughly (P-1)/(V·M) because a
+# microbatch re-enters the pipe V times with 1/V the work per visit)
+# ----------------------------------------------------------------------
+
+def stack_interleaved_params(body_params: Any, num_stages: int,
+                             num_virtual: int) -> Any:
+    """[L, ...] → [P, V, L/(V·P), ...]: leaf[s, v] holds global layer
+    chunk ``v·P + s`` (stage dim leads so the pp sharding is unchanged)."""
+    P_, V = num_stages, num_virtual
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        assert L % (P_ * V) == 0, \
+            f"n_layers {L} not divisible by stages*virtual {P_}x{V}"
+        k = L // (P_ * V)
+        # [V, P, k, ...] in (chunk, stage) order, then stage-major
+        return leaf.reshape((V, P_, k) + leaf.shape[1:]).swapaxes(0, 1)
+    return jax.tree_util.tree_map(reshape, body_params)
+
+
+def pipeline_interleaved(stage_fn: Callable,
+                         stage_params: Any,
+                         x_mbs: jax.Array,
+                         num_stages: int,
+                         num_virtual: int) -> jax.Array:
+    """Forward pipeline with V virtual stages per device.
+
+    Clock: microbatches advance in groups of P injection ticks; the
+    circular ``roll`` delivers both stage-to-stage sends AND the
+    chunk-(c)→chunk-(c+1) wraparound (slot P-1 → slot 0).  Slot 0 takes a
+    NEW microbatch only during injection groups (G % V == 0); otherwise it
+    keeps the wrapped activation.  The chunk a slot is executing is a pure
+    function of the clock: v(s, t) = ((t - s) // P) mod V.
+
+    Differentiable via scan autodiff (total residual volume ≈ GPipe's:
+    V× the ticks at 1/V the per-tick size); combine with per-layer remat
+    for the memory cap.
+    """
+    M = x_mbs.shape[0]
+    Pn, V = int(num_stages), int(num_virtual)
+    if V == 1:
+        return pipeline_spmd(stage_fn, stage_params, x_mbs, Pn,
+                             schedule="gpipe")
+    groups_inject = -(-M // Pn)            # ceil(M/P) injection groups
+    # device 0's group stream: V groups per injection group; the last
+    # microbatch's final chunk then drains P-1 ticks
+    T = (groups_inject * V) * Pn + (Pn - 1)
+    vstage = jax.vmap(stage_fn)
+    feat_shape = x_mbs.shape[1:]
+    buf = jnp.zeros((Pn,) + feat_shape, x_mbs.dtype)
+    buf = maybe_constrain(buf, _buf_spec(buf.ndim))
+    stage_ids = jnp.arange(Pn)
+
+    def params_at(t):
+        # per-stage virtual-chunk selection: leaf [P, V, k, ...] → [P, k, ...]
+        v = jnp.mod(jnp.maximum(t - stage_ids, 0) // Pn, V)
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.vmap(
+                lambda ls, vi: jax.lax.dynamic_index_in_dim(
+                    ls, vi, 0, keepdims=False))(leaf, v),
+            stage_params)
+
+    def tick(buf, t):
+        G, r = t // Pn, jnp.mod(t, Pn)
+        mb_new = (G // V) * Pn + r
+        inject = (jnp.mod(G, V) == 0) & (mb_new < M)
+        inp = jax.lax.dynamic_index_in_dim(
+            x_mbs, jnp.clip(mb_new, 0, M - 1), 0, keepdims=False)
+        slot0 = jnp.where(inject, inp, buf[0])   # else: chunk wraparound
+        buf = jax.lax.dynamic_update_index_in_dim(buf, slot0, 0, 0)
+        buf = maybe_constrain(buf, _buf_spec(buf.ndim))
+        y = vstage(params_at(t), buf)
+        y = maybe_constrain(y, _buf_spec(y.ndim))
+        return jnp.roll(y, 1, axis=0), y[Pn - 1]
+
+    _, ys = jax.lax.scan(tick, buf, jnp.arange(T))
+    # mb m's final (chunk V-1) output exits device P-1 at
+    # t = ((m // P)·V + V - 1)·P + (m % P) + (P - 1)
+    exit_t = jnp.asarray(
+        [((m // Pn) * V + V - 1) * Pn + (m % Pn) + (Pn - 1)
+         for m in range(M)])
+    out = jnp.take(ys, exit_t, axis=0)
+    entries = [None, tuple(BATCH_AXES)] + [None] * (out.ndim - 2)
+    return maybe_constrain(out, P(*entries))
+
+
 def stack_stage_params(body_params: Any, num_stages: int) -> Any:
     """Reshape stacked per-layer params ``[L, ...]`` into per-stage chunks
     ``[P, L/P, ...]`` (contiguous layer ranges per stage, like the
