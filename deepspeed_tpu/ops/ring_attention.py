@@ -14,6 +14,18 @@ backward is a **custom VJP** that re-rotates K/V and recomputes each block
 from the saved log-sum-exp — per-device residuals stay O(S/sp), never the
 full sequence.  K/V stay at their GQA head count through the ring (the query
 group dim is folded into the block einsums), so ppermute traffic is Hkv-sized.
+
+Causal FLOPs: fully-masked future blocks (kv past the device's own
+sequence position) are skipped with a per-device ``lax.cond`` — the ring
+still rotates every hop (collectives stay outside the branch) but only
+n(n+1)/2 of the n^2 block products are computed.  NOTE this is a
+FLOPs/energy saving, NOT wall-clock: the lockstep ppermute after each hop
+synchronizes the ring, and with contiguous sequence blocks the last
+device computes a full block on every hop while earlier devices idle.
+Converting the triangle saving into step time needs load-balanced
+(zig-zag/striped) token placement so every device owns both early and
+late positions — a layout change through the whole model, left as the
+known next step.
 """
 
 import math
@@ -65,20 +77,35 @@ def _ring_fwd_local(q, k, v, axis_name, causal, scale):
     def body(i, carry):
         o, m, l, k_cur, v_cur = carry
         kv_idx = (my_idx - i) % n
-        mask = _causal_mask(my_idx, kv_idx, S) if causal else None
-        s = _block_scores(q5, k_cur, scale, mask)      # [B,Hkv,G,Sq,Sk]
-        bm = jnp.max(s, axis=-1)
-        new_m = jnp.maximum(m, bm)
-        p = jnp.exp(s - new_m[..., None])
-        p = jnp.where(new_m[..., None] <= _NEG / 2, 0.0, p)
-        corr = jnp.exp(m - new_m)
-        corr = jnp.where(m <= _NEG / 2, 0.0, corr)
-        l = l * corr + jnp.sum(p, axis=-1)
-        bo = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_cur.dtype),
-                        v_cur).astype(jnp.float32)
-        corr_o = jnp.moveaxis(corr, 3, 1)[..., None]   # [B,Sq,Hkv,G,1]
-        o = o * corr_o + bo
-        return o, new_m, l, _rotate(k_cur, axis_name, n), \
+
+        def compute(acc):
+            o, m, l = acc
+            mask = _causal_mask(my_idx, kv_idx, S) if causal else None
+            s = _block_scores(q5, k_cur, scale, mask)  # [B,Hkv,G,Sq,Sk]
+            bm = jnp.max(s, axis=-1)
+            new_m = jnp.maximum(m, bm)
+            p = jnp.exp(s - new_m[..., None])
+            p = jnp.where(new_m[..., None] <= _NEG / 2, 0.0, p)
+            corr = jnp.exp(m - new_m)
+            corr = jnp.where(m <= _NEG / 2, 0.0, corr)
+            l2 = l * corr + jnp.sum(p, axis=-1)
+            bo = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v_cur.dtype),
+                            v_cur).astype(jnp.float32)
+            corr_o = jnp.moveaxis(corr, 3, 1)[..., None]  # [B,Sq,Hkv,G,1]
+            return o * corr_o + bo, new_m, l2
+
+        if causal:
+            # future blocks (kv_idx > my_idx) are fully masked: their
+            # contribution is exactly zero, so SKIP the compute entirely —
+            # per-device lax.cond inside shard_map; the ring ppermutes stay
+            # outside so every device still participates in every hop.
+            # n(n+1)/2 of n^2 blocks computed — a FLOPs/energy saving;
+            # wall-clock needs zig-zag placement (module docstring).
+            o, m, l = jax.lax.cond(kv_idx <= my_idx, compute,
+                                   lambda acc: acc, (o, m, l))
+        else:
+            o, m, l = compute((o, m, l))
+        return o, m, l, _rotate(k_cur, axis_name, n), \
             _rotate(v_cur, axis_name, n)
 
     o, m, l, _, _ = jax.lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
@@ -109,15 +136,29 @@ def _ring_bwd_local(q, k, v, out, lse, g, axis_name, causal, scale):
     def body(i, carry):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         kv_idx = (my_idx - i) % n
-        mask = _causal_mask(my_idx, kv_idx, S) if causal else None
-        s = _block_scores(q5, k_cur, scale, mask)
-        p = jnp.exp(s - lse[..., None])                # [B,Hkv,G,Sq,Sk]
-        dp = jnp.einsum("bqhgd,bkhd->bhgqk", g5, v_cur.astype(jnp.float32))
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", ds,
-                             k_cur.astype(jnp.float32))
-        dk_cur = dk_cur + jnp.einsum("bhgqk,bqhgd->bkhd", ds, q5)
-        dv_cur = dv_cur + jnp.einsum("bhgqk,bqhgd->bkhd", p, g5)
+
+        def compute(acc):
+            dq, dk_c, dv_c = acc
+            mask = _causal_mask(my_idx, kv_idx, S) if causal else None
+            s = _block_scores(q5, k_cur, scale, mask)
+            p = jnp.exp(s - lse[..., None])            # [B,Hkv,G,Sq,Sk]
+            dp = jnp.einsum("bqhgd,bkhd->bhgqk", g5,
+                            v_cur.astype(jnp.float32))
+            ds = p * (dp - delta[..., None]) * scale
+            dq = dq + jnp.einsum("bhgqk,bkhd->bqhgd", ds,
+                                 k_cur.astype(jnp.float32))
+            dk_c = dk_c + jnp.einsum("bhgqk,bqhgd->bkhd", ds, q5)
+            dv_c = dv_c + jnp.einsum("bhgqk,bqhgd->bkhd", p, g5)
+            return dq, dk_c, dv_c
+
+        if causal:
+            # mirror of the forward skip: fully-masked future blocks
+            # contribute exact zeros to dq/dk/dv
+            dq, dk_cur, dv_cur = jax.lax.cond(
+                kv_idx <= my_idx, compute, lambda acc: acc,
+                (dq, dk_cur, dv_cur))
+        else:
+            dq, dk_cur, dv_cur = compute((dq, dk_cur, dv_cur))
         return (dq, _rotate(k_cur, axis_name, n), _rotate(v_cur, axis_name, n),
                 _rotate(dk_cur, axis_name, n), _rotate(dv_cur, axis_name, n))
 
