@@ -101,11 +101,13 @@ class GPT2Policy(InjectionPolicy):
 
 
 class LlamaPolicy(InjectionPolicy):
-    """HF ``LlamaForCausalLM`` / ``MistralForCausalLM`` (reference has no
-    llama container in 0.8.3 — auto-TP handles it; here it is first-class).
-    Linear weights are [out, in] → transpose.  GQA via num_key_value_heads."""
+    """HF ``LlamaForCausalLM`` / ``MistralForCausalLM`` /
+    ``Qwen2ForCausalLM`` (reference has no llama container in 0.8.3 —
+    auto-TP handles it; here it is first-class).  Linear weights are
+    [out, in] → transpose.  GQA via num_key_value_heads; Qwen2 adds
+    biases on q/k/v only (picked up when present)."""
 
-    model_types = ("llama", "mistral")
+    model_types = ("llama", "mistral", "qwen2")
 
     @classmethod
     def build(cls, hf, sd):
@@ -130,11 +132,17 @@ class LlamaPolicy(InjectionPolicy):
             "wk": _stack(sd, pre + "self_attn.k_proj.weight", L, transpose=True),
             "wv": _stack(sd, pre + "self_attn.v_proj.weight", L, transpose=True),
             "wo": _stack(sd, pre + "self_attn.o_proj.weight", L, transpose=True),
+        }
+        if pre.format(0) + "self_attn.q_proj.bias" in sd:   # Qwen2
+            layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L)
+            layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L)
+            layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L)
+        layers.update({
             "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight", L),
             "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L, transpose=True),
             "w_up": _stack(sd, pre + "mlp.up_proj.weight", L, transpose=True),
             "w_down": _stack(sd, pre + "mlp.down_proj.weight", L, transpose=True),
-        }
+        })
         params = {
             "tok_embed": _np(sd["model.embed_tokens.weight"]),
             "final_norm": _np(sd["model.norm.weight"]),
@@ -643,6 +651,88 @@ class CLIPPolicy(InjectionPolicy):
         return cfg, params
 
 
+class FalconPolicy(InjectionPolicy):
+    """HF ``FalconForCausalLM`` (falcon-7b lineage:
+    ``new_decoder_architecture=False``, ``multi_query=True``,
+    ``parallel_attn=True``): parallel attn+MLP residual sharing ONE
+    input layernorm (duplicated into attn_norm/mlp_norm like the GPT-J
+    policy), fused QKV ``[(H+2)·dh, d]`` with a single shared K/V head
+    (multi-query = GQA with kv_heads=1), RoPE, GELU, biasless linears,
+    tied embeddings."""
+
+    model_types = ("falcon",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "new_decoder_architecture", False):
+            raise ValueError(
+                "Falcon new_decoder_architecture (40b/180b grouped-KV "
+                "layout) is not supported yet; falcon-7b lineage only")
+        if getattr(hf_config, "alibi", False) or \
+                not getattr(hf_config, "parallel_attn", True):
+            raise ValueError(
+                "only the rotary + parallel_attn Falcon variant is "
+                "supported (falcon-7b lineage)")
+        if not getattr(hf_config, "multi_query", True):
+            raise ValueError(
+                "Falcon multi_query=False uses a per-head [H, 3, dh] QKV "
+                "interleave this policy does not un-scramble yet")
+        if getattr(hf_config, "bias", False):
+            raise ValueError(
+                "Falcon bias=True checkpoints are not supported (the "
+                "falcon-7b lineage is biasless)")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = d // H
+        tied = bool(getattr(hf, "tie_word_embeddings", True))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=1,                      # multi_query
+            ffn_hidden_size=getattr(hf, "ffn_hidden_size", None) or 4 * d,
+            max_seq_len=getattr(hf, "max_position_embeddings", 2048),
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=True, norm_bias=True,
+            parallel_block=True, tie_embeddings=tied, remat=False)
+
+        pre = "transformer.h.{}."
+        ln_w = _stack(sd, pre + "input_layernorm.weight", L)
+        ln_b = _stack(sd, pre + "input_layernorm.bias", L)
+        wq, wk, wv = [], [], []
+        for i in range(L):
+            qkv = _np(sd[pre.format(i) +
+                         "self_attention.query_key_value.weight"])
+            wq.append(qkv[:H * dh].T)          # [d, H*dh]
+            wk.append(qkv[H * dh:(H + 1) * dh].T)
+            wv.append(qkv[(H + 1) * dh:].T)
+        layers = {
+            # one LN feeds both parallel branches (GPT-J duplication trick)
+            "attn_norm": ln_w, "attn_norm_b": ln_b,
+            "mlp_norm": ln_w.copy(), "mlp_norm_b": ln_b.copy(),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wo": _stack(sd, pre + "self_attention.dense.weight", L,
+                         transpose=True),
+            "w_up": _stack(sd, pre + "mlp.dense_h_to_4h.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.dense_4h_to_h.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.word_embeddings.weight"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 def _megatron_qkv(sd, key_w, key_b, H, dh, d, v2):
     """Un-scramble one layer's fused Megatron QKV (both checkpoint
     layouts): v2 per-head ``[H, 3, dh, d]`` interleave, v0/v1 ``[3, H*dh]``
@@ -858,8 +948,8 @@ class MegatronGPTMoEPolicy(InjectionPolicy):
 REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTNeoXPolicy, BertPolicy, BloomPolicy,
                                 GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
-                                CLIPPolicy, MegatronGPTMoEPolicy,
-                                MegatronGPTPolicy]
+                                CLIPPolicy, FalconPolicy,
+                                MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 
 def find_policy(hf_config) -> Optional[type]:

@@ -416,3 +416,51 @@ def test_megatron_moe_conversion_matches_oracle():
     got = _ours_logits(model, params, ids)
     ref = _ours_logits(oracle, oparams, ids)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_qwen2_conversion_matches_hf():
+    """Qwen2 = llama family + biases on q/k/v only."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert "wq_b" in params["layers"] and "wo_b" not in params["layers"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_falcon_conversion_matches_hf():
+    """Falcon-7b lineage: parallel attn+MLP on one layernorm, multi-query
+    fused QKV, RoPE, tied embeddings."""
+    hf_cfg = transformers.FalconConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, new_decoder_architecture=False,
+        multi_query=True, parallel_attn=True, bias=False, alibi=False)
+    torch.manual_seed(0)
+    hf = transformers.FalconForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.kv_heads == 1 and model.config.parallel_block
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_falcon_unsupported_variants_raise():
+    with pytest.raises(ValueError, match="new_decoder_architecture"):
+        find_policy(transformers.FalconConfig(new_decoder_architecture=True))
+    with pytest.raises(ValueError, match="parallel_attn|rotary"):
+        find_policy(transformers.FalconConfig(
+            new_decoder_architecture=False, alibi=True))
+
+
+def test_falcon_mq_false_and_bias_raise():
+    with pytest.raises(ValueError, match="multi_query"):
+        find_policy(transformers.FalconConfig(
+            new_decoder_architecture=False, multi_query=False,
+            parallel_attn=True, alibi=False))
+    with pytest.raises(ValueError, match="bias"):
+        find_policy(transformers.FalconConfig(
+            new_decoder_architecture=False, multi_query=True,
+            parallel_attn=True, alibi=False, bias=True))
