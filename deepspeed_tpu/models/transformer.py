@@ -66,6 +66,9 @@ class TransformerConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"
+    # ring attention token layout: "zigzag" balances the causal triangle
+    # across sp devices (~2x step time at large sp); needs S % (2*sp) == 0
+    ring_layout: str = "contiguous"
     # Pallas flash-attention tile sizes (tunable per chip generation)
     attn_block_q: int = 512
     attn_block_k: int = 512
@@ -531,7 +534,8 @@ class CausalTransformerLM:
         elif c.attn_impl == "ring":
             from deepspeed_tpu.ops.ring_attention import ring_attention
             attn = ring_attention(q, k, v, causal=True,
-                                  softmax_scale=c.attn_scale)
+                                  softmax_scale=c.attn_scale,
+                                  layout=c.ring_layout)
         elif c.attn_impl == "ulysses":
             from deepspeed_tpu.ops.ulysses import ulysses_attention, sp_degree
             sp = sp_degree()

@@ -426,8 +426,15 @@ def test_qwen2_conversion_matches_hf():
         max_position_embeddings=64, tie_word_embeddings=False)
     torch.manual_seed(0)
     hf = transformers.Qwen2ForCausalLM(hf_cfg)
+    # HF zero-inits Linear biases: randomise them so logit parity actually
+    # exercises the bias path (a dropped wq_b would otherwise still pass)
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith("proj.bias"):
+                p.normal_(std=0.5)
     model, params = replace_transformer_layer(hf)
     assert "wq_b" in params["layers"] and "wo_b" not in params["layers"]
+    assert float(np.abs(params["layers"]["wq_b"]).max()) > 0
     ids = _ids(96)
     _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
 
