@@ -551,3 +551,38 @@ def test_interleaved_schedule_validation():
     with pytest.raises(ValueError, match="interleaved"):
         transformer_pipeline(cfg, num_stages=4, schedule="gpipe",
                              num_virtual_stages=2)
+
+
+def test_pipeline_with_compression_and_fp16(pp_mesh):
+    """The cast-site transforms (compression STE) and the MoQ anneal clock
+    must reach the pipeline engine too (round-3 fix: PipelineEngine
+    threads step/qstep into _loss_and_grads) — compressed fp16 pipeline
+    training descends through the schedule-offset flip."""
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    from deepspeed_tpu.runtime.pipe.module import transformer_pipeline
+    cfg = TransformerConfig.tiny(hidden_size=32, n_heads=4, n_layers=4,
+                                 vocab_size=128, max_seq_len=16)
+    model = transformer_pipeline(cfg, num_stages=4)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "fp16": {"enabled": True, "initial_scale_power": 8},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"pp": 4, "fsdp": 2},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "compression_training": {"sparse_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 3,
+                                      "method": "l1"},
+                "different_groups": {"sp1": {"params": {"dense_ratio": 0.9},
+                                             "modules": ["w_up"]}}}},
+        })
+    assert engine._compression is not None
+    rng = np.random.default_rng(0)
+    mb = {"input_ids": rng.integers(0, 128, (2, 16)).astype(np.int32)}
+    losses = [float(engine.train_batch(data_iter=iter(lambda: mb, None)))
+              for _ in range(10)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    groups.reset_mesh()
