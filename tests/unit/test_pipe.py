@@ -553,7 +553,7 @@ def test_interleaved_schedule_validation():
                              num_virtual_stages=2)
 
 
-def test_pipeline_with_compression_and_fp16(pp_mesh):
+def test_pipeline_with_compression_and_fp16():
     """The cast-site transforms (compression STE) and the MoQ anneal clock
     must reach the pipeline engine too (round-3 fix: PipelineEngine
     threads step/qstep into _loss_and_grads) — compressed fp16 pipeline
@@ -585,4 +585,13 @@ def test_pipeline_with_compression_and_fp16(pp_mesh):
     losses = [float(engine.train_batch(data_iter=iter(lambda: mb, None)))
               for _ in range(10)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
-    groups.reset_mesh()
+    # prove the transform actually engages past schedule_offset on the
+    # params the step consumes: pruning zeroes ~10% of w_up entries —
+    # a regression that stops threading `step` into the pipeline's
+    # _loss_and_grads would make compression a silent no-op (step=None)
+    body = engine.state.params["body"]
+    comp = engine._compression.transform(engine.state.params, step=9)
+    w = np.asarray(comp["body"]["w_up"], np.float32)
+    frac_zero = float((w == 0).mean())
+    assert 0.05 < frac_zero < 0.2, frac_zero
+    assert float((np.asarray(body["w_up"]) == 0).mean()) < 0.01
