@@ -349,8 +349,12 @@ def main():
     spec = {"model": kw, "batch": batch, "seq": seq, "steps": steps,
             "remat": True, "gas": 4 if on_tpu else 1, "zero": {"stage": 3}}
     train, err = _run_worker("train", spec, timeout=1800, cpu=not on_tpu)
+    if not train:
+        # record the first attempt's failure NOW: if the budget runs out
+        # before any retry, this error would otherwise vanish from the
+        # output line (observed: only probe timeouts reported)
+        errors["train_tpu" if on_tpu else "train_cpu"] = err
     if not train and on_tpu:
-        errors["train_tpu"] = err
         # one retry, one rung down, shorter leash (a hung backend costs
         # the timeout — don't walk the whole ladder at 1800 s each)
         idx = [n for n, _ in _LADDER].index(name)
@@ -363,11 +367,12 @@ def main():
             else:
                 errors[f"train_{smaller}"] = err
     if not train and _remaining() > 120:
-        errors["train"] = err
         name = "gpt2_125m_cpu_fallback"
         spec = {"model": dict(_LADDER[-1][1]), "batch": 4, "seq": 256,
                 "steps": 3, "remat": True, "zero": {"stage": 3}}
         train, err = _run_worker("train", spec, timeout=1800, cpu=True)
+        if not train:
+            errors["train_fallback"] = err   # the LAST thing that ran
         on_tpu = False
         peak = None
         kind = "cpu"

@@ -580,18 +580,24 @@ def test_pipeline_with_compression_and_fp16():
                                              "modules": ["w_up"]}}}},
         })
     assert engine._compression is not None
+    # observe the step the ENGINE passes into the transform at trace time:
+    # a regression that stops threading `step` into the pipeline's
+    # _loss_and_grads would make compression a silent no-op (step=None —
+    # the transform is then never called)
+    seen_steps = []
+    orig_transform = engine._compression.transform
+
+    def spy(params, step):
+        seen_steps.append(step)
+        return orig_transform(params, step)
+    engine._compression.transform = spy
     rng = np.random.default_rng(0)
     mb = {"input_ids": rng.integers(0, 128, (2, 16)).astype(np.int32)}
     losses = [float(engine.train_batch(data_iter=iter(lambda: mb, None)))
               for _ in range(10)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
-    # prove the transform actually engages past schedule_offset on the
-    # params the step consumes: pruning zeroes ~10% of w_up entries —
-    # a regression that stops threading `step` into the pipeline's
-    # _loss_and_grads would make compression a silent no-op (step=None)
-    body = engine.state.params["body"]
-    comp = engine._compression.transform(engine.state.params, step=9)
-    w = np.asarray(comp["body"]["w_up"], np.float32)
-    frac_zero = float((w == 0).mean())
+    assert seen_steps and all(st is not None for st in seen_steps)
+    # and the engaged transform prunes ~10% of w_up past the offset
+    comp = orig_transform(engine.state.params, step=9)
+    frac_zero = float((np.asarray(comp["body"]["w_up"]) == 0).mean())
     assert 0.05 < frac_zero < 0.2, frac_zero
-    assert float((np.asarray(body["w_up"]) == 0).mean()) < 0.01
