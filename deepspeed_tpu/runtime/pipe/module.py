@@ -169,12 +169,19 @@ class EmbeddingPipe:
 
 class TransformerBlockPipe:
     """One transformer block — the homogeneous pipelined body unit.
-    Reuses the flagship model's block math (attention + MLP)."""
+    Reuses the flagship model's block math (attention + MLP).
+
+    MoE bodies (pp × ep composition) need ``moe_layer_freq == 1`` so the
+    body stays homogeneous (every block carries an expert bank); the
+    block then reports ``has_aux`` and returns ``(x, gate_aux)``."""
 
     def __init__(self, config: TransformerConfig):
-        assert not config.is_moe, \
-            "MoE layers in the pipeline body are not supported yet"
+        if config.is_moe and config.moe_layer_freq != 1:
+            raise ValueError(
+                "pipelined MoE needs moe_layer_freq=1 (a homogeneous "
+                "body); mixed dense/MoE stacks cannot stack into one scan")
         self.config = config
+        self.has_aux = config.is_moe
         self._model = CausalTransformerLM(config)
 
     def init(self, rng, dtype=jnp.float32):
@@ -194,9 +201,17 @@ class TransformerBlockPipe:
             "wv": dense(ks[2], (d, Hkv * dh), d),
             "wo": dense(ks[3], (H * dh, d), H * dh),
             "mlp_norm": jnp.ones((d,), dtype),
-            "w_up": dense(ks[4], (d, f), d),
-            "w_down": dense(ks[5], (f, d), f),
         }
+        if c.is_moe:
+            E = c.moe_num_experts
+            layer["moe"] = {
+                "wg": dense(ks[4], (d, E), d).astype(jnp.float32),
+                "w_up": dense(ks[5], (E, d, f), d),
+                "w_down": dense(ks[6], (E, f, d), f),
+            }
+            return layer
+        layer["w_up"] = dense(ks[4], (d, f), d)
+        layer["w_down"] = dense(ks[5], (f, d), f)
         if c.activation == "silu":
             layer["w_gate"] = dense(ks[6], (d, f), d)
         return layer
@@ -204,11 +219,20 @@ class TransformerBlockPipe:
     def __call__(self, params, x, tied=None):
         B, S, _ = x.shape
         positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-        x, _aux = self._model._layer(x, params, positions, train=True)
-        return x
+        x, aux = self._model._layer(x, params, positions, train=True)
+        return (x, aux) if self.has_aux else x
 
     def tp_rules(self):
         """Single-layer Megatron split (PipelineModule prepends the pp dim)."""
+        if self.config.is_moe:
+            from deepspeed_tpu.parallel.topology import EP_AXIS
+            return [
+                (r"moe.*w_up", P(EP_AXIS, None, TP_AXIS)),
+                (r"moe.*w_down", P(EP_AXIS, TP_AXIS, None)),
+                (r"moe.*wg", P()),
+                (r"wq|wk|wv", P(None, TP_AXIS)),
+                (r"wo", P(TP_AXIS, None)),
+            ]
         return [
             (r"wq|wk|wv|w_up|w_gate", P(None, TP_AXIS)),
             (r"wo|w_down", P(TP_AXIS, None)),
@@ -415,17 +439,34 @@ class PipelineModule:
         t = tied.get(key) if key is not None else None
         return self._layers[i](params, x, tied=t)
 
+    @property
+    def _body_has_aux(self) -> bool:
+        start = self._split[0] if self._split else 0
+        return bool(getattr(self._layers[start], "has_aux", False)) \
+            if self._layers else False
+
     def _stage_fn(self):
         start, end = self._split
         layer = self._layers[start]
         remat = self.activation_checkpoint_interval > 0
+        has_aux = self._body_has_aux
 
-        def apply_one(x, lp):
-            return layer(lp, x), None
+        if has_aux:
+            def apply_one(carry, lp):
+                x, aux = carry
+                y, a = layer(lp, x)
+                return (y, aux + a), None
+        else:
+            def apply_one(x, lp):
+                return layer(lp, x), None
         if remat:
             apply_one = jax.checkpoint(apply_one)
 
         def stage_fn(chunk_params, x):
+            if has_aux:
+                (y, aux), _ = jax.lax.scan(apply_one, (x, jnp.float32(0.0)),
+                                           chunk_params)
+                return y, aux
             x, _ = jax.lax.scan(apply_one, x, chunk_params)
             return x
         return stage_fn
@@ -443,7 +484,8 @@ class PipelineModule:
             return x
 
         x = jax.vmap(pre_fn)(batch_mbs)
-        if self.schedule == "interleaved":
+        has_aux = self._body_has_aux
+        if self.schedule == "interleaved" and not has_aux:
             x = pipeline_interleaved(
                 self._stage_fn(),
                 stack_interleaved_params(params["body"], self.num_stages,
@@ -452,8 +494,13 @@ class PipelineModule:
         else:
             stage_params = stack_stage_params(params["body"],
                                               self.num_stages)
+            sched = ("1f1b-remat" if self.schedule == "interleaved"
+                     else self.schedule)
             x = pipeline_spmd(self._stage_fn(), stage_params, x,
-                              self.num_stages, schedule=self.schedule)
+                              self.num_stages, schedule=sched,
+                              with_aux=has_aux)
+            if has_aux:
+                x, _ = x          # aux is a training-only term
 
         def post_fn(h):
             for j in range(end, len(self._layers)):
@@ -486,7 +533,21 @@ class PipelineModule:
 
         # _stage_fn already checkpoints per layer when activation
         # checkpointing is on — no second stage-level remat wrap
-        if self.schedule == "interleaved":
+        has_aux = self._body_has_aux
+        schedule = self.schedule
+        if has_aux and schedule == "interleaved":
+            # MoE bodies emit the gate aux loss per (stage, microbatch);
+            # the interleaved clock does not plumb it yet
+            raise ValueError(
+                "MoE pipeline bodies need schedule='1f1b-remat', '1f1b' "
+                "or 'gpipe' (the gate aux loss is not threaded through "
+                "'interleaved' yet)")
+        if has_aux and schedule == "1f1b":
+            # the hand-threaded 1F1B VJP doesn't carry the aux either;
+            # the chunked-remat schedule keeps the O(P) residual cap and
+            # lets autodiff own the aux gradients
+            schedule = "1f1b-remat"
+        if schedule == "interleaved":
             x = pipeline_interleaved(
                 self._stage_fn(),
                 stack_interleaved_params(params["body"], self.num_stages,
@@ -497,7 +558,7 @@ class PipelineModule:
 
         stage_params = stack_stage_params(params["body"], self.num_stages)
 
-        if self.schedule == "1f1b" and self.num_stages > 1:
+        if schedule == "1f1b" and self.num_stages > 1:
             # TRUE 1F1B: the loss head runs inside the interleaved scan so
             # each microbatch's backward starts the tick its forward exits
             # (reference TrainSchedule, runtime/pipe/schedule.py:184) —
@@ -515,19 +576,34 @@ class PipelineModule:
                 stage_params, (post_params, tied), x, inputs,
                 loss_ct=loss_scale)
 
-        x = pipeline_spmd(self._stage_fn(), stage_params, x, self.num_stages,
-                          schedule=self.schedule)
-        return self._post_loss_tail(params, x, inputs, tied, end, loss_scale)
+        out = pipeline_spmd(self._stage_fn(), stage_params, x,
+                            self.num_stages, schedule=schedule,
+                            with_aux=has_aux)
+        if has_aux:
+            x, aux_sum = out
+            coef = getattr(self._layers[start].config, "moe_aux_loss_coef",
+                           0.0)
+            # microbatched semantics (same as the dense GAS scan): mean over
+            # microbatches of (ce_m + coef * aux_m)
+            extra = coef * aux_sum / x.shape[0]
+            return self._post_loss_tail(params, x, inputs, tied, end,
+                                        loss_scale, extra=extra)
+        return self._post_loss_tail(params, out, inputs, tied, end,
+                                    loss_scale)
 
-    def _post_loss_tail(self, params, x, inputs, tied, end, loss_scale):
+    def _post_loss_tail(self, params, x, inputs, tied, end, loss_scale,
+                        extra=None):
         """Shared post-layers + loss over pipelined outputs (one
-        definition for every autodiff schedule)."""
+        definition for every autodiff schedule).  ``extra``: additive loss
+        terms computed inside the pipeline (MoE gate aux)."""
         def mb_loss(args):
             h, mb = args
             for j in range(end, len(self._layers)):
                 h = self._call_layer(j, params["post"][j - end], h, tied)
             return self.loss_fn(h, mb)
         mean = jnp.mean(jax.lax.map(mb_loss, (x, inputs)))
+        if extra is not None:
+            mean = mean + extra
         return mean if loss_scale is None else mean * loss_scale
 
     def partition_layers(self):

@@ -67,7 +67,8 @@ def pipeline_spmd(stage_fn: Callable,
                   x_mbs: jax.Array,
                   num_stages: int,
                   remat: bool = False,
-                  schedule: str = "1f1b") -> jax.Array:
+                  schedule: str = "1f1b",
+                  with_aux: bool = False):
     """Run ``M`` microbatches through ``P = num_stages`` pipeline stages.
 
     Args:
@@ -101,18 +102,26 @@ def pipeline_spmd(stage_fn: Callable,
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
 
+    def call_stage(sp, x):
+        # with_aux contract: stage_fn returns (y, aux_scalar) — MoE bodies
+        # emit the gate load-balance loss per (stage, microbatch)
+        out = stage_fn(sp, x)
+        return out if with_aux else (out, jnp.float32(0.0))
+
     if Pn == 1:
         # degenerate pipeline: plain microbatch loop
-        def one(carry, x):
-            return carry, stage_fn(
+        def one(aux, x):
+            y, a = call_stage(
                 jax.tree_util.tree_map(lambda p: p[0], stage_params), x)
-        _, ys = jax.lax.scan(one, (), x_mbs)
-        return ys
+            return aux + a, y
+        aux, ys = jax.lax.scan(one, jnp.float32(0.0), x_mbs)
+        return (ys, aux) if with_aux else ys
 
-    vstage = jax.vmap(stage_fn)
+    vstage = jax.vmap(call_stage)
     feat_shape = x_mbs.shape[1:]
     buf = jnp.zeros((Pn,) + feat_shape, x_mbs.dtype)
     buf = maybe_constrain(buf, _buf_spec(buf.ndim))
+    stage_ids = jnp.arange(Pn)
 
     def tick(buf, t):
         # LoadMicroBatch: microbatch t enters stage 0 while t < M
@@ -122,20 +131,26 @@ def pipeline_spmd(stage_fn: Callable,
         buf = jax.lax.dynamic_update_index_in_dim(buf, slot0, 0, 0)
         buf = maybe_constrain(buf, _buf_spec(buf.ndim))
         # ForwardPass on every stage (stage s holds microbatch t - s)
-        y = vstage(stage_params, buf)
+        y, aux_s = vstage(stage_params, buf)
         y = maybe_constrain(y, _buf_spec(y.ndim))
+        # aux only from slots holding a REAL microbatch (warmup/drain slots
+        # run on zero/stale activations — their gate stats are garbage)
+        mb_s = t - stage_ids
+        valid = (mb_s >= 0) & (mb_s < M)
+        aux_t = jnp.sum(jnp.where(valid, aux_s, 0.0))
         # SendActivation/RecvActivation: shift one slot down the pipe
         # (roll over the pp-sharded dim → CollectivePermute); the last
         # stage's output is this tick's exit (microbatch t - (P-1))
-        return jnp.roll(y, 1, axis=0), y[Pn - 1]
+        return jnp.roll(y, 1, axis=0), (y[Pn - 1], aux_t)
 
     if schedule == "gpipe":
-        _, ys = jax.lax.scan(tick, buf, jnp.arange(T))
+        _, (ys, auxs) = jax.lax.scan(tick, buf, jnp.arange(T))
     else:
         # 1f1b-memory schedule: chunks of P ticks, chunk body remat'd, so
         # autodiff saves one [P, ...] carry per chunk boundary instead of
         # every tick's buffer (padding ticks past T are harmless: they
-        # load nothing and their outputs are sliced off below)
+        # load nothing, their outputs are sliced off below, and their aux
+        # is masked out)
         chunk = Pn
         T_pad = -(-T // chunk) * chunk
 
@@ -143,13 +158,15 @@ def pipeline_spmd(stage_fn: Callable,
             return jax.lax.scan(tick, buf, ts)
 
         run_chunk = jax.checkpoint(run_chunk, prevent_cse=False)
-        _, ys = jax.lax.scan(run_chunk, buf,
-                             jnp.arange(T_pad).reshape(-1, chunk))
+        _, (ys, auxs) = jax.lax.scan(run_chunk, buf,
+                                     jnp.arange(T_pad).reshape(-1, chunk))
         ys = ys.reshape((T_pad,) + ys.shape[2:])
+        auxs = auxs.reshape(-1)
     # tick t emits microbatch t-(P-1): the valid window is [P-1, P-1+M)
     out = jax.lax.slice_in_dim(ys, Pn - 1, Pn - 1 + M, axis=0)
     entries = [None, tuple(BATCH_AXES)] + [None] * (out.ndim - 2)
-    return maybe_constrain(out, P(*entries))
+    out = maybe_constrain(out, P(*entries))
+    return (out, jnp.sum(auxs)) if with_aux else out
 
 
 # ----------------------------------------------------------------------
