@@ -32,6 +32,7 @@ try:  # pltpu is only importable on TPU-capable installs
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PLTPU = True
 except Exception:  # pragma: no cover
+    pltpu = None
     _HAS_PLTPU = False
 
 DEFAULT_BLOCK_Q = 512
@@ -103,8 +104,9 @@ def _fwd_impl(q_ref, k_ref, v_ref, slope_ref, window_ref, o_ref, lse_ref,
     q = q_ref[0].astype(jnp.float32) * scale          # [BLK_Q, D]
     d = q.shape[-1]
 
-    slope = slope_ref[0, 0] if slope_ref is not None else None
-    window = window_ref[0, 0] if window_ref is not None else None
+    bh = pl.program_id(0)
+    slope = slope_ref[bh, 0] if slope_ref is not None else None
+    window = window_ref[bh, 0] if window_ref is not None else None
     lo, hi = _k_range(qi, block_q, block_k, seq_len, causal, window)
 
     def body(kb, carry):
@@ -134,6 +136,22 @@ def _fwd_impl(q_ref, k_ref, v_ref, slope_ref, window_ref, o_ref, lse_ref,
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _scalar_specs(shape):
+    """Block specs for the per-(batch·head) bias scalars.
+
+    The scalars ride as FULL ``[B*H, 1]`` arrays — a ``(1, 1)`` VMEM block of
+    a ``[B*H, 1]`` array violates Mosaic's last-two-dims tiling rule (must
+    tile (8, 128) or equal the array dims).  On TPU they live in SMEM (the
+    scalar memory, where dynamic scalar reads are native); kernels index them
+    with ``pl.program_id(0)``.
+    """
+    if _HAS_PLTPU:
+        smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+        return [smem, smem]
+    full = pl.BlockSpec(shape, lambda *_: (0,) * len(shape))
+    return [full, full]
 
 
 def _bias_inputs(alibi_slopes, window, B, H):
@@ -179,8 +197,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret=False,
             block_k=block_k, seq_len=S,
             use_slope=alibi_slopes is not None,
             use_window=window is not None)
-        in_specs += [pl.BlockSpec((1, 1), lambda bh, qi: (bh, 0)),
-                     pl.BlockSpec((1, 1), lambda bh, qi: (bh, 0))]
+        in_specs += _scalar_specs(slopes_bh.shape)
         args += [slopes_bh, w_bh]
 
     out, lse = pl.pallas_call(
@@ -232,8 +249,9 @@ def _bwd_dq_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slope_ref,
     delta = delta_ref[0].reshape(block_q, 1)
     d = q.shape[-1]
 
-    slope = slope_ref[0, 0] if slope_ref is not None else None
-    window = window_ref[0, 0] if window_ref is not None else None
+    bh = pl.program_id(0)
+    slope = slope_ref[bh, 0] if slope_ref is not None else None
+    window = window_ref[bh, 0] if window_ref is not None else None
     lo, hi = _k_range(qi, block_q, block_k, seq_len, causal, window)
 
     def body(kb, dq):
@@ -286,8 +304,9 @@ def _bwd_dkv_impl(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[0].astype(jnp.float32)
     d = k.shape[-1]
 
-    slope = slope_ref[0, 0] if slope_ref is not None else None
-    window = window_ref[0, 0] if window_ref is not None else None
+    bh = pl.program_id(0)
+    slope = slope_ref[bh, 0] if slope_ref is not None else None
+    window = window_ref[bh, 0] if window_ref is not None else None
     num_q_blocks = seq_len // block_q
     lo = (ki * block_k) // block_q if causal else 0
     hi = num_q_blocks
@@ -351,8 +370,8 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
                     axis=-1, keepdims=True)
 
     slopes_bh, w_bh = _bias_inputs(alibi_slopes, window, B, H)
-    scalar_specs = [pl.BlockSpec((1, 1), lambda bh, i: (bh, 0)),
-                    pl.BlockSpec((1, 1), lambda bh, i: (bh, 0))]
+    scalar_specs = ([] if slopes_bh is None
+                    else _scalar_specs(slopes_bh.shape))
     scalar_args = [] if slopes_bh is None else [slopes_bh, w_bh]
 
     kv_spec = pl.BlockSpec((1, S, D), lambda bh, i, g=group: (bh // g, 0, 0))
@@ -370,7 +389,7 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0)),
-        ] + (scalar_specs if scalar_args else []),
+        ] + scalar_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         interpret=interpret,
@@ -395,7 +414,7 @@ def _flash_bwd_pallas(scale, causal, res, g, block_q, block_k,
             full_spec,                                     # dO
             pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),  # lse
             pl.BlockSpec((1, S, 1), lambda bh, ki: (bh, 0, 0)),  # delta
-        ] + (scalar_specs if scalar_args else []),
+        ] + scalar_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
