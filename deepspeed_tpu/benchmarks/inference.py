@@ -10,6 +10,7 @@ Usage::
 """
 
 import argparse
+import json
 import time
 from typing import List
 
@@ -91,9 +92,22 @@ def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
     stats = print_latency(per_token, f"generation token latency "
                           f"({model_size}, {dtype}"
                           f"{', int8' if quant else ''}, bs={batch})")
-    print_latency(e2e, f"end-to-end latency ({max_new_tokens} tokens)")
+    e2e_stats = print_latency(e2e, f"end-to-end latency ({max_new_tokens} "
+                              "tokens)")
     tput = batch * max_new_tokens / (sum(e2e[3:]) / max(1, len(e2e[3:])))
     print(f"\tThroughput: {tput:.1f} tokens/s")
+    # one machine-readable line so harnesses (scripts/onchip_r03.py) can
+    # journal the result without scraping the human table
+    record = {"model": model_size, "dtype": dtype, "int8": bool(quant),
+              "batch": batch, "prompt_len": prompt_len,
+              "max_new_tokens": max_new_tokens,
+              "rpc_floor_ms": round(rpc_floor * 1000, 2),
+              "token_latency_ms": {k: round(v * 1000, 3)
+                                   for k, v in (stats or {}).items()},
+              "e2e_latency_ms": {k: round(v * 1000, 2)
+                                 for k, v in (e2e_stats or {}).items()},
+              "tokens_per_sec": round(tput, 1)}
+    print(json.dumps(record))
     return stats
 
 

@@ -25,6 +25,12 @@ MODELS = {
     "gpt2_125m": dict(hidden_size=768, n_layers=12, n_heads=12),
     "gpt_350m": dict(hidden_size=1024, n_layers=24, n_heads=16),
     "gpt_760m": dict(hidden_size=1536, n_layers=24, n_heads=16),
+    # 1.01B: the largest shape whose full train state fits one 16 GB chip
+    # with bf16 Adam moments (master 4B + mu 2B + nu 2B per param) — the
+    # single-chip >=1B MFU config (ZeRO-3 Offload would need host traffic
+    # that a tunneled chip cannot sustain)
+    "gpt_1b": dict(hidden_size=2048, n_layers=18, n_heads=16),
+    "gpt_1_1b": dict(hidden_size=2048, n_layers=20, n_heads=16),
     "gpt2_1_5b": dict(hidden_size=1600, n_layers=48, n_heads=25),
     "gpt_2_7b": dict(hidden_size=2560, n_layers=32, n_heads=32),
     "gpt_6_7b": dict(hidden_size=4096, n_layers=32, n_heads=32),
@@ -45,7 +51,8 @@ def _peak_tflops(kind: str):
 def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
                   zero_stage=3, offload=None, remat=True,
                   remat_policy="dots_saveable", attn_block_q=None,
-                  attn_block_k=None, dtype="bf16", vocab_size=50304):
+                  attn_block_k=None, dtype="bf16", vocab_size=50304,
+                  moment_dtype="float32"):
     import jax
     import numpy as np
 
@@ -80,7 +87,9 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
         model=model_obj, model_parameters=model_obj.init(jax.random.key(0)),
         config={"train_micro_batch_size_per_gpu": batch // ndev,
                 "gradient_accumulation_steps": gas,
-                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 1e-4,
+                                         "moment_dtype": moment_dtype}},
                 dtype: {"enabled": True},
                 "zero_optimization": zero})
 
@@ -114,6 +123,8 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
         "loss": float(loss),
         "device_kind": kind, "n_chips": n_chips,
     }
+    if moment_dtype != "float32":
+        out["moment_dtype"] = moment_dtype
     if peak:
         out["mfu"] = round(tflops / peak, 4)
     return out
@@ -134,6 +145,10 @@ def main(argv=None):
     p.add_argument("--attn-block-q", type=int, default=None)
     p.add_argument("--attn-block-k", type=int, default=None)
     p.add_argument("--dtype", choices=["bf16", "fp16"], default="bf16")
+    p.add_argument("--moment-dtype", choices=["float32", "bfloat16"],
+                   default="float32",
+                   help="Adam moment storage dtype (bfloat16 halves "
+                        "optimizer-state HBM; stochastic rounding)")
     p.add_argument("--json", action="store_true",
                    help="print one JSON line instead of a table")
     a = p.parse_args(argv)
@@ -141,7 +156,8 @@ def main(argv=None):
         model=a.model, batch=a.batch, gas=a.gas, seq=a.seq, steps=a.steps,
         zero_stage=a.zero_stage, offload=a.offload, remat=not a.no_remat,
         remat_policy=a.remat_policy, attn_block_q=a.attn_block_q,
-        attn_block_k=a.attn_block_k, dtype=a.dtype)
+        attn_block_k=a.attn_block_k, dtype=a.dtype,
+        moment_dtype=a.moment_dtype)
     if a.json:
         print(json.dumps(out))
     else:

@@ -34,11 +34,104 @@ SGD_OPTIMIZER = "sgd"
 ADAGRAD_OPTIMIZER = "adagrad"
 
 
+def _sr_cast(x32, key, dtype):
+    """Stochastic-round an fp32 array to ``dtype`` (bf16): add uniform noise
+    to the truncated mantissa bits, then truncate.  Unbiased in expectation,
+    so low-precision moment accumulation does not systematically lose the
+    (1-beta)-scaled increments the way nearest-rounding does — the reason
+    plain bf16 second moments decay under b2=0.999."""
+    import jax
+    import jax.numpy as jnp
+    if dtype == jnp.float32:
+        return x32
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    rnd = jax.random.bits(key, x32.shape, jnp.uint16).astype(jnp.uint32)
+    out = (bits + rnd) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(out, jnp.float32).astype(dtype)
+
+
+def _scale_by_adam_dtyped(b1, b2, eps, moment_dtype) -> optax.GradientTransformation:
+    """``optax.scale_by_adam`` with BOTH moments stored in ``moment_dtype``
+    (optax only supports ``mu_dtype``).  Accumulation happens in fp32 every
+    step; the stored state is stochastically rounded down to the target dtype.
+    Halves Adam's optimizer-state HBM (8 bytes/param -> 4 at bf16), which is
+    what lets a >=1B-param model train on one 16 GB chip without host offload
+    (cf. reference ZeRO-Offload's motivation, runtime/zero/offload.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(jnp.shape(p), moment_dtype)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(zeros, params),
+            nu=jax.tree_util.tree_map(zeros, params))
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(jnp.float32(b1), cf)
+        bc2 = 1.0 - jnp.power(jnp.float32(b2), cf)
+        base = jax.random.fold_in(jax.random.key(0), count)
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        n = max(1, len(leaves))
+        mu_keys = treedef.unflatten(list(jax.random.split(
+            jax.random.fold_in(base, 0), n))[:len(leaves)])
+        nu_keys = treedef.unflatten(list(jax.random.split(
+            jax.random.fold_in(base, 1), n))[:len(leaves)])
+
+        mu32 = jax.tree_util.tree_map(
+            lambda g, m: b1 * m.astype(jnp.float32) +
+            (1.0 - b1) * g.astype(jnp.float32), updates, state.mu)
+        nu32 = jax.tree_util.tree_map(
+            lambda g, v: b2 * v.astype(jnp.float32) +
+            (1.0 - b2) * jnp.square(g.astype(jnp.float32)),
+            updates, state.nu)
+        out = jax.tree_util.tree_map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps), mu32, nu32)
+
+        mu_new = jax.tree_util.tree_map(
+            lambda m, k: _sr_cast(m, k, moment_dtype), mu32, mu_keys)
+        nu_new = jax.tree_util.tree_map(
+            lambda v, k: _sr_cast(v, k, moment_dtype), nu32, nu_keys)
+        return out, optax.ScaleByAdamState(count=count, mu=mu_new, nu=nu_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+def _moment_dtype(params: Dict[str, Any]):
+    import jax.numpy as jnp
+    name = str(params.get("moment_dtype", "float32")).lower()
+    table = {"float32": jnp.float32, "fp32": jnp.float32,
+             "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}
+    if name not in table:
+        raise ValueError(f"moment_dtype must be one of {sorted(table)}, "
+                         f"got '{name}'")
+    return table[name]
+
+
 def _adam(params: Dict[str, Any], adamw_mode=True) -> optax.GradientTransformation:
     lr = params.get("lr", 1e-3)
     betas = params.get("betas", (0.9, 0.999))
     eps = params.get("eps", 1e-8)
     wd = params.get("weight_decay", 0.01 if adamw_mode else 0.0)
+    mdt = _moment_dtype(params)
+    import jax.numpy as jnp
+    if mdt != jnp.float32:
+        if params.get("_b1_schedule") is not None:
+            raise ValueError("moment_dtype != float32 is not supported "
+                             "together with OneCycle momentum cycling")
+        # reduced-precision moments: custom scale_by_adam (optax only casts
+        # mu), chained to match optax.adamw/adam semantics exactly
+        tx = optax.chain(
+            _scale_by_adam_dtyped(betas[0], betas[1], eps, mdt),
+            optax.add_decayed_weights(wd) if (adamw_mode and wd)
+            else optax.identity(),
+            optax.scale_by_learning_rate(lr))
+        if not adamw_mode and wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
     b1_schedule = params.get("_b1_schedule")   # 1Cycle momentum cycling
     if b1_schedule is not None:
         # inject_hyperparams lets b1 follow a schedule (the reference's
