@@ -52,7 +52,7 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
                   zero_stage=3, offload=None, remat=True,
                   remat_policy="dots_saveable", attn_block_q=None,
                   attn_block_k=None, dtype="bf16", vocab_size=50304,
-                  moment_dtype="float32"):
+                  moment_dtype="float32", grad_accum_dtype=None):
     import jax
     import numpy as np
 
@@ -83,15 +83,18 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
     zero = {"stage": zero_stage}
     if offload:
         zero["offload_optimizer"] = {"device": offload}
+    ds_config = {"train_micro_batch_size_per_gpu": batch // ndev,
+                 "gradient_accumulation_steps": gas,
+                 "optimizer": {"type": "AdamW",
+                               "params": {"lr": 1e-4,
+                                          "moment_dtype": moment_dtype}},
+                 dtype: {"enabled": True},
+                 "zero_optimization": zero}
+    if grad_accum_dtype:
+        ds_config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
     engine, *_ = deepspeed_tpu.initialize(
         model=model_obj, model_parameters=model_obj.init(jax.random.key(0)),
-        config={"train_micro_batch_size_per_gpu": batch // ndev,
-                "gradient_accumulation_steps": gas,
-                "optimizer": {"type": "AdamW",
-                              "params": {"lr": 1e-4,
-                                         "moment_dtype": moment_dtype}},
-                dtype: {"enabled": True},
-                "zero_optimization": zero})
+        config=ds_config)
 
     rng = np.random.default_rng(0)
     bshape = (gas, batch, seq) if gas > 1 else (batch, seq)
@@ -125,6 +128,8 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
     }
     if moment_dtype != "float32":
         out["moment_dtype"] = moment_dtype
+    if grad_accum_dtype:
+        out["grad_accum_dtype"] = grad_accum_dtype
     if peak:
         out["mfu"] = round(tflops / peak, 4)
     return out
@@ -149,6 +154,10 @@ def main(argv=None):
                    default="float32",
                    help="Adam moment storage dtype (bfloat16 halves "
                         "optimizer-state HBM; stochastic rounding)")
+    p.add_argument("--grad-accum-dtype", choices=["float32", "bfloat16"],
+                   default=None,
+                   help="grad tree / GAS-carry dtype (data_types."
+                        "grad_accum_dtype; bfloat16 halves grad HBM)")
     p.add_argument("--json", action="store_true",
                    help="print one JSON line instead of a table")
     a = p.parse_args(argv)
@@ -157,7 +166,7 @@ def main(argv=None):
         zero_stage=a.zero_stage, offload=a.offload, remat=not a.no_remat,
         remat_policy=a.remat_policy, attn_block_q=a.attn_block_q,
         attn_block_k=a.attn_block_k, dtype=a.dtype,
-        moment_dtype=a.moment_dtype)
+        moment_dtype=a.moment_dtype, grad_accum_dtype=a.grad_accum_dtype)
     if a.json:
         print(json.dumps(out))
     else:

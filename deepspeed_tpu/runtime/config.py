@@ -180,6 +180,14 @@ class DeepSpeedConfig:
         if self.fp16_config.enabled and self.bf16_config.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
 
+        # data_types.grad_accum_dtype (reference runtime/config.py:943):
+        # the dtype the GAS carry / gradient tree rides in.  bfloat16
+        # halves grad HBM — the knob that lets a 1B-param model train on
+        # one 16 GB chip (Adam math still accumulates fp32 per step).
+        dt = pd.get("data_types") or {}
+        self.grad_accum_dtype = self._parse_grad_accum_dtype(
+            dt.get("grad_accum_dtype"))
+
         opt_dict = pd.get(C.OPTIMIZER)
         self.optimizer_config = OptimizerConfig(opt_dict) if opt_dict else None
         sched_dict = pd.get(C.SCHEDULER)
@@ -230,10 +238,10 @@ class DeepSpeedConfig:
         # configs don't warn
         "gradient_accumulation_dtype", "communication_data_type",
         "memory_breakdown",
-        # more reference top-level keys (reference runtime/config.py reads
-        # data_types at :943, nebula at :954; disable_allgather/
-        # zero_force_ds_cpu_optimizer are ZeRO-impl knobs with no TPU
-        # analogue) — accepted so ported configs don't warn
+        # data_types IS wired (grad_accum_dtype); nebula /
+        # disable_allgather / zero_force_ds_cpu_optimizer are ZeRO-impl
+        # knobs with no TPU analogue — accepted so ported configs don't
+        # warn (reference runtime/config.py:943,:954)
         "data_types", "nebula", "disable_allgather",
         "zero_force_ds_cpu_optimizer",
         # sparse_attention gets its own notice (_note_inert_sparse_attention)
@@ -243,6 +251,20 @@ class DeepSpeedConfig:
         # rebuilding the model; informational for the engine itself
         "autotuning_model_overrides",
     })
+
+    @staticmethod
+    def _parse_grad_accum_dtype(name):
+        if name is None:
+            return None
+        table = {"fp32": "float32", "float32": "float32",
+                 "bf16": "bfloat16", "bfloat16": "bfloat16",
+                 "fp16": "float16", "float16": "float16"}
+        key = str(name).lower()
+        if key not in table:
+            raise DeepSpeedConfigError(
+                "data_types.grad_accum_dtype must be one of "
+                f"{sorted(set(table))}, got {name!r}")
+        return table[key]
 
     def _note_inert_sparse_attention(self, pd):
         # 'sparse_attention' names functionality this repo DOES ship
@@ -315,12 +337,20 @@ class DeepSpeedConfig:
         micro = self.train_micro_batch_size_per_gpu
         gas = self.gradient_accumulation_steps
         dp = max(1, self.data_parallel_size)
-        assert train > 0, f"train_batch_size: {train} must be positive"
-        assert micro > 0, f"micro_batch_size: {micro} must be positive"
-        assert gas > 0, f"gradient_accumulation_steps: {gas} must be positive"
-        assert train == micro * gas * dp, (
-            f"Check batch-size settings: train_batch_size={train} must equal "
-            f"micro_batch={micro} * gradient_accumulation={gas} * dp_world={dp}")
+        if train <= 0:
+            raise DeepSpeedConfigError(
+                f"train_batch_size: {train} must be positive")
+        if micro <= 0:
+            raise DeepSpeedConfigError(
+                f"micro_batch_size: {micro} must be positive")
+        if gas <= 0:
+            raise DeepSpeedConfigError(
+                f"gradient_accumulation_steps: {gas} must be positive")
+        if train != micro * gas * dp:
+            raise DeepSpeedConfigError(
+                f"Check batch-size settings: train_batch_size={train} must "
+                f"equal micro_batch={micro} * gradient_accumulation={gas} "
+                f"* dp_world={dp}")
 
     def _do_sanity_check(self):
         if self.zero_config.stage > 0 and self.fp16_config.enabled:

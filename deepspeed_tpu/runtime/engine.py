@@ -88,6 +88,15 @@ class StepMetrics:
     overflow: jnp.ndarray
 
 
+def _global_norm_f32(grads) -> jnp.ndarray:
+    """``optax.global_norm`` with the square-sum accumulated in fp32 —
+    bf16 grad trees (data_types.grad_accum_dtype) would otherwise sum
+    millions of squares at 8 mantissa bits.  XLA fuses the cast into the
+    reduction; nothing materializes."""
+    return optax.global_norm(jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32), grads))
+
+
 def moq_anneal_step(state: "TrainState") -> jnp.ndarray:
     """The MoQ anneal clock: the *successful*-step counter.  The reference
     Quantizer only advances qsteps/ratio on non-overflow steps; every
@@ -131,6 +140,11 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float16
         else:
             self.compute_dtype = jnp.float32
+        # grad tree / GAS-carry dtype (reference data_types.grad_accum_dtype,
+        # runtime/config.py:943).  bf16 halves grad HBM; norms and the Adam
+        # math still run fp32 (optimizers._scale_by_adam_dtyped upcasts).
+        self.grad_accum_dtype = jnp.dtype(
+            config.grad_accum_dtype or "float32")
 
         # ---- ZeRO plan ----------------------------------------------
         # auto-TP: a model that ships its own sharding rules (the whole
@@ -312,8 +326,17 @@ class DeepSpeedEngine:
             base_lr = 1e-3
 
         if self._config.gradient_clipping and self._config.gradient_clipping > 0:
+            clip = float(self._config.gradient_clipping)
+
+            def clip_f32(updates, state, params=None):
+                del params
+                norm = _global_norm_f32(updates)   # fp32 even for bf16 grads
+                coef = jnp.minimum(1.0, clip / (norm + 1e-6))
+                return jax.tree_util.tree_map(
+                    lambda g: (g * coef.astype(g.dtype)), updates), state
             tx = optax.chain(
-                optax.clip_by_global_norm(self._config.gradient_clipping), tx)
+                optax.GradientTransformation(
+                    lambda _: optax.EmptyState(), clip_f32), tx)
         if schedule_fn is None:
             schedule_fn = lambda step: jnp.asarray(base_lr, jnp.float32)  # noqa: E731
         return tx, base_lr, schedule_fn
@@ -469,9 +492,11 @@ class DeepSpeedEngine:
             return self._model_scaled_loss(p_c, batch, rng, loss_scale)
 
         (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
-        # unscale in fp32
+        # unscale in fp32, then store at grad_accum_dtype (XLA fuses the
+        # round-trip; bf16 storage halves the grad tree / GAS carry)
         grads = jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32) / loss_scale, grads)
+            lambda g: (g.astype(jnp.float32) / loss_scale).astype(
+                self.grad_accum_dtype), grads)
         return loss, grads
 
     def _transformed_compute_params(self, p, rng, step, qstep):
@@ -511,7 +536,7 @@ class DeepSpeedEngine:
         (Reference analogue: ``_take_model_step:2074`` +
         ``_overflow_check_and_loss_scale_update:1840``.)"""
         cfg = self._config
-        grad_norm = optax.global_norm(grads)
+        grad_norm = _global_norm_f32(grads)
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
@@ -569,7 +594,7 @@ class DeepSpeedEngine:
                 return (acc, rloss + loss), None
 
             zeros = jax.tree_util.tree_map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), params)
+                lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), params)
             (gsum, lsum), _ = jax.lax.scan(
                 micro, (zeros, jnp.float32(0.0)),
                 (jnp.arange(gas), batch))
@@ -620,7 +645,7 @@ class DeepSpeedEngine:
                                   self.mesh)
                 overflow = (has_inf_or_nan(grads) if fp16
                             else jnp.asarray(False))
-                grad_norm = optax.global_norm(grads)
+                grad_norm = _global_norm_f32(grads)
                 return loss, grads, overflow, grad_norm, rng
             self._compiled_offload_grad = jax.jit(grad_step)
         return self._compiled_offload_grad

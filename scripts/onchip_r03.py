@@ -172,13 +172,17 @@ def main():
              "--gen", "64"], timeout=1500)
 
     if "big" in steps:
-        # 1.5B params: 18 B/param doesn't fit 16 GB; host-offload Adam
-        # leaves bf16 params+grads (~6 GB) + remat activations on chip
-        for batch in (8, 4):
-            got = run(f"big_1_5b_b{batch}",
-                      [py, "bin/ds_bench", "train", "--model", "gpt2_1_5b",
-                       "--batch", str(batch), "--gas", "1", "--seq", "1024",
-                       "--steps", "4", "--offload", "cpu", "--json"],
+        # >=1B on one 16 GB chip with NO offload: bf16 Adam moments (SR)
+        # + bf16 grad accum shrink the train state to 8 B/param (the
+        # host-offload route moves ~6 GB/step over the tunnel and times
+        # out — measured, journal big_1_5b_b4)
+        for model, batch, gas in (("gpt_1_1b", 1, 8), ("gpt_1b", 2, 4)):
+            got = run(f"big_{model}_b{batch}_gas{gas}",
+                      [py, "bin/ds_bench", "train", "--model", model,
+                       "--batch", str(batch), "--gas", str(gas),
+                       "--seq", "1024", "--steps", "8",
+                       "--moment-dtype", "bfloat16",
+                       "--grad-accum-dtype", "bfloat16", "--json"],
                       timeout=2400)
             if got:
                 break

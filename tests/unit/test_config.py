@@ -46,7 +46,7 @@ def test_triangle_infer_micro():
 
 
 def test_triangle_inconsistent_raises():
-    with pytest.raises(AssertionError):
+    with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig({
             "train_batch_size": 64,
             "train_micro_batch_size_per_gpu": 4,
