@@ -53,7 +53,7 @@ class ServingEngine:
                  page_size: int = 128, num_pages: Optional[int] = None,
                  max_seq: int = 2048, dtype=jnp.bfloat16,
                  eos_token_id: Optional[int] = None, tp_size: int = 1,
-                 ep_size: int = 1):
+                 ep_size: int = 1, decode_chunk: int = 1):
         self.model = model
         self.config = model.config
         self.max_batch = max_batch
@@ -114,6 +114,14 @@ class ServingEngine:
         self._step_fn = jax.jit(self.model.apply_with_paged_cache,
                                 donate_argnums=(2,))
         self._rng = {}
+        # multi-token decode: one device program advances every slot
+        # ``decode_chunk`` tokens (sampling included) per host round-trip.
+        # Through a tunneled chip the per-dispatch floor (~69 ms measured,
+        # ONCHIP_r03/inference_latency.json) dominates single-token decode,
+        # so chunking multiplies serving throughput by ~decode_chunk.
+        self.decode_chunk = int(decode_chunk)
+        assert self.decode_chunk >= 1
+        self._chunk_fn = None
 
     # -- host control flow ---------------------------------------------
     def add_request(self, req_id, prompt_ids, max_new_tokens: int = 32,
@@ -208,13 +216,104 @@ class ServingEngine:
     def n_active(self) -> int:
         return sum(s is not None for s in self.slots)
 
+    # -- the chunked decode step (K tokens per dispatch) ----------------
+    def _build_chunk_fn(self):
+        K = self.decode_chunk
+        model = self.model
+
+        def chunk(params, caches, tables, lengths, last, temps, seeds,
+                  gen_counts):
+            """K decode iterations in one device program.  Emits the K
+            sampled tokens per slot; the host truncates past EOS /
+            max_new_tokens (overrun writes land on the reserved scratch
+            page — admission reserved every page a live request can
+            validly reach, vLLM-style multi-step scheduling).  Sampling
+            keys on (request seed, tokens generated so far), so a
+            request's random stream is independent of slot assignment
+            and arrival order — the per-token engine's req.seed contract."""
+            def one(carry, t):
+                caches, lengths, last = carry
+                logits, caches, _ = model.apply_with_paged_cache(
+                    params, last[:, None], caches, tables, lengths)
+                lg = logits[:, 0]
+                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                keys = jax.vmap(
+                    lambda s, g: jax.random.fold_in(jax.random.key(s),
+                                                    g + t))(seeds, gen_counts)
+                sampled = jax.vmap(
+                    lambda k, l, tt: jax.random.categorical(
+                        k, l / jnp.maximum(tt, 1e-6)))(
+                    keys, lg, temps).astype(jnp.int32)
+                nxt = jnp.where(temps > 0, sampled, greedy)
+                return (caches, lengths + 1, nxt), nxt
+
+            (caches, lengths, last), toks = jax.lax.scan(
+                one, (caches, lengths, last), jnp.arange(K))
+            return toks.T, caches   # [B, K]
+
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def _step_chunk(self) -> Dict[Any, List[int]]:
+        K = self.decode_chunk
+        if self._chunk_fn is None:
+            self._chunk_fn = self._build_chunk_fn()
+        last = np.zeros(self.max_batch, np.int32)
+        temps = np.zeros(self.max_batch, np.float32)
+        seeds = np.zeros(self.max_batch, np.uint32)
+        gen_counts = np.zeros(self.max_batch, np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                last[slot] = req.last_token
+                temps[slot] = max(0.0, req.temperature)
+                seeds[slot] = np.uint32(req.seed)
+                gen_counts[slot] = len(req.out)
+        args = (self.params, self.caches, jnp.asarray(self.tables),
+                jnp.asarray(self.lengths), jnp.asarray(last),
+                jnp.asarray(temps), jnp.asarray(seeds),
+                jnp.asarray(gen_counts))
+        if self.mesh is not None:
+            with self.mesh:
+                toks, self.caches = self._chunk_fn(*args)
+        else:
+            toks, self.caches = self._chunk_fn(*args)
+        toks = np.asarray(toks)
+
+        done_slots, done_now = [], {}
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            # tokens appended to the cache this chunk: the pre-chunk last
+            # token, then the first K-1 samples; sample K-1 is the next
+            # chunk's carry (per-token step() semantics, K times)
+            seq = [req.last_token] + toks[slot, :-1].tolist()
+            finished = False
+            for tok in seq:
+                req.out.append(int(tok))
+                self.lengths[slot] += 1
+                if (self.eos is not None and int(tok) == self.eos) or \
+                        len(req.out) >= req.max_new_tokens:
+                    finished = True
+                    break
+            if finished:
+                done_slots.append(slot)
+            else:
+                req.last_token = int(toks[slot, -1])
+        for slot in done_slots:
+            rid = self.slots[slot].req_id
+            self._finish(slot)
+            done_now[rid] = self.finished.pop(rid)
+        return done_now
+
     # -- the batched decode step ---------------------------------------
     def step(self) -> Dict[Any, List[int]]:
-        """Advance every active request by one token; returns ONLY the
-        requests that finished during this step (req_id → full tokens)."""
+        """Advance every active request by one token (``decode_chunk``
+        tokens when configured); returns ONLY the requests that finished
+        during this step (req_id → full tokens)."""
         self._admit()
         if self.n_active == 0:
             return {}
+        if self.decode_chunk > 1:
+            return self._step_chunk()
         last = np.zeros((self.max_batch, 1), np.int32)
         for slot, req in enumerate(self.slots):
             if req is not None:

@@ -47,7 +47,13 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
-    activation: str = "silu"                # "silu" (SwiGLU) | "gelu" | "relu"
+    activation: str = "silu"    # "silu" (SwiGLU) | "gelu" (tanh approx)
+                                # | "gelu_exact" (erf, MPT) | "relu"
+    gated_mlp: Optional[bool] = None   # None → gated iff silu; True forces
+                                       # a GLU (Gemma GeGLU)
+    head_dim_override: Optional[int] = None  # H*dh != d (Gemma-7b)
+    embed_scale: Optional[float] = None      # input embeds × scale (Gemma
+                                             # sqrt(d); tied head unscaled)
     use_rmsnorm: bool = True
     use_rope: bool = True                   # False → learned positions (GPT-2)
     rope_dim: Optional[int] = None          # partial rotary (GPT-NeoX); None → full
@@ -99,7 +105,14 @@ class TransformerConfig:
 
     @property
     def head_dim(self):
-        return self.hidden_size // self.n_heads
+        return self.head_dim_override or self.hidden_size // self.n_heads
+
+    @property
+    def gated(self):
+        """Gated (GLU) MLP: explicit flag, else implied by SwiGLU."""
+        if self.gated_mlp is not None:
+            return self.gated_mlp
+        return self.activation == "silu"
 
     @property
     def ffn_dim(self):
@@ -162,10 +175,7 @@ class TransformerConfig:
         dh = self.head_dim
         per_layer = (d * self.n_heads * dh + 2 * d * self.kv_heads * dh +
                      self.n_heads * dh * d)
-        if self.activation == "silu":
-            per_layer += 3 * d * f
-        else:
-            per_layer += 2 * d * f
+        per_layer += (3 if self.gated else 2) * d * f
         per_layer += 2 * d  # norms
         total = self.n_layers * per_layer + v * d + d
         if not self.tie_embeddings:
@@ -177,6 +187,17 @@ class TransformerConfig:
         if self.embed_norm:
             total += d
         return total
+
+
+# "gelu" is the tanh approximation (GPT-2 gelu_new / Gemma
+# gelu_pytorch_tanh); "gelu_exact" the erf form (MPT).  One table shared
+# by the dense MLP and the MoE expert_fn so the two can never disagree.
+_ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+}
 
 
 def _norm(x, weight, eps, use_rms, bias=None):
@@ -365,7 +386,7 @@ class CausalTransformerLM:
             "w_up": dense(keys[4], (L, d, f), d),
             "w_down": dense(keys[5], (L, f, d), f),
         }
-        if c.activation == "silu":
+        if c.gated:
             layers["w_gate"] = dense(keys[6], (L, d, f), d)
         if c.use_bias:
             for name, width in (("wq_b", H * dh), ("wk_b", Hkv * dh),
@@ -419,10 +440,12 @@ class CausalTransformerLM:
                     "w_up": dense(ks[5], (E, d, f), d),
                     "w_down": dense(ks[6], (E, f, d), f),
                 }
+                if c.gated:          # SwiGLU/GLU experts (Mixtral)
+                    layer["moe"]["w_gate"] = dense(ks[7], (E, d, f), d)
             else:
                 layer["w_up"] = dense(ks[5], (d, f), d)
                 layer["w_down"] = dense(ks[6], (f, d), f)
-                if c.activation == "silu":
+                if c.gated:
                     layer["w_gate"] = dense(ks[7], (d, f), d)
             return layer
 
@@ -449,6 +472,7 @@ class CausalTransformerLM:
                 (r"moe.*w_up_b", P(EP_AXIS, TP_AXIS)),
                 (r"moe.*w_down_b", P(EP_AXIS, None)),
                 # expert weights: expert dim over ep, ffn dim over tp
+                (r"moe.*w_gate", P(EP_AXIS, None, TP_AXIS)),
                 (r"moe.*w_up", P(EP_AXIS, None, TP_AXIS)),
                 (r"moe.*w_down", P(EP_AXIS, TP_AXIS, None)),
                 (r"moe.*wg", P()),
@@ -573,16 +597,22 @@ class CausalTransformerLM:
         c = self.config
         if "moe" in layer:
             from deepspeed_tpu.moe.sharded_moe import moe_layer_forward
-            act = jax.nn.silu if c.activation == "silu" else jax.nn.gelu
+            act = _ACTIVATIONS[c.activation]
 
             def expert_fn(ep, dispatched):
-                # gateless 2-layer expert FFN (reference Experts module);
+                # 2-layer expert FFN (reference Experts module) or GLU
+                # experts when w_gate is present (Mixtral SwiGLU);
                 # activation follows the model config; optional per-expert
                 # biases for Megatron-MoE checkpoints
                 inner = jnp.einsum("ecd,edf->ecf", dispatched, ep["w_up"])
                 if "w_up_b" in ep:
                     inner = inner + ep["w_up_b"][:, None, :]
-                inner = act(inner)
+                if "w_gate" in ep:
+                    gate = jnp.einsum("ecd,edf->ecf", dispatched,
+                                      ep["w_gate"])
+                    inner = act(gate) * inner
+                else:
+                    inner = act(inner)
                 out = jnp.einsum("ecf,efd->ecd", inner, ep["w_down"])
                 if "w_down_b" in ep:
                     out = out + ep["w_down_b"][:, None, :]
@@ -592,13 +622,11 @@ class CausalTransformerLM:
                 self.gate, {"wg": layer["moe"]["wg"]}, layer["moe"],
                 expert_fn, h, train=train, rng=rng)
             return moe_out, l_aux
-        if c.activation == "silu":
-            inner = jax.nn.silu(h @ layer["w_gate"]) * \
-                self._proj(h, layer, "w_up")
-        elif c.activation == "relu":
-            inner = jax.nn.relu(self._proj(h, layer, "w_up"))
+        act = _ACTIVATIONS[c.activation]
+        if c.gated:
+            inner = act(h @ layer["w_gate"]) * self._proj(h, layer, "w_up")
         else:
-            inner = jax.nn.gelu(self._proj(h, layer, "w_up"))
+            inner = act(self._proj(h, layer, "w_up"))
         return self._proj(inner, layer, "w_down"), jnp.float32(0.0)
 
     def _layer(self, x, layer, positions, rng=None, train=True):
@@ -625,6 +653,9 @@ class CausalTransformerLM:
             positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
 
         x = params["tok_embed"][input_ids]
+        if c.embed_scale is not None:   # Gemma: sqrt(d) on the
+            x = x * jnp.asarray(c.embed_scale, x.dtype)  # input side only
+
         if not c.use_rope and not c.use_alibi:
             x = x + params["pos_embed"][positions].astype(x.dtype)
         if c.embed_norm:
@@ -751,6 +782,9 @@ class CausalTransformerLM:
             start = caches.length
         positions = start + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = params["tok_embed"][input_ids]
+        if c.embed_scale is not None:   # Gemma: sqrt(d) on the
+            x = x * jnp.asarray(c.embed_scale, x.dtype)  # input side only
+
         if not c.use_rope and not c.use_alibi:
             x = x + params["pos_embed"][positions].astype(x.dtype)
         if c.embed_norm:
@@ -828,6 +862,9 @@ class CausalTransformerLM:
         positions = lengths[:, None] + jnp.broadcast_to(
             jnp.arange(T)[None, :], (B, T))
         x = params["tok_embed"][input_ids]
+        if c.embed_scale is not None:   # Gemma: sqrt(d) on the
+            x = x * jnp.asarray(c.embed_scale, x.dtype)  # input side only
+
         if not c.use_rope and not c.use_alibi:
             x = x + params["pos_embed"][positions].astype(x.dtype)
         if c.embed_norm:

@@ -945,10 +945,388 @@ class MegatronGPTMoEPolicy(InjectionPolicy):
         return cfg, params
 
 
+class PhiPolicy(InjectionPolicy):
+    """HF ``PhiForCausalLM`` (phi-1/1.5/2 lineage; the reference's
+    injection matrix covers the same era of decoder archs under
+    ``module_inject/containers/``).  GPT-J-shaped: parallel attn+MLP
+    residual sharing ONE LayerNorm (duplicated into both sub-block
+    norms), partial rotary (``partial_rotary_factor``, half-rope layout
+    like GPT-NeoX — no interleave permutation needed), biases on every
+    linear, tanh-GELU MLP, biased LM head, final LayerNorm."""
+
+    model_types = ("phi",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "qk_layernorm", False):
+            raise ValueError("phi qk_layernorm is not supported yet")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        rot = int(round(getattr(hf, "partial_rotary_factor", 1.0) * dh))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.layer_norm_eps, activation="gelu",
+            use_rmsnorm=False, use_rope=True,
+            rope_dim=(None if rot == dh else rot),
+            parallel_block=True, use_bias=True, norm_bias=True,
+            tie_embeddings=False, lm_head_bias=True, remat=False)
+
+        pre = "model.layers.{}."
+        ln_w = _stack(sd, pre + "input_layernorm.weight", L)
+        ln_b = _stack(sd, pre + "input_layernorm.bias", L)
+        layers = {
+            # one shared LN feeds both parallel branches (GPT-J trick)
+            "attn_norm": ln_w, "attn_norm_b": ln_b,
+            "mlp_norm": ln_w.copy(), "mlp_norm_b": ln_b.copy(),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wq_b": _stack(sd, pre + "self_attn.q_proj.bias", L),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wk_b": _stack(sd, pre + "self_attn.k_proj.bias", L),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wv_b": _stack(sd, pre + "self_attn.v_proj.bias", L),
+            "wo": _stack(sd, pre + "self_attn.dense.weight", L,
+                         transpose=True),
+            "wo_b": _stack(sd, pre + "self_attn.dense.bias", L),
+            "w_up": _stack(sd, pre + "mlp.fc1.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.fc1.bias", L),
+            "w_down": _stack(sd, pre + "mlp.fc2.weight", L, transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.fc2.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.final_layernorm.weight"]),
+            "final_norm_b": _np(sd["model.final_layernorm.bias"]),
+            "lm_head": _np(sd["lm_head.weight"]).T,
+            "lm_head_b": _np(sd["lm_head.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class StableLmPolicy(InjectionPolicy):
+    """HF ``StableLmForCausalLM`` (stablelm-3b/zephyr lineage): llama
+    wiring (SwiGLU MLP, GQA, o_proj) but LayerNorm-with-bias instead of
+    RMSNorm, partial rotary (``partial_rotary_factor``), optional QKV
+    biases (``use_qkv_bias``, presence-based like Qwen2)."""
+
+    model_types = ("stablelm",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "use_parallel_residual", False):
+            raise ValueError(
+                "stablelm use_parallel_residual=True (stablelm-2 lineage) "
+                "shares norms differently and is not supported yet")
+        if getattr(hf_config, "qk_layernorm", False):
+            raise ValueError("stablelm qk_layernorm is not supported yet")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        rot = int(round(getattr(hf, "partial_rotary_factor", 1.0) * dh))
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.layer_norm_eps, activation="silu",
+            use_rmsnorm=False, norm_bias=True, use_rope=True,
+            rope_dim=(None if rot == dh else rot),
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "input_layernorm.bias", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight",
+                               L),
+            "mlp_norm_b": _stack(sd, pre + "post_attention_layernorm.bias",
+                                 L),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        if pre.format(0) + "self_attn.q_proj.bias" in sd:  # use_qkv_bias
+            layers["wq_b"] = _stack(sd, pre + "self_attn.q_proj.bias", L)
+            layers["wk_b"] = _stack(sd, pre + "self_attn.k_proj.bias", L)
+            layers["wv_b"] = _stack(sd, pre + "self_attn.v_proj.bias", L)
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "final_norm_b": _np(sd["model.norm.bias"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
+class MptPolicy(InjectionPolicy):
+    """HF ``MptForCausalLM`` (mpt-7b lineage: ``no_bias=True``, ALiBi):
+    fused ``Wqkv [3d, d]`` split by rows, biasless LayerNorms, ALiBi
+    attention with no position embeddings (Bloom-style slopes), GELU
+    MLP, tied embeddings."""
+
+    model_types = ("mpt",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        attn_cfg = getattr(hf_config, "attn_config", None)
+        alibi = getattr(attn_cfg, "alibi", True) if attn_cfg is not None \
+            else True
+        if not alibi:
+            raise ValueError(
+                "mpt with attn_config.alibi=False (learned positions) is "
+                "not supported yet")
+        if not getattr(hf_config, "no_bias", True):
+            raise ValueError(
+                "mpt no_bias=False checkpoints are not supported (the "
+                "mpt-7b lineage is biasless)")
+        if attn_cfg is not None:
+            if getattr(attn_cfg, "clip_qkv", None):
+                raise ValueError(
+                    "mpt attn_config.clip_qkv (mpt-30b lineage) is not "
+                    "supported — the converted model would silently skip "
+                    "the QKV clamp")
+            if getattr(attn_cfg, "qk_ln", False):
+                raise ValueError(
+                    "mpt attn_config.qk_ln (replit-code lineage) is not "
+                    "supported yet")
+            if getattr(attn_cfg, "softmax_scale", None):
+                raise ValueError(
+                    "mpt attn_config.softmax_scale overrides are not "
+                    "supported yet")
+        if getattr(hf_config, "logit_scale", None):
+            raise ValueError("mpt logit_scale is not supported yet")
+        H = getattr(hf_config, "n_heads", 1)
+        bias_max = getattr(attn_cfg, "alibi_bias_max", 8) \
+            if attn_cfg is not None else 8
+        if bias_max != 8 or (H & (H - 1)):
+            # MPT pads slopes to the NEXT power of two and reorders
+            # [1::2]+[::2]; our alibi_slopes (models/transformer.py:302)
+            # is the Bloom schedule (floor power of two + interleaved
+            # extras).  They agree exactly iff H is a power of two and
+            # alibi_bias_max is the default 8.
+            raise ValueError(
+                "mpt with non-power-of-two n_heads or non-default "
+                "alibi_bias_max uses a slope schedule this policy does "
+                "not reproduce")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.d_model, hf.n_layers, hf.n_heads
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            ffn_hidden_size=getattr(hf, "expansion_ratio", 4) * d,
+            max_seq_len=hf.max_seq_len,
+            norm_eps=getattr(hf, "layer_norm_epsilon", 1e-5),
+            activation="gelu_exact", use_rmsnorm=False, use_rope=False,
+            use_alibi=True, tie_embeddings=True, remat=False)
+
+        pre = "transformer.blocks.{}."
+        wq, wk, wv = [], [], []
+        for i in range(L):
+            qkv = _np(sd[pre.format(i) + "attn.Wqkv.weight"])   # [3d, d]
+            wq.append(qkv[:d].T)
+            wk.append(qkv[d:2 * d].T)
+            wv.append(qkv[2 * d:].T)
+        layers = {
+            "attn_norm": _stack(sd, pre + "norm_1.weight", L),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wo": _stack(sd, pre + "attn.out_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(sd, pre + "norm_2.weight", L),
+            "w_up": _stack(sd, pre + "ffn.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "ffn.down_proj.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "final_norm": _np(sd["transformer.norm_f.weight"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class MixtralPolicy(InjectionPolicy):
+    """HF ``MixtralForCausalLM``: llama attention + per-layer top-2 MoE
+    with SwiGLU experts.  HF's router (softmax over ALL experts → top-2 →
+    renormalize) is exactly this repo's ``top2gating`` renormalization,
+    so converted logits are exact at eval given non-dropping capacity —
+    ``moe_eval_capacity_factor`` is set so no token can overflow.  The
+    converted tree serves expert-parallel through
+    ``ServingEngine(ep_size=...)`` like Megatron-MoE checkpoints."""
+
+    model_types = ("mixtral",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        E = hf.num_local_experts
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        if getattr(hf, "num_experts_per_tok", 2) != 2:
+            raise ValueError(
+                "mixtral with num_experts_per_tok != 2 is not supported "
+                "(top2gating renormalization is the exact-match path)")
+        window = getattr(hf, "sliding_window", None)
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 1e6)),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True,
+            local_attn_pattern=((int(window),) * L if window else None),
+            moe_num_experts=E, moe_top_k=2, moe_layer_freq=1,
+            # eval capacity >= every token to every expert: exactness
+            # requires the non-dropping regime (HF routes without capacity)
+            moe_eval_capacity_factor=float(E),
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+
+        def experts(i, which):                     # [E, in, out]
+            return np.stack([
+                _np(sd[pre.format(i) +
+                       f"block_sparse_moe.experts.{e}.{which}.weight"]).T
+                for e in range(E)])
+
+        layers = []
+        for i in range(L):
+            layers.append({
+                "attn_norm": _np(sd[pre.format(i) +
+                                    "input_layernorm.weight"]),
+                "wq": _np(sd[pre.format(i) +
+                             "self_attn.q_proj.weight"]).T,
+                "wk": _np(sd[pre.format(i) +
+                             "self_attn.k_proj.weight"]).T,
+                "wv": _np(sd[pre.format(i) +
+                             "self_attn.v_proj.weight"]).T,
+                "wo": _np(sd[pre.format(i) +
+                             "self_attn.o_proj.weight"]).T,
+                "mlp_norm": _np(sd[pre.format(i) +
+                                   "post_attention_layernorm.weight"]),
+                "moe": {
+                    "wg": _np(sd[pre.format(i) +
+                                 "block_sparse_moe.gate.weight"]).T,
+                    "w_gate": experts(i, "w1"),    # SwiGLU gate
+                    "w_down": experts(i, "w2"),
+                    "w_up": experts(i, "w3"),
+                },
+            })
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
+class GemmaPolicy(InjectionPolicy):
+    """HF ``GemmaForCausalLM``: llama wiring with three twists — RMSNorm
+    applies ``(1 + w)`` (folded into the stored weight at conversion, so
+    the runtime norm stays the plain Llama form), input embeddings are
+    scaled by ``sqrt(hidden_size)`` (input side only: the tied LM head
+    reads the UNscaled table — ``embed_scale`` config knob), and
+    ``head_dim`` is explicit with ``H*dh != d`` (``head_dim_override``).
+    GeGLU MLP (tanh-GELU gate, ``gated_mlp=True``)."""
+
+    model_types = ("gemma",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = getattr(hf, "head_dim", None) or d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            head_dim_override=(None if dh == d // H else dh),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.rms_norm_eps, activation="gelu", gated_mlp=True,
+            embed_scale=float(d) ** 0.5,
+            use_rmsnorm=True, use_rope=True,
+            tie_embeddings=True, remat=False)
+
+        pre = "model.layers.{}."
+
+        def norm1p(fmt):
+            return _stack(sd, fmt, L) + 1.0      # fold Gemma's (1 + w)
+
+        layers = {
+            "attn_norm": norm1p(pre + "input_layernorm.weight"),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": norm1p(pre + "post_attention_layernorm.weight"),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]) + 1.0,
+            "layers": layers,
+        }
+        return cfg, params
+
+
 REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTNeoXPolicy, BertPolicy, BloomPolicy,
                                 GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
-                                CLIPPolicy, FalconPolicy,
+                                CLIPPolicy, FalconPolicy, PhiPolicy,
+                                StableLmPolicy, MptPolicy, GemmaPolicy,
+                                MixtralPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

@@ -161,6 +161,8 @@ class EmbeddingPipe:
         ids = batch["input_ids"] if isinstance(batch, dict) else batch
         tok = tied["tok_embed"] if tied is not None else params["tok_embed"]
         x = tok[ids]
+        if self.config.embed_scale is not None:   # Gemma: input side only
+            x = x * jnp.asarray(self.config.embed_scale, x.dtype)
         if not self.config.use_rope:
             S = ids.shape[-1]
             x = x + params["pos_embed"][:S][None].astype(x.dtype)
@@ -212,7 +214,7 @@ class TransformerBlockPipe:
             return layer
         layer["w_up"] = dense(ks[4], (d, f), d)
         layer["w_down"] = dense(ks[5], (f, d), f)
-        if c.activation == "silu":
+        if c.gated:
             layer["w_gate"] = dense(ks[6], (d, f), d)
         return layer
 

@@ -471,3 +471,136 @@ def test_falcon_mq_false_and_bias_raise():
         find_policy(transformers.FalconConfig(
             new_decoder_architecture=False, multi_query=True,
             parallel_attn=True, alibi=False, bias=True))
+
+
+def test_phi_conversion_matches_hf():
+    """Phi-2 lineage: parallel attn+MLP sharing one LayerNorm, partial
+    rotary (half-rope, no interleave), biases everywhere, biased head."""
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, partial_rotary_factor=0.5)
+    torch.manual_seed(0)
+    hf = transformers.PhiForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.parallel_block and model.config.rope_dim == 4
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_phi_qk_layernorm_raises():
+    with pytest.raises(ValueError, match="qk_layernorm"):
+        find_policy(transformers.PhiConfig(qk_layernorm=True))
+
+
+def test_stablelm_conversion_matches_hf():
+    """StableLM: llama wiring under LayerNorm-with-bias, partial rotary,
+    QKV biases picked up presence-based."""
+    hf_cfg = transformers.StableLmConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=64, partial_rotary_factor=0.25,
+        use_qkv_bias=True, use_parallel_residual=False, qk_layernorm=False,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.StableLmForCausalLM(hf_cfg)
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith("proj.bias"):
+                p.normal_(std=0.5)
+    model, params = replace_transformer_layer(hf)
+    assert not model.config.use_rmsnorm and model.config.rope_dim == 2
+    assert "wq_b" in params["layers"] and "attn_norm_b" in params["layers"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_stablelm_unsupported_variants_raise():
+    with pytest.raises(ValueError, match="parallel_residual"):
+        find_policy(transformers.StableLmConfig(use_parallel_residual=True))
+    with pytest.raises(ValueError, match="qk_layernorm"):
+        find_policy(transformers.StableLmConfig(qk_layernorm=True))
+
+
+def test_mpt_conversion_matches_hf():
+    """MPT-7b lineage: fused Wqkv, ALiBi, biasless LayerNorms, exact-erf
+    GELU, tied embeddings."""
+    hf_cfg = transformers.MptConfig(
+        vocab_size=96, d_model=32, n_layers=2, n_heads=4, max_seq_len=64,
+        expansion_ratio=4)
+    torch.manual_seed(0)
+    hf = transformers.MptForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.use_alibi
+    assert model.config.activation == "gelu_exact"
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_mpt_unsupported_variants_raise():
+    with pytest.raises(ValueError, match="alibi"):
+        find_policy(transformers.MptConfig(
+            attn_config=transformers.models.mpt.configuration_mpt
+            .MptAttentionConfig(alibi=False)))
+    with pytest.raises(ValueError, match="power-of-two"):
+        find_policy(transformers.MptConfig(n_heads=6))
+
+
+def test_gemma_conversion_matches_hf():
+    """Gemma: (1+w) RMSNorm folded at conversion, sqrt(d)-scaled input
+    embeddings with an UNscaled tied head, explicit head_dim != d/H,
+    GeGLU."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=64,
+        hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.head_dim == 16 and c.gated and c.embed_scale == 32 ** 0.5
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_mpt_quirk_variants_raise():
+    MptAttnCfg = transformers.models.mpt.configuration_mpt.MptAttentionConfig
+    with pytest.raises(ValueError, match="clip_qkv"):
+        find_policy(transformers.MptConfig(
+            attn_config=MptAttnCfg(clip_qkv=8.0)))
+    with pytest.raises(ValueError, match="qk_ln"):
+        find_policy(transformers.MptConfig(attn_config=MptAttnCfg(qk_ln=True)))
+    with pytest.raises(ValueError, match="softmax_scale"):
+        find_policy(transformers.MptConfig(
+            attn_config=MptAttnCfg(softmax_scale=0.1)))
+    with pytest.raises(ValueError, match="logit_scale"):
+        find_policy(transformers.MptConfig(logit_scale=0.5))
+
+
+def test_mixtral_conversion_matches_hf():
+    """Mixtral: llama attention + top-2 SwiGLU MoE.  HF's router
+    (softmax-all -> top-2 -> renormalize) is top2gating's renormalized
+    path, so eval logits are exact under non-dropping capacity."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, sliding_window=None,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.MixtralForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.is_moe and c.moe_top_k == 2 and c.moe_num_experts == 4
+    assert "w_gate" in params["layers"][0]["moe"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_mixtral_topk_guard():
+    with pytest.raises(ValueError, match="num_experts_per_tok"):
+        find_policy(transformers.MixtralConfig(
+            num_local_experts=4, num_experts_per_tok=3)).build(
+            transformers.MixtralConfig(num_local_experts=4,
+                                       num_experts_per_tok=3), {})
