@@ -326,7 +326,13 @@ class Autotuner:
             return ResourceManager.best_of([best] + exps,
                                            self.at_config.metric) or best
 
+        opt_type = str((best.ds_config.get("optimizer") or {})
+                       .get("type", "adamw")).lower()
         for path, candidates in tmpl["ds"].items():
+            if path == "optimizer/params/moment_dtype" and \
+                    opt_type not in ("adam", "adamw"):
+                continue   # only the Adam family reads moment_dtype — a
+                # trial would re-measure the incumbent under a new name
             exps = []
             for v in candidates:
                 if v == get_ds_path(best.ds_config, path):

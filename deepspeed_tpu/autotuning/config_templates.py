@@ -23,6 +23,11 @@ from typing import Any, Dict, List
 # ds-config knobs common to every stage
 _COMMON_DS: Dict[str, List[Any]] = {
     "gradient_accumulation_steps": [1, 2, 4, 8],
+    # reduced-precision state: the knobs that fit gpt_1b (1.01B params)
+    # on one 16 GB chip at MFU 0.486 (ONCHIP_r03/big_1b.json) — the
+    # tuner must be able to rediscover that configuration
+    "optimizer/params/moment_dtype": ["float32", "bfloat16"],
+    "data_types/grad_accum_dtype": [None, "bfloat16"],
 }
 
 # model-config knobs common to every stage (TPU-native)
@@ -52,6 +57,8 @@ TEMPLATES: Dict[int, Dict[str, Dict[str, List[Any]]]] = {
 # effective value must not burn a trial re-measuring the winner)
 KNOB_DEFAULTS: Dict[str, Any] = {
     "gradient_accumulation_steps": 1,
+    "optimizer/params/moment_dtype": "float32",
+    "data_types/grad_accum_dtype": None,
     "zero_optimization/offload_optimizer": None,
     "remat_policy": "nothing_saveable",   # TransformerConfig defaults
     "attn_blocks": (512, 512),

@@ -1262,6 +1262,147 @@ class MixtralPolicy(InjectionPolicy):
         return cfg, params
 
 
+class CodeGenPolicy(InjectionPolicy):
+    """HF ``CodeGenForCausalLM`` (GPT-J lineage): parallel attn+MLP on one
+    LayerNorm, partial INTERLEAVED rotary (GPT-J column permutation), and
+    the mp_num=4 fused QKV scramble — rows are four tensor-parallel-era
+    blocks each holding [q | v | k] (note the v/k swap) of d/4 rows
+    (``modeling_codegen.py`` ``mp_num = 4; query, value, key =
+    torch.split(qkv_split, local_dim, dim=-1)``).  Biasless attention
+    linears, biased MLP + LM head, untied embeddings."""
+
+    model_types = ("codegen",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.n_embd, hf.n_layer, hf.n_head
+        dh = d // H
+        rot = getattr(hf, "rotary_dim", None) or dh
+        perm = _interleaved_to_half_rope_perm(rot, dh)
+        mp, local = 4, d // 4
+
+        def qvk(i):
+            w = _np(sd[f"transformer.h.{i}.attn.qkv_proj.weight"])
+            w4 = w.reshape(mp, 3, local, d)        # rows: [mp][q|v|k][local]
+            q, v, k = (w4[:, j].reshape(d, d).T for j in range(3))
+            q = q.reshape(d, H, dh)[:, :, perm].reshape(d, d)
+            k = k.reshape(d, H, dh)[:, :, perm].reshape(d, d)
+            return q, k, v
+
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            ffn_hidden_size=getattr(hf, "n_inner", None) or 4 * d,
+            max_seq_len=hf.n_positions,
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=True,
+            rope_dim=(None if rot == dh else rot),
+            parallel_block=True, use_bias=True, norm_bias=True,
+            tie_embeddings=False, lm_head_bias=True, remat=False)
+
+        pre = "transformer.h.{}."
+        ln_w = _stack(sd, pre + "ln_1.weight", L)
+        ln_b = _stack(sd, pre + "ln_1.bias", L)
+        qs, ks, vs = zip(*(qvk(i) for i in range(L)))
+        layers = {
+            "attn_norm": ln_w, "attn_norm_b": ln_b,
+            "mlp_norm": ln_w.copy(), "mlp_norm_b": ln_b.copy(),
+            "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+            "wo": _stack(sd, pre + "attn.out_proj.weight", L,
+                         transpose=True),
+            "w_up": _stack(sd, pre + "mlp.fc_in.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.fc_in.bias", L),
+            "w_down": _stack(sd, pre + "mlp.fc_out.weight", L,
+                             transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.fc_out.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "lm_head": _np(sd["lm_head.weight"]).T,
+            "lm_head_b": _np(sd["lm_head.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
+class GPTBigCodePolicy(InjectionPolicy):
+    """HF ``GPTBigCodeForCausalLM`` (SantaCoder/StarCoder): GPT-2 wiring
+    through ``nn.Linear`` ([out, in] → transpose, unlike GPT-2's Conv1D)
+    with a fused ``c_attn [d + 2·kv_dim, d]`` whose K/V block is a single
+    shared head when ``multi_query`` (GQA kv_heads=1), learned positions,
+    tanh-GELU, biases everywhere, tied embeddings."""
+
+    model_types = ("gpt_bigcode",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.n_embd, hf.n_layer, hf.n_head
+        dh = d // H
+        mq = bool(getattr(hf, "multi_query", True))
+        kv = 1 if mq else H
+        kv_dim = kv * dh
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(1 if mq else None),
+            ffn_hidden_size=getattr(hf, "n_inner", None) or 4 * d,
+            max_seq_len=hf.n_positions,
+            norm_eps=hf.layer_norm_epsilon, activation="gelu",
+            use_rmsnorm=False, use_rope=False, use_bias=True,
+            norm_bias=True,
+            attn_scale=(None if getattr(hf, "scale_attn_weights", True)
+                        else 1.0),
+            tie_embeddings=True, remat=False)
+
+        pre = "transformer.h.{}."
+        wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
+        for i in range(L):
+            w = _np(sd[pre.format(i) + "attn.c_attn.weight"])  # [d+2kv, d]
+            b = _np(sd[pre.format(i) + "attn.c_attn.bias"])
+            if mq:
+                # [q(all heads) | k(one head) | v(one head)] row blocks
+                qw, kw, vw = w[:d], w[d:d + kv_dim], w[d + kv_dim:]
+                qb, kb, vb = b[:d], b[d:d + kv_dim], b[d + kv_dim:]
+            else:
+                # MHA fuses PER HEAD: rows are [H, 3*dh] with q/k/v dh-row
+                # thirds inside each head block (modeling_gpt_bigcode
+                # .view(..., num_heads, 3*head_dim).split(3*[head_dim]))
+                w4 = w.reshape(H, 3, dh, d)
+                b3 = b.reshape(H, 3, dh)
+                qw, kw, vw = (w4[:, j].reshape(H * dh, d) for j in range(3))
+                qb, kb, vb = (b3[:, j].reshape(-1) for j in range(3))
+            wq.append(qw.T)
+            wk.append(kw.T)
+            wv.append(vw.T)
+            bq.append(qb)
+            bk.append(kb)
+            bv.append(vb)
+        layers = {
+            "attn_norm": _stack(sd, pre + "ln_1.weight", L),
+            "attn_norm_b": _stack(sd, pre + "ln_1.bias", L),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wq_b": np.stack(bq), "wk_b": np.stack(bk),
+            "wv_b": np.stack(bv),
+            "wo": _stack(sd, pre + "attn.c_proj.weight", L, transpose=True),
+            "wo_b": _stack(sd, pre + "attn.c_proj.bias", L),
+            "mlp_norm": _stack(sd, pre + "ln_2.weight", L),
+            "mlp_norm_b": _stack(sd, pre + "ln_2.bias", L),
+            "w_up": _stack(sd, pre + "mlp.c_fc.weight", L, transpose=True),
+            "w_up_b": _stack(sd, pre + "mlp.c_fc.bias", L),
+            "w_down": _stack(sd, pre + "mlp.c_proj.weight", L,
+                             transpose=True),
+            "w_down_b": _stack(sd, pre + "mlp.c_proj.bias", L),
+        }
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "pos_embed": _np(sd["transformer.wpe.weight"]),
+            "final_norm": _np(sd["transformer.ln_f.weight"]),
+            "final_norm_b": _np(sd["transformer.ln_f.bias"]),
+            "layers": layers,
+        }
+        return cfg, params
+
+
 class GemmaPolicy(InjectionPolicy):
     """HF ``GemmaForCausalLM``: llama wiring with three twists — RMSNorm
     applies ``(1 + w)`` (folded into the stored weight at conversion, so
@@ -1326,7 +1467,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
-                                MixtralPolicy,
+                                MixtralPolicy, GPTBigCodePolicy,
+                                CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Probe the TPU tunnel until it answers, then fire the on-chip program.
+
+The tunnel dies for hours at a time (round-2 lost its whole on-chip
+window to an outage; this session watched a 30-minute near-OOM compile
+wedge it).  This watcher converts recovery into artifacts with no human
+in the loop:
+
+    nohup python scripts/tunnel_watcher.py --steps serving,bench &
+
+Each probe is a subprocess with a hard timeout (the axon backend hangs
+forever rather than failing).  On the first healthy probe it runs
+``scripts/onchip_r03.py --only <steps>`` and exits.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe(timeout_s: int) -> bool:
+    code = ("import jax; d = jax.devices()[0]; "
+            "import jax.numpy as jnp; "
+            "x = jnp.ones((128, 128), jnp.bfloat16); "
+            "print(float((x @ x).sum()), d.platform)")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return False
+    return out.returncode == 0 and "tpu" in (out.stdout or "").lower()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", default="probe,serving,bench",
+                    help="comma list forwarded to onchip_r03.py --only")
+    ap.add_argument("--interval", type=int, default=300)
+    ap.add_argument("--probe-timeout", type=int, default=150)
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        ok = probe(args.probe_timeout)
+        print(f"[watcher] probe {attempt}: {'UP' if ok else 'down'}",
+              flush=True)
+        if ok:
+            rc = subprocess.call(
+                [sys.executable, "scripts/onchip_r03.py",
+                 "--only", args.steps], cwd=REPO)
+            print(f"[watcher] onchip program exited rc={rc}", flush=True)
+            return rc
+        time.sleep(args.interval)
+    print("[watcher] gave up: tunnel never recovered", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -604,3 +604,34 @@ def test_mixtral_topk_guard():
             num_local_experts=4, num_experts_per_tok=3)).build(
             transformers.MixtralConfig(num_local_experts=4,
                                        num_experts_per_tok=3), {})
+
+
+@pytest.mark.parametrize("mq", [True, False])
+def test_gpt_bigcode_conversion_matches_hf(mq):
+    """SantaCoder/StarCoder: fused c_attn through nn.Linear with a
+    single shared K/V head when multi_query."""
+    hf_cfg = transformers.GPTBigCodeConfig(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        multi_query=mq)
+    torch.manual_seed(0)
+    hf = transformers.GPTBigCodeForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.kv_heads == (1 if mq else 4)
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_codegen_conversion_matches_hf():
+    """CodeGen: GPT-J parallel block + the mp_num=4 fused QKV scramble
+    ([q|v|k] per mp block) + partial interleaved rotary."""
+    # n_head=8 > mp_num=4: two heads per mp block, so a block-vs-head
+    # ordering bug in the unscramble cannot cancel out
+    hf_cfg = transformers.CodeGenConfig(
+        vocab_size=96, n_positions=64, n_embd=64, n_layer=2, n_head=8,
+        rotary_dim=4)
+    torch.manual_seed(0)
+    hf = transformers.CodeGenForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.parallel_block and model.config.rope_dim == 4
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
