@@ -68,6 +68,9 @@ class TransformerConfig:
     #                                         uses 1.0 instead of 1/sqrt(dh))
     local_attn_pattern: Optional[Tuple[int, ...]] = None  # per-layer sliding
     #                window (0 = global); GPT-Neo alternates (0, 256, 0, ...)
+    attn_logit_softcap: Optional[float] = None   # tanh-cap raw attention
+    #                scores (Gemma-2); runs the XLA attention path
+    final_logit_softcap: Optional[float] = None  # tanh-cap LM-head logits
     tie_embeddings: bool = False
     remat: bool = True
     remat_policy: str = "nothing_saveable"
@@ -238,7 +241,16 @@ def next_token_xent(logits, batch):
     return jnp.mean(nll)
 
 
-def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int):
+def _softcap(logits, cap):
+    """Gemma-2 tanh capping: bounded logits, one definition for every
+    head/loss path so decode can never drift from the full forward."""
+    if cap:
+        return cap * jnp.tanh(logits / cap)
+    return logits
+
+
+def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int,
+                            logit_softcap=None):
     """Next-token cross-entropy WITHOUT materializing the full fp32
     ``[B, S, V]`` logits tensor: the flattened token stream is processed in
     ``chunk_size``-token chunks under a remat'd ``lax.scan`` — each chunk's
@@ -291,6 +303,7 @@ def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int):
         logits = (xc @ head_c).astype(jnp.float32)
         if bias32 is not None:
             logits = logits + bias32
+        logits = _softcap(logits, logit_softcap)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         ll = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
         nll_sum, m_sum = carry
@@ -525,9 +538,14 @@ class CausalTransformerLM:
             window=layer.get("attn_window"))
 
     def _attn_block(self, x, layer, positions):
-        h = _norm(x, layer["attn_norm"], self.config.norm_eps,
-                  self.config.use_rmsnorm, layer.get("attn_norm_b"))
-        return x + self._attn_delta(h, layer, positions)
+        c = self.config
+        h = _norm(x, layer["attn_norm"], c.norm_eps,
+                  c.use_rmsnorm, layer.get("attn_norm_b"))
+        delta = self._attn_delta(h, layer, positions)
+        if "attn_post_norm" in layer:   # Gemma-2 sandwich: norm the
+            delta = _norm(delta, layer["attn_post_norm"], c.norm_eps,
+                          c.use_rmsnorm)   # sub-block OUTPUT pre-residual
+        return x + delta
 
     def _attn_delta(self, h, layer, positions):
         """Attention sub-block on pre-normed input; returns the residual
@@ -554,13 +572,22 @@ class CausalTransformerLM:
                 impl=impl, block_q=c.attn_block_q, block_k=c.attn_block_k,
                 alibi_slopes=alibi_slopes(H) if has_alibi else None,
                 window=layer["attn_window"] if has_window else None,
-                interpret=on_cpu and impl == "pallas")
+                interpret=on_cpu and impl == "pallas",
+                logit_softcap=c.attn_logit_softcap)
         elif c.attn_impl == "ring":
+            if c.attn_logit_softcap:
+                raise ValueError(
+                    "attn_logit_softcap is not implemented for the ring "
+                    "attention path; use attn_impl='reference'/'auto'")
             from deepspeed_tpu.ops.ring_attention import ring_attention
             attn = ring_attention(q, k, v, causal=True,
                                   softmax_scale=c.attn_scale,
                                   layout=c.ring_layout)
         elif c.attn_impl == "ulysses":
+            if c.attn_logit_softcap:
+                raise ValueError(
+                    "attn_logit_softcap is not implemented for the ulysses "
+                    "attention path; use attn_impl='reference'/'auto'")
             from deepspeed_tpu.ops.ulysses import ulysses_attention, sp_degree
             sp = sp_degree()
             # K/V only need a head count divisible by sp for the all-to-all;
@@ -577,7 +604,8 @@ class CausalTransformerLM:
         elif c.attn_impl in ("auto", "pallas", "reference"):
             attn = attention(q, k, v, causal=True,
                              softmax_scale=c.attn_scale, impl=c.attn_impl,
-                             block_q=c.attn_block_q, block_k=c.attn_block_k)
+                             block_q=c.attn_block_q, block_k=c.attn_block_k,
+                             logit_softcap=c.attn_logit_softcap)
         else:
             raise ValueError(
                 f"unknown attn_impl '{c.attn_impl}'; expected one of "
@@ -590,6 +618,9 @@ class CausalTransformerLM:
         h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
                   layer.get("mlp_norm_b"))
         delta, aux = self._mlp_delta(h, layer, rng=rng, train=train)
+        if "mlp_post_norm" in layer:    # Gemma-2 sandwich
+            delta = _norm(delta, layer["mlp_post_norm"], c.norm_eps,
+                          c.use_rmsnorm)
         return x + delta, aux
 
     def _mlp_delta(self, h, layer, rng=None, train=True):
@@ -709,6 +740,7 @@ class CausalTransformerLM:
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
+        logits = _softcap(logits, c.final_logit_softcap)
         if return_aux:
             return logits, aux
         return logits
@@ -760,8 +792,12 @@ class CausalTransformerLM:
         bias = self._cached_attn_bias(layer, T, cache.k.shape[2],
                                       cache.length)
         attn = decode_attention(q, cache, softmax_scale=c.attn_scale,
-                                bias=bias)
+                                bias=bias,
+                                logit_softcap=c.attn_logit_softcap)
         attn_delta = self._proj(attn.reshape(B, T, H * dh), layer, "wo")
+        if "attn_post_norm" in layer:   # Gemma-2 sandwich (decode too)
+            attn_delta = _norm(attn_delta, layer["attn_post_norm"],
+                               c.norm_eps, c.use_rmsnorm)
         if c.parallel_block:
             hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
                        layer.get("mlp_norm_b"))
@@ -825,6 +861,7 @@ class CausalTransformerLM:
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
+        logits = _softcap(logits, c.final_logit_softcap)
         return logits, out_caches
 
     # ------------------------------------------------------------------
@@ -885,8 +922,12 @@ class CausalTransformerLM:
             # paged kernels don't take); init_paged_caches guards this
             attn = paged_decode_attention(q, cache, block_tables,
                                           lengths + T,
-                                          softmax_scale=c.attn_scale)
+                                          softmax_scale=c.attn_scale,
+                                          logit_softcap=c.attn_logit_softcap)
             attn_delta = self._proj(attn.reshape(B, T, H * dh), layer, "wo")
+            if "attn_post_norm" in layer:   # Gemma-2 sandwich
+                attn_delta = _norm(attn_delta, layer["attn_post_norm"],
+                                   c.norm_eps, c.use_rmsnorm)
             if c.parallel_block:
                 hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
                            layer.get("mlp_norm_b"))
@@ -920,6 +961,7 @@ class CausalTransformerLM:
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
+        logits = _softcap(logits, c.final_logit_softcap)
         return logits, PagedKVCache(k_pages=new_k, v_pages=new_v), \
             lengths + T
 
@@ -935,7 +977,8 @@ class CausalTransformerLM:
             head = (params["tok_embed"].T if c.tie_embeddings
                     else params["lm_head"])
             ce = chunked_next_token_xent(x, head, params.get("lm_head_b"),
-                                         batch, c.loss_chunk_size)
+                                         batch, c.loss_chunk_size,
+                                         logit_softcap=c.final_logit_softcap)
         else:
             logits, aux = self.apply(params, input_ids, rng=rng,
                                      return_aux=True)

@@ -1184,6 +1184,87 @@ class MptPolicy(InjectionPolicy):
         return cfg, params
 
 
+class Gemma2Policy(InjectionPolicy):
+    """HF ``Gemma2ForCausalLM``: Gemma wiring plus four twists — tanh
+    softcapping of attention scores AND final logits
+    (``attn_logit_softcap``/``final_logit_softcap``; scores capped BEFORE
+    the causal/window mask, matching ``modeling_gemma2.eager_attention_
+    forward``), sandwich norms (``post_attention_layernorm`` /
+    ``post_feedforward_layernorm`` normalize each sub-block's OUTPUT
+    pre-residual — ``attn_post_norm``/``mlp_post_norm`` layer keys),
+    alternating sliding/full attention per ``layer_types`` (HF mask:
+    ``q - kv < sliding_window`` — exactly this repo's window
+    convention), and ``query_pre_attn_scalar**-0.5`` logit scaling.
+    All (1+w) RMSNorms folded at conversion like Gemma."""
+
+    model_types = ("gemma2",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = getattr(hf, "head_dim", None) or d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        window = int(hf.sliding_window)
+        types = list(getattr(hf, "layer_types", None) or
+                     ["sliding_attention" if (i + 1) % 2 else
+                      "full_attention" for i in range(L)])
+        pattern = tuple(window if t == "sliding_attention" else 0
+                        for t in types)
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            head_dim_override=(None if dh == d // H else dh),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.rms_norm_eps, activation="gelu", gated_mlp=True,
+            embed_scale=float(d) ** 0.5,
+            attn_scale=float(hf.query_pre_attn_scalar) ** -0.5,
+            attn_logit_softcap=(float(hf.attn_logit_softcapping)
+                                if hf.attn_logit_softcapping else None),
+            final_logit_softcap=(float(hf.final_logit_softcapping)
+                                 if hf.final_logit_softcapping else None),
+            local_attn_pattern=(pattern if any(pattern) else None),
+            use_rmsnorm=True, use_rope=True,
+            tie_embeddings=True, remat=False)
+
+        pre = "model.layers.{}."
+
+        def norm1p(fmt):
+            return _stack(sd, fmt, L) + 1.0      # fold Gemma's (1 + w)
+
+        layers = {
+            "attn_norm": norm1p(pre + "input_layernorm.weight"),
+            # NAMING TRAP: Gemma2's "post_attention_layernorm" is the
+            # POST-norm of the attention OUTPUT (not llama's pre-MLP norm)
+            "attn_post_norm": norm1p(pre + "post_attention_layernorm"
+                                     ".weight"),
+            "mlp_norm": norm1p(pre + "pre_feedforward_layernorm.weight"),
+            "mlp_post_norm": norm1p(pre + "post_feedforward_layernorm"
+                                    ".weight"),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]) + 1.0,
+            "layers": layers,
+        }
+        return cfg, params
+
+
 class MixtralPolicy(InjectionPolicy):
     """HF ``MixtralForCausalLM``: llama attention + per-layer top-2 MoE
     with SwiGLU experts.  HF's router (softmax over ALL experts → top-2 →
@@ -1467,8 +1548,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
-                                MixtralPolicy, GPTBigCodePolicy,
-                                CodeGenPolicy,
+                                Gemma2Policy, MixtralPolicy,
+                                GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

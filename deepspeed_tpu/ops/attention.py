@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 
 def reference_attention(q, k, v, causal=True, bias=None, segment_ids=None,
-                        softmax_scale: Optional[float] = None):
+                        softmax_scale: Optional[float] = None,
+                        logit_softcap: Optional[float] = None):
     """Plain softmax attention.
 
     q: [B, S, H, D]; k/v: [B, S, Hkv, D] (Hkv divides H → GQA).
@@ -34,6 +35,9 @@ def reference_attention(q, k, v, causal=True, bias=None, segment_ids=None,
         v = jnp.repeat(v, rep, axis=2)
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if logit_softcap:
+        # Gemma-2 style: bounded raw scores, applied BEFORE mask/bias
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     Sk = k.shape[1]
     if bias is not None:
         logits = logits + bias
@@ -78,10 +82,10 @@ def alibi_window_bias(Sq, Sk, slopes=None, window=None):
 
 @functools.partial(jax.jit, static_argnames=("causal", "softmax_scale",
                                              "impl", "block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "logit_softcap"))
 def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
               block_q=None, block_k=None, alibi_slopes=None, window=None,
-              interpret=False):
+              interpret=False, logit_softcap=None):
     """Dispatching attention entry point — the ONE place the
     pallas-vs-reference policy (and its loud fallback) lives.
 
@@ -99,6 +103,10 @@ def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
         use_pallas = True
     elif impl == "auto":
         use_pallas = jax.default_backend() not in ("cpu",)
+    if logit_softcap:
+        # tanh capping lives inside the softmax loop; the flash kernel
+        # does not implement it yet — XLA fuses the jnp path fine
+        use_pallas = False
     if use_pallas:
         try:
             from deepspeed_tpu.ops.pallas.flash_attention import (
@@ -116,7 +124,8 @@ def attention(q, k, v, causal=True, softmax_scale=None, impl="auto",
         bias = alibi_window_bias(q.shape[1], k.shape[1],
                                  slopes=alibi_slopes, window=window)
     return reference_attention(q, k, v, causal=causal,
-                               softmax_scale=softmax_scale, bias=bias)
+                               softmax_scale=softmax_scale, bias=bias,
+                               logit_softcap=logit_softcap)
 
 
 @functools.lru_cache(maxsize=8)

@@ -65,7 +65,8 @@ def update_cache(cache: KVCache, k_new, v_new) -> KVCache:
 
 
 def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
-                     block_k=DEFAULT_BLOCK_K, interpret=False, bias=None):
+                     block_k=DEFAULT_BLOCK_K, interpret=False, bias=None,
+                     logit_softcap=None):
     """q: [B, T, H, D] (T=1 decode or T=prompt prefill, already appended to
     cache); attends over cache[:length].  fp32 softmax.
 
@@ -73,7 +74,8 @@ def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
     or "jnp".  ``bias``: additive logit bias broadcastable to [B, H, T, S]
     (ALiBi / local-window models); forces the jnp path."""
     B, T, H, D = q.shape
-    if bias is None and use_pallas(impl, cache.k.shape[2], block_k):
+    if bias is None and not logit_softcap and \
+            use_pallas(impl, cache.k.shape[2], block_k):
         from deepspeed_tpu.ops.pallas.decode_attention import \
             decode_attention_pallas
         lengths = jnp.broadcast_to(jnp.asarray(cache.length, jnp.int32), (B,))
@@ -89,6 +91,8 @@ def decode_attention(q, cache: KVCache, softmax_scale=None, impl=None,
         v = jnp.repeat(v, rep, axis=1)
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
     logits = jnp.einsum("bqhd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if logit_softcap:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     S = cache.k.shape[2]
     kpos = jnp.arange(S)[None, :]
     qpos = cache.length - T + jnp.arange(T)[:, None]

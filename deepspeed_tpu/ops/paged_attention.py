@@ -81,14 +81,15 @@ def prefill_paged(cache: PagedKVCache, block_tables, lengths, k_new, v_new
 def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
                            softmax_scale: Optional[float] = None,
                            impl: Optional[str] = None,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           logit_softcap: Optional[float] = None):
     """q: [B, T, H, D] — the last T tokens of each sequence (T=1 decode).
 
     ``impl``: None (auto: Pallas kernel on TPU, jnp elsewhere), "pallas",
     or "jnp".  The jnp path gathers each sequence's pages into its logical
     view and runs masked attention over the valid ragged prefix."""
     from deepspeed_tpu.ops.decode_attention import use_pallas
-    if use_pallas(impl):
+    if use_pallas(impl) and not logit_softcap:
         from deepspeed_tpu.ops.pallas.decode_attention import \
             paged_attention_pallas
         return paged_attention_pallas(q, cache.k_pages, cache.v_pages,
@@ -112,6 +113,8 @@ def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
         v = jnp.repeat(v, rep, axis=1)
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
     logits = jnp.einsum("bqhd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if logit_softcap:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     kpos = jnp.arange(S)[None, None, :]                       # [1, 1, S]
     qpos = (lengths[:, None] - T + jnp.arange(T)[None, :])[..., None]
     mask = kpos <= qpos                                       # [B, T, S]

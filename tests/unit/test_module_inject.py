@@ -675,3 +675,49 @@ def test_mixtral_serves_expert_parallel_chunked():
     for p, got in zip(prompts, outs):
         assert got == dense_greedy(p, 5), p
     groups.reset_mesh()
+
+
+def test_gemma2_conversion_matches_hf():
+    """Gemma2: attention + final logit softcapping, sandwich norms
+    (post-attn/post-ffw norms on sub-block outputs), alternating
+    sliding/full layers, query_pre_attn_scalar scaling — logit-exact."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=64,
+        sliding_window=8, query_pre_attn_scalar=16,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(0)
+    hf = transformers.Gemma2ForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.attn_logit_softcap == 50.0 and c.final_logit_softcap == 30.0
+    assert c.local_attn_pattern == (8, 0)       # sliding layer 0, full 1
+    assert "attn_post_norm" in params["layers"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_gemma2_cached_decode_matches_hf_generate():
+    """The cached decode path must apply the sandwich post-norms and the
+    attention softcap too (not just the full forward): greedy generate
+    through init_inference vs HF greedy generate, token-exact."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=64,
+        sliding_window=8, query_pre_attn_scalar=16,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        hidden_activation="gelu_pytorch_tanh")
+    torch.manual_seed(0)
+    hf = transformers.Gemma2ForCausalLM(hf_cfg)
+    engine = deepspeed_tpu.init_inference(
+        model=hf, dtype="fp32", replace_with_kernel_inject=True)
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 96, (1, 12))
+    ours = np.asarray(engine.generate(ids, max_new_tokens=8))
+    hf_out = hf.generate(
+        torch.tensor(ids), max_new_tokens=8, do_sample=False,
+        pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours, hf_out)
