@@ -57,6 +57,9 @@ class TransformerConfig:
     use_rmsnorm: bool = True
     use_rope: bool = True                   # False → learned positions (GPT-2)
     rope_dim: Optional[int] = None          # partial rotary (GPT-NeoX); None → full
+    rope_inv_freq: Optional[Tuple[float, ...]] = None  # scaled inverse
+    #   frequencies (Llama-3 / linear rope scaling), length rotary_dim//2
+    #   (= the ROTATED slice's half-dim when rope_dim is set)
     use_bias: bool = False                  # linear biases (GPT-2/OPT families)
     norm_bias: bool = False                 # LayerNorm beta (GPT-2/OPT)
     use_alibi: bool = False                 # ALiBi slopes, no positions (Bloom)
@@ -315,16 +318,25 @@ def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int,
     return nll_sum / jnp.maximum(m_sum, 1.0)
 
 
-def _rope(x, positions, theta, rope_dim=None):
+def _rope(x, positions, theta, rope_dim=None, inv_freq=None):
     """Rotary embedding; x: [B, S, H, D].  ``rope_dim`` < D rotates only the
-    leading dims (GPT-NeoX partial rotary)."""
+    leading dims (GPT-NeoX partial rotary).  ``inv_freq``: per-dim inverse
+    frequencies overriding the theta power law — how Llama-3 / linear
+    rope scaling ships (the policy precomputes the scaled table)."""
     if rope_dim is not None and rope_dim < x.shape[-1]:
         rot, rest = x[..., :rope_dim], x[..., rope_dim:]
         return jnp.concatenate(
-            [_rope(rot, positions, theta), rest], axis=-1)
+            [_rope(rot, positions, theta, inv_freq=inv_freq), rest], axis=-1)
     B, S, H, D = x.shape
     half = D // 2
-    freqs = jnp.exp(-math.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
+    if inv_freq is not None:
+        freqs = jnp.asarray(inv_freq, jnp.float32)
+        assert freqs.shape == (half,), \
+            (f"rope_inv_freq must cover the rotated slice: expected "
+             f"length {half}, got {freqs.shape}")
+    else:
+        freqs = jnp.exp(-math.log(theta) *
+                        jnp.arange(half, dtype=jnp.float32) / half)
     angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -521,8 +533,10 @@ class CausalTransformerLM:
         k = self._proj(h, layer, "wk").reshape(B, S, Hkv, dh)
         v = self._proj(h, layer, "wv").reshape(B, S, Hkv, dh)
         if c.use_rope:
-            q = _rope(q, positions, c.rope_theta, c.rope_dim)
-            k = _rope(k, positions, c.rope_theta, c.rope_dim)
+            q = _rope(q, positions, c.rope_theta, c.rope_dim,
+                      inv_freq=c.rope_inv_freq)
+            k = _rope(k, positions, c.rope_theta, c.rope_dim,
+                      inv_freq=c.rope_inv_freq)
         return q, k, v
 
     def _attn_bias(self, layer, Sq, Sk):

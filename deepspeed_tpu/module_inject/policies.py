@@ -100,6 +100,41 @@ class GPT2Policy(InjectionPolicy):
         return cfg, params
 
 
+def _rope_scaled_inv_freq(hf, dh: int):
+    """Precompute the scaled inverse-frequency table for HF
+    ``rope_scaling`` (None when unscaled).  Implements "linear" and
+    "llama3" (the Llama-3.1+ NTK-by-parts rescale, matching HF
+    ``_compute_llama3_parameters``); seq-len-dependent or
+    attention-scaled types (dynamic/yarn/longrope) raise."""
+    rs = getattr(hf, "rope_scaling", None)
+    if not rs:
+        return None
+    kind = rs.get("rope_type", rs.get("type", "default"))
+    theta = float(getattr(hf, "rope_theta", 10000.0))
+    half = dh // 2
+    inv = theta ** (-np.arange(half, dtype=np.float64) / half)
+    if kind in ("default",):
+        return None
+    if kind == "linear":
+        return tuple(float(v) for v in inv / float(rs["factor"]))
+    if kind == "llama3":
+        factor = float(rs["factor"])
+        lo_f = float(rs["low_freq_factor"])
+        hi_f = float(rs["high_freq_factor"])
+        old_len = float(rs["original_max_position_embeddings"])
+        wavelen = 2.0 * np.pi / inv
+        out = np.where(wavelen > old_len / lo_f, inv / factor, inv)
+        smooth = (old_len / wavelen - lo_f) / (hi_f - lo_f)
+        smoothed = (1.0 - smooth) / factor * inv + smooth * inv
+        medium = (wavelen >= old_len / hi_f) & (wavelen <= old_len / lo_f)
+        out = np.where(medium, smoothed, out)
+        return tuple(float(v) for v in out)
+    raise ValueError(
+        f"rope_scaling type {kind!r} is not supported (linear/llama3 "
+        "convert; dynamic/yarn/longrope need runtime or attention "
+        "scaling this model does not implement)")
+
+
 class LlamaPolicy(InjectionPolicy):
     """HF ``LlamaForCausalLM`` / ``MistralForCausalLM`` /
     ``Qwen2ForCausalLM`` (reference has no llama container in 0.8.3 —
@@ -121,6 +156,8 @@ class LlamaPolicy(InjectionPolicy):
             ffn_hidden_size=hf.intermediate_size,
             max_seq_len=getattr(hf, "max_position_embeddings", 4096),
             rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            rope_inv_freq=_rope_scaled_inv_freq(
+                hf, d // hf.num_attention_heads),
             norm_eps=hf.rms_norm_eps, activation="silu",
             use_rmsnorm=True, use_rope=True,
             tie_embeddings=tied, remat=False)
@@ -1184,6 +1221,73 @@ class MptPolicy(InjectionPolicy):
         return cfg, params
 
 
+class Phi3Policy(InjectionPolicy):
+    """HF ``Phi3ForCausalLM`` (phi-3-mini-4k lineage): llama wiring with
+    fused ``qkv_proj [(H+2·Hkv)·dh, d]`` (q|k|v row blocks) and fused
+    ``gate_up_proj [2f, d]`` (gate|up halves), RMSNorm, SwiGLU, RoPE,
+    biasless, untied head.  The longrope-scaled 128k variants are
+    guarded (su/longrope rescaling is not implemented)."""
+
+    model_types = ("phi3",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError(
+                "phi3 rope_scaling (longrope/su 128k variants) is not "
+                "supported yet; the 4k-context checkpoints convert")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True,
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        f = hf.intermediate_size
+        wq, wk, wv, wg, wu = [], [], [], [], []
+        for i in range(L):
+            qkv = _np(sd[pre.format(i) + "self_attn.qkv_proj.weight"])
+            wq.append(qkv[:H * dh].T)
+            wk.append(qkv[H * dh:(H + n_kv) * dh].T)
+            wv.append(qkv[(H + n_kv) * dh:].T)
+            gu = _np(sd[pre.format(i) + "mlp.gate_up_proj.weight"])
+            wg.append(gu[:f].T)
+            wu.append(gu[f:].T)
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight",
+                               L),
+            "w_gate": np.stack(wg), "w_up": np.stack(wu),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class Gemma2Policy(InjectionPolicy):
     """HF ``Gemma2ForCausalLM``: Gemma wiring plus four twists — tanh
     softcapping of attention scores AND final logits
@@ -1548,7 +1652,7 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 GPTJPolicy, GPTNeoPolicy, DistilBertPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
-                                Gemma2Policy, MixtralPolicy,
+                                Gemma2Policy, Phi3Policy, MixtralPolicy,
                                 GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 

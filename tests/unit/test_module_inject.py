@@ -721,3 +721,67 @@ def test_gemma2_cached_decode_matches_hf_generate():
         torch.tensor(ids), max_new_tokens=8, do_sample=False,
         pad_token_id=0).numpy()
     np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_phi3_conversion_matches_hf():
+    """Phi-3: fused qkv_proj (q|k|v blocks, GQA) + fused gate_up_proj
+    (gate|up halves), llama semantics otherwise."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_scaling=None,
+        tie_word_embeddings=False, pad_token_id=0)
+    torch.manual_seed(0)
+    hf = transformers.Phi3ForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.n_kv_heads == 2 and model.config.gated
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_phi3_longrope_guard():
+    with pytest.raises(ValueError, match="rope_scaling"):
+        find_policy(transformers.Phi3Config(
+            max_position_embeddings=131072,
+            original_max_position_embeddings=4096,
+            rope_scaling={"type": "longrope",
+                          "short_factor": [1.0] * 16,
+                          "long_factor": [1.0] * 16}))
+
+
+def test_llama3_rope_scaling_matches_hf():
+    """Llama-3.1-style NTK-by-parts rope scaling: the policy precomputes
+    the scaled inverse-frequency table; logits AND cached greedy decode
+    stay exact vs HF."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.rope_inv_freq is not None
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+    engine = deepspeed_tpu.init_inference(
+        model=hf, dtype="fp32", replace_with_kernel_inject=True)
+    rng = np.random.default_rng(9)
+    pid = rng.integers(0, 96, (1, 10))
+    ours = np.asarray(engine.generate(pid, max_new_tokens=6))
+    hf_out = hf.generate(torch.tensor(pid), max_new_tokens=6,
+                         do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_unsupported_rope_scaling_raises():
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0})
+    from deepspeed_tpu.module_inject.policies import LlamaPolicy
+    with pytest.raises(ValueError, match="rope_scaling"):
+        LlamaPolicy.build(cfg, {})
