@@ -129,7 +129,8 @@ def main():
     ap.add_argument("--only", default="")
     args = ap.parse_args()
     steps = [s for s in args.only.split(",") if s] or [
-        "probe", "kernels", "sweep", "bench", "serving", "big", "tune"]
+        "probe", "kernels", "sweep", "bench", "serving", "big",
+        "longseq", "tune"]
     py = sys.executable
 
     if "probe" in steps:
@@ -175,17 +176,29 @@ def main():
         # >=1B on one 16 GB chip with NO offload: bf16 Adam moments (SR)
         # + bf16 grad accum shrink the train state to 8 B/param (the
         # host-offload route moves ~6 GB/step over the tunnel and times
-        # out — measured, journal big_1_5b_b4)
-        for model, batch, gas in (("gpt_1_1b", 1, 8), ("gpt_1b", 2, 4)):
-            got = run(f"big_{model}_b{batch}_gas{gas}",
-                      [py, "bin/ds_bench", "train", "--model", model,
-                       "--batch", str(batch), "--gas", str(gas),
-                       "--seq", "1024", "--steps", "8",
-                       "--moment-dtype", "bfloat16",
-                       "--grad-accum-dtype", "bfloat16", "--json"],
-                      timeout=2400)
-            if got:
-                break
+        # out — measured, journal big_1_5b_b4).  gas sweep around the
+        # measured MFU-0.486 config; the 1.1B shape is known to hit a
+        # pathological near-limit XLA scheduling compile (>30 min,
+        # journal big_1_1b timeout) so it goes LAST with a short leash.
+        for model, batch, gas, leash in (("gpt_1b", 2, 4, 1500),
+                                         ("gpt_1b", 2, 8, 1500),
+                                         ("gpt_1_1b", 1, 8, 1200)):
+            run(f"big_{model}_b{batch}_gas{gas}",
+                [py, "bin/ds_bench", "train", "--model", model,
+                 "--batch", str(batch), "--gas", str(gas),
+                 "--seq", "1024", "--steps", "8",
+                 "--moment-dtype", "bfloat16",
+                 "--grad-accum-dtype", "bfloat16", "--json"],
+                timeout=leash)
+
+    if "longseq" in steps:
+        # long-context single-chip evidence: flash fwd+bwd at S=4096
+        # (GPT-350M shape) — the training bench path exercises the Pallas
+        # flash kernel end-to-end at 4x the usual sequence
+        run("longseq_s4096",
+            [py, "bin/ds_bench", "train", "--model", "gpt_350m",
+             "--batch", "2", "--gas", "4", "--seq", "4096",
+             "--steps", "6", "--json"], timeout=1800)
 
     if "tune" in steps:
         spec = {"kind": "causal_lm",
