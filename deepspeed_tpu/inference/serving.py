@@ -35,6 +35,8 @@ class _Request:
     max_new_tokens: int
     temperature: float = 0.0
     seed: int = 0
+    top_k: int = 0              # 0 = off
+    top_p: float = 1.0          # 1.0 = off
     out: List[int] = field(default_factory=list)
     last_token: Optional[int] = None
 
@@ -121,11 +123,12 @@ class ServingEngine:
         # so chunking multiplies serving throughput by ~decode_chunk.
         self.decode_chunk = int(decode_chunk)
         assert self.decode_chunk >= 1
-        self._chunk_fn = None
+        self._chunk_fns = {}   # use_filters(bool) -> compiled chunk fn
 
     # -- host control flow ---------------------------------------------
     def add_request(self, req_id, prompt_ids, max_new_tokens: int = 32,
-                    temperature: float = 0.0, seed: int = 0):
+                    temperature: float = 0.0, seed: int = 0,
+                    top_k: int = 0, top_p: float = 1.0):
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         assert len(prompt) + max_new_tokens <= self.max_seq, \
             f"request {req_id} exceeds max_seq {self.max_seq}"
@@ -140,8 +143,9 @@ class ServingEngine:
             req_id not in self.finished and \
             all(r.req_id != req_id for r in self.queue), \
             f"duplicate req_id {req_id!r}"
+        assert 0.0 < top_p <= 1.0 and top_k >= 0, (top_k, top_p)
         self.queue.append(_Request(req_id, prompt, max_new_tokens,
-                                   temperature, seed))
+                                   temperature, seed, top_k, top_p))
         self._admit()
 
     def _bucket(self, n: int) -> int:
@@ -198,9 +202,28 @@ class ServingEngine:
             return int(np.argmax(logits))
         rng = self._rng.setdefault(req.req_id,
                                    np.random.default_rng(req.seed))
-        p = logits.astype(np.float64) / req.temperature
-        p = np.exp(p - p.max())
-        return int(rng.choice(len(p), p=p / p.sum()))
+        l = logits.astype(np.float64) / req.temperature
+        V = len(l)
+        if req.top_k or req.top_p < 1.0:
+            # rank-based filtering — EXACTLY cut tokens survive, stable
+            # tie order, mirroring the device sampler's policy
+            order = np.argsort(-l, kind="stable")
+            ranks = np.empty(V, np.int64)
+            ranks[order] = np.arange(V)
+            k_eff = req.top_k if 0 < req.top_k < V else V
+            l = np.where(ranks < k_eff, l, -np.inf)
+            p = np.exp(l - l.max())
+            p = p / p.sum()
+            if req.top_p < 1.0:
+                cs = np.cumsum(p[order])
+                # smallest prefix whose mass reaches top_p
+                cut = int(np.searchsorted(cs, req.top_p) + 1)
+                p = np.where(ranks < cut, p, 0.0)
+                p = p / p.sum()
+        else:
+            p = np.exp(l - l.max())
+            p = p / p.sum()
+        return int(rng.choice(V, p=p))
 
     def _finish(self, slot: int):
         req = self.slots[slot]
@@ -217,12 +240,12 @@ class ServingEngine:
         return sum(s is not None for s in self.slots)
 
     # -- the chunked decode step (K tokens per dispatch) ----------------
-    def _build_chunk_fn(self):
+    def _build_chunk_fn(self, use_filters: bool):
         K = self.decode_chunk
         model = self.model
 
         def chunk(params, caches, tables, lengths, last, temps, seeds,
-                  gen_counts):
+                  gen_counts, top_ks, top_ps):
             """K decode iterations in one device program.  Emits the K
             sampled tokens per slot; the host truncates past EOS /
             max_new_tokens (overrun writes land on the reserved scratch
@@ -231,6 +254,26 @@ class ServingEngine:
             keys on (request seed, tokens generated so far), so a
             request's random stream is independent of slot assignment
             and arrival order — the per-token engine's req.seed contract."""
+            def one_sample(key, l, temp, top_k, top_p):
+                """One slot's filtered sampler: temperature -> top-k ->
+                top-p (nucleus) -> categorical.  Rank-based like the host
+                sampler: a single stable descending argsort; exactly
+                ``cut`` ranked tokens survive each stage (top_k=0 /
+                top_p=1.0 gate their stage off explicitly)."""
+                V = l.shape[-1]
+                l = l / jnp.maximum(temp, 1e-6)
+                order = jnp.argsort(-l, stable=True)
+                ranks = jnp.zeros(V, jnp.int32).at[order].set(
+                    jnp.arange(V, dtype=jnp.int32))
+                k_eff = jnp.where((top_k > 0) & (top_k < V), top_k, V)
+                l = jnp.where(ranks < k_eff, l, -1e30)
+                p = jax.nn.softmax(l)
+                cs = jnp.cumsum(p[order])
+                # smallest prefix reaching top_p mass (searchsorted+1)
+                cut = jnp.where(top_p < 1.0, jnp.sum(cs < top_p) + 1, V)
+                l = jnp.where(ranks < cut, l, -1e30)
+                return jax.random.categorical(key, l).astype(jnp.int32)
+
             def one(carry, t):
                 caches, lengths, last = carry
                 logits, caches, _ = model.apply_with_paged_cache(
@@ -240,10 +283,14 @@ class ServingEngine:
                 keys = jax.vmap(
                     lambda s, g: jax.random.fold_in(jax.random.key(s),
                                                     g + t))(seeds, gen_counts)
-                sampled = jax.vmap(
-                    lambda k, l, tt: jax.random.categorical(
-                        k, l / jnp.maximum(tt, 1e-6)))(
-                    keys, lg, temps).astype(jnp.int32)
+                if use_filters:
+                    sampled = jax.vmap(one_sample)(keys, lg, temps,
+                                                   top_ks, top_ps)
+                else:   # plain temperature: no vocab sorts in the loop
+                    sampled = jax.vmap(
+                        lambda k, l, tt: jax.random.categorical(
+                            k, l / jnp.maximum(tt, 1e-6)))(
+                        keys, lg, temps).astype(jnp.int32)
                 nxt = jnp.where(temps > 0, sampled, greedy)
                 return (caches, lengths + 1, nxt), nxt
 
@@ -255,27 +302,35 @@ class ServingEngine:
 
     def _step_chunk(self) -> Dict[Any, List[int]]:
         K = self.decode_chunk
-        if self._chunk_fn is None:
-            self._chunk_fn = self._build_chunk_fn()
+        use_filters = any(r is not None and (r.top_k or r.top_p < 1.0)
+                          for r in self.slots)
+        if self._chunk_fns.get(use_filters) is None:
+            self._chunk_fns[use_filters] = self._build_chunk_fn(use_filters)
+        chunk_fn = self._chunk_fns[use_filters]
         last = np.zeros(self.max_batch, np.int32)
         temps = np.zeros(self.max_batch, np.float32)
         seeds = np.zeros(self.max_batch, np.uint32)
         gen_counts = np.zeros(self.max_batch, np.int32)
+        top_ks = np.zeros(self.max_batch, np.int32)
+        top_ps = np.ones(self.max_batch, np.float32)
         for slot, req in enumerate(self.slots):
             if req is not None:
                 last[slot] = req.last_token
                 temps[slot] = max(0.0, req.temperature)
                 seeds[slot] = np.uint32(req.seed)
                 gen_counts[slot] = len(req.out)
+                top_ks[slot] = req.top_k
+                top_ps[slot] = req.top_p
         args = (self.params, self.caches, jnp.asarray(self.tables),
                 jnp.asarray(self.lengths), jnp.asarray(last),
                 jnp.asarray(temps), jnp.asarray(seeds),
-                jnp.asarray(gen_counts))
+                jnp.asarray(gen_counts), jnp.asarray(top_ks),
+                jnp.asarray(top_ps))
         if self.mesh is not None:
             with self.mesh:
-                toks, self.caches = self._chunk_fn(*args)
+                toks, self.caches = chunk_fn(*args)
         else:
-            toks, self.caches = self._chunk_fn(*args)
+            toks, self.caches = chunk_fn(*args)
         toks = np.asarray(toks)
 
         done_slots, done_now = [], {}
@@ -349,11 +404,13 @@ class ServingEngine:
 
     # -- convenience ----------------------------------------------------
     def generate(self, prompts, max_new_tokens: int = 32,
-                 temperature: float = 0.0) -> List[List[int]]:
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0) -> List[List[int]]:
         """Serve a list of prompts (continuous batching when
         len(prompts) > max_batch); returns full token lists in order."""
         for i, p in enumerate(prompts):
-            self.add_request(i, p, max_new_tokens, temperature)
+            self.add_request(i, p, max_new_tokens, temperature,
+                            top_k=top_k, top_p=top_p)
         steps = 0
         results: Dict[Any, List[int]] = {}
         limit = (max(len(p) for p in prompts) + max_new_tokens + 4) * \

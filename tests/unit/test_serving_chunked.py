@@ -122,3 +122,61 @@ def test_chunked_temperature_seed_contract(tiny):
     assert a != c
     assert len(a) == len(p) + 9
     assert all(0 <= t < cfg.vocab_size for t in a[len(p):])
+
+
+def test_topk_topp_sampling_support(tiny):
+    """top-k / top-p on both sampler paths: every sampled token must lie
+    in the allowed support computed offline from the dense logits, for
+    the per-token host sampler AND the on-device chunked sampler."""
+    cfg, model, params = tiny
+    rng = np.random.default_rng(6)
+    p = rng.integers(0, cfg.vocab_size, (5,)).tolist()
+    p32 = jax.tree_util.tree_map(jnp.asarray, params)
+
+    def allowed(seq, top_k, top_p, temperature):
+        logits = np.asarray(model.apply(
+            p32, jnp.asarray(seq)[None, :], train=False)[0, -1],
+            dtype=np.float64) / temperature
+        if top_k:
+            thresh = np.sort(logits)[-top_k]
+            logits = np.where(logits < thresh, -np.inf, logits)
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        order = np.argsort(-probs)
+        cs = np.cumsum(probs[order])
+        cut = int(np.searchsorted(cs, top_p) + 1)
+        return set(int(t) for t in order[:cut])
+
+    for chunk in (1, 4):
+        eng = ServingEngine(model, params, max_batch=1, page_size=8,
+                            max_seq=64, dtype=jnp.float32,
+                            decode_chunk=chunk)
+        eng.add_request("x", p, max_new_tokens=8, temperature=1.5,
+                        seed=3, top_k=3, top_p=0.9)
+        done = {}
+        for _ in range(12):
+            done.update(eng.step())
+            if "x" in done:
+                break
+        got = done["x"]
+        assert len(got) == len(p) + 8
+        seq = list(p)
+        for tok in got[len(p):]:
+            assert tok in allowed(seq, 3, 0.9, 1.5), (chunk, tok)
+            seq.append(tok)
+
+
+def test_topk_one_equals_greedy_chunked(tiny):
+    """top_k=1 with any temperature must reproduce greedy exactly on the
+    chunked device sampler."""
+    cfg, model, params = tiny
+    rng = np.random.default_rng(8)
+    p = rng.integers(0, cfg.vocab_size, (6,)).tolist()
+    eng = ServingEngine(model, params, max_batch=1, page_size=8,
+                        max_seq=64, dtype=jnp.float32, decode_chunk=4)
+    greedy = eng.generate([p], max_new_tokens=6)[0]
+    eng2 = ServingEngine(model, params, max_batch=1, page_size=8,
+                         max_seq=64, dtype=jnp.float32, decode_chunk=4)
+    topk1 = eng2.generate([p], max_new_tokens=6, temperature=0.7,
+                          top_k=1)[0]
+    assert topk1 == greedy
