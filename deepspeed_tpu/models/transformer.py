@@ -99,6 +99,8 @@ class TransformerConfig:
     moe_layer_freq: int = 1        # every Nth layer is MoE
     moe_aux_loss_coef: float = 0.01
     moe_noisy_gate_policy: Optional[str] = None
+    moe_norm_topk_prob: bool = True  # renormalize the k gate values
+    #   (Mixtral / Qwen2-MoE norm_topk_prob); False keeps softmax mass
     moe_eval_capacity_factor: Optional[float] = None  # None → capacity_factor
 
     @property
@@ -379,7 +381,8 @@ class CausalTransformerLM:
                                       is not None
                                       else config.moe_capacity_factor),
                 min_capacity=config.moe_min_capacity,
-                noisy_gate_policy=config.moe_noisy_gate_policy)
+                noisy_gate_policy=config.moe_noisy_gate_policy,
+                norm_topk_prob=config.moe_norm_topk_prob)
 
     def _is_moe_layer(self, i: int) -> bool:
         # reference convention: every Nth layer hosts experts (freq=2 →
@@ -493,6 +496,11 @@ class CausalTransformerLM:
         if self.config.is_moe:
             from deepspeed_tpu.parallel.topology import EP_AXIS
             return [
+                # shared (always-on) expert first: 2-D leaves that the
+                # 3-D expert patterns below must not capture
+                (r"moe.*shared.*wg", P()),
+                (r"moe.*shared.*(w_gate|w_up)", P(None, TP_AXIS)),
+                (r"moe.*shared.*w_down", P(TP_AXIS, None)),
                 # expert biases first (the weight patterns would match them)
                 (r"moe.*w_up_b", P(EP_AXIS, TP_AXIS)),
                 (r"moe.*w_down_b", P(EP_AXIS, None)),
@@ -666,6 +674,15 @@ class CausalTransformerLM:
             moe_out, l_aux, _ = moe_layer_forward(
                 self.gate, {"wg": layer["moe"]["wg"]}, layer["moe"],
                 expert_fn, h, train=train, rng=rng)
+            if "shared" in layer["moe"]:
+                # Qwen2-MoE: an always-on SwiGLU expert scaled by a
+                # sigmoid gate rides beside the routed experts
+                sh = layer["moe"]["shared"]
+                inner = jax.nn.silu(h @ sh["w_gate"]) * (h @ sh["w_up"])
+                shared_out = inner @ sh["w_down"]
+                sg = jax.nn.sigmoid(
+                    (h @ sh["wg"]).astype(jnp.float32)).astype(h.dtype)
+                moe_out = moe_out + sg * shared_out
             return moe_out, l_aux
         act = _ACTIVATIONS[c.activation]
         if c.gated:

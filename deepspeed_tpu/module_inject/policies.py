@@ -1288,6 +1288,105 @@ class Phi3Policy(InjectionPolicy):
         return cfg, params
 
 
+class Qwen2MoEPolicy(InjectionPolicy):
+    """HF ``Qwen2MoeForCausalLM``: qwen2 attention (q/k/v biases) +
+    per-layer top-k MoE (``norm_topk_prob`` honored — qwen2-moe ships
+    False, i.e. raw softmax mass) + an always-on SHARED SwiGLU expert
+    scaled by a sigmoid gate (``shared_expert_gate``), served through
+    this repo's general ``topkgating``.  Heterogeneous layer layouts
+    (``decoder_sparse_step != 1`` / ``mlp_only_layers``) are guarded."""
+
+    model_types = ("qwen2_moe",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "decoder_sparse_step", 1) != 1 or \
+                list(getattr(hf_config, "mlp_only_layers", []) or []):
+            raise ValueError(
+                "qwen2_moe with decoder_sparse_step != 1 or mlp_only_layers "
+                "(mixed dense/MoE stacks beyond every-Nth) is not "
+                "supported yet")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        E = hf.num_experts
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.moe_intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 1e6)),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True,
+            moe_num_experts=E, moe_top_k=hf.num_experts_per_tok,
+            moe_layer_freq=1,
+            moe_norm_topk_prob=bool(getattr(hf, "norm_topk_prob", False)),
+            moe_eval_capacity_factor=float(E),
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+
+        def experts(i, which):                     # [E, in, out]
+            return np.stack([
+                _np(sd[pre.format(i) +
+                       f"mlp.experts.{e}.{which}.weight"]).T
+                for e in range(E)])
+
+        layers = []
+        for i in range(L):
+            lay = {
+                "attn_norm": _np(sd[pre.format(i) +
+                                    "input_layernorm.weight"]),
+                "wq": _np(sd[pre.format(i) +
+                             "self_attn.q_proj.weight"]).T,
+                "wq_b": _np(sd[pre.format(i) + "self_attn.q_proj.bias"]),
+                "wk": _np(sd[pre.format(i) +
+                             "self_attn.k_proj.weight"]).T,
+                "wk_b": _np(sd[pre.format(i) + "self_attn.k_proj.bias"]),
+                "wv": _np(sd[pre.format(i) +
+                             "self_attn.v_proj.weight"]).T,
+                "wv_b": _np(sd[pre.format(i) + "self_attn.v_proj.bias"]),
+                "wo": _np(sd[pre.format(i) +
+                             "self_attn.o_proj.weight"]).T,
+                "mlp_norm": _np(sd[pre.format(i) +
+                                   "post_attention_layernorm.weight"]),
+                "moe": {
+                    "wg": _np(sd[pre.format(i) + "mlp.gate.weight"]).T,
+                    "w_gate": experts(i, "gate_proj"),
+                    "w_up": experts(i, "up_proj"),
+                    "w_down": experts(i, "down_proj"),
+                    "shared": {
+                        "wg": _np(sd[pre.format(i) +
+                                     "mlp.shared_expert_gate.weight"]).T,
+                        "w_gate": _np(sd[pre.format(i) +
+                                         "mlp.shared_expert.gate_proj"
+                                         ".weight"]).T,
+                        "w_up": _np(sd[pre.format(i) +
+                                       "mlp.shared_expert.up_proj"
+                                       ".weight"]).T,
+                        "w_down": _np(sd[pre.format(i) +
+                                         "mlp.shared_expert.down_proj"
+                                         ".weight"]).T,
+                    },
+                },
+            }
+            layers.append(lay)
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class Gemma2Policy(InjectionPolicy):
     """HF ``Gemma2ForCausalLM``: Gemma wiring plus four twists — tanh
     softcapping of attention scores AND final logits
@@ -1653,7 +1752,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
-                                GPTBigCodePolicy, CodeGenPolicy,
+                                Qwen2MoEPolicy, GPTBigCodePolicy,
+                                CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

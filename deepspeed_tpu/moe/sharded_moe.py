@@ -166,13 +166,96 @@ def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
                       slots=slots, gate_vals=gate_vals, capacity=C)
 
 
+def topkgating(logits, k: int, capacity_factor=1.0, min_capacity=4,
+               norm_topk=True, build_dense=True, drop_tokens=True,
+               noisy_gate_policy=None, rng=None) -> GateOutput:
+    """General top-k gating (k statically unrolled; the reference stops
+    at k=2, but the modern MoE zoo — Qwen2-MoE/DBRX/OLMoE — routes top-4
+    to top-8).  Same machinery as :func:`top2gating`: per-rank masked
+    argmax, slot priority = (choice rank, token order), capacity
+    ``tokens/E * cf * k``, aux loss from the rank-1 assignment, and
+    ``norm_topk`` renormalizes over SURVIVING assignments (post-drop,
+    like top2gating / the reference; Mixtral / Qwen2-MoE
+    ``norm_topk_prob``).  False keeps raw softmax mass.
+    ``drop_tokens=False`` sets C=tokens (an expert can never queue more
+    than one assignment per token).  ``noisy_gate_policy`` perturbs the
+    SELECTION logits only (RSample gumbel / Jitter), like top1gating."""
+    tokens, E = logits.shape
+    C = _capacity(tokens, E, capacity_factor * float(k), min_capacity)
+    if not drop_tokens:
+        C = tokens
+
+    logits = logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        select = logits + jax.random.gumbel(rng, logits.shape)
+    elif noisy_gate_policy == "Jitter" and rng is not None:
+        select = logits * jax.random.uniform(rng, logits.shape,
+                                             minval=0.98, maxval=1.02)
+    else:
+        select = logits
+
+    masks, idxs = [], []
+    masked = select
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        m = _one_hot(idx, E)
+        idxs.append(idx)
+        masks.append(m)
+        masked = jnp.where(m > 0, -jnp.inf, masked)
+
+    exp_counts = sum(jnp.sum(m, axis=0) for m in masks)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    prev_counts = jnp.zeros((E,), jnp.float32)
+    keeps, locs, kept_flags = [], [], []
+    for m in masks:
+        pos_in_expert = jnp.cumsum(m, axis=0) - m + prev_counts[None]
+        p = jnp.sum(pos_in_expert * m, axis=-1)
+        keep = (p < C)[:, None] * m
+        keeps.append(keep)
+        locs.append(p)
+        kept_flags.append(jnp.sum(keep, axis=-1) > 0)
+        prev_counts = prev_counts + jnp.sum(m, axis=0)
+
+    # gate mass from SURVIVING assignments; renormalize after the drop
+    g_list = [jnp.sum(gates * keep, axis=-1) for keep in keeps]
+    if norm_topk:
+        denom = jnp.maximum(sum(g_list), 1e-9)
+        g_list = [g / denom for g in g_list]
+
+    slot_cols = [jnp.where(kept, idx.astype(jnp.int32) * C
+                           + p.astype(jnp.int32), E * C)
+                 for idx, p, kept in zip(idxs, locs, kept_flags)]
+    gval_cols = [g * kept for g, kept in zip(g_list, kept_flags)]
+    slots = jnp.stack(slot_cols, axis=1)
+    gate_vals = jnp.stack(gval_cols, axis=1)
+    if not build_dense:
+        return GateOutput(l_aux=l_aux, combine_weights=None,
+                          dispatch_mask=None, exp_counts=exp_counts,
+                          slots=slots, gate_vals=gate_vals, capacity=C)
+    combine = sum(
+        g[:, None, None] * keep[:, :, None]
+        * _one_hot(p.astype(jnp.int32), C)[:, None, :]
+        for g, keep, p in zip(g_list, keeps, locs))
+    dispatch = combine > 0
+    return GateOutput(l_aux=l_aux, combine_weights=combine,
+                      dispatch_mask=dispatch, exp_counts=exp_counts,
+                      slots=slots, gate_vals=gate_vals, capacity=C)
+
+
 class TopKGate:
     """Parity shim of reference ``TopKGate:351`` as a functional object."""
 
     def __init__(self, model_dim, num_experts, k=1, capacity_factor=1.0,
                  eval_capacity_factor=1.0, min_capacity=4,
-                 noisy_gate_policy=None, drop_tokens=True):
-        assert k in (1, 2), "only top-1 and top-2 gating are supported"
+                 noisy_gate_policy=None, drop_tokens=True,
+                 norm_topk_prob=True):
+        assert 1 <= k <= num_experts, (k, num_experts)
+        self.norm_topk_prob = norm_topk_prob
         self.model_dim = model_dim
         self.num_experts = num_experts
         self.k = k
@@ -196,11 +279,21 @@ class TopKGate:
                               self.noisy_gate_policy if train else None,
                               rng=rng, drop_tokens=self.drop_tokens,
                               build_dense=build_dense)
-        # second-expert sampling noise only during training (eval must be
-        # deterministic, matching the top-1 path)
-        return top2gating(logits, cf, self.min_capacity,
-                          rng=rng if train else None,
-                          build_dense=build_dense)
+        if self.k == 2 and self.norm_topk_prob:
+            # second-expert sampling noise only during training (eval must
+            # be deterministic, matching the top-1 path)
+            return top2gating(logits, cf, self.min_capacity,
+                              rng=rng if train else None,
+                              build_dense=build_dense)
+        # k > 2 (or k=2 without renormalization): Qwen2-MoE/DBRX-era
+        # routing; selection noise only during training
+        return topkgating(logits, self.k, cf, self.min_capacity,
+                          norm_topk=self.norm_topk_prob,
+                          build_dense=build_dense,
+                          drop_tokens=self.drop_tokens,
+                          noisy_gate_policy=(self.noisy_gate_policy
+                                             if train else None),
+                          rng=rng if train else None)
 
 
 def moe_layer_forward(gate: TopKGate, gate_params, expert_params, expert_fn,

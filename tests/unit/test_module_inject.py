@@ -785,3 +785,45 @@ def test_unsupported_rope_scaling_raises():
     from deepspeed_tpu.module_inject.policies import LlamaPolicy
     with pytest.raises(ValueError, match="rope_scaling"):
         LlamaPolicy.build(cfg, {})
+
+
+def test_qwen2_moe_conversion_matches_hf():
+    """Qwen2-MoE: top-4 routing WITHOUT renormalization (norm_topk_prob
+    =False keeps raw softmax mass) + an always-on shared SwiGLU expert
+    scaled by a sigmoid gate — logit-exact under non-dropping capacity."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=8, num_experts_per_tok=4, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.moe_top_k == 4 and not c.moe_norm_topk_prob
+    assert "shared" in params["layers"][0]["moe"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_qwen2_moe_norm_topk_variant():
+    """norm_topk_prob=True variant must also match (renormalized path)."""
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=56,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(1)
+    hf = transformers.Qwen2MoeForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_qwen2_moe_sparse_step_guard():
+    with pytest.raises(ValueError, match="decoder_sparse_step"):
+        find_policy(transformers.Qwen2MoeConfig(decoder_sparse_step=2))

@@ -186,3 +186,115 @@ def test_compact_gating_slots_consistent_with_dense():
     compact = np.asarray(dense.slots).reshape(-1)
     kept = sorted(s for s in compact if s < mask.shape[1] * C)
     assert kept == dense_slots
+
+
+def test_topkgating_k2_matches_top2gating():
+    """topkgating(k=2, norm) must agree with the GShard top2gating path
+    (deterministic, no sampling noise): same slots, gate values, aux."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import top2gating, topkgating
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(24, 4)), jnp.float32)
+    a = top2gating(logits, capacity_factor=4.0, rng=None)
+    b = topkgating(logits, 2, capacity_factor=4.0, norm_topk=True)
+    np.testing.assert_array_equal(np.asarray(a.slots), np.asarray(b.slots))
+    np.testing.assert_allclose(np.asarray(a.gate_vals),
+                               np.asarray(b.gate_vals), rtol=1e-6)
+    np.testing.assert_allclose(float(a.l_aux), float(b.l_aux), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.combine_weights),
+                               np.asarray(b.combine_weights), rtol=1e-6)
+
+
+def test_topkgating_k4_routes_to_four_distinct_experts():
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import topkgating
+    rng = np.random.default_rng(1)
+    E, T = 8, 16
+    logits = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    out = topkgating(logits, 4, capacity_factor=float(E), norm_topk=True)
+    C = out.capacity
+    experts = np.asarray(out.slots) // C          # [T, 4]
+    for t in range(T):
+        es = experts[t][np.asarray(out.slots)[t] < E * C]
+        assert len(set(es.tolist())) == len(es)   # distinct experts
+        # the chosen 4 are exactly the 4 highest-softmax experts
+        top4 = set(np.argsort(-np.asarray(logits[t]))[:4].tolist())
+        assert set(es.tolist()) == top4
+    # renormalized weights sum to 1 where nothing dropped
+    np.testing.assert_allclose(np.asarray(out.gate_vals).sum(-1),
+                               np.ones(T), rtol=1e-5)
+
+
+def test_topkgating_no_norm_keeps_softmax_mass():
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import topkgating
+    rng = np.random.default_rng(2)
+    logits = jnp.asarray(rng.normal(size=(12, 6)), jnp.float32)
+    out = topkgating(logits, 3, capacity_factor=6.0, norm_topk=False)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    top3_mass = np.sort(probs, axis=-1)[:, -3:].sum(-1)
+    np.testing.assert_allclose(np.asarray(out.gate_vals).sum(-1),
+                               top3_mass, rtol=1e-5)
+
+
+def test_topkgating_scatter_equals_einsum_dispatch():
+    """The compact scatter routing and the dense einsum oracle must
+    produce identical MoE outputs for k=4 too."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_layer_forward
+    rng = np.random.default_rng(3)
+    D, E, T = 16, 8, 12
+    gate = TopKGate(D, E, k=4, capacity_factor=float(E))
+    gp = gate.init(jax.random.key(0))
+    ep = {"w_up": jnp.asarray(rng.normal(size=(E, D, 32)) * 0.1,
+                              jnp.float32),
+          "w_down": jnp.asarray(rng.normal(size=(E, 32, D)) * 0.1,
+                                jnp.float32)}
+
+    def expert_fn(epp, dispatched):
+        return jnp.einsum(
+            "ecf,efd->ecd",
+            jax.nn.gelu(jnp.einsum("ecd,edf->ecf", dispatched,
+                                   epp["w_up"])), epp["w_down"])
+
+    x = jnp.asarray(rng.normal(size=(1, T, D)), jnp.float32)
+    a, la, _ = moe_layer_forward(gate, gp, ep, expert_fn, x, train=False,
+                                 dispatch_impl="scatter")
+    b, lb, _ = moe_layer_forward(gate, gp, ep, expert_fn, x, train=False,
+                                 dispatch_impl="einsum")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+
+
+def test_topkgating_renormalizes_over_survivors_after_drop():
+    """With a binding capacity and a dropped assignment, surviving gate
+    values renormalize over the SURVIVORS (top2gating / reference
+    semantics), not the pre-drop denominator."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import topkgating
+    # 4 tokens, 2 experts, all tokens prefer expert 0 then 1; capacity 2
+    logits = jnp.asarray([[2.0, 1.0]] * 4, jnp.float32)
+    out = topkgating(logits, 2, capacity_factor=1.0, min_capacity=1,
+                     norm_topk=True)
+    gv = np.asarray(out.gate_vals)
+    slots = np.asarray(out.slots)
+    C = out.capacity
+    dropped = slots == 2 * C
+    # tokens with one dropped assignment: the survivor carries weight 1.0
+    for t in range(4):
+        alive = gv[t][~dropped[t]]
+        if dropped[t].any() and alive.size:
+            np.testing.assert_allclose(alive.sum(), 1.0, rtol=1e-5)
+
+
+def test_topkgating_drop_tokens_false_keeps_everything():
+    import jax.numpy as jnp
+    from deepspeed_tpu.moe.sharded_moe import topkgating
+    rng = np.random.default_rng(5)
+    T, E = 16, 4
+    logits = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    out = topkgating(logits, 3, capacity_factor=0.25, min_capacity=1,
+                     drop_tokens=False)
+    assert out.capacity == T
+    assert not (np.asarray(out.slots) == E * out.capacity).any()
