@@ -1288,6 +1288,70 @@ class Phi3Policy(InjectionPolicy):
         return cfg, params
 
 
+class OlmoPolicy(InjectionPolicy):
+    """HF ``OlmoForCausalLM``: llama wiring under NON-PARAMETRIC
+    LayerNorm (no weight, no bias — converted as all-ones weights),
+    SwiGLU, RoPE, untied head.  ``clip_qkv`` checkpoints are guarded
+    (the post-projection clamp is not implemented)."""
+
+    model_types = ("olmo",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "clip_qkv", None):
+            raise ValueError(
+                "olmo clip_qkv is not supported — the converted model "
+                "would silently skip the QKV clamp")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            rope_inv_freq=_rope_scaled_inv_freq(hf, d // H),
+            norm_eps=1e-5, activation="silu",
+            use_rmsnorm=False, norm_bias=False, use_rope=True,
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        ones = np.ones((L, d), np.float32)
+        layers = {
+            # non-parametric LayerNorms → identity weights
+            "attn_norm": ones, "mlp_norm": ones.copy(),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": np.ones((d,), np.float32),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class Qwen2MoEPolicy(InjectionPolicy):
     """HF ``Qwen2MoeForCausalLM``: qwen2 attention (q/k/v biases) +
     per-layer top-k MoE (``norm_topk_prob`` honored — qwen2-moe ships
@@ -1752,8 +1816,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
-                                Qwen2MoEPolicy, GPTBigCodePolicy,
-                                CodeGenPolicy,
+                                Qwen2MoEPolicy, OlmoPolicy,
+                                GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

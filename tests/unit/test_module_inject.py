@@ -827,3 +827,24 @@ def test_qwen2_moe_norm_topk_variant():
 def test_qwen2_moe_sparse_step_guard():
     with pytest.raises(ValueError, match="decoder_sparse_step"):
         find_policy(transformers.Qwen2MoeConfig(decoder_sparse_step=2))
+
+
+def test_olmo_conversion_matches_hf():
+    """OLMo: llama wiring under non-parametric LayerNorm (identity
+    weights at conversion)."""
+    hf_cfg = transformers.OlmoConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, clip_qkv=None,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.OlmoForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert not model.config.use_rmsnorm
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_olmo_clip_qkv_guard():
+    with pytest.raises(ValueError, match="clip_qkv"):
+        find_policy(transformers.OlmoConfig(clip_qkv=8.0))
