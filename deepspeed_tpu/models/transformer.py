@@ -71,6 +71,8 @@ class TransformerConfig:
     #                                         uses 1.0 instead of 1/sqrt(dh))
     local_attn_pattern: Optional[Tuple[int, ...]] = None  # per-layer sliding
     #                window (0 = global); GPT-Neo alternates (0, 256, 0, ...)
+    clip_qkv: Optional[float] = None        # clamp q/k/v projections to
+    #   [-clip, clip] pre-rope (OLMo / MPT-30b / DBRX lineage)
     attn_logit_softcap: Optional[float] = None   # tanh-cap raw attention
     #                scores (Gemma-2); runs the XLA attention path
     final_logit_softcap: Optional[float] = None  # tanh-cap LM-head logits
@@ -540,6 +542,12 @@ class CausalTransformerLM:
         q = self._proj(h, layer, "wq").reshape(B, S, H, dh)
         k = self._proj(h, layer, "wk").reshape(B, S, Hkv, dh)
         v = self._proj(h, layer, "wv").reshape(B, S, Hkv, dh)
+        if c.clip_qkv:
+            # OLMo / MPT-30b / DBRX: clamp the projections pre-rope
+            lim = jnp.asarray(c.clip_qkv, q.dtype)
+            q = jnp.clip(q, -lim, lim)
+            k = jnp.clip(k, -lim, lim)
+            v = jnp.clip(v, -lim, lim)
         if c.use_rope:
             q = _rope(q, positions, c.rope_theta, c.rope_dim,
                       inv_freq=c.rope_inv_freq)

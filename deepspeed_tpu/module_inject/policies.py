@@ -1291,20 +1291,10 @@ class Phi3Policy(InjectionPolicy):
 class OlmoPolicy(InjectionPolicy):
     """HF ``OlmoForCausalLM``: llama wiring under NON-PARAMETRIC
     LayerNorm (no weight, no bias — converted as all-ones weights),
-    SwiGLU, RoPE, untied head.  ``clip_qkv`` checkpoints are guarded
-    (the post-projection clamp is not implemented)."""
+    SwiGLU, RoPE, untied head, optional pre-rope QKV clamp
+    (``clip_qkv``)."""
 
     model_types = ("olmo",)
-
-    @classmethod
-    def matches(cls, hf_config) -> bool:
-        if getattr(hf_config, "model_type", None) not in cls.model_types:
-            return False
-        if getattr(hf_config, "clip_qkv", None):
-            raise ValueError(
-                "olmo clip_qkv is not supported — the converted model "
-                "would silently skip the QKV clamp")
-        return True
 
     @classmethod
     def build(cls, hf, sd):
@@ -1318,6 +1308,8 @@ class OlmoPolicy(InjectionPolicy):
             max_seq_len=hf.max_position_embeddings,
             rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
             rope_inv_freq=_rope_scaled_inv_freq(hf, d // H),
+            clip_qkv=(float(hf.clip_qkv) if getattr(hf, "clip_qkv", None)
+                      else None),
             norm_eps=1e-5, activation="silu",
             use_rmsnorm=False, norm_bias=False, use_rope=True,
             tie_embeddings=tied, remat=False)

@@ -845,6 +845,17 @@ def test_olmo_conversion_matches_hf():
     _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
 
 
-def test_olmo_clip_qkv_guard():
-    with pytest.raises(ValueError, match="clip_qkv"):
-        find_policy(transformers.OlmoConfig(clip_qkv=8.0))
+def test_olmo_clip_qkv_matches_hf():
+    """clip_qkv clamps the q/k/v projections pre-rope; pick a tight clip
+    so the clamp actually engages."""
+    hf_cfg = transformers.OlmoConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, clip_qkv=0.02,
+        tie_word_embeddings=False)
+    torch.manual_seed(3)
+    hf = transformers.OlmoForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert model.config.clip_qkv == 0.02
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
