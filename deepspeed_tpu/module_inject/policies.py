@@ -1288,6 +1288,94 @@ class Phi3Policy(InjectionPolicy):
         return cfg, params
 
 
+class DbrxPolicy(InjectionPolicy):
+    """HF ``DbrxForCausalLM``: fused ``Wqkv`` with a mandatory pre-rope
+    clamp (``clip_qkv``), biasless LayerNorms, and top-4 MoE whose
+    experts are PACKED tensors ``w1/v1/w2 [E·f, d]`` (w1=gate, v1=up —
+    both used transposed; w2=down used untransposed, i.e. already this
+    repo's ``[E, f, d]`` layout).  Router renormalization
+    ``moe_normalize_expert_weights=1`` is exactly ``topkgating``'s
+    sum-renorm; other p-norms are guarded."""
+
+    model_types = ("dbrx",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        ffn = getattr(hf_config, "ffn_config", None)
+        p = getattr(ffn, "moe_normalize_expert_weights", 1.0) \
+            if ffn is not None else 1.0
+        if p is not None and float(p) != 1.0:
+            raise ValueError(
+                "dbrx moe_normalize_expert_weights != 1 (p-norm "
+                "renormalization) is not supported; 1 (sum) and None "
+                "(no renorm) convert")
+        act = getattr(ffn, "ffn_act_fn", None) if ffn is not None else None
+        if act and act.get("name", "silu") != "silu":
+            raise ValueError("dbrx non-silu expert activation is not "
+                             "supported yet")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.d_model, hf.n_layers, hf.n_heads
+        dh = d // H
+        ac, fc = hf.attn_config, hf.ffn_config
+        n_kv = ac.kv_n_heads
+        E, f = fc.moe_num_experts, fc.ffn_hidden_size
+        renorm = fc.moe_normalize_expert_weights is not None
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=f, max_seq_len=hf.max_seq_len,
+            rope_theta=float(getattr(ac, "rope_theta", 5e5)),
+            clip_qkv=(float(ac.clip_qkv) if ac.clip_qkv else None),
+            norm_eps=1e-5, activation="silu",
+            use_rmsnorm=False, norm_bias=False, use_rope=True,
+            moe_num_experts=E, moe_top_k=fc.moe_top_k, moe_layer_freq=1,
+            moe_norm_topk_prob=renorm,
+            moe_eval_capacity_factor=float(E),
+            tie_embeddings=bool(getattr(hf, "tie_word_embeddings", False)),
+            remat=False)
+
+        pre = "transformer.blocks.{}."
+        layers = []
+        for i in range(L):
+            qkv = _np(sd[pre.format(i) + "norm_attn_norm.attn.Wqkv.weight"])
+            w1 = _np(sd[pre.format(i) + "ffn.experts.mlp.w1"])
+            v1 = _np(sd[pre.format(i) + "ffn.experts.mlp.v1"])
+            w2 = _np(sd[pre.format(i) + "ffn.experts.mlp.w2"])
+            layers.append({
+                "attn_norm": _np(sd[pre.format(i) +
+                                    "norm_attn_norm.norm_1.weight"]),
+                "wq": qkv[:H * dh].T,
+                "wk": qkv[H * dh:(H + n_kv) * dh].T,
+                "wv": qkv[(H + n_kv) * dh:].T,
+                "wo": _np(sd[pre.format(i) +
+                             "norm_attn_norm.attn.out_proj.weight"]).T,
+                "mlp_norm": _np(sd[pre.format(i) +
+                                   "norm_attn_norm.norm_2.weight"]),
+                "moe": {
+                    "wg": _np(sd[pre.format(i) +
+                                 "ffn.router.layer.weight"]).T,
+                    # packed [E*f, d]: gate/up transpose per expert,
+                    # down is already [E, f, d]
+                    "w_gate": w1.reshape(E, f, d).transpose(0, 2, 1),
+                    "w_up": v1.reshape(E, f, d).transpose(0, 2, 1),
+                    "w_down": w2.reshape(E, f, d),
+                },
+            })
+        params = {
+            "tok_embed": _np(sd["transformer.wte.weight"]),
+            "final_norm": _np(sd["transformer.norm_f.weight"]),
+            "layers": layers,
+        }
+        if "lm_head.weight" in sd:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class OlmoPolicy(InjectionPolicy):
     """HF ``OlmoForCausalLM``: llama wiring under NON-PARAMETRIC
     LayerNorm (no weight, no bias — converted as all-ones weights),
@@ -1808,7 +1896,7 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
-                                Qwen2MoEPolicy, OlmoPolicy,
+                                Qwen2MoEPolicy, OlmoPolicy, DbrxPolicy,
                                 GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 

@@ -859,3 +859,32 @@ def test_olmo_clip_qkv_matches_hf():
     assert model.config.clip_qkv == 0.02
     ids = _ids(96)
     _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_dbrx_conversion_matches_hf():
+    """DBRX: fused Wqkv + mandatory clip, packed w1/v1/w2 expert tensors,
+    top-2-of-4 sum-renormalized routing."""
+    DbrxAttnCfg = transformers.models.dbrx.configuration_dbrx \
+        .DbrxAttentionConfig
+    DbrxFFNCfg = transformers.models.dbrx.configuration_dbrx.DbrxFFNConfig
+    hf_cfg = transformers.DbrxConfig(
+        vocab_size=96, d_model=32, n_heads=4, n_layers=2, max_seq_len=64,
+        attn_config=DbrxAttnCfg(clip_qkv=0.05, kv_n_heads=2,
+                                rope_theta=10000.0),
+        ffn_config=DbrxFFNCfg(ffn_hidden_size=48, moe_num_experts=4,
+                              moe_top_k=2,
+                              moe_normalize_expert_weights=1.0))
+    torch.manual_seed(0)
+    hf = transformers.DbrxForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.clip_qkv == 0.05 and c.moe_top_k == 2 and c.moe_norm_topk_prob
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_dbrx_pnorm_guard():
+    DbrxFFNCfg = transformers.models.dbrx.configuration_dbrx.DbrxFFNConfig
+    with pytest.raises(ValueError, match="normalize_expert_weights"):
+        find_policy(transformers.DbrxConfig(
+            ffn_config=DbrxFFNCfg(moe_normalize_expert_weights=2.0)))
