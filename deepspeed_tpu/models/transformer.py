@@ -76,6 +76,8 @@ class TransformerConfig:
     attn_logit_softcap: Optional[float] = None   # tanh-cap raw attention
     #                scores (Gemma-2); runs the XLA attention path
     final_logit_softcap: Optional[float] = None  # tanh-cap LM-head logits
+    final_logit_scale: Optional[float] = None    # multiply LM-head logits
+    #   (Cohere logit_scale); applied before any softcap
     tie_embeddings: bool = False
     remat: bool = True
     remat_policy: str = "nothing_saveable"
@@ -257,7 +259,7 @@ def _softcap(logits, cap):
 
 
 def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int,
-                            logit_softcap=None):
+                            logit_softcap=None, logit_scale=None):
     """Next-token cross-entropy WITHOUT materializing the full fp32
     ``[B, S, V]`` logits tensor: the flattened token stream is processed in
     ``chunk_size``-token chunks under a remat'd ``lax.scan`` — each chunk's
@@ -310,6 +312,8 @@ def chunked_next_token_xent(x, head, head_b, batch, chunk_size: int,
         logits = (xc @ head_c).astype(jnp.float32)
         if bias32 is not None:
             logits = logits + bias32
+        if logit_scale is not None:
+            logits = logits * logit_scale
         logits = _softcap(logits, logit_softcap)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         ll = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
@@ -779,6 +783,8 @@ class CausalTransformerLM:
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
+        if c.final_logit_scale is not None:   # Cohere logit_scale
+            logits = logits * c.final_logit_scale
         logits = _softcap(logits, c.final_logit_softcap)
         if return_aux:
             return logits, aux
@@ -900,6 +906,8 @@ class CausalTransformerLM:
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
+        if c.final_logit_scale is not None:   # Cohere logit_scale
+            logits = logits * c.final_logit_scale
         logits = _softcap(logits, c.final_logit_softcap)
         return logits, out_caches
 
@@ -1000,6 +1008,8 @@ class CausalTransformerLM:
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
         if "lm_head_b" in params:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
+        if c.final_logit_scale is not None:   # Cohere logit_scale
+            logits = logits * c.final_logit_scale
         logits = _softcap(logits, c.final_logit_softcap)
         return logits, PagedKVCache(k_pages=new_k, v_pages=new_v), \
             lengths + T
@@ -1017,7 +1027,8 @@ class CausalTransformerLM:
                     else params["lm_head"])
             ce = chunked_next_token_xent(x, head, params.get("lm_head_b"),
                                          batch, c.loss_chunk_size,
-                                         logit_softcap=c.final_logit_softcap)
+                                         logit_softcap=c.final_logit_softcap,
+                                         logit_scale=c.final_logit_scale)
         else:
             logits, aux = self.apply(params, input_ids, rng=rng,
                                      return_aux=True)

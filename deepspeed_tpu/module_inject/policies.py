@@ -1288,6 +1288,75 @@ class Phi3Policy(InjectionPolicy):
         return cfg, params
 
 
+class CoherePolicy(InjectionPolicy):
+    """HF ``CohereForCausalLM`` (Command-R): parallel attn+MLP residual
+    sharing ONE biasless LayerNorm (GPT-J duplication), INTERLEAVED
+    rotary folded into the wq/wk column permutation, SwiGLU, tied
+    embeddings with a ``logit_scale`` multiplier on the head
+    (``final_logit_scale``).  ``use_qk_norm`` checkpoints are guarded."""
+
+    model_types = ("cohere",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "use_qk_norm", False):
+            raise ValueError("cohere use_qk_norm is not supported yet")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        perm = _interleaved_to_half_rope_perm(dh, dh)
+
+        def rot_cols(name, i, heads):
+            w = _np(sd[f"model.layers.{i}.self_attn.{name}.weight"]).T
+            return w.reshape(d, heads, dh)[:, :, perm].reshape(d, heads * dh)
+
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            norm_eps=hf.layer_norm_eps, activation="silu",
+            use_rmsnorm=False, norm_bias=False, use_rope=True,
+            parallel_block=True,
+            final_logit_scale=float(hf.logit_scale),
+            tie_embeddings=bool(getattr(hf, "tie_word_embeddings", True)),
+            remat=False)
+
+        pre = "model.layers.{}."
+        ln = _stack(sd, pre + "input_layernorm.weight", L)
+        layers = {
+            # one LN feeds both parallel branches (GPT-J duplication)
+            "attn_norm": ln, "mlp_norm": ln.copy(),
+            "wq": np.stack([rot_cols("q_proj", i, H) for i in range(L)]),
+            "wk": np.stack([rot_cols("k_proj", i, n_kv) for i in range(L)]),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class DbrxPolicy(InjectionPolicy):
     """HF ``DbrxForCausalLM``: fused ``Wqkv`` with a mandatory pre-rope
     clamp (``clip_qkv``), biasless LayerNorms, and top-4 MoE whose
@@ -1897,7 +1966,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
                                 Qwen2MoEPolicy, OlmoPolicy, DbrxPolicy,
-                                GPTBigCodePolicy, CodeGenPolicy,
+                                CoherePolicy, GPTBigCodePolicy,
+                                CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

@@ -888,3 +888,40 @@ def test_dbrx_pnorm_guard():
     with pytest.raises(ValueError, match="normalize_expert_weights"):
         find_policy(transformers.DbrxConfig(
             ffn_config=DbrxFFNCfg(moe_normalize_expert_weights=2.0)))
+
+
+def test_cohere_conversion_matches_hf():
+    """Cohere/Command-R: parallel block on one biasless LayerNorm,
+    INTERLEAVED rotary (column-permutation fold), logit_scale on the
+    tied head."""
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.25, use_qk_norm=False,
+        tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.CohereForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.parallel_block and c.final_logit_scale == 0.25
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_cohere_qk_norm_guard():
+    with pytest.raises(ValueError, match="qk_norm"):
+        find_policy(transformers.CohereConfig(use_qk_norm=True))
+
+
+def test_cohere_untied_head_matches_hf():
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.5, use_qk_norm=False,
+        tie_word_embeddings=False)
+    torch.manual_seed(2)
+    hf = transformers.CohereForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    assert "lm_head" in params
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
