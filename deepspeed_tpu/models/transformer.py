@@ -71,6 +71,9 @@ class TransformerConfig:
     #                                         uses 1.0 instead of 1/sqrt(dh))
     local_attn_pattern: Optional[Tuple[int, ...]] = None  # per-layer sliding
     #                window (0 = global); GPT-Neo alternates (0, 256, 0, ...)
+    qk_norm: Optional[str] = None           # "rms" | "layernorm": per-head
+    #   q/k normalization over head_dim before rope (Qwen3 / qk-norm
+    #   lineages); weights ride presence-based layer keys q_norm/k_norm
     clip_qkv: Optional[float] = None        # clamp q/k/v projections to
     #   [-clip, clip] pre-rope (OLMo / MPT-30b / DBRX lineage)
     attn_logit_softcap: Optional[float] = None   # tanh-cap raw attention
@@ -422,6 +425,12 @@ class CausalTransformerLM:
         }
         if c.gated:
             layers["w_gate"] = dense(keys[6], (L, d, f), d)
+        if c.qk_norm:
+            layers["q_norm"] = jnp.ones((L, dh), dtype)
+            layers["k_norm"] = jnp.ones((L, dh), dtype)
+            if c.qk_norm == "layernorm" and c.norm_bias:
+                layers["q_norm_b"] = jnp.zeros((L, dh), dtype)
+                layers["k_norm_b"] = jnp.zeros((L, dh), dtype)
         if c.use_bias:
             for name, width in (("wq_b", H * dh), ("wk_b", Hkv * dh),
                                 ("wv_b", Hkv * dh), ("wo_b", d),
@@ -468,6 +477,12 @@ class CausalTransformerLM:
                 "wo": dense(ks[3], (H * dh, d), H * dh),
                 "mlp_norm": jnp.ones((d,), dtype),
             }
+            if c.qk_norm:
+                layer["q_norm"] = jnp.ones((dh,), dtype)
+                layer["k_norm"] = jnp.ones((dh,), dtype)
+                if c.qk_norm == "layernorm" and c.norm_bias:
+                    layer["q_norm_b"] = jnp.zeros((dh,), dtype)
+                    layer["k_norm_b"] = jnp.zeros((dh,), dtype)
             if moe:
                 layer["moe"] = {
                     "wg": dense(ks[4], (d, E), d).astype(jnp.float32),
@@ -552,6 +567,14 @@ class CausalTransformerLM:
             q = jnp.clip(q, -lim, lim)
             k = jnp.clip(k, -lim, lim)
             v = jnp.clip(v, -lim, lim)
+        if c.qk_norm:
+            # Qwen3-style per-head q/k norm over head_dim, pre-rope
+            # (weight [dh] broadcasts over [B, S, H, dh])
+            rms = c.qk_norm == "rms"
+            q = _norm(q, layer["q_norm"], c.norm_eps, rms,
+                      layer.get("q_norm_b"))
+            k = _norm(k, layer["k_norm"], c.norm_eps, rms,
+                      layer.get("k_norm_b"))
         if c.use_rope:
             q = _rope(q, positions, c.rope_theta, c.rope_dim,
                       inv_freq=c.rope_inv_freq)

@@ -1322,6 +1322,7 @@ class CoherePolicy(InjectionPolicy):
             ffn_hidden_size=hf.intermediate_size,
             max_seq_len=hf.max_position_embeddings,
             rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            rope_inv_freq=_rope_scaled_inv_freq(hf, dh),
             norm_eps=hf.layer_norm_eps, activation="silu",
             use_rmsnorm=False, norm_bias=False, use_rope=True,
             parallel_block=True,
@@ -1347,6 +1348,12 @@ class CoherePolicy(InjectionPolicy):
             "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
                              transpose=True),
         }
+        # NB: q/k biases would need the same interleave permutation as
+        # the weights; cohere ships attention_bias=False, so guard
+        if pre.format(0) + "self_attn.q_proj.bias" in sd:
+            raise ValueError(
+                "cohere attention_bias=True checkpoints are not "
+                "supported (bias would need the rotary column fold)")
         params = {
             "tok_embed": _np(sd["model.embed_tokens.weight"]),
             "final_norm": _np(sd["model.norm.weight"]),
@@ -1494,6 +1501,77 @@ class OlmoPolicy(InjectionPolicy):
         params = {
             "tok_embed": _np(sd["model.embed_tokens.weight"]),
             "final_norm": np.ones((d,), np.float32),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
+class Qwen3Policy(InjectionPolicy):
+    """HF ``Qwen3ForCausalLM``: llama wiring plus per-head RMSNorm on q
+    and k over ``head_dim`` pre-rope (``qk_norm="rms"``; weight [dh]
+    broadcasts over heads), explicit ``head_dim``, biasless linears.
+    Sliding-window variants are guarded."""
+
+    model_types = ("qwen3",)
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        if getattr(hf_config, "model_type", None) not in cls.model_types:
+            return False
+        if getattr(hf_config, "use_sliding_window", False):
+            raise ValueError(
+                "qwen3 use_sliding_window is not supported yet")
+        return True
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        dh = getattr(hf, "head_dim", None) or d // H
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            head_dim_override=(None if dh == d // H else dh),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 1e6)),
+            rope_inv_freq=_rope_scaled_inv_freq(hf, dh),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True, qk_norm="rms",
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "q_norm": _stack(sd, pre + "self_attn.q_norm.weight", L),
+            "k_norm": _stack(sd, pre + "self_attn.k_norm.weight", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight",
+                               L),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        for name, key in (("wq_b", "q_proj"), ("wk_b", "k_proj"),
+                          ("wv_b", "v_proj"), ("wo_b", "o_proj")):
+            if pre.format(0) + f"self_attn.{key}.bias" in sd:
+                layers[name] = _stack(sd, pre + f"self_attn.{key}.bias", L)
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
             "layers": layers,
         }
         if not tied:
@@ -1965,9 +2043,9 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
-                                Qwen2MoEPolicy, OlmoPolicy, DbrxPolicy,
-                                CoherePolicy, GPTBigCodePolicy,
-                                CodeGenPolicy,
+                                Qwen2MoEPolicy, Qwen3Policy, OlmoPolicy,
+                                DbrxPolicy, CoherePolicy,
+                                GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 
 

@@ -169,3 +169,18 @@ def test_bench_loss_chunk_matches_config():
     spec.loader.exec_module(bench)
     assert bench.LOSS_CHUNK_TOKENS == \
         TransformerConfig.__dataclass_fields__["loss_chunk_size"].default
+
+
+def test_qk_norm_scratch_init_trains():
+    """qk_norm must work from scratch init (not just HF conversion):
+    init materializes q_norm/k_norm and the forward consumes them."""
+    import numpy as np
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, qk_norm="rms")
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    assert params["layers"]["q_norm"].shape == (2, 16)
+    ids = jnp.asarray(np.arange(32, dtype=np.int32)[None, :])
+    logits = model.apply(params, ids, train=False)
+    assert np.isfinite(np.asarray(logits)).all()

@@ -925,3 +925,33 @@ def test_cohere_untied_head_matches_hf():
     assert "lm_head" in params
     ids = _ids(96)
     _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+
+
+def test_qwen3_conversion_matches_hf():
+    """Qwen3: per-head RMS q/k-norm over head_dim pre-rope, explicit
+    head_dim != d/H, logits AND cached greedy decode exact."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, use_sliding_window=False,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.Qwen3ForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.qk_norm == "rms" and c.head_dim == 16
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+    engine = deepspeed_tpu.init_inference(
+        model=hf, dtype="fp32", replace_with_kernel_inject=True)
+    rng = np.random.default_rng(11)
+    pid = rng.integers(0, 96, (1, 10))
+    ours = np.asarray(engine.generate(pid, max_new_tokens=6))
+    hf_out = hf.generate(torch.tensor(pid), max_new_tokens=6,
+                         do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_qwen3_sliding_guard():
+    with pytest.raises(ValueError, match="sliding"):
+        find_policy(transformers.Qwen3Config(use_sliding_window=True))
