@@ -71,9 +71,12 @@ class TransformerConfig:
     #                                         uses 1.0 instead of 1/sqrt(dh))
     local_attn_pattern: Optional[Tuple[int, ...]] = None  # per-layer sliding
     #                window (0 = global); GPT-Neo alternates (0, 256, 0, ...)
+    post_norm_only: bool = False            # OLMo2: no pre-norms; blocks
+    #   are x + post_norm(sublayer(x)) (sandwich keys only)
     qk_norm: Optional[str] = None           # "rms" | "layernorm": per-head
     #   q/k normalization over head_dim before rope (Qwen3 / qk-norm
-    #   lineages); weights ride presence-based layer keys q_norm/k_norm
+    #   lineages); "rms_flat": RMS over the whole flat projection
+    #   (OLMo2).  Weights ride presence-based layer keys q_norm/k_norm
     clip_qkv: Optional[float] = None        # clamp q/k/v projections to
     #   [-clip, clip] pre-rope (OLMo / MPT-30b / DBRX lineage)
     attn_logit_softcap: Optional[float] = None   # tanh-cap raw attention
@@ -253,6 +256,21 @@ def next_token_xent(logits, batch):
     return jnp.mean(nll)
 
 
+def _pre_norm(x, layer, key, c):
+    """Pre-sub-block norm.  Identity ONLY under ``post_norm_only``
+    (OLMo2's blocks omit the pre-norms entirely); for every other
+    architecture a missing weight stays a loud KeyError so a conversion
+    bug cannot silently run un-normalized activations."""
+    if c.post_norm_only:
+        w = layer.get(key)
+        if w is None:
+            return x
+        return _norm(x, w, c.norm_eps, c.use_rmsnorm,
+                     layer.get(key + "_b"))
+    return _norm(x, layer[key], c.norm_eps, c.use_rmsnorm,
+                 layer.get(key + "_b"))
+
+
 def _softcap(logits, cap):
     """Gemma-2 tanh capping: bounded logits, one definition for every
     head/loss path so decode can never drift from the full forward."""
@@ -426,11 +444,13 @@ class CausalTransformerLM:
         if c.gated:
             layers["w_gate"] = dense(keys[6], (L, d, f), d)
         if c.qk_norm:
-            layers["q_norm"] = jnp.ones((L, dh), dtype)
-            layers["k_norm"] = jnp.ones((L, dh), dtype)
+            qd, kd = ((H * dh, Hkv * dh) if c.qk_norm == "rms_flat"
+                      else (dh, dh))
+            layers["q_norm"] = jnp.ones((L, qd), dtype)
+            layers["k_norm"] = jnp.ones((L, kd), dtype)
             if c.qk_norm == "layernorm" and c.norm_bias:
-                layers["q_norm_b"] = jnp.zeros((L, dh), dtype)
-                layers["k_norm_b"] = jnp.zeros((L, dh), dtype)
+                layers["q_norm_b"] = jnp.zeros((L, qd), dtype)
+                layers["k_norm_b"] = jnp.zeros((L, kd), dtype)
         if c.use_bias:
             for name, width in (("wq_b", H * dh), ("wk_b", Hkv * dh),
                                 ("wv_b", Hkv * dh), ("wo_b", d),
@@ -478,11 +498,13 @@ class CausalTransformerLM:
                 "mlp_norm": jnp.ones((d,), dtype),
             }
             if c.qk_norm:
-                layer["q_norm"] = jnp.ones((dh,), dtype)
-                layer["k_norm"] = jnp.ones((dh,), dtype)
+                qd, kd = ((H * dh, Hkv * dh) if c.qk_norm == "rms_flat"
+                          else (dh, dh))
+                layer["q_norm"] = jnp.ones((qd,), dtype)
+                layer["k_norm"] = jnp.ones((kd,), dtype)
                 if c.qk_norm == "layernorm" and c.norm_bias:
-                    layer["q_norm_b"] = jnp.zeros((dh,), dtype)
-                    layer["k_norm_b"] = jnp.zeros((dh,), dtype)
+                    layer["q_norm_b"] = jnp.zeros((qd,), dtype)
+                    layer["k_norm_b"] = jnp.zeros((kd,), dtype)
             if moe:
                 layer["moe"] = {
                     "wg": dense(ks[4], (d, E), d).astype(jnp.float32),
@@ -558,8 +580,15 @@ class CausalTransformerLM:
     def _qkv(self, h, layer, B, S, positions):
         c = self.config
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
-        q = self._proj(h, layer, "wq").reshape(B, S, H, dh)
-        k = self._proj(h, layer, "wk").reshape(B, S, Hkv, dh)
+        qf = self._proj(h, layer, "wq")
+        kf = self._proj(h, layer, "wk")
+        if c.qk_norm == "rms_flat":
+            # OLMo2: RMS over the WHOLE flat projection (variance pooled
+            # across heads), weights [H*dh] / [Hkv*dh], pre-reshape
+            qf = _norm(qf, layer["q_norm"], c.norm_eps, True)
+            kf = _norm(kf, layer["k_norm"], c.norm_eps, True)
+        q = qf.reshape(B, S, H, dh)
+        k = kf.reshape(B, S, Hkv, dh)
         v = self._proj(h, layer, "wv").reshape(B, S, Hkv, dh)
         if c.clip_qkv:
             # OLMo / MPT-30b / DBRX: clamp the projections pre-rope
@@ -567,7 +596,7 @@ class CausalTransformerLM:
             q = jnp.clip(q, -lim, lim)
             k = jnp.clip(k, -lim, lim)
             v = jnp.clip(v, -lim, lim)
-        if c.qk_norm:
+        if c.qk_norm and c.qk_norm != "rms_flat":
             # Qwen3-style per-head q/k norm over head_dim, pre-rope
             # (weight [dh] broadcasts over [B, S, H, dh])
             rms = c.qk_norm == "rms"
@@ -596,8 +625,7 @@ class CausalTransformerLM:
 
     def _attn_block(self, x, layer, positions):
         c = self.config
-        h = _norm(x, layer["attn_norm"], c.norm_eps,
-                  c.use_rmsnorm, layer.get("attn_norm_b"))
+        h = _pre_norm(x, layer, "attn_norm", c)
         delta = self._attn_delta(h, layer, positions)
         if "attn_post_norm" in layer:   # Gemma-2 sandwich: norm the
             delta = _norm(delta, layer["attn_post_norm"], c.norm_eps,
@@ -672,8 +700,7 @@ class CausalTransformerLM:
     def _mlp_block(self, x, layer, rng=None, train=True):
         """Dense or MoE FFN; returns (x, aux_loss)."""
         c = self.config
-        h = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
-                  layer.get("mlp_norm_b"))
+        h = _pre_norm(x, layer, "mlp_norm", c)
         delta, aux = self._mlp_delta(h, layer, rng=rng, train=train)
         if "mlp_post_norm" in layer:    # Gemma-2 sandwich
             delta = _norm(delta, layer["mlp_post_norm"], c.norm_eps,
@@ -733,10 +760,8 @@ class CausalTransformerLM:
             # residual stream, one fused add (GPT-J shares one LN — the
             # policy duplicates it into attn_norm/mlp_norm; NeoX parallel
             # keeps two distinct LNs)
-            ha = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
-                       layer.get("attn_norm_b"))
-            hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
-                       layer.get("mlp_norm_b"))
+            ha = _pre_norm(x, layer, "attn_norm", c)
+            hm = _pre_norm(x, layer, "mlp_norm", c)
             mlp, aux = self._mlp_delta(hm, layer, rng=rng, train=train)
             return x + self._attn_delta(ha, layer, positions) + mlp, aux
         x = self._attn_block(x, layer, positions)
@@ -853,8 +878,7 @@ class CausalTransformerLM:
         c = self.config
         B, T, d = x.shape
         H, Hkv, dh = c.n_heads, c.kv_heads, c.head_dim
-        h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
-                  layer.get("attn_norm_b"))
+        h = _pre_norm(x, layer, "attn_norm", c)
         q, k, v = self._qkv(h, layer, B, T, positions)
         cache = update_cache(KVCache(k=cache_k, v=cache_v, length=length), k, v)
         bias = self._cached_attn_bias(layer, T, cache.k.shape[2],
@@ -867,8 +891,7 @@ class CausalTransformerLM:
             attn_delta = _norm(attn_delta, layer["attn_post_norm"],
                                c.norm_eps, c.use_rmsnorm)
         if c.parallel_block:
-            hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
-                       layer.get("mlp_norm_b"))
+            hm = _pre_norm(x, layer, "mlp_norm", c)
             mlp_delta, _ = self._mlp_delta(hm, layer, train=False)
             return x + attn_delta + mlp_delta, cache
         x = x + attn_delta
@@ -982,8 +1005,7 @@ class CausalTransformerLM:
 
         def body(x, inp):
             layer, ck, cv = inp
-            h = _norm(x, layer["attn_norm"], c.norm_eps, c.use_rmsnorm,
-                      layer.get("attn_norm_b"))
+            h = _pre_norm(x, layer, "attn_norm", c)
             q, k, v = self._qkv(h, layer, B, T, positions)
             cache, _ = prefill_paged(PagedKVCache(ck, cv), block_tables,
                                      lengths, k, v)
@@ -999,8 +1021,7 @@ class CausalTransformerLM:
                 attn_delta = _norm(attn_delta, layer["attn_post_norm"],
                                    c.norm_eps, c.use_rmsnorm)
             if c.parallel_block:
-                hm = _norm(x, layer["mlp_norm"], c.norm_eps, c.use_rmsnorm,
-                           layer.get("mlp_norm_b"))
+                hm = _pre_norm(x, layer, "mlp_norm", c)
                 mlp_delta, _ = self._mlp_delta(hm, layer, train=False)
                 x = x + attn_delta + mlp_delta
             else:

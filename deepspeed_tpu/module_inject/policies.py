@@ -1364,6 +1364,72 @@ class CoherePolicy(InjectionPolicy):
         return cfg, params
 
 
+class Olmo2Policy(InjectionPolicy):
+    """HF ``Olmo2ForCausalLM``: POST-norm-only blocks
+    (``x + post_attn_norm(attn(x))`` — no pre-norms at all; the layer
+    simply omits ``attn_norm``/``mlp_norm`` and ships the sandwich
+    post-norm keys) plus FLAT q/k RMSNorm over the whole projection
+    (``qk_norm="rms_flat"``, weights [H·dh]/[Hkv·dh], variance pooled
+    across heads), RMSNorm final norm, SwiGLU, RoPE, untied head."""
+
+    model_types = ("olmo2",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", False))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 5e5)),
+            rope_inv_freq=_rope_scaled_inv_freq(hf, d // H),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True, qk_norm="rms_flat",
+            post_norm_only=True,
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        layers = {
+            # NO pre-norms: post-norm keys only (post_norm_only makes
+            # the model treat the absent pre-norm weights as identity)
+            "attn_post_norm": _stack(
+                sd, pre + "post_attention_layernorm.weight", L),
+            "mlp_post_norm": _stack(
+                sd, pre + "post_feedforward_layernorm.weight", L),
+            "q_norm": _stack(sd, pre + "self_attn.q_norm.weight", L),
+            "k_norm": _stack(sd, pre + "self_attn.k_norm.weight", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        for name, key in (("wq_b", "q_proj"), ("wk_b", "k_proj"),
+                          ("wv_b", "v_proj"), ("wo_b", "o_proj")):
+            if pre.format(0) + f"self_attn.{key}.bias" in sd:
+                layers[name] = _stack(sd, pre + f"self_attn.{key}.bias", L)
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class DbrxPolicy(InjectionPolicy):
     """HF ``DbrxForCausalLM``: fused ``Wqkv`` with a mandatory pre-rope
     clamp (``clip_qkv``), biasless LayerNorms, and top-4 MoE whose
@@ -2044,7 +2110,7 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
                                 Qwen2MoEPolicy, Qwen3Policy, OlmoPolicy,
-                                DbrxPolicy, CoherePolicy,
+                                Olmo2Policy, DbrxPolicy, CoherePolicy,
                                 GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]
 

@@ -173,14 +173,20 @@ def test_bench_loss_chunk_matches_config():
 
 def test_qk_norm_scratch_init_trains():
     """qk_norm must work from scratch init (not just HF conversion):
-    init materializes q_norm/k_norm and the forward consumes them."""
+    init materializes q_norm/k_norm at the right shapes (per-head [dh]
+    vs rms_flat [H*dh]/[Hkv*dh] with GQA) and the forward consumes
+    them."""
     import numpy as np
     from deepspeed_tpu.models.transformer import (CausalTransformerLM,
                                                   TransformerConfig)
-    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, qk_norm="rms")
-    model = CausalTransformerLM(cfg)
-    params = model.init(jax.random.key(0))
-    assert params["layers"]["q_norm"].shape == (2, 16)
-    ids = jnp.asarray(np.arange(32, dtype=np.int32)[None, :])
-    logits = model.apply(params, ids, train=False)
-    assert np.isfinite(np.asarray(logits)).all()
+    for mode, qshape, kshape in (("rms", (2, 16), (2, 16)),
+                                 ("rms_flat", (2, 64), (2, 32))):
+        cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4,
+                                     n_kv_heads=2, qk_norm=mode)
+        model = CausalTransformerLM(cfg)
+        params = model.init(jax.random.key(0))
+        assert params["layers"]["q_norm"].shape == qshape, mode
+        assert params["layers"]["k_norm"].shape == kshape, mode
+        ids = jnp.asarray(np.arange(32, dtype=np.int32)[None, :])
+        logits = model.apply(params, ids, train=False)
+        assert np.isfinite(np.asarray(logits)).all(), mode

@@ -955,3 +955,30 @@ def test_qwen3_conversion_matches_hf():
 def test_qwen3_sliding_guard():
     with pytest.raises(ValueError, match="sliding"):
         find_policy(transformers.Qwen3Config(use_sliding_window=True))
+
+
+def test_olmo2_conversion_matches_hf():
+    """OLMo2: post-norm-only blocks (no pre-norms — omitted keys mean
+    identity) + flat q/k RMSNorm over the whole projection.  Logits AND
+    cached greedy decode exact."""
+    hf_cfg = transformers.Olmo2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.Olmo2ForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.qk_norm == "rms_flat"
+    assert "attn_norm" not in params["layers"]
+    assert "attn_post_norm" in params["layers"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+    engine = deepspeed_tpu.init_inference(
+        model=hf, dtype="fp32", replace_with_kernel_inject=True)
+    rng = np.random.default_rng(13)
+    pid = rng.integers(0, 96, (1, 9))
+    ours = np.asarray(engine.generate(pid, max_new_tokens=6))
+    hf_out = hf.generate(torch.tensor(pid), max_new_tokens=6,
+                         do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours, hf_out)
