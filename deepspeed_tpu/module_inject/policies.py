@@ -1574,6 +1574,72 @@ class OlmoPolicy(InjectionPolicy):
         return cfg, params
 
 
+class Starcoder2Policy(InjectionPolicy):
+    """HF ``Starcoder2ForCausalLM``: llama wiring under
+    LayerNorm-with-bias, biased linears throughout (``use_bias``),
+    tanh-GELU ``c_fc/c_proj`` MLP, RoPE, GQA, optional uniform sliding
+    window, tied embeddings."""
+
+    model_types = ("starcoder2",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", True))
+        window = getattr(hf, "sliding_window", None)
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 1e4)),
+            rope_inv_freq=_rope_scaled_inv_freq(hf, d // H),
+            norm_eps=hf.norm_epsilon, activation="gelu",
+            use_rmsnorm=False, norm_bias=True, use_rope=True,
+            use_bias=bool(getattr(hf, "use_bias", True)),
+            local_attn_pattern=((int(window),) * L if window else None),
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "attn_norm_b": _stack(sd, pre + "input_layernorm.bias", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight",
+                               L),
+            "mlp_norm_b": _stack(sd, pre + "post_attention_layernorm.bias",
+                                 L),
+            "w_up": _stack(sd, pre + "mlp.c_fc.weight", L, transpose=True),
+            "w_down": _stack(sd, pre + "mlp.c_proj.weight", L,
+                             transpose=True),
+        }
+        if getattr(hf, "use_bias", True):
+            for name, key in (("wq_b", "self_attn.q_proj"),
+                              ("wk_b", "self_attn.k_proj"),
+                              ("wv_b", "self_attn.v_proj"),
+                              ("wo_b", "self_attn.o_proj"),
+                              ("w_up_b", "mlp.c_fc"),
+                              ("w_down_b", "mlp.c_proj")):
+                layers[name] = _stack(sd, pre + key + ".bias", L)
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "final_norm_b": _np(sd["model.norm.bias"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class Qwen3Policy(InjectionPolicy):
     """HF ``Qwen3ForCausalLM``: llama wiring plus per-head RMSNorm on q
     and k over ``head_dim`` pre-rope (``qk_norm="rms"``; weight [dh]
@@ -2109,7 +2175,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 CLIPPolicy, FalconPolicy, PhiPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
-                                Qwen2MoEPolicy, Qwen3Policy, OlmoPolicy,
+                                Qwen2MoEPolicy, Qwen3Policy,
+                                Starcoder2Policy, OlmoPolicy,
                                 Olmo2Policy, DbrxPolicy, CoherePolicy,
                                 GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]

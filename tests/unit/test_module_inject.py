@@ -982,3 +982,24 @@ def test_olmo2_conversion_matches_hf():
     hf_out = hf.generate(torch.tensor(pid), max_new_tokens=6,
                          do_sample=False, pad_token_id=0).numpy()
     np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_starcoder2_conversion_matches_hf():
+    """StarCoder2: llama wiring under LayerNorm-with-bias, biased
+    linears, tanh-GELU c_fc/c_proj, uniform sliding window."""
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8, use_bias=True,
+        tie_word_embeddings=True)
+    torch.manual_seed(0)
+    hf = transformers.Starcoder2ForCausalLM(hf_cfg)
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith("proj.bias") or name.endswith("c_fc.bias"):
+                p.normal_(std=0.5)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.local_attn_pattern == (8, 8) and "wq_b" in params["layers"]
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
