@@ -71,6 +71,8 @@ class TransformerConfig:
     #                                         uses 1.0 instead of 1/sqrt(dh))
     local_attn_pattern: Optional[Tuple[int, ...]] = None  # per-layer sliding
     #                window (0 = global); GPT-Neo alternates (0, 256, 0, ...)
+    residual_scale: Optional[float] = None  # x + scale*delta on every
+    #   sub-block residual add (Granite residual_multiplier)
     post_norm_only: bool = False            # OLMo2: no pre-norms; blocks
     #   are x + post_norm(sublayer(x)) (sandwich keys only)
     qk_norm: Optional[str] = None           # "rms" | "layernorm": per-head
@@ -630,6 +632,8 @@ class CausalTransformerLM:
         if "attn_post_norm" in layer:   # Gemma-2 sandwich: norm the
             delta = _norm(delta, layer["attn_post_norm"], c.norm_eps,
                           c.use_rmsnorm)   # sub-block OUTPUT pre-residual
+        if c.residual_scale is not None:   # Granite residual_multiplier
+            delta = delta * c.residual_scale
         return x + delta
 
     def _attn_delta(self, h, layer, positions):
@@ -705,6 +709,8 @@ class CausalTransformerLM:
         if "mlp_post_norm" in layer:    # Gemma-2 sandwich
             delta = _norm(delta, layer["mlp_post_norm"], c.norm_eps,
                           c.use_rmsnorm)
+        if c.residual_scale is not None:   # Granite residual_multiplier
+            delta = delta * c.residual_scale
         return x + delta, aux
 
     def _mlp_delta(self, h, layer, rng=None, train=True):
@@ -763,7 +769,11 @@ class CausalTransformerLM:
             ha = _pre_norm(x, layer, "attn_norm", c)
             hm = _pre_norm(x, layer, "mlp_norm", c)
             mlp, aux = self._mlp_delta(hm, layer, rng=rng, train=train)
-            return x + self._attn_delta(ha, layer, positions) + mlp, aux
+            attn = self._attn_delta(ha, layer, positions)
+            if c.residual_scale is not None:   # Granite-style multiplier
+                attn = attn * c.residual_scale
+                mlp = mlp * c.residual_scale
+            return x + attn + mlp, aux
         x = self._attn_block(x, layer, positions)
         return self._mlp_block(x, layer, rng=rng, train=train)
 
@@ -890,9 +900,13 @@ class CausalTransformerLM:
         if "attn_post_norm" in layer:   # Gemma-2 sandwich (decode too)
             attn_delta = _norm(attn_delta, layer["attn_post_norm"],
                                c.norm_eps, c.use_rmsnorm)
+        if c.residual_scale is not None:   # Granite residual_multiplier
+            attn_delta = attn_delta * c.residual_scale
         if c.parallel_block:
             hm = _pre_norm(x, layer, "mlp_norm", c)
             mlp_delta, _ = self._mlp_delta(hm, layer, train=False)
+            if c.residual_scale is not None:
+                mlp_delta = mlp_delta * c.residual_scale
             return x + attn_delta + mlp_delta, cache
         x = x + attn_delta
         x, _ = self._mlp_block(x, layer, train=False)
@@ -1020,9 +1034,13 @@ class CausalTransformerLM:
             if "attn_post_norm" in layer:   # Gemma-2 sandwich
                 attn_delta = _norm(attn_delta, layer["attn_post_norm"],
                                    c.norm_eps, c.use_rmsnorm)
+            if c.residual_scale is not None:   # Granite
+                attn_delta = attn_delta * c.residual_scale
             if c.parallel_block:
                 hm = _pre_norm(x, layer, "mlp_norm", c)
                 mlp_delta, _ = self._mlp_delta(hm, layer, train=False)
+                if c.residual_scale is not None:
+                    mlp_delta = mlp_delta * c.residual_scale
                 x = x + attn_delta + mlp_delta
             else:
                 x = x + attn_delta

@@ -1574,6 +1574,70 @@ class OlmoPolicy(InjectionPolicy):
         return cfg, params
 
 
+class GranitePolicy(InjectionPolicy):
+    """HF ``GraniteForCausalLM``: llama wiring plus four scalar
+    multipliers — ``embedding_multiplier`` (→ ``embed_scale``),
+    ``attention_multiplier`` (→ ``attn_scale``), ``residual_multiplier``
+    on every sub-block residual add (→ ``residual_scale``), and
+    ``logits_scaling`` which DIVIDES head logits
+    (→ ``final_logit_scale = 1/logits_scaling``)."""
+
+    model_types = ("granite",)
+
+    @classmethod
+    def build(cls, hf, sd):
+        d, L, H = hf.hidden_size, hf.num_hidden_layers, hf.num_attention_heads
+        n_kv = getattr(hf, "num_key_value_heads", None) or H
+        tied = bool(getattr(hf, "tie_word_embeddings", True))
+        cfg = TransformerConfig(
+            vocab_size=hf.vocab_size, hidden_size=d, n_layers=L, n_heads=H,
+            n_kv_heads=(None if n_kv == H else n_kv),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            rope_theta=float(getattr(hf, "rope_theta", 1e4)),
+            rope_inv_freq=_rope_scaled_inv_freq(hf, d // H),
+            norm_eps=hf.rms_norm_eps, activation="silu",
+            use_rmsnorm=True, use_rope=True,
+            embed_scale=float(hf.embedding_multiplier),
+            attn_scale=float(hf.attention_multiplier),
+            residual_scale=float(hf.residual_multiplier),
+            final_logit_scale=1.0 / float(hf.logits_scaling),
+            tie_embeddings=tied, remat=False)
+
+        pre = "model.layers.{}."
+        layers = {
+            "attn_norm": _stack(sd, pre + "input_layernorm.weight", L),
+            "wq": _stack(sd, pre + "self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(sd, pre + "self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(sd, pre + "self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(sd, pre + "self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(sd, pre + "post_attention_layernorm.weight",
+                               L),
+            "w_gate": _stack(sd, pre + "mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(sd, pre + "mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(sd, pre + "mlp.down_proj.weight", L,
+                             transpose=True),
+        }
+        if pre.format(0) + "self_attn.q_proj.bias" in sd:
+            for name, key in (("wq_b", "q_proj"), ("wk_b", "k_proj"),
+                              ("wv_b", "v_proj"), ("wo_b", "o_proj")):
+                layers[name] = _stack(sd, pre + f"self_attn.{key}.bias", L)
+        params = {
+            "tok_embed": _np(sd["model.embed_tokens.weight"]),
+            "final_norm": _np(sd["model.norm.weight"]),
+            "layers": layers,
+        }
+        if not tied:
+            params["lm_head"] = _np(sd["lm_head.weight"]).T
+        return cfg, params
+
+
 class Starcoder2Policy(InjectionPolicy):
     """HF ``Starcoder2ForCausalLM``: llama wiring under
     LayerNorm-with-bias, biased linears throughout (``use_bias``),
@@ -2176,7 +2240,8 @@ REPLACE_POLICIES: List[type] = [GPT2Policy, LlamaPolicy, OPTPolicy,
                                 StableLmPolicy, MptPolicy, GemmaPolicy,
                                 Gemma2Policy, Phi3Policy, MixtralPolicy,
                                 Qwen2MoEPolicy, Qwen3Policy,
-                                Starcoder2Policy, OlmoPolicy,
+                                Starcoder2Policy, GranitePolicy,
+                                OlmoPolicy,
                                 Olmo2Policy, DbrxPolicy, CoherePolicy,
                                 GPTBigCodePolicy, CodeGenPolicy,
                                 MegatronGPTMoEPolicy, MegatronGPTPolicy]

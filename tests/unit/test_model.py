@@ -190,3 +190,25 @@ def test_qk_norm_scratch_init_trains():
         ids = jnp.asarray(np.arange(32, dtype=np.int32)[None, :])
         logits = model.apply(params, ids, train=False)
         assert np.isfinite(np.asarray(logits)).all(), mode
+
+
+def test_residual_scale_consistent_across_paths():
+    """residual_scale must mean the same thing in apply() and the cached
+    decode path, including under parallel_block."""
+    import numpy as np
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    for parallel in (False, True):
+        cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4,
+                                     residual_scale=0.5,
+                                     parallel_block=parallel)
+        model = CausalTransformerLM(cfg)
+        params = model.init(jax.random.key(1))
+        ids = np.arange(24, dtype=np.int32)[None, :]
+        full = np.asarray(model.apply(params, jnp.asarray(ids),
+                                      train=False))
+        caches = model.init_caches(1, 32, dtype=jnp.float32)
+        cached_logits, _ = model.apply_with_cache(params,
+                                                  jnp.asarray(ids), caches)
+        np.testing.assert_allclose(full, np.asarray(cached_logits),
+                                   rtol=2e-4, atol=2e-5)

@@ -1038,3 +1038,30 @@ def test_converted_model_trains_under_zero3():
     losses = [float(engine.train_batch(batch=batch)) for _ in range(8)]
     assert losses[-1] < losses[0] * 0.9, losses
     groups.reset_mesh()
+
+
+def test_granite_conversion_matches_hf():
+    """Granite: llama + four scalar multipliers (embedding, attention,
+    residual, logits-division).  Logits AND cached greedy decode exact
+    (the residual multiplier rides every decode path too)."""
+    hf_cfg = transformers.GraniteConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, embedding_multiplier=6.0,
+        attention_multiplier=0.2, residual_multiplier=0.5,
+        logits_scaling=4.0, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.GraniteForCausalLM(hf_cfg)
+    model, params = replace_transformer_layer(hf)
+    c = model.config
+    assert c.residual_scale == 0.5 and c.final_logit_scale == 0.25
+    ids = _ids(96)
+    _assert_close(_ours_logits(model, params, ids), _hf_logits(hf, ids))
+    engine = deepspeed_tpu.init_inference(
+        model=hf, dtype="fp32", replace_with_kernel_inject=True)
+    rng = np.random.default_rng(17)
+    pid = rng.integers(0, 96, (1, 9))
+    ours = np.asarray(engine.generate(pid, max_new_tokens=6))
+    hf_out = hf.generate(torch.tensor(pid), max_new_tokens=6,
+                         do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(ours, hf_out)
