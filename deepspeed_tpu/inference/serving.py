@@ -110,7 +110,14 @@ class ServingEngine:
         self.queue: List[_Request] = []
         self.finished: Dict[Any, List[int]] = {}
         self.lengths = np.zeros(max_batch, np.int32)
-        self.tables = np.zeros((max_batch, self.max_pages_per_seq), np.int32)
+        # +1 overrun column, permanently the scratch page (page 0): when a
+        # reservation fills the whole table (prompt + max_new == max_seq),
+        # the final chunk's last write indexes one page past the
+        # reservation — this column catches it ON SCRATCH by construction
+        # instead of relying on OOB-gather clamping (which would overwrite
+        # the request's own last real page)
+        self.tables = np.zeros((max_batch, self.max_pages_per_seq + 1),
+                               np.int32)
         # one jit serves prefill (B=1, bucketed T) and decode (B=max_batch,
         # T=1) alike: jax.jit caches a compilation per input shape
         self._step_fn = jax.jit(self.model.apply_with_paged_cache,

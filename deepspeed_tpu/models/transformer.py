@@ -458,7 +458,16 @@ class CausalTransformerLM:
                                 ("wv_b", Hkv * dh), ("wo_b", d),
                                 ("w_up_b", f), ("w_down_b", d)):
                 layers[name] = jnp.zeros((L, width), dtype)
-        if c.norm_bias:
+        if c.post_norm_only:
+            # OLMo2 blocks: x + post_norm(sublayer(x)) — no pre-norms at
+            # all.  Fresh init must create the post-norm weights, not the
+            # pre-norm ones, or the configured architecture silently
+            # degrades to un-normalized blocks (the converted-checkpoint
+            # path supplies these keys; init now matches it).
+            del layers["attn_norm"], layers["mlp_norm"]
+            layers["attn_post_norm"] = jnp.ones((L, d), dtype)
+            layers["mlp_post_norm"] = jnp.ones((L, d), dtype)
+        if c.norm_bias and not c.post_norm_only:
             layers["attn_norm_b"] = jnp.zeros((L, d), dtype)
             layers["mlp_norm_b"] = jnp.zeros((L, d), dtype)
         params = {
@@ -491,13 +500,15 @@ class CausalTransformerLM:
 
         def one_layer(key, moe: bool):
             ks = jax.random.split(key, 8)
+            norm_keys = (("attn_post_norm", "mlp_post_norm")
+                         if c.post_norm_only else ("attn_norm", "mlp_norm"))
             layer = {
-                "attn_norm": jnp.ones((d,), dtype),
+                norm_keys[0]: jnp.ones((d,), dtype),
                 "wq": dense(ks[0], (d, H * dh), d),
                 "wk": dense(ks[1], (d, Hkv * dh), d),
                 "wv": dense(ks[2], (d, Hkv * dh), d),
                 "wo": dense(ks[3], (H * dh, d), H * dh),
-                "mlp_norm": jnp.ones((d,), dtype),
+                norm_keys[1]: jnp.ones((d,), dtype),
             }
             if c.qk_norm:
                 qd, kd = ((H * dh, Hkv * dh) if c.qk_norm == "rms_flat"
@@ -1075,6 +1086,73 @@ class CausalTransformerLM:
         logits = _softcap(logits, c.final_logit_softcap)
         return logits, PagedKVCache(k_pages=new_k, v_pages=new_v), \
             lengths + T
+
+    # ------------------------------------------------------------------
+    # layer-stream contract (training-time parameter offload —
+    # runtime/zero/param_stream.py; reference partition_parameters.py:539
+    # zero.Init(remote_device) + partitioned_param_coordinator.py:458).
+    # These decompose apply()/loss() into per-layer programs with
+    # IDENTICAL math, so the streamed step's trajectory matches the
+    # scan-over-layers step.
+    # ------------------------------------------------------------------
+    def stream_split(self, params):
+        """(resident, layers): resident = everything device-pinned
+        (embeddings / head / final norm), layers = the streamed stack."""
+        resident = {k: v for k, v in params.items() if k != "layers"}
+        return resident, params["layers"]
+
+    def stream_join(self, resident, layers):
+        out = dict(resident)
+        out["layers"] = layers
+        return out
+
+    def stream_embed(self, resident, batch, rng=None):
+        """Embedding front of ``apply`` → (x, positions)."""
+        del rng
+        c = self.config
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        x = resident["tok_embed"][input_ids]
+        if c.embed_scale is not None:
+            x = x * jnp.asarray(c.embed_scale, x.dtype)
+        if not c.use_rope and not c.use_alibi:
+            x = x + resident["pos_embed"][positions].astype(x.dtype)
+        if c.embed_norm:
+            x = _norm(x, resident["embed_norm"], c.norm_eps, c.use_rmsnorm,
+                      resident.get("embed_norm_b"))
+        x = maybe_constrain(x, P(tuple(BATCH_AXES), SP_AXIS, None))
+        return x, positions
+
+    def stream_layer(self, layer, x, positions, window=None, rng=None,
+                     train=True):
+        """One transformer block → (x, aux).  ``window``: traced scalar
+        per-layer sliding window (0 = global), matching the scan's
+        side-input convention."""
+        if window is not None:
+            layer = dict(layer, attn_window=window)
+        return self._layer(x, layer, positions, rng, train)
+
+    def stream_head_loss(self, resident, x, batch):
+        """Final norm + LM head + next-token cross-entropy on the streamed
+        hidden state — the tail of ``loss`` (chunked logits included)."""
+        c = self.config
+        x = _norm(x, resident["final_norm"], c.norm_eps, c.use_rmsnorm,
+                  resident.get("final_norm_b"))
+        head = (resident["tok_embed"].T if c.tie_embeddings
+                else resident["lm_head"])
+        if c.loss_chunk_size and c.loss_chunk_size > 0:
+            return chunked_next_token_xent(
+                x, head, resident.get("lm_head_b"), batch, c.loss_chunk_size,
+                logit_softcap=c.final_logit_softcap,
+                logit_scale=c.final_logit_scale)
+        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_b" in resident:
+            logits = logits + resident["lm_head_b"].astype(jnp.float32)
+        if c.final_logit_scale is not None:
+            logits = logits * c.final_logit_scale
+        logits = _softcap(logits, c.final_logit_softcap)
+        return next_token_xent(logits, batch)
 
     # ------------------------------------------------------------------
     def loss(self, params, batch, rng=None):

@@ -1016,6 +1016,9 @@ class PhiPolicy(InjectionPolicy):
             norm_eps=hf.layer_norm_eps, activation="gelu",
             use_rmsnorm=False, use_rope=True,
             rope_dim=(None if rot == dh else rot),
+            # partial rotary: the scaled table covers the ROTATED slice
+            # (raises on dynamic/yarn — no silent unscaled conversion)
+            rope_inv_freq=_rope_scaled_inv_freq(hf, rot),
             parallel_block=True, use_bias=True, norm_bias=True,
             tie_embeddings=False, lm_head_bias=True, remat=False)
 
@@ -1090,6 +1093,9 @@ class StableLmPolicy(InjectionPolicy):
             norm_eps=hf.layer_norm_eps, activation="silu",
             use_rmsnorm=False, norm_bias=True, use_rope=True,
             rope_dim=(None if rot == dh else rot),
+            # partial rotary: the scaled table covers the ROTATED slice
+            # (raises on dynamic/yarn — no silent unscaled conversion)
+            rope_inv_freq=_rope_scaled_inv_freq(hf, rot),
             tie_embeddings=tied, remat=False)
 
         pre = "model.layers.{}."

@@ -173,7 +173,9 @@ def topkgating(logits, k: int, capacity_factor=1.0, min_capacity=4,
     at k=2, but the modern MoE zoo — Qwen2-MoE/DBRX/OLMoE — routes top-4
     to top-8).  Same machinery as :func:`top2gating`: per-rank masked
     argmax, slot priority = (choice rank, token order), capacity
-    ``tokens/E * cf * k``, aux loss from the rank-1 assignment, and
+    ``tokens/E * cf * k``; aux loss keeps the reference-0.8.3 rank-1/E
+    convention for k<=2 and switches to upstream general-topk's full-mask
+    ``E*E/k`` scaling for k>2 (see the in-body comment), and
     ``norm_topk`` renormalizes over SURVIVING assignments (post-drop,
     like top2gating / the reference; Mixtral / Qwen2-MoE
     ``norm_topk_prob``).  False keeps raw softmax mass.
@@ -207,8 +209,18 @@ def topkgating(logits, k: int, capacity_factor=1.0, min_capacity=4,
 
     exp_counts = sum(jnp.sum(m, axis=0) for m in masks)
     me = jnp.mean(gates, axis=0)
-    ce = jnp.mean(masks[0], axis=0)
-    l_aux = jnp.sum(me * ce) * E
+    if k <= 2:
+        # reference 0.8.3 convention (top1/top2gating): balance loss from
+        # the rank-1 assignment, scale E
+        ce = jnp.mean(masks[0], axis=0)
+        l_aux = jnp.sum(me * ce) * E
+    else:
+        # upstream general-topk convention: FULL top-k mask, scale E*E/k
+        # (torch.mean(me*ce)*E*E/k == sum(me*ce)*E/k) — so k>2 training
+        # (Qwen2-MoE/DBRX-style) sees the same balance pressure as the
+        # framework it mirrors
+        ce = jnp.mean(sum(masks).astype(jnp.float32), axis=0)
+        l_aux = jnp.sum(me * ce) * E / k
 
     prev_counts = jnp.zeros((E,), jnp.float32)
     keeps, locs, kept_flags = [], [], []

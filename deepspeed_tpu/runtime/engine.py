@@ -346,7 +346,13 @@ class DeepSpeedEngine:
         zc = cfg.zero_config
         # ZeRO-Offload / ZeRO-Infinity: optimizer lives on the host (and
         # optionally NVMe); device keeps compute-dtype params only.
+        # With offload_param the PARAMS live on the host too and stream
+        # per-layer (runtime/zero/param_stream.py) — the full model never
+        # resides in HBM.
         self._offload = None
+        self._param_stream = None
+        if zc.offload_param_device != "none":
+            return self._init_param_stream_state(params)
         if zc.offload_optimizer_device != "none":
             return self._init_offload_state(params)
         # master params in fp32 (reference: fp16/bf16 optimizers keep fp32
@@ -386,6 +392,64 @@ class DeepSpeedEngine:
             ls, jax.tree_util.tree_map(lambda _: repl, ls))
         return TrainState(
             params=params, opt_state=opt_state, loss_scale=ls,
+            global_step=step0, skipped_steps=skip0, rng=rng)
+
+    def _init_param_stream_state(self, params) -> TrainState:
+        """ZeRO-Infinity parameter offload: host master params + moments,
+        double-buffered per-layer device streaming
+        (``runtime/zero/param_stream.py``).  Max trainable params/chip is
+        bounded by HOST memory, not HBM — the reference's
+        ``zero.Init(remote_device="cpu"/"nvme")`` capability
+        (``partition_parameters.py:539``)."""
+        from deepspeed_tpu.runtime.zero.param_stream import ParamStreamRunner
+        cfg = self._config
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "offload_param streaming is single-controller for now; "
+                "multi-host pods should use ZeRO-3 (fsdp sharding) whose "
+                "aggregate HBM usually removes the need")
+        if cfg.compression_config:
+            raise NotImplementedError(
+                "compression/MoQ does not compose with offload_param "
+                "streaming yet")
+        opt_name = self.optimizer_name_ or "adamw"
+        supported = {"adam", "adamw", "fusedadam", "cpuadam", "adagrad"}
+        if opt_name not in supported:
+            raise ValueError(
+                f"offload_param supports {sorted(supported)}; got "
+                f"'{opt_name}' (reference: ZeRO-Offload requires "
+                "DeepSpeedCPUAdam/Adagrad)")
+        opt_params = (dict(cfg.optimizer_config.params)
+                      if cfg.optimizer_config else {})
+        self._param_stream = ParamStreamRunner(
+            self.module, params, cfg, self.mesh, self.plan,
+            compute_dtype=self.compute_dtype,
+            grad_accum_dtype=self.grad_accum_dtype,
+            opt_name=opt_name, opt_params=opt_params)
+        log_dist(
+            f"param-stream offload: {self._param_stream.store.num_params():,}"
+            f" params host-resident, {self._param_stream.n_layers} layers "
+            f"streamed ({self._param_stream.resident_layers} pinned), "
+            f"device={cfg.zero_config.offload_param_device}", ranks=[0])
+        if cfg.fp16_enabled and cfg.dynamic_loss_scale:
+            ls = dynamic_loss_scale_state(
+                cfg.fp16_config.initial_scale_power,
+                hysteresis=cfg.fp16_config.hysteresis)
+        elif cfg.fp16_enabled:
+            ls = static_loss_scale_state(cfg.loss_scale)
+        else:
+            ls = static_loss_scale_state(1.0)
+        repl = self.plan.replicated_sharding()
+        seed = cfg.seed
+        with self.mesh:
+            rng, step0, skip0 = jax.jit(
+                lambda: (jax.random.key(seed), jnp.asarray(0, jnp.int32),
+                         jnp.asarray(0, jnp.int32)),
+                out_shardings=repl)()
+        return TrainState(
+            params=(), opt_state=(),
+            loss_scale=device_put_global(
+                ls, jax.tree_util.tree_map(lambda _: repl, ls)),
             global_step=step0, skipped_steps=skip0, rng=rng)
 
     def _init_offload_state(self, params) -> TrainState:
@@ -701,6 +765,11 @@ class DeepSpeedEngine:
     def forward(self, batch, rng=None):
         """Computes loss (and, functionally, gradients — cached for
         ``backward``).  Returns the unscaled loss."""
+        if self._param_stream is not None:
+            raise NotImplementedError(
+                "offload_param streaming runs whole optimizer steps; use "
+                "train_batch() (the 3-call API would re-stream the model "
+                "per call)")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self._compiled_fwd_bwd is None:
             def fwd_bwd(state, batch):
@@ -811,7 +880,34 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(self.global_steps)
         batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
         self._maybe_profile_flops(batch, gas)
-        if self._offload is not None:
+        if self._param_stream is not None:
+            cfg = self._config
+            fp16 = cfg.fp16_enabled
+            rng, step_rng = jax.random.split(self.state.rng)
+            lr_now = float(jax.device_get(
+                jnp.asarray(self._schedule_fn(self.state.global_step))))
+            scale = (float(jax.device_get(self.state.loss_scale.cur_scale))
+                     if fp16 else 1.0)
+            loss_f, gnorm, overflow_b = self._param_stream.train_step(
+                batch, gas, lr_now, scale, fp16,
+                cfg.gradient_clipping, step_rng)
+            new_ls = update_scale(
+                self.state.loss_scale, jnp.asarray(overflow_b),
+                dynamic=fp16 and cfg.dynamic_loss_scale,
+                scale_window=cfg.fp16_config.loss_scale_window,
+                min_scale=cfg.fp16_config.min_loss_scale,
+                hysteresis=cfg.fp16_config.hysteresis)
+            self.state = self.state.replace(
+                rng=rng, loss_scale=new_ls,
+                global_step=self.state.global_step + 1,
+                skipped_steps=(self.state.skipped_steps +
+                               int(overflow_b)))
+            metrics = StepMetrics(
+                loss=jnp.float32(loss_f), grad_norm=jnp.float32(gnorm),
+                lr=jnp.asarray(lr_now, jnp.float32),
+                loss_scale=self.state.loss_scale.cur_scale,
+                overflow=jnp.asarray(overflow_b))
+        elif self._offload is not None:
             grad_fn = self._get_compiled_offload_grad_step(gas)
             with self.mesh:
                 loss, grads, overflow, grad_norm, rng = grad_fn(
@@ -869,6 +965,12 @@ class DeepSpeedEngine:
         return batch
 
     def eval_batch(self, batch, rng=None):
+        if self._param_stream is not None:
+            batch = self._prep_eval_batch(batch)
+            batch = self._shard_batch(
+                batch, leading_gas_dim=self._eval_leading_gas_dim)
+            return jnp.float32(
+                self._param_stream.eval_loss(batch, rng=self.state.rng))
         if not hasattr(self, "_compiled_eval"):
             def ev(state, batch):
                 p_c = jax.tree_util.tree_map(
@@ -957,6 +1059,8 @@ class DeepSpeedEngine:
         fpc = self._config.flops_profiler_config
         if not fpc.enabled or self.global_steps != fpc.profile_step:
             return
+        if self._param_stream is not None:
+            return   # params live on host; no device tree to trace
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
         micro = batch
         if gas > 1:
@@ -1050,6 +1154,8 @@ class DeepSpeedEngine:
         ``_zero3_consolidated_16bit_state_dict:3432`` rolled into one: orbax
         handles gather-on-save, so consolidation is just a replicated
         device_get."""
+        if self._param_stream is not None:
+            return self._param_stream.params_tree()
         if self._offload is not None:
             if self._offload_sharded:
                 # multi-host: the host master is shard-local; consolidate
@@ -1084,6 +1190,8 @@ class DeepSpeedEngine:
                              if self.lr_scheduler else None),
         })
         eng.save(self.state, save_dir, tag, client_state=client_state)
+        if self._param_stream is not None:
+            self._param_stream.save(save_dir, tag)
         if self._offload is not None:
             self._offload.save(save_dir, tag)
         if save_latest and jax.process_index() == 0:
@@ -1109,6 +1217,13 @@ class DeepSpeedEngine:
             load_optimizer_states=load_optimizer_states,
             load_module_only=load_module_only)
         self.state = state
+        if self._param_stream is not None:
+            if not self._param_stream.load(
+                    load_dir, tag,
+                    load_optimizer_states=load_optimizer_states):
+                logger.warning(
+                    "no param-stream host state in checkpoint "
+                    f"{load_dir}/{tag}; host params unchanged")
         if self._offload is not None:
             restored = load_optimizer_states and self._offload.load(load_dir,
                                                                     tag)

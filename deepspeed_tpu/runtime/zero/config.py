@@ -20,10 +20,18 @@ class OffloadDeviceEnum:
 class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     device = OffloadDeviceEnum.none
     nvme_path = None
+    # device-resident streamed working sets (reference: number of aio/pinned
+    # buffers in AsyncPartitionedParameterSwapper; here: how many per-layer
+    # uploads may be in flight, >=2 for double buffering)
     buffer_count = 5
     buffer_size = 100_000_000
     max_in_cpu = 1_000_000_000
     pin_memory = False
+    # TPU extension: pin the first N layers' working sets in HBM across the
+    # whole step (uploaded once per optimizer step instead of once per
+    # fwd/bwd traversal) — the dial between max model size (0) and max
+    # throughput (n_layers)
+    resident_layers = 0
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

@@ -53,8 +53,27 @@ class Init:
 
     # ------------------------------------------------------------------
     def partition(self, params: Any) -> Any:
-        """Place a params pytree with stage-3 (fsdp) sharding."""
-        if not self.enabled or self.plan is None:
+        """Place a params pytree with stage-3 (fsdp) sharding.
+
+        ``remote_device == "cpu"/"nvme"`` keeps the tree HOST-resident
+        (numpy) — the reference's off-device construction
+        (``partition_parameters.py:539``): the engine's param-stream mode
+        (``runtime/zero/param_stream.py``) consumes it without the full
+        tree ever materializing in HBM."""
+        if not self.enabled:
+            return params
+        if self.remote_device in ("cpu", "nvme"):
+            import jax.numpy as jnp
+
+            def host(x):
+                arr = np.asarray(jax.device_get(x)) \
+                    if isinstance(x, jax.Array) else np.asarray(x)
+                if self.dtype is not None and \
+                        jnp.issubdtype(arr.dtype, jnp.floating):
+                    arr = arr.astype(np.dtype(jnp.dtype(self.dtype).name))
+                return arr
+            return jax.tree_util.tree_map(host, params)
+        if self.plan is None:
             return params
         sh = self.plan._to_sharding(self.plan.param_specs(params))
         if self.dtype is not None:

@@ -1,0 +1,292 @@
+"""Training-time parameter offload (param-stream) tests.
+
+Parity model: reference ``tests/unit/runtime/zero/test_zero_context*.py``
+(``zero.Init(remote_device=...)`` semantics) and the offload paths of
+``test_zero.py`` — here the bar is trajectory equality against the
+device-resident offload engine, since both share the host C++ Adam.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from unit.simple_model import base_config
+
+V, S = 64, 32
+
+
+def _toy_lm(**kw):
+    cfg = TransformerConfig.tiny(vocab_size=V, max_seq_len=S,
+                                 hidden_size=32, n_layers=3, n_heads=4,
+                                 loss_chunk_size=0, **kw)
+    return CausalTransformerLM(cfg)
+
+
+def _batch(bsz=8, seed=0, gas=None):
+    rng = np.random.default_rng(seed)
+    if gas:
+        return {"input_ids": rng.integers(0, V, size=(gas, bsz, S),
+                                          dtype=np.int64)}
+    return {"input_ids": rng.integers(0, V, size=(bsz, S), dtype=np.int64)}
+
+
+def _engine(model, params, **overrides):
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(**overrides))
+    return eng
+
+
+def _stream_cfg(extra_param=None, stage=0, **overrides):
+    zo = {"stage": stage,
+          "offload_param": dict({"device": "cpu"}, **(extra_param or {})),
+          "offload_optimizer": {"device": "cpu"}}
+    return dict(zero_optimization=zo, **overrides)
+
+
+def _offload_cfg(**overrides):
+    return dict(zero_optimization={
+        "stage": 0, "offload_optimizer": {"device": "cpu"}}, **overrides)
+
+
+# ----------------------------------------------------------------------
+# trajectory equality vs the device-resident offload engine
+# ----------------------------------------------------------------------
+def test_stream_matches_offload_trajectory():
+    """fp32 compute: the streamed step must track the resident offload
+    step (same host Adam, same math, different execution shape)."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e_res = _engine(model, params, **_offload_cfg())
+    e_str = _engine(model, params, **_stream_cfg())
+    assert e_str._param_stream is not None
+    assert e_str._param_stream.store.homogeneous
+    for seed in range(3):
+        b = _batch(seed=seed)
+        l1 = float(e_res.train_batch(batch=b))
+        l2 = float(e_str.train_batch(batch=b))
+        np.testing.assert_allclose(l1, l2, rtol=2e-5)
+    p_res = e_res.module_state_dict()
+    p_str = e_str._param_stream.params_tree()
+    np.testing.assert_allclose(np.asarray(p_str["layers"]["wq"]),
+                               np.asarray(p_res["layers"]["wq"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p_str["tok_embed"]),
+                               np.asarray(p_res["tok_embed"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_stream_gas_matches_offload():
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e_res = _engine(model, params, gradient_accumulation_steps=2,
+                    **_offload_cfg())
+    e_str = _engine(model, params, gradient_accumulation_steps=2,
+                    **_stream_cfg())
+    for seed in range(2):
+        b = _batch(seed=seed, gas=2)
+        l1 = float(e_res.train_batch(batch=b))
+        l2 = float(e_str.train_batch(batch=b))
+        np.testing.assert_allclose(l1, l2, rtol=2e-5)
+
+
+def test_stream_grad_clipping_matches():
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e_res = _engine(model, params, gradient_clipping=0.01, **_offload_cfg())
+    e_str = _engine(model, params, gradient_clipping=0.01, **_stream_cfg())
+    for seed in range(2):
+        b = _batch(seed=seed)
+        l1 = float(e_res.train_batch(batch=b))
+        l2 = float(e_str.train_batch(batch=b))
+        np.testing.assert_allclose(l1, l2, rtol=2e-5)
+    np.testing.assert_allclose(e_str._last_metrics.grad_norm,
+                               e_res._last_metrics.grad_norm, rtol=1e-3)
+
+
+def test_resident_layers_pinning_matches():
+    """Pinned working sets are a pure perf knob — identical trajectory."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e0 = _engine(model, params, **_stream_cfg())
+    e2 = _engine(model, params,
+                 **_stream_cfg(extra_param={"resident_layers": 2}))
+    assert e2._param_stream.resident_layers == 2
+    for seed in range(2):
+        b = _batch(seed=seed)
+        l0 = float(e0.train_batch(batch=b))
+        l2 = float(e2.train_batch(batch=b))
+        np.testing.assert_allclose(l0, l2, rtol=1e-6)
+
+
+def test_stream_trains_tied_gpt2_shape():
+    """GPT-2 family: tied embeddings + learned positions + biases all ride
+    the resident group; loss must fall."""
+    model = _toy_lm(activation="gelu", use_rmsnorm=False, use_rope=False,
+                    tie_embeddings=True, use_bias=True, norm_bias=True)
+    params = model.init(jax.random.key(0))
+    e = _engine(model, params, **_stream_cfg())
+    losses = [float(e.train_batch(batch=_batch(seed=0))) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_stream_local_window_pattern():
+    """Per-layer sliding windows ride as side inputs, like the scan."""
+    model = _toy_lm(local_attn_pattern=(0, 8, 0))
+    params = model.init(jax.random.key(0))
+    e_res = _engine(model, params, **_offload_cfg())
+    e_str = _engine(model, params, **_stream_cfg())
+    b = _batch(seed=0)
+    np.testing.assert_allclose(float(e_res.train_batch(batch=b)),
+                               float(e_str.train_batch(batch=b)),
+                               rtol=2e-5)
+
+
+def test_stream_moe_list_layers():
+    """Heterogeneous (MoE list) stacks stream per-layer layouts; the MoE
+    aux loss flows into the gate gradients."""
+    model = _toy_lm(moe_num_experts=4, moe_top_k=1, moe_layer_freq=2)
+    params = model.init(jax.random.key(0))
+    e_str = _engine(model, params, **_stream_cfg())
+    assert not e_str._param_stream.store.homogeneous
+    wg_before = e_str._param_stream.params_tree()["layers"][1]["moe"][
+        "wg"].copy()
+    losses = [float(e_str.train_batch(batch=_batch(seed=s)))
+              for s in range(4)]
+    assert losses[-1] < losses[0]
+    wg_after = e_str._param_stream.params_tree()["layers"][1]["moe"]["wg"]
+    assert np.abs(wg_after - wg_before).max() > 0   # gate actually learns
+
+
+# ----------------------------------------------------------------------
+# fp16 overflow + loss-scale automaton
+# ----------------------------------------------------------------------
+def test_stream_fp16_overflow_skips_step():
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e = _engine(model, params,
+                fp16={"enabled": True, "initial_scale_power": 24,
+                      "hysteresis": 1},
+                **_stream_cfg())
+    before = e._param_stream.params_tree()["layers"]["wq"].copy()
+    e.train_batch(batch=_batch(seed=0))
+    if int(e.state.skipped_steps) >= 1:
+        after = e._param_stream.params_tree()["layers"]["wq"]
+        np.testing.assert_array_equal(after, before)
+        assert float(e.state.loss_scale.cur_scale) < 2.0 ** 24
+    # train until a successful step happens; scale keeps adapting
+    for s in range(6):
+        e.train_batch(batch=_batch(seed=s))
+    assert int(e.state.global_step) == 7
+    assert int(e.state.skipped_steps) < 7
+
+
+# ----------------------------------------------------------------------
+# checkpoint / state surface
+# ----------------------------------------------------------------------
+def test_stream_checkpoint_roundtrip(tmp_path):
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e1 = _engine(model, params, **_stream_cfg())
+    for s in range(2):
+        e1.train_batch(batch=_batch(seed=s))
+    e1.save_checkpoint(str(tmp_path), tag="ck")
+    e2 = _engine(model, params, **_stream_cfg())
+    e2.load_checkpoint(str(tmp_path), tag="ck")
+    np.testing.assert_allclose(e2._param_stream.store.masters,
+                               e1._param_stream.store.masters, rtol=1e-6)
+    b = _batch(seed=9)
+    np.testing.assert_allclose(float(e1.train_batch(batch=b)),
+                               float(e2.train_batch(batch=b)), rtol=1e-5)
+
+
+def test_stream_nvme_memmap(tmp_path):
+    """ZeRO-Infinity: host state memmap-backed under nvme_path."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e_cpu = _engine(model, params, **_stream_cfg())
+    e_nvme = _engine(model, params, **_stream_cfg(
+        extra_param={"device": "nvme", "nvme_path": str(tmp_path)}))
+    assert isinstance(e_nvme._param_stream.store.masters, np.memmap)
+    files = os.listdir(os.path.join(
+        str(tmp_path), "zero_param_stream", "rank0"))
+    assert any("layer_master" in f for f in files)
+    for seed in range(2):
+        b = _batch(seed=seed)
+        np.testing.assert_allclose(float(e_cpu.train_batch(batch=b)),
+                                   float(e_nvme.train_batch(batch=b)),
+                                   rtol=1e-6)
+
+
+def test_stream_eval_and_state_dict():
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e = _engine(model, params, **_stream_cfg())
+    ev0 = float(e.eval_batch(_batch(seed=3)))
+    for s in range(3):
+        e.train_batch(batch=_batch(seed=3))
+    assert float(e.eval_batch(_batch(seed=3))) < ev0
+    sd = e.module_state_dict()
+    assert "tok_embed" in sd and "layers" in sd
+    # eager whole-model loss on the consolidated params agrees with eval
+    loss = float(model.loss(
+        jax.tree_util.tree_map(jnp.asarray, sd), _batch(seed=3)))
+    np.testing.assert_allclose(loss, float(e.eval_batch(_batch(seed=3))),
+                               rtol=1e-5)
+
+
+def test_stream_three_call_api_raises():
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e = _engine(model, params, **_stream_cfg())
+    with pytest.raises(NotImplementedError, match="train_batch"):
+        e.forward(_batch())
+
+
+def test_stream_requires_streamable_model():
+    from unit.simple_model import SimpleModel
+    m = SimpleModel(hidden_dim=16)
+    p = m.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="layer-streamable"):
+        _engine(m, p, **_stream_cfg())
+
+
+def test_zero_init_remote_device_hosts_params():
+    """zero.Init(remote_device='cpu') keeps the tree host-resident
+    (reference partition_parameters.py:539) and the engine consumes it."""
+    from deepspeed_tpu.runtime.zero.partition_parameters import Init
+    model = _toy_lm()
+    with Init(remote_device="cpu", dtype=jnp.float32) as ctx:
+        params = ctx.init(model.init, jax.random.key(0))
+    assert all(isinstance(x, np.ndarray)
+               for x in jax.tree_util.tree_leaves(params))
+    e = _engine(model, params, **_stream_cfg())
+    losses = [float(e.train_batch(batch=_batch(seed=0))) for _ in range(3)]
+    assert losses[-1] < losses[0]
+
+
+# ----------------------------------------------------------------------
+# sharded streaming (multi-device mesh)
+# ----------------------------------------------------------------------
+def test_stream_sharded_uploads_match(mesh_2d):
+    """tp×fsdp mesh: uploaded working sets carry tail-aligned tp specs +
+    fsdp; trajectory matches the single-device stream."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e_plain = _engine(model, params, **_stream_cfg())
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(**_stream_cfg(stage=3)), mesh=mesh_2d,
+        tp_rules=model.tp_rules())
+    assert eng._param_stream is not None
+    for seed in range(2):
+        b = _batch(bsz=8, seed=seed)
+        l1 = float(e_plain.train_batch(batch=b))
+        l2 = float(eng.train_batch(batch=b))
+        np.testing.assert_allclose(l1, l2, rtol=5e-5)
