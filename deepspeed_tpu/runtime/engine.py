@@ -719,33 +719,39 @@ class DeepSpeedEngine:
         the flat master (NVMe-swapped moments under ZeRO-Infinity), stream
         updated params H2D, run the loss-scale automaton."""
         cfg = self._config
-        overflow_b = bool(jax.device_get(overflow))
+        # bf16/fp32 runs never overflow-skip: the flag is a traced constant
+        # False, and fetching it would serialize the host on the whole
+        # device step before the grad D2H stream even starts
+        overflow_b = (bool(jax.device_get(overflow))
+                      if cfg.fp16_enabled else False)
         if not overflow_b:
+            # schedule evaluated on the HOST step counter: no sync against
+            # the in-flight device step
             lr = float(jax.device_get(
-                jnp.asarray(self._schedule_fn(self.state.global_step))))
+                jnp.asarray(self._schedule_fn(self.global_steps))))
             coef = None
             if cfg.gradient_clipping and cfg.gradient_clipping > 0:
                 gn = float(jax.device_get(grad_norm))
                 clip = cfg.gradient_clipping
                 if gn > clip:
                     coef = clip / (gn + 1e-6)
-            # streamed: per-leaf D2H overlaps per-subgroup host Adam
-            self._offload.step_streamed(grads, lr=lr, clip_coef=coef)
             if self._offload_sharded:
-                # multi-host: assemble the global device tree from each
-                # process's local master shards
+                # multi-host: streamed D2H/Adam, then assemble the global
+                # device tree from each process's local master shards
+                self._offload.step_streamed(grads, lr=lr, clip_coef=coef)
                 with self.mesh:
                     new_params = self._offload.device_params(
                         self._offload_param_sh, dtype=self.compute_dtype)
             else:
-                new_params = jax.tree_util.tree_map(
-                    lambda x: jnp.asarray(
-                        x.astype(self.compute_dtype)
-                        if jnp.issubdtype(x.dtype, jnp.floating) else x),
-                    self._offload.params_tree())
+                # fully pipelined: per-leaf D2H / per-subgroup C++ Adam /
+                # per-leaf H2D of the updated master all overlap (no
+                # whole-tree host cast + serial upload tail)
                 with self.mesh:
-                    new_params = device_put_global(new_params,
-                                                   self._offload_param_sh)
+                    new_params = self._offload.step_streamed(
+                        grads, lr=lr, clip_coef=coef,
+                        upload_shardings=self._offload_param_sh,
+                        upload_dtype=np.dtype(
+                            jnp.dtype(self.compute_dtype).name))
             self.state = self.state.replace(params=new_params)
         new_ls = update_scale(
             self.state.loss_scale, jnp.asarray(overflow_b),

@@ -422,7 +422,8 @@ class HostOffloadOptimizer(ZeROOptimizer):
             self.swapper.release()
 
     def step_streamed(self, grads_tree, lr: Optional[float] = None,
-                      clip_coef: Optional[float] = None):
+                      clip_coef: Optional[float] = None,
+                      upload_shardings=None, upload_dtype=None):
         """``step`` fed directly by DEVICE gradients, pipelined: all D2H
         transfers are issued up front (``copy_to_host_async``), then each
         flat-order leaf is awaited individually and a sub-group's fused
@@ -430,8 +431,19 @@ class HostOffloadOptimizer(ZeROOptimizer):
         leaf i+1 overlaps the update covering leaf i (the role of the
         reference's grad-bucket D2H streams in
         ``stage3.py``/``cpu_adam`` interplay).  NVMe moment prefetch
-        (``_apply_subgroup``) stacks on top, giving a 3-deep pipeline:
-        D2H grads / NVMe moments / C++ Adam (OpenMP, GIL released)."""
+        (``_apply_subgroup``) stacks on top.
+
+        ``upload_shardings`` (+ optional ``upload_dtype``): a shardings
+        pytree matching the params — as the Adam frontier passes each
+        leaf, its updated master slice is unflattened, cast, and
+        ``jax.device_put`` immediately (async dispatch), so the H2D of
+        leaf i rides under the Adam of leaves i+1.. — the streamed
+        write-back the reference gets from per-bucket H2D streams
+        (``stage_1_and_2.py:1086``); no whole-tree host cast + serial
+        upload at the end of the step.  Returns the new device tree (None
+        without ``upload_shardings``), giving a 4-deep pipeline:
+        D2H grads / NVMe moments / C++ Adam (OpenMP, GIL released) / H2D
+        params."""
         lr = self.lr if lr is None else float(lr)
         leaves = self.layout.treedef.flatten_up_to(grads_tree)
         for leaf, is_f in zip(leaves, self.layout.is_float):
@@ -439,6 +451,39 @@ class HostOffloadOptimizer(ZeROOptimizer):
                 leaf.copy_to_host_async()       # start every D2H now
         flat_grads = np.empty(self.layout.total, np.float32)
         self.step_count += 1
+
+        sh_leaves = None
+        out_leaves = None
+        up_fi = 0          # next float leaf (flat order) to upload
+        if upload_shardings is not None:
+            assert isinstance(self.layout, FlatLayout), \
+                "streamed upload needs the single-host FlatLayout"
+            sh_leaves = self.layout.treedef.flatten_up_to(upload_shardings)
+            out_leaves = [None] * len(leaves)
+
+        def upload_through(applied: int):
+            """Upload every float leaf fully covered by the applied-Adam
+            frontier (master offsets < ``applied`` are final)."""
+            nonlocal up_fi
+            if out_leaves is None:
+                return
+            fi = 0
+            for i, is_f in enumerate(self.layout.is_float):
+                if not is_f:
+                    continue
+                if fi == up_fi:
+                    end = int(self.layout.offsets[fi + 1])
+                    if end > applied:
+                        return
+                    off = int(self.layout.offsets[fi])
+                    host = self.master[off:end].reshape(
+                        self.layout.shapes[i])
+                    if upload_dtype is not None:
+                        host = host.astype(upload_dtype)
+                    out_leaves[i] = jax.device_put(host, sh_leaves[i])
+                    up_fi += 1
+                fi += 1
+
         gi = 0
         for off, size, fetch in self.layout.pieces(grads_tree):
             arr = fetch()
@@ -450,11 +495,21 @@ class HostOffloadOptimizer(ZeROOptimizer):
                     self.subgroups[gi][1] <= frontier:
                 self._apply_subgroup(gi, flat_grads, lr)
                 gi += 1
+                upload_through(self.subgroups[gi - 1][1])
         while gi < len(self.subgroups):
             self._apply_subgroup(gi, flat_grads, lr)
             gi += 1
+            upload_through(self.subgroups[gi - 1][1])
         if self.swapper is not None:
             self.swapper.release()
+        if out_leaves is None:
+            return None
+        # non-float leaves pass through; every float leaf is uploaded by now
+        for i, is_f in enumerate(self.layout.is_float):
+            if not is_f:
+                out_leaves[i] = jax.device_put(self.layout.static_leaves[i],
+                                               sh_leaves[i])
+        return jax.tree_util.tree_unflatten(self.layout.treedef, out_leaves)
 
     def device_params(self, shardings, dtype=None):
         """Assemble the updated master straight into a global DEVICE tree

@@ -132,3 +132,43 @@ def test_pipelined_and_serial_agree_numerically(tmp_path):
             opt.step({"w": rng.normal(size=numel).astype(np.float32)})
         opts[name] = opt.master
     np.testing.assert_allclose(opts["s"], opts["p"], rtol=0, atol=0)
+
+
+def test_streamed_upload_matches_bulk_writeback():
+    """``step_streamed(upload_shardings=...)`` (per-leaf H2D overlapped
+    with the remaining sub-group Adams) must produce the identical device
+    tree as the old unflatten-cast-device_put tail."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+    rng = np.random.default_rng(0)
+    tree = {"a": rng.normal(size=(64, 32)).astype(np.float32),
+            "b": {"w": rng.normal(size=(1000,)).astype(np.float32),
+                  "idx": np.arange(5, dtype=np.int32)},
+            "c": rng.normal(size=(7,)).astype(np.float32)}
+    zc = DeepSpeedZeroConfig({"sub_group_size": 700})
+    opt_a = HostOffloadOptimizer(tree, zc, opt_name="adamw")
+    opt_b = HostOffloadOptimizer(tree, zc, opt_name="adamw")
+
+    sh = jax.tree_util.tree_map(
+        lambda x: jax.devices("cpu")[0].client.live_arrays and
+        jax.sharding.SingleDeviceSharding(jax.devices("cpu")[0]), tree)
+    grads = jax.tree_util.tree_map(
+        lambda x: (jnp.asarray(rng.normal(size=np.shape(x)),
+                               jnp.float32)
+                   if np.issubdtype(np.asarray(x).dtype, np.floating)
+                   else jnp.asarray(x)), tree)
+
+    up = opt_a.step_streamed(grads, lr=1e-2, upload_shardings=sh,
+                             upload_dtype=np.dtype("bfloat16"))
+    opt_b.step_streamed(grads, lr=1e-2)
+    bulk = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x.astype(np.dtype("bfloat16")))
+        if np.issubdtype(x.dtype, np.floating) else jnp.asarray(x),
+        opt_b.params_tree())
+    for k, (u, r) in enumerate(zip(jax.tree_util.tree_leaves(up),
+                                   jax.tree_util.tree_leaves(bulk))):
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(r))
+    np.testing.assert_allclose(opt_a.master, opt_b.master, rtol=0, atol=0)
