@@ -21,9 +21,14 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     device = OffloadDeviceEnum.none
     nvme_path = None
     # device-resident streamed working sets (reference: number of aio/pinned
-    # buffers in AsyncPartitionedParameterSwapper; here: how many per-layer
-    # uploads may be in flight, >=2 for double buffering)
-    buffer_count = 5
+    # buffers in AsyncPartitionedParameterSwapper).  Controls BOTH sides of
+    # the stream: the fwd/bwd loops keep a window of ``buffer_count``
+    # per-layer working sets on device (prefetch depth = buffer_count-1
+    # layers ahead) and backward bounds in-flight gradient D2H trees to the
+    # same count; >=2 for double buffering.  Default 2 = the minimal HBM
+    # footprint (the capacity-sized models offload_param exists for);
+    # raise it to deepen the prefetch pipeline when HBM allows
+    buffer_count = 2
     buffer_size = 100_000_000
     max_in_cpu = 1_000_000_000
     pin_memory = False

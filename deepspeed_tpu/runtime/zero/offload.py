@@ -455,6 +455,10 @@ class HostOffloadOptimizer(ZeROOptimizer):
         sh_leaves = None
         out_leaves = None
         up_fi = 0          # next float leaf (flat order) to upload
+        # float-ordinal -> leaf-index map so each upload_through call resumes
+        # at the frontier instead of rescanning the leaf list from 0 (the
+        # rescan made the bookkeeping O(leaves * subgroups) per step)
+        float_idx = [i for i, f in enumerate(self.layout.is_float) if f]
         if upload_shardings is not None:
             assert isinstance(self.layout, FlatLayout), \
                 "streamed upload needs the single-host FlatLayout"
@@ -467,22 +471,17 @@ class HostOffloadOptimizer(ZeROOptimizer):
             nonlocal up_fi
             if out_leaves is None:
                 return
-            fi = 0
-            for i, is_f in enumerate(self.layout.is_float):
-                if not is_f:
-                    continue
-                if fi == up_fi:
-                    end = int(self.layout.offsets[fi + 1])
-                    if end > applied:
-                        return
-                    off = int(self.layout.offsets[fi])
-                    host = self.master[off:end].reshape(
-                        self.layout.shapes[i])
-                    if upload_dtype is not None:
-                        host = host.astype(upload_dtype)
-                    out_leaves[i] = jax.device_put(host, sh_leaves[i])
-                    up_fi += 1
-                fi += 1
+            while up_fi < len(float_idx):
+                end = int(self.layout.offsets[up_fi + 1])
+                if end > applied:
+                    return
+                i = float_idx[up_fi]
+                off = int(self.layout.offsets[up_fi])
+                host = self.master[off:end].reshape(self.layout.shapes[i])
+                if upload_dtype is not None:
+                    host = host.astype(upload_dtype)
+                out_leaves[i] = jax.device_put(host, sh_leaves[i])
+                up_fi += 1
 
         gi = 0
         for off, size, fetch in self.layout.pieces(grads_tree):

@@ -46,11 +46,18 @@ exactly like the reference's prefetch stream.
 
 Sharding composes: each uploaded working set is placed with the plan's
 tp/fsdp sharding for that layer, so multi-chip param streaming shards the
-working set over the mesh like everything else.
+working set over the mesh like everything else.  ep (MoE list stacks take
+the heterogeneous per-layer layouts) and sp (activations shard over sp;
+params don't) compose the same way.  PP does NOT compose: the pipelined
+step is one jitted SPMD scan with no per-layer program boundary to stream
+through — the same line the reference draws (ZeRO-3 param partitioning is
+incompatible with PP, reference ``engine.py:1541``); PP composes with
+ZeRO-Offload via ``offload_optimizer`` instead (``pipe/engine.py``).
 """
 
 import math
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -59,6 +66,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.ops import cpu_adam
+from deepspeed_tpu.runtime.zero.config import OffloadDeviceEnum
 from deepspeed_tpu.runtime.zero.offload import FlatLayout
 from deepspeed_tpu.utils.logging import logger
 
@@ -73,7 +81,10 @@ def _np_dtype(dtype) -> np.dtype:
 def _alloc(shape, dtype, nvme_dir: Optional[str], name: str) -> np.ndarray:
     """Host buffer, optionally NVMe-backed (ZeRO-Infinity: ``np.memmap``
     keeps host RAM bounded; the OS page cache plays the pinned-buffer
-    role of the reference's aio swapper)."""
+    role of the reference's aio swapper).  ``nvme_dir=None`` = plain RAM.
+    Param-state buffers (masters/mirrors/grad accumulators) and optimizer
+    moments get separately chosen dirs so ``offload_optimizer: nvme`` can
+    swap the moments without dragging the hot upload mirrors to disk."""
     if nvme_dir is None:
         return np.zeros(shape, dtype)
     os.makedirs(nvme_dir, exist_ok=True)
@@ -103,10 +114,16 @@ class HostParamStore:
     get per-layer layouts and buffers.
     """
 
+    #: default for ``moments_nvme_dir``: moments live on the same tier as
+    #: the param state (callers pass an explicit dir — or None for RAM —
+    #: when offload_optimizer.device differs from offload_param.device)
+    FOLLOW_PARAM_TIER = "__follow_param_tier__"
+
     def __init__(self, resident_tree, layer_trees: List[Any],
                  opt_params: Optional[dict] = None, opt_name: str = "adamw",
                  compute_dtype=jnp.bfloat16, nvme_dir: Optional[str] = None,
-                 grad_dtype=np.float32):
+                 grad_dtype=np.float32,
+                 moments_nvme_dir=FOLLOW_PARAM_TIER):
         opt_params = dict(opt_params or {})
         betas = opt_params.get("betas", (0.9, 0.999))
         self.beta1, self.beta2 = float(betas[0]), float(betas[1])
@@ -121,6 +138,12 @@ class HostParamStore:
         self.compute_dtype = _np_dtype(compute_dtype)
         self.grad_dtype = _np_dtype(grad_dtype)
         self.nvme_dir = nvme_dir
+        # moments may live on a different tier than the param state
+        # (offload_optimizer.device is independent of offload_param.device)
+        self.moments_nvme_dir = (nvme_dir
+                                 if moments_nvme_dir == self.FOLLOW_PARAM_TIER
+                                 else moments_nvme_dir)
+        mdir = self.moments_nvme_dir
         self.n_layers = len(layer_trees)
 
         host = jax.tree_util.tree_map(np.asarray, resident_tree)
@@ -129,26 +152,31 @@ class HostParamStore:
                                  nvme_dir, "res_master")
         self.res_layout.flatten(host, out=self.res_master)
         self.res_moments = [_alloc((self.res_layout.total,), np.float32,
-                                   nvme_dir, f"res_m{i}")
+                                   mdir, f"res_m{i}")
                             for i in range(self.n_moments)]
         self.res_gacc = _alloc((self.res_layout.total,), self.grad_dtype,
                                nvme_dir, "res_gacc")
 
         host_layers = [jax.tree_util.tree_map(np.asarray, t)
                        for t in layer_trees]
-        l0 = FlatLayout(host_layers[0])
+        all_layouts = [FlatLayout(t) for t in host_layers]
+        l0 = all_layouts[0]
+        # homogeneity requires identical PER-LEAF shapes, not just structure
+        # + total count: equal-total layers with transposed/differently
+        # shaped leaves must take the heterogeneous path or layer 0's layout
+        # would unflatten their weights into wrong views
         self.homogeneous = all(
-            FlatLayout(t).total == l0.total and
+            lay.shapes == l0.shapes and
             jax.tree_util.tree_structure(t) ==
             jax.tree_util.tree_structure(host_layers[0])
-            for t in host_layers[1:])
+            for lay, t in zip(all_layouts[1:], host_layers[1:]))
         if self.homogeneous:
             self.layouts = [l0] * self.n_layers
             F = l0.total
             self.masters = _alloc((self.n_layers, F), np.float32,
                                   nvme_dir, "layer_master")
             self.moments = [_alloc((self.n_layers, F), np.float32,
-                                   nvme_dir, f"layer_m{i}")
+                                   mdir, f"layer_m{i}")
                             for i in range(self.n_moments)]
             self.mirrors = _alloc((self.n_layers, F), self.compute_dtype,
                                   nvme_dir, "layer_mirror")
@@ -158,11 +186,11 @@ class HostParamStore:
                 l0.flatten(t, out=self.masters[l])
                 self.mirrors[l] = self.masters[l].astype(self.compute_dtype)
         else:
-            self.layouts = [FlatLayout(t) for t in host_layers]
+            self.layouts = all_layouts
             self.masters = [_alloc((lay.total,), np.float32, nvme_dir,
                                    f"layer{l}_master")
                             for l, lay in enumerate(self.layouts)]
-            self.moments = [[_alloc((lay.total,), np.float32, nvme_dir,
+            self.moments = [[_alloc((lay.total,), np.float32, mdir,
                                     f"layer{l}_m{i}")
                              for l, lay in enumerate(self.layouts)]
                             for i in range(self.n_moments)]
@@ -325,11 +353,30 @@ class ParamStreamRunner:
         self.config = config
         zc = config.zero_config
         pc = zc.offload_param
-        nvme_dir = None
-        if zc.offload_param_device == "nvme":
-            nvme_path = (pc.nvme_path if pc and pc.nvme_path else "/tmp")
-            nvme_dir = os.path.join(str(nvme_path), STREAM_SUBDIR,
+        oc = zc.offload_optimizer
+        base_dir = None
+        # NVMe backing is chosen PER TIER: the param state (masters, hot
+        # upload mirrors, grad accumulators) follows offload_param.device;
+        # the Adam moments follow offload_optimizer.device (the reference
+        # offloads optimizer state to NVMe independently of where params
+        # live), defaulting to the param tier when unspecified.  So
+        # param=cpu + optimizer=nvme swaps ONLY the moments, and
+        # param=nvme + optimizer=cpu keeps the moments in RAM.
+        if OffloadDeviceEnum.nvme in (zc.offload_param_device,
+                                      zc.offload_optimizer_device):
+            nvme_path = (pc.nvme_path if pc and pc.nvme_path else
+                         (oc.nvme_path if oc and oc.nvme_path else "/tmp"))
+            base_dir = os.path.join(str(nvme_path), STREAM_SUBDIR,
                                     f"rank{jax.process_index()}")
+        nvme_dir = (base_dir
+                    if zc.offload_param_device == OffloadDeviceEnum.nvme
+                    else None)
+        if zc.offload_optimizer_device == OffloadDeviceEnum.nvme:
+            moments_dir = base_dir
+        elif zc.offload_optimizer_device == OffloadDeviceEnum.cpu:
+            moments_dir = None
+        else:
+            moments_dir = nvme_dir
         self.buffer_count = max(2, int(getattr(pc, "buffer_count", 2) or 2))
         self.resident_layers = int(getattr(pc, "resident_layers", 0) or 0)
 
@@ -349,7 +396,8 @@ class ParamStreamRunner:
         self.store = HostParamStore(
             resident, layer_trees, opt_params=opt_params, opt_name=opt_name,
             compute_dtype=compute_dtype, nvme_dir=nvme_dir,
-            grad_dtype=_np_dtype(grad_accum_dtype))
+            grad_dtype=_np_dtype(grad_accum_dtype),
+            moments_nvme_dir=moments_dir)
 
         # shardings for uploads (tp rules tail-aligned to per-layer rank,
         # fsdp added per plan stage)
@@ -373,6 +421,17 @@ class ParamStreamRunner:
         self._pinned: Dict[int, Any] = {}    # resident_layers working sets
         self._upload_pinned()
         self._jits: Dict[str, Any] = {}
+        self._adam_ex: Optional[ThreadPoolExecutor] = None
+        self.boundary_pipelined = True   # ablation knob (benchmarks)
+
+    def _adam_pool(self) -> ThreadPoolExecutor:
+        """Single-worker pool for boundary Adam updates: one worker keeps
+        unit updates in submission order while freeing the main thread to
+        dispatch H2D uploads under them."""
+        if self._adam_ex is None:
+            self._adam_ex = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="param_stream_adam")
+        return self._adam_ex
 
     # -- placement -----------------------------------------------------
     def _shardings_for(self, tree, prefix: str):
@@ -565,12 +624,14 @@ class ParamStreamRunner:
             mrng = jax.random.fold_in(rng, m) if rng is not None else None
 
             # ---- forward ----
+            bc = self.buffer_count
             x, positions = embed_fwd(self.resident_dev, mb, mrng)
             stash = [None] * L
             aux = jnp.float32(0.0)
             self._ensure(0)
             for l in range(L):
-                self._ensure(l + 1)          # prefetch under compute
+                for k in range(1, bc):       # prefetch bc-1 ahead, under
+                    self._ensure(l + k)      # compute (no-op once in flight)
                 params_l = self._ensure(l)
                 stash[l] = x
                 lrng = (None if self.stacked else
@@ -578,7 +639,7 @@ class ParamStreamRunner:
                          if mrng is not None else None))
                 w = (win_dev[l] if win_dev is not None else None)
                 x, aux = layer_fwd(params_l, x, positions, aux, lrng, w)
-                self._evict([l, l + 1])
+                self._evict(list(range(l, l + bc)))
 
             # ---- head loss + bwd ----
             ce, dres_h, dx, fin = head(self.resident_dev, x, mb, scale)
@@ -587,7 +648,8 @@ class ParamStreamRunner:
 
             # ---- backward over layers ----
             for l in range(L - 1, -1, -1):
-                self._ensure(l - 1)          # prefetch under compute
+                for k in range(1, bc):       # prefetch under compute
+                    self._ensure(l - k)
                 params_l = self._ensure(l)
                 lrng = (None if self.stacked else
                         (jax.random.fold_in(mrng, l)
@@ -600,7 +662,7 @@ class ParamStreamRunner:
                 self._start_d2h(dlayer)
                 pending.append((l, dlayer))
                 flush_pending(self.buffer_count)
-                self._evict([l, l - 1])
+                self._evict(list(range(l - bc + 1, l + 1)))
 
             dres_e, fin = embed_bwd(self.resident_dev, mb, mrng, dx, scale)
             finite_all = jnp.logical_and(finite_all, fin)
@@ -625,16 +687,49 @@ class ParamStreamRunner:
             clip_coef = None
             if clip and clip > 0 and grad_norm > clip:
                 clip_coef = clip / (grad_norm + 1e-6)
-            self.store.begin_step()
+            self._apply_boundary(lr, clip_coef, gas,
+                                 pipelined=self.boundary_pipelined)
+        return mean_loss, grad_norm, overflow
+
+    def _apply_boundary(self, lr: float, clip_coef: Optional[float],
+                        gas: int, pipelined: bool = True):
+        """GAS-boundary optimizer walk + H2D mirror refresh.
+
+        ``pipelined`` (default): ONE worker thread runs the fused C++ Adam
+        unit-by-unit in submission order (ctypes/OpenMP release the GIL, so
+        it truly runs beside the main thread), while the main thread issues
+        the async H2D re-upload of each unit the moment its update lands —
+        the H2D of unit l rides under the Adam of unit l+1
+        (``offload.py step_streamed``'s pattern applied to the layer walk).
+        ``pipelined=False`` is the serial reference walk, kept as the
+        benchmark ablation (``benchmarks/param_stream_boundary``).
+        """
+        L = self.n_layers
+        self.store.begin_step()
+        # every cached working set is stale once updates start
+        self._dev.clear()
+        if not pipelined:
             self.store.apply_unit(-1, lr, clip_coef, gas)
             self.resident_dev = self._upload_resident()
             for l in range(L):
                 self.store.apply_unit(l, lr, clip_coef, gas)
-            # every cached working set is stale after the update
-            self._dev.clear()
             self._upload_pinned()
-            self._ensure(0)   # warm the first working set for the next step
-        return mean_loss, grad_norm, overflow
+            for l in range(self.resident_layers,
+                           min(self.buffer_count, L)):
+                self._ensure(l)   # warm next step's first window
+            return
+        ex = self._adam_pool()
+        futs = [ex.submit(self.store.apply_unit, u, lr, clip_coef, gas)
+                for u in [-1] + list(range(L))]
+        futs[0].result()
+        self.resident_dev = self._upload_resident()
+        for l in range(L):
+            futs[l + 1].result()
+            if l < self.resident_layers:
+                self._pinned[l] = jax.device_put(
+                    self.store.mirror_tree(l), self._layer_shardings[l])
+            elif l < self.buffer_count:
+                self._ensure(l)   # warm next step's first window
 
     # -- eval ----------------------------------------------------------
     def eval_loss(self, batch, rng=None) -> float:
@@ -648,15 +743,17 @@ class ParamStreamRunner:
         x, positions = embed_fwd(self.resident_dev, batch, rng)
         aux = jnp.float32(0.0)
         win = self.windows
+        bc = self.buffer_count
         for l in range(self.n_layers):
-            self._ensure(l + 1)
+            for k in range(1, bc):
+                self._ensure(l + k)
             # same per-layer rng convention as the train path / apply()
             lrng = (None if self.stacked else
                     (jax.random.fold_in(rng, l) if rng is not None
                      else None))
             w = (jnp.asarray(win[l]) if win is not None else None)
             x, aux = layer_fwd(self._ensure(l), x, positions, aux, lrng, w)
-            self._evict([l, l + 1])
+            self._evict(list(range(l, l + bc)))
         loss = self._jit(
             "eval_head",
             lambda res, xx, mb: model.stream_head_loss(res, xx, mb))(

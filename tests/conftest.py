@@ -39,3 +39,10 @@ def mesh_2d():
     """4-way fsdp × 2-way tp."""
     from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
     return build_mesh(TopologyConfig(tp=2))
+
+
+@pytest.fixture
+def mesh_sp():
+    """4-way fsdp × 2-way sp (sequence parallelism)."""
+    from deepspeed_tpu.parallel.topology import TopologyConfig, build_mesh
+    return build_mesh(TopologyConfig(sp=2))

@@ -224,6 +224,65 @@ def test_stream_nvme_memmap(tmp_path):
                                    rtol=1e-6)
 
 
+def test_stream_nvme_via_optimizer_device(tmp_path):
+    """offload_param cpu + offload_optimizer nvme memmaps ONLY the Adam
+    moments — optimizer NVMe offload is independent of where params live
+    (round-4 advisor), and the hot upload mirrors / masters stay in RAM
+    as the explicit 'cpu' setting demands."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    zo = {"stage": 0,
+          "offload_param": {"device": "cpu"},
+          "offload_optimizer": {"device": "nvme",
+                                "nvme_path": str(tmp_path)}}
+    e = _engine(model, params, zero_optimization=zo)
+    store = e._param_stream.store
+    assert all(isinstance(m, np.memmap) for m in store.moments)
+    assert not isinstance(store.masters, np.memmap)
+    assert not isinstance(store.mirrors, np.memmap)
+
+
+def test_stream_nvme_param_with_cpu_optimizer_keeps_moments_in_ram(tmp_path):
+    """The reverse split: params on NVMe, moments explicitly in RAM."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e = _engine(model, params, **_stream_cfg(
+        extra_param={"device": "nvme", "nvme_path": str(tmp_path)}))
+    store = e._param_stream.store
+    assert isinstance(store.masters, np.memmap)
+    assert not any(isinstance(m, np.memmap) for m in store.moments)
+
+
+def test_stream_buffer_count_deepens_window():
+    """buffer_count sets the on-device working-set window (prefetch depth
+    buffer_count-1); a deeper window is a pure perf knob — trajectory
+    identical to double buffering."""
+    model = _toy_lm()
+    params = model.init(jax.random.key(0))
+    e2 = _engine(model, params,
+                 **_stream_cfg(extra_param={"buffer_count": 2}))
+    e4 = _engine(model, params,
+                 **_stream_cfg(extra_param={"buffer_count": 4}))
+    assert e4._param_stream.buffer_count == 4
+    for seed in range(2):
+        b = _batch(seed=seed)
+        np.testing.assert_allclose(float(e2.train_batch(batch=b)),
+                                   float(e4.train_batch(batch=b)),
+                                   rtol=1e-6)
+
+
+def test_host_store_shape_mismatch_not_homogeneous():
+    """Equal totals + equal structure but different per-leaf shapes must
+    take the heterogeneous path — sharing layer 0's FlatLayout would
+    unflatten transposed views (round-4 advisor)."""
+    from deepspeed_tpu.runtime.zero.param_stream import HostParamStore
+    t0 = {"w": np.ones((4, 8), np.float32)}
+    t1 = {"w": np.ones((8, 4), np.float32)}
+    store = HostParamStore({"e": np.ones((2,), np.float32)}, [t0, t1])
+    assert not store.homogeneous
+    assert store.layouts[1].unflatten(store.masters[1])["w"].shape == (8, 4)
+
+
 def test_stream_eval_and_state_dict():
     model = _toy_lm()
     params = model.init(jax.random.key(0))
@@ -274,6 +333,25 @@ def test_zero_init_remote_device_hosts_params():
 # ----------------------------------------------------------------------
 # sharded streaming (multi-device mesh)
 # ----------------------------------------------------------------------
+def test_stream_sp_matches(mesh_sp):
+    """sp×fsdp mesh + ulysses attention: sequence-parallel activations
+    under streamed host-resident params — trajectory matches the
+    device-resident offload engine (round-4 verdict, next #10)."""
+    model = _toy_lm(attn_impl="ulysses")
+    params = model.init(jax.random.key(0))
+    e_res = _engine(model, params, **_offload_cfg())
+    eng, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(**_stream_cfg(stage=3)), mesh=mesh_sp,
+        tp_rules=model.tp_rules())
+    assert eng._param_stream is not None
+    for seed in range(2):
+        b = _batch(bsz=8, seed=seed)
+        l1 = float(e_res.train_batch(batch=b))
+        l2 = float(eng.train_batch(batch=b))
+        np.testing.assert_allclose(l1, l2, rtol=5e-5)
+
+
 def test_stream_sharded_uploads_match(mesh_2d):
     """tp×fsdp mesh: uploaded working sets carry tail-aligned tp specs +
     fsdp; trajectory matches the single-device stream."""
