@@ -38,12 +38,15 @@ class PipelineEngine(DeepSpeedEngine):
                              "is required")
         # tp_rules default comes from the base engine's auto-TP
         # (DeepSpeedEngine.__init__ pulls model.tp_rules())
-        if config.zero_config.offload_optimizer_device != "none":
-            raise NotImplementedError(
-                "offload_optimizer is not supported with pipeline "
-                "parallelism: the offload step path bypasses the pipeline "
-                "schedule (reference: PP composes with ZeRO-Offload only "
-                "through BF16_Optimizer, not the CPU-Adam path)")
+        if config.zero_config.offload_param_device != "none":
+            raise ValueError(
+                "offload_param (param-stream) does not compose with "
+                "pipeline parallelism: the pipelined step is one jitted "
+                "SPMD scan with no per-layer program boundary to stream "
+                "through (the reference draws the same line — ZeRO-3 param "
+                "partitioning is incompatible with PP, engine.py:1541).  "
+                "Use offload_optimizer (host Adam at the step boundary) "
+                "with PP instead.")
         super().__init__(model=model, config=config, **kwargs)
         assert self.zero_stage <= 1, (
             "ZeRO-2/3 is incompatible with pipeline parallelism "
@@ -74,6 +77,35 @@ class PipelineEngine(DeepSpeedEngine):
             return self._finish_step(state, loss, grads, rng)
 
         return train_step
+
+    # ZeRO-Offload x PP: the base builder wraps a GAS scan around the loss,
+    # but here the microbatch dim IS the pipeline clock — build the grad
+    # step from the pipelined loss directly.  The host tail (streamed D2H /
+    # C++ Adam / streamed H2D, engine._offload_host_apply) is shared.
+    def _get_compiled_offload_grad_step(self, gas: int):
+        if getattr(self, "_compiled_offload_grad", None) is None:
+            from deepspeed_tpu.runtime.engine import (_global_norm_f32,
+                                                      constrain,
+                                                      has_inf_or_nan)
+            fp16 = self._config.fp16_enabled
+
+            def grad_step(state: TrainState, batch):
+                if gas == 1:
+                    batch = jax.tree_util.tree_map(lambda x: x[None], batch)
+                scale = (state.loss_scale.cur_scale if fp16
+                         else jnp.float32(1.0))
+                rng, step_rng = jax.random.split(state.rng)
+                loss, grads = self._loss_and_grads(
+                    state.params, scale, batch, step_rng,
+                    step=state.global_step, qstep=moq_anneal_step(state))
+                grads = constrain(grads, self.plan.grad_specs(state.params),
+                                  self.mesh)
+                overflow = (has_inf_or_nan(grads) if fp16
+                            else jnp.asarray(False))
+                grad_norm = _global_norm_f32(grads)
+                return loss, grads, overflow, grad_norm, rng
+            self._compiled_offload_grad = jax.jit(grad_step)
+        return self._compiled_offload_grad
 
     def _model_scaled_loss(self, p_c, batch, rng, loss_scale):
         """Scale AT THE SOURCE: the interleaved 1F1B backward runs inside

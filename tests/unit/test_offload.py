@@ -266,13 +266,18 @@ def test_engine_offload_load_without_optimizer_states(tmp_path):
     assert np.max(np.abs(after_w - trained_w)) < 0.05
 
 
-def test_pipeline_rejects_offload():
-    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+def test_pipeline_rejects_param_stream():
+    """offload_optimizer now composes with PP (host Adam at the step
+    boundary — test_pipe.py::test_pipeline_offload_optimizer_matches);
+    offload_param still cannot (no per-layer program boundary inside the
+    jitted pipeline scan — the reference's ZeRO-3 x PP line)."""
+    from deepspeed_tpu.runtime.pipe import PipelineModule
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
     from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
     cfg = DeepSpeedConfig(base_config(
         zero_optimization={"stage": 0,
+                           "offload_param": {"device": "cpu"},
                            "offload_optimizer": {"device": "cpu"}}))
-    with pytest.raises(NotImplementedError, match="offload"):
+    with pytest.raises(ValueError, match="offload_param"):
         PipelineEngine(model=object.__new__(PipelineModule), config=cfg,
                        params={}, tp_rules=[])

@@ -691,3 +691,59 @@ def test_moe_pipeline_mixed_freq_raises():
     cfg = TransformerConfig.tiny(moe_num_experts=4, moe_layer_freq=2)
     with pytest.raises(ValueError, match="moe_layer_freq"):
         TransformerBlockPipe(cfg)
+
+
+# ----------------------------------------------------------------------
+# ZeRO-Offload x PP (round-4 verdict, next #10: streaming x the matrix)
+# ----------------------------------------------------------------------
+def test_pipeline_offload_optimizer_matches():
+    """PP + offload_optimizer: host C++ Adam at the step boundary tracks
+    the in-program optax trajectory (the reference composes ZeRO-Offload
+    with PP the same way — optimizer state off-device, schedule intact)."""
+    groups.reset_mesh()
+    cfg = TransformerConfig.tiny(hidden_size=32, n_heads=4, n_layers=4,
+                                 vocab_size=128, max_seq_len=16)
+
+    def build(offload):
+        groups.reset_mesh()
+        m = transformer_pipeline(cfg, num_stages=2)
+        zo = {"stage": 1}
+        if offload:
+            zo["offload_optimizer"] = {"device": "cpu"}
+        engine, *_ = deepspeed_tpu.initialize(
+            model=m, model_parameters=m.init(jax.random.key(0)),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": zo,
+                    "mesh": {"pp": 2, "fsdp": -1}})
+        return engine
+
+    e_off, e_plain = build(True), build(False)
+    rng = np.random.default_rng(0)
+    dp = e_off._config.data_parallel_size
+    for s in range(3):
+        b = {"input_ids": rng.integers(0, 128, size=(4, dp, 16))}
+        l1 = float(e_plain.train_batch(batch=b))
+        l2 = float(e_off.train_batch(batch=b))
+        np.testing.assert_allclose(l1, l2, rtol=2e-4)
+    groups.reset_mesh()
+
+
+def test_pipeline_param_stream_raises_clearly():
+    """offload_param x PP is rejected with the reference's rationale
+    (ZeRO-3 param partitioning is incompatible with PP, engine.py:1541)."""
+    groups.reset_mesh()
+    cfg = TransformerConfig.tiny(hidden_size=32, n_heads=4, n_layers=4,
+                                 vocab_size=128, max_seq_len=16)
+    m = transformer_pipeline(cfg, num_stages=2)
+    with pytest.raises(ValueError, match="offload_param"):
+        deepspeed_tpu.initialize(
+            model=m, model_parameters=m.init(jax.random.key(0)),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 1,
+                        "offload_param": {"device": "cpu"}},
+                    "mesh": {"pp": 2, "fsdp": -1}})
+    groups.reset_mesh()
