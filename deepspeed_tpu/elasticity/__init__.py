@@ -1,12 +1,15 @@
 """Elastic training (reference ``deepspeed/elasticity/``)."""
 
-from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, ScaleEvent
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    HeartbeatMonitor,
+                                                    ScaleEvent)
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityConfig, ElasticityConfigError, ElasticityError,
     ElasticityIncompatibleWorldSize, compute_elastic_config,
     ensure_immutable_elastic_config, get_valid_gpus)
 
-__all__ = ["DSElasticAgent", "ScaleEvent", "ElasticityConfig",
+__all__ = ["DSElasticAgent", "HeartbeatMonitor", "ScaleEvent",
+           "ElasticityConfig",
            "ElasticityError", "ElasticityConfigError",
            "ElasticityIncompatibleWorldSize", "compute_elastic_config",
            "ensure_immutable_elastic_config", "get_valid_gpus"]

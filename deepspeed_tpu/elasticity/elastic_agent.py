@@ -2,22 +2,85 @@
 
 Parity: reference ``elasticity/elastic_agent.py:25`` (``DSElasticAgent``
 extends torch-elastic's ``LocalElasticAgent``: on a rendezvous membership
-change it tears down workers and restarts them with the new world size).
+change it tears down workers and restarts them with the new world size;
+liveness comes from the rendezvous keep-alive heartbeat).
 
-TPU design: jax has no in-process rendezvous to re-enter, so the agent is a
-supervisor loop around the training entrypoint: on a worker failure or an
-explicit scale event it recomputes the elastic batch configuration for the
-new chip count (``compute_elastic_config``) and re-invokes the entrypoint,
-which resumes from the latest checkpoint (orbax reshards the ZeRO state to
-the new mesh).
+TPU design: jax has no in-process rendezvous to re-enter, so the agent
+supervises at two levels:
+
+* :meth:`DSElasticAgent.run` — in-process loop around a training callable:
+  a worker failure or an explicit :class:`ScaleEvent` re-enters with the
+  elastic batch configuration recomputed for the new chip count
+  (``compute_elastic_config``); training resumes from the latest
+  checkpoint (orbax reshards the ZeRO state to the new mesh).
+* :meth:`DSElasticAgent.run_procs` — PROCESS supervision for the
+  multi-host launcher path: one subprocess per worker, liveness from BOTH
+  process exit codes and a heartbeat file each worker touches
+  (:class:`HeartbeatMonitor` — the torch-elastic keep-alive analogue).  A
+  dead or silent worker tears the generation down and restarts at the
+  surviving world size.
 """
 
+import os
+import subprocess
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityIncompatibleWorldSize, compute_elastic_config)
 from deepspeed_tpu.utils.logging import logger
+
+HEARTBEAT_ENV = "DS_ELASTIC_HEARTBEAT_FILE"
+
+
+class HeartbeatMonitor:
+    """File-based worker liveness (reference: the rendezvous keep-alive).
+
+    Workers call :meth:`beat` (or just ``touch`` the path handed to them in
+    ``$DS_ELASTIC_HEARTBEAT_FILE``); the agent polls :meth:`dead_ranks`.
+    A rank with no heartbeat file yet is given grace until ``timeout_s``
+    after :meth:`start`."""
+
+    def __init__(self, hb_dir: str, world_size: int, timeout_s: float = 60.0):
+        self.hb_dir = hb_dir
+        self.world_size = world_size
+        self.timeout_s = float(timeout_s)
+        self.t0 = time.time()
+        os.makedirs(hb_dir, exist_ok=True)
+        # a fresh monitor is a fresh generation: leftover heartbeat files
+        # (prior generation / prior agent run) would read as instantly
+        # stale and kill healthy workers before they start beating
+        for r in range(world_size):
+            try:
+                os.remove(self.path(r))
+            except OSError:
+                pass
+
+    def path(self, rank: int) -> str:
+        return os.path.join(self.hb_dir, f"heartbeat_rank{rank}")
+
+    def start(self):
+        self.t0 = time.time()
+
+    @staticmethod
+    def beat(path: Optional[str] = None):
+        """Touch the heartbeat file (workers call this periodically)."""
+        path = path or os.environ.get(HEARTBEAT_ENV)
+        if path:
+            with open(path, "w") as f:
+                f.write(str(time.time()))
+
+    def dead_ranks(self, now: Optional[float] = None) -> List[int]:
+        now = now if now is not None else time.time()
+        dead = []
+        for r in range(self.world_size):
+            try:
+                last = os.path.getmtime(self.path(r))
+            except OSError:
+                last = self.t0        # not yet written: grace from start
+            if now - last > self.timeout_s:
+                dead.append(r)
+        return dead
 
 
 class ScaleEvent(Exception):
@@ -63,5 +126,78 @@ class DSElasticAgent:
                     raise
                 logger.warning(f"worker failure ({e}); restart "
                                f"{self.restarts}/{self.max_restarts}")
+            if self.restart_delay_s:
+                time.sleep(self.restart_delay_s)
+
+    # ------------------------------------------------------------------
+    # multi-host process supervision (launcher path)
+    # ------------------------------------------------------------------
+    def run_procs(self, cmd_for: Callable[[int, int, Dict], Sequence[str]],
+                  heartbeat_dir: str, heartbeat_timeout_s: float = 60.0,
+                  poll_s: float = 1.0) -> int:
+        """Supervise one subprocess per worker with liveness detection.
+
+        ``cmd_for(rank, world_size, ds_config)`` returns the argv for one
+        worker; each worker gets its heartbeat path in
+        ``$DS_ELASTIC_HEARTBEAT_FILE`` and should touch it periodically
+        (``HeartbeatMonitor.beat()``).  A worker that exits nonzero, or
+        whose heartbeat goes stale past ``heartbeat_timeout_s``, is a
+        membership change: the surviving generation is torn down and
+        restarted at the new world size (reference
+        ``_invoke_run``'s monitor loop → ``_restart_workers``).  Returns 0
+        when every worker of a generation exits cleanly."""
+        while True:
+            batch, valid, micro = compute_elastic_config(
+                self.ds_config, world_size=self.world_size)
+            cfg = dict(self.ds_config)
+            cfg["train_batch_size"] = batch
+            cfg["train_micro_batch_size_per_gpu"] = micro
+            hb = HeartbeatMonitor(heartbeat_dir, self.world_size,
+                                  timeout_s=heartbeat_timeout_s)
+            procs = []
+            for r in range(self.world_size):
+                env = dict(os.environ, RANK=str(r),
+                           WORLD_SIZE=str(self.world_size))
+                env[HEARTBEAT_ENV] = hb.path(r)
+                procs.append(subprocess.Popen(
+                    list(cmd_for(r, self.world_size, cfg)), env=env))
+            hb.start()
+            dead: List[int] = []
+            try:
+                while True:
+                    rcs = [p.poll() for p in procs]
+                    dead = [r for r, rc in enumerate(rcs)
+                            if rc is not None and rc != 0]
+                    if not dead:
+                        dead = [r for r in hb.dead_ranks()
+                                if rcs[r] is None]   # silent, not exited
+                    if dead:
+                        break
+                    if all(rc == 0 for rc in rcs):
+                        return 0
+                    time.sleep(poll_s)
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                raise RuntimeError(
+                    f"elastic agent: exceeded max_restarts="
+                    f"{self.max_restarts} (last dead ranks: {dead})")
+            new_world = self.world_size - len(dead)
+            if new_world < 1:
+                raise RuntimeError(
+                    "elastic agent: every worker died "
+                    f"(ranks {dead}) — nothing to restart with")
+            logger.warning(
+                f"elastic membership change: ranks {dead} died; "
+                f"restarting at world size {self.world_size} → {new_world}")
+            self.world_size = new_world
             if self.restart_delay_s:
                 time.sleep(self.restart_delay_s)

@@ -3,20 +3,36 @@
 Parity: reference ``runtime/data_pipeline/data_sampling/data_analyzer.py``
 (``DataAnalyzer``: map metric functions over the dataset in parallel
 workers, write per-metric ``sample_to_metric`` / ``metric_to_sample``
-indexed files, then ``index_to_sample_percentile_merged``).
+indexed files, then merge ``index_to_sample_percentile_merged`` so the
+sampler can address samples by difficulty percentile).
 
-TPU design: host-side numpy + the mmap indexed dataset; the output feeds
-``DeepSpeedDataSampler`` difficulties directly.
+TPU design: host-side numpy + the mmap indexed dataset; the outputs feed
+``DeepSpeedDataSampler`` difficulties directly.  Per metric the full
+reference index family is written:
+
+* ``{metric}_sample_to_metric``            — item 0: value per sample
+* ``{metric}_index_to_metric``             — item 0: sorted unique values
+* ``{metric}_index_to_sample``             — item i: sample ids whose value
+  is ``index_to_metric[i]`` (the reference's metric_to_sample inverse)
+* ``{metric}_index_to_sample_percentile_merged`` — item p (p=0..99):
+  sample ids in percentile bucket p of the metric distribution
+
+Multi-metric curricula compose via :meth:`compose_metrics` — per-metric
+percentile ranks, weighted-summed into ONE difficulty array (values
+0..100), which is what ``DeepSpeedDataSampler(difficulties=...)`` and a
+``CurriculumScheduler`` whose difficulty runs 0..100 consume.
 """
 
 import os
-from typing import Callable, Dict, List, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
     MMapIndexedDataset, MMapIndexedDatasetBuilder)
 from deepspeed_tpu.utils.logging import logger
+
+PERCENTILE_BUCKETS = 100
 
 
 class DataAnalyzer:
@@ -35,9 +51,12 @@ class DataAnalyzer:
         self.num_workers = num_workers
         self.worker_id = worker_id
 
-    def _prefix(self, name: str) -> str:
-        return os.path.join(self.save_path, f"{name}_sample_to_metric")
+    def _prefix(self, name: str, kind: str = "sample_to_metric") -> str:
+        return os.path.join(self.save_path, f"{name}_{kind}")
 
+    # ------------------------------------------------------------------
+    # map / reduce over workers
+    # ------------------------------------------------------------------
     def run_map(self) -> Dict[str, np.ndarray]:
         """Compute each metric over this worker's shard and persist."""
         os.makedirs(self.save_path, exist_ok=True)
@@ -50,26 +69,108 @@ class DataAnalyzer:
                 vals[i] = int(fn(self.dataset[i]))
             out[name] = vals
             if self.num_workers == 1:
-                b = MMapIndexedDatasetBuilder(self._prefix(name),
-                                              dtype=np.int64)
-                b.add_item(vals)
-                b.finalize()
-                logger.info(f"data_analyzer: wrote {self._prefix(name)}")
+                self._write_indexes(name, vals)
         return out
 
     def run_reduce(self, partials: List[Dict[str, np.ndarray]]
                    ) -> Dict[str, np.ndarray]:
-        """Merge worker shards (element-wise max — shards are disjoint)."""
+        """Merge worker shards (element-wise max — shards are disjoint) and
+        write the full index family per metric."""
         merged = {}
         for name in self.metric_names:
             acc = partials[0][name].copy()
             for p in partials[1:]:
                 acc = np.maximum(acc, p[name])
             merged[name] = acc
-            b = MMapIndexedDatasetBuilder(self._prefix(name), dtype=np.int64)
-            b.add_item(acc)
-            b.finalize()
+            self._write_indexes(name, acc)
         return merged
 
+    # ------------------------------------------------------------------
+    # index family (reference: sample_to_metric + metric_to_sample +
+    # index_to_sample_percentile_merged)
+    # ------------------------------------------------------------------
+    def _write_indexes(self, name: str, vals: np.ndarray) -> None:
+        b = MMapIndexedDatasetBuilder(self._prefix(name), dtype=np.int64)
+        b.add_item(vals)
+        b.finalize()
+
+        uniq, inverse = np.unique(vals, return_inverse=True)
+        b = MMapIndexedDatasetBuilder(self._prefix(name, "index_to_metric"),
+                                      dtype=np.int64)
+        b.add_item(uniq)
+        b.finalize()
+
+        order = np.argsort(inverse, kind="stable")
+        counts = np.bincount(inverse, minlength=len(uniq))
+        bounds = np.concatenate([[0], np.cumsum(counts)])
+        b = MMapIndexedDatasetBuilder(self._prefix(name, "index_to_sample"),
+                                      dtype=np.int64)
+        for i in range(len(uniq)):
+            b.add_item(order[bounds[i]:bounds[i + 1]])
+        b.finalize()
+
+        by_value = np.argsort(vals, kind="stable")
+        edges = np.linspace(0, len(vals), PERCENTILE_BUCKETS + 1)
+        edges = np.round(edges).astype(np.int64)
+        b = MMapIndexedDatasetBuilder(
+            self._prefix(name, "index_to_sample_percentile_merged"),
+            dtype=np.int64)
+        for p in range(PERCENTILE_BUCKETS):
+            b.add_item(by_value[edges[p]:edges[p + 1]])
+        b.finalize()
+        logger.info(f"data_analyzer: wrote {name} index family under "
+                    f"{self.save_path}")
+
+    # ------------------------------------------------------------------
+    # loaders
+    # ------------------------------------------------------------------
     def load_metric(self, name: str) -> np.ndarray:
         return MMapIndexedDataset(self._prefix(name))[0]
+
+    def load_index_to_metric(self, name: str) -> np.ndarray:
+        return MMapIndexedDataset(self._prefix(name, "index_to_metric"))[0]
+
+    def load_index_to_sample(self, name: str) -> List[np.ndarray]:
+        ds = MMapIndexedDataset(self._prefix(name, "index_to_sample"))
+        return [ds[i] for i in range(len(ds))]
+
+    def load_percentile_index(self, name: str) -> List[np.ndarray]:
+        ds = MMapIndexedDataset(
+            self._prefix(name, "index_to_sample_percentile_merged"))
+        return [ds[i] for i in range(len(ds))]
+
+    # ------------------------------------------------------------------
+    # multi-metric composition
+    # ------------------------------------------------------------------
+    @staticmethod
+    def compose_metrics(metrics: Dict[str, np.ndarray],
+                        weights: Optional[Dict[str, float]] = None
+                        ) -> np.ndarray:
+        """Compose several per-sample metric arrays into ONE difficulty.
+
+        Each metric is converted to its percentile rank (0..100) so
+        incommensurable scales (sequence length vs vocab rarity) mix
+        sanely, then weighted-averaged.  The result plugs straight into
+        ``DeepSpeedDataSampler(difficulties=...)`` with a curriculum whose
+        difficulty schedule runs 0..100 — the role of the reference's
+        percentile-merged multi-metric index.
+        """
+        assert metrics, "need at least one metric"
+        names = sorted(metrics)
+        weights = weights or {}
+        n = len(next(iter(metrics.values())))
+        total_w = sum(float(weights.get(nm, 1.0)) for nm in names)
+        out = np.zeros(n, np.float64)
+        for nm in names:
+            vals = np.asarray(metrics[nm])
+            assert len(vals) == n, f"metric {nm} length {len(vals)} != {n}"
+            # average rank over ties: equal metric values must compose to
+            # equal difficulties (a curriculum threshold may not split
+            # samples that are indistinguishable under the metric)
+            sorted_vals = np.sort(vals, kind="stable")
+            lo = np.searchsorted(sorted_vals, vals, side="left")
+            hi = np.searchsorted(sorted_vals, vals, side="right")
+            ranks = (lo + hi - 1) / 2.0
+            pct = ranks * (PERCENTILE_BUCKETS / max(1, n - 1))
+            out += float(weights.get(nm, 1.0)) * pct
+        return np.rint(out / total_w).astype(np.int64)
