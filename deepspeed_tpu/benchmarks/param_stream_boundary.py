@@ -1,0 +1,175 @@
+"""Param-stream GAS-boundary + streamed-writeback benchmark (round-4
+verdict, next #4).
+
+Two measurements, both on the real local device (no synthetic SlowHandle):
+
+* ``boundary``  — ``ParamStreamRunner._apply_boundary`` with the threaded
+  Adam/H2D pipeline vs the serial reference walk.  The pipeline hides the
+  H2D re-upload of updated units (resident group + pinned + first window)
+  under the C++ Adam of later units.
+* ``writeback`` — ``HostOffloadOptimizer.step_streamed`` (per-leaf D2H /
+  per-subgroup Adam / per-leaf H2D, all overlapped) vs the serial
+  D2H → step() → whole-tree cast + upload sequence the engine used before
+  round 4.  Reference anchor: the per-bucket H2D streams of
+  ``stage_1_and_2.py:1086``.
+
+Run:  python -m deepspeed_tpu.benchmarks.param_stream_boundary
+      [--hidden 2048] [--layers 16] [--numel 200000000] [--reps 3]
+Prints one JSON line per section plus a summary line.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+
+
+def _runner(hidden, layers, vocab, buffer_count):
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden_size=hidden, n_layers=layers,
+        n_heads=max(4, hidden // 128), max_seq_len=128)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {
+                "stage": 0,
+                "offload_param": {"device": "cpu",
+                                  "buffer_count": buffer_count},
+                "offload_optimizer": {"device": "cpu"},
+            },
+        })
+    return engine._param_stream
+
+
+def _fill_grads(store, rng):
+    store.res_gacc[:] = rng.normal(
+        size=store.res_gacc.shape).astype(store.res_gacc.dtype)
+    if store.homogeneous:
+        store.gaccs[:] = rng.normal(
+            size=store.gaccs.shape).astype(store.gaccs.dtype)
+    else:
+        for g in store.gaccs:
+            g[:] = rng.normal(size=g.shape).astype(g.dtype)
+
+
+def _block_runner(runner):
+    jax.block_until_ready(runner.resident_dev)
+    for t in list(runner._pinned.values()) + list(runner._dev.values()):
+        jax.block_until_ready(t)
+
+
+def _time_boundary(runner, pipelined, reps, warmup=True):
+    rng = np.random.default_rng(0)
+    if warmup:
+        _fill_grads(runner.store, rng)
+        runner._apply_boundary(1e-4, None, 1, pipelined=pipelined)
+        _block_runner(runner)
+    ts = []
+    for _ in range(reps):
+        _fill_grads(runner.store, rng)
+        t0 = time.perf_counter()
+        runner._apply_boundary(1e-4, None, 1, pipelined=pipelined)
+        _block_runner(runner)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def _time_writeback(numel, sub_groups, reps):
+    dev = jax.devices()[0]
+    sh = jax.sharding.SingleDeviceSharding(dev)
+    params = {"w": np.zeros(numel, np.float32)}
+    zc = DeepSpeedZeroConfig({"stage": 3,
+                              "sub_group_size": numel // sub_groups})
+
+    def build():
+        return HostOffloadOptimizer(params, zc, opt_name="adamw",
+                                    opt_params={"lr": 1e-4})
+
+    rng = np.random.default_rng(0)
+    g_host = rng.normal(size=numel).astype(np.float32)
+    g_dev = jax.device_put(g_host, sh)
+    jax.block_until_ready(g_dev)
+
+    opt = build()
+    serial, streamed = [], []
+    for i in range(reps + 1):
+        # serial: D2H fetch, full Adam, whole-tree cast + upload tail
+        t0 = time.perf_counter()
+        host_g = {"w": np.asarray(jax.device_get(g_dev))}
+        opt.step(host_g)
+        new = jax.device_put(
+            opt.params_tree(dtype=np.dtype("bfloat16"))["w"], sh)
+        jax.block_until_ready(new)
+        if i > 0:                     # first rep is warmup
+            serial.append(time.perf_counter() - t0)
+    opt = build()
+    for i in range(reps + 1):
+        t0 = time.perf_counter()
+        new = opt.step_streamed({"w": g_dev}, upload_shardings={"w": sh},
+                                upload_dtype=np.dtype("bfloat16"))
+        jax.block_until_ready(new)
+        if i > 0:
+            streamed.append(time.perf_counter() - t0)
+    return min(serial), min(streamed)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--buffer-count", type=int, default=5)
+    ap.add_argument("--numel", type=int, default=200_000_000)
+    ap.add_argument("--sub-groups", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend in-process (the JAX_PLATFORMS "
+                         "env var can hang under the site backend hook)")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    runner = _runner(args.hidden, args.layers, args.vocab, args.buffer_count)
+    n = runner.store.num_params()
+    serial_b = _time_boundary(runner, pipelined=False, reps=args.reps)
+    piped_b = _time_boundary(runner, pipelined=True, reps=args.reps)
+    boundary = {
+        "section": "boundary", "n_params": n,
+        "serial_sec": round(serial_b, 4), "pipelined_sec": round(piped_b, 4),
+        "speedup_x": round(serial_b / piped_b, 3),
+        "hidden": args.hidden, "layers": args.layers,
+        "buffer_count": args.buffer_count,
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(boundary))
+
+    ser_w, str_w = _time_writeback(args.numel, args.sub_groups, args.reps)
+    writeback = {
+        "section": "writeback", "numel": args.numel,
+        "serial_sec": round(ser_w, 4), "streamed_sec": round(str_w, 4),
+        "speedup_x": round(ser_w / str_w, 3),
+        "sub_groups": args.sub_groups,
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(writeback))
+    print(json.dumps({"section": "summary",
+                      "boundary_speedup_x": boundary["speedup_x"],
+                      "writeback_speedup_x": writeback["speedup_x"]}))
+    return boundary, writeback
+
+
+if __name__ == "__main__":
+    main()

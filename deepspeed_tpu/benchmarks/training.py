@@ -33,7 +33,21 @@ MODELS = {
     "gpt_1_1b": dict(hidden_size=2048, n_layers=20, n_heads=16),
     "gpt2_1_5b": dict(hidden_size=1600, n_layers=48, n_heads=25),
     "gpt_2_7b": dict(hidden_size=2560, n_layers=32, n_heads=32),
+    # beyond-HBM ladder (param-stream: --offload-param cpu hosts the stack;
+    # only the resident group + a working-set window live in HBM).  Host
+    # Adam state is 16 B/param (fp32 master + 2 fp32 moments + bf16 mirror
+    # + bf16 grad accum), so host RAM — not HBM — caps the ladder
+    "gpt_5b": dict(hidden_size=4096, n_layers=24, n_heads=32),
     "gpt_6_7b": dict(hidden_size=4096, n_layers=32, n_heads=32),
+    "gpt_8b": dict(hidden_size=4096, n_layers=40, n_heads=32),
+    # north-star shapes (--arch llama: GQA + SwiGLU + RoPE + RMSNorm —
+    # BASELINE.md's Llama-2-70B-class MFU target, scaled to chip)
+    "llama_1b": dict(hidden_size=2048, n_layers=16, n_heads=16,
+                     n_kv_heads=4, ffn_hidden_size=5632),
+    "llama_3b": dict(hidden_size=3072, n_layers=26, n_heads=24,
+                     n_kv_heads=8, ffn_hidden_size=8192),
+    "llama_7b": dict(hidden_size=4096, n_layers=32, n_heads=32,
+                     n_kv_heads=8, ffn_hidden_size=11008),
 }
 
 _PEAK_BF16 = (("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0),
@@ -51,8 +65,10 @@ def _peak_tflops(kind: str):
 def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
                   zero_stage=3, offload=None, remat=True,
                   remat_policy="dots_saveable", attn_block_q=None,
-                  attn_block_k=None, dtype="bf16", vocab_size=50304,
-                  moment_dtype="float32", grad_accum_dtype=None):
+                  attn_block_k=None, dtype="bf16", vocab_size=None,
+                  moment_dtype="float32", grad_accum_dtype=None,
+                  arch=None, offload_param=None, resident_layers=0,
+                  buffer_count=None, serial_boundary=False):
     import jax
     import numpy as np
 
@@ -69,20 +85,40 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
         print(f"# batch rounded to {batch} (divisible by {ndev} devices)",
               file=sys.stderr)
     shape = MODELS[model] if isinstance(model, str) else dict(model)
+    if arch is None:     # auto from the model name; explicit --arch wins
+        arch = ("llama" if isinstance(model, str)
+                and model.startswith("llama") else "gpt")
     over = {}
     if attn_block_q:
         over["attn_block_q"] = attn_block_q
     if attn_block_k:
         over["attn_block_k"] = attn_block_k
+    if arch == "llama":
+        # GQA + SwiGLU + RoPE + RMSNorm (the BASELINE.md north-star shape)
+        arch_kw = dict(activation="silu", use_rmsnorm=True, use_rope=True,
+                       tie_embeddings=False,
+                       vocab_size=vocab_size or 32000)
+    else:
+        arch_kw = dict(activation="gelu", use_rmsnorm=False, use_rope=False,
+                       tie_embeddings=True,
+                       vocab_size=vocab_size or 50304)
     cfg = TransformerConfig(
-        vocab_size=vocab_size, max_seq_len=seq, activation="gelu",
-        use_rmsnorm=False, use_rope=False, tie_embeddings=True,
-        remat=remat, remat_policy=remat_policy, **shape, **over)
+        max_seq_len=seq, remat=remat, remat_policy=remat_policy,
+        **arch_kw, **shape, **over)
     model_obj = CausalTransformerLM(cfg)
 
     zero = {"stage": zero_stage}
     if offload:
         zero["offload_optimizer"] = {"device": offload}
+    if offload_param:
+        pc = {"device": offload_param}
+        if resident_layers:
+            pc["resident_layers"] = resident_layers
+        if buffer_count:
+            pc["buffer_count"] = buffer_count
+        zero["offload_param"] = pc
+        # param-stream needs the host Adam; default its state host-side too
+        zero.setdefault("offload_optimizer", {"device": "cpu"})
     ds_config = {"train_micro_batch_size_per_gpu": batch // ndev,
                  "gradient_accumulation_steps": gas,
                  "optimizer": {"type": "AdamW",
@@ -92,9 +128,23 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
                  "zero_optimization": zero}
     if grad_accum_dtype:
         ds_config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    if offload_param:
+        # beyond-HBM init: run the initialiser on the HOST backend (the
+        # full tree must never materialise in HBM — zero.Init
+        # remote_device semantics), at compute dtype to halve host RAM
+        import jax.numpy as jnp
+        with jax.default_device(jax.devices("cpu")[0]):
+            params0 = model_obj.init(
+                jax.random.key(0),
+                dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32)
+        params0 = jax.tree_util.tree_map(np.asarray, params0)
+    else:
+        params0 = model_obj.init(jax.random.key(0))
     engine, *_ = deepspeed_tpu.initialize(
-        model=model_obj, model_parameters=model_obj.init(jax.random.key(0)),
-        config=ds_config)
+        model=model_obj, model_parameters=params0, config=ds_config)
+    del params0
+    if serial_boundary and getattr(engine, "_param_stream", None):
+        engine._param_stream.boundary_pipelined = False   # ablation
 
     rng = np.random.default_rng(0)
     bshape = (gas, batch, seq) if gas > 1 else (batch, seq)
@@ -130,6 +180,12 @@ def run_benchmark(model="gpt_350m", batch=8, gas=1, seq=1024, steps=10,
         out["moment_dtype"] = moment_dtype
     if grad_accum_dtype:
         out["grad_accum_dtype"] = grad_accum_dtype
+    if offload_param:
+        out["offload_param"] = offload_param
+        out["resident_layers"] = resident_layers
+        out["boundary"] = "serial" if serial_boundary else "pipelined"
+    if arch != "gpt":
+        out["arch"] = arch
     if peak:
         out["mfu"] = round(tflops / peak, 4)
     return out
@@ -145,6 +201,16 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--zero-stage", type=int, default=3)
     p.add_argument("--offload", choices=["cpu", "nvme"], default=None)
+    p.add_argument("--offload-param", choices=["cpu", "nvme"], default=None,
+                   help="host the parameter stack (param-stream): only the "
+                        "resident group + a working-set window live in HBM")
+    p.add_argument("--resident-layers", type=int, default=0)
+    p.add_argument("--buffer-count", type=int, default=None)
+    p.add_argument("--serial-boundary", action="store_true",
+                   help="ablation: serial GAS-boundary walk instead of the "
+                        "threaded Adam/H2D pipeline")
+    p.add_argument("--arch", choices=["gpt", "llama"], default=None,
+                   help="default: auto from the model name")
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--remat-policy", default="dots_saveable")
     p.add_argument("--attn-block-q", type=int, default=None)
@@ -166,7 +232,10 @@ def main(argv=None):
         zero_stage=a.zero_stage, offload=a.offload, remat=not a.no_remat,
         remat_policy=a.remat_policy, attn_block_q=a.attn_block_q,
         attn_block_k=a.attn_block_k, dtype=a.dtype,
-        moment_dtype=a.moment_dtype, grad_accum_dtype=a.grad_accum_dtype)
+        moment_dtype=a.moment_dtype, grad_accum_dtype=a.grad_accum_dtype,
+        arch=a.arch, offload_param=a.offload_param,
+        resident_layers=a.resident_layers, buffer_count=a.buffer_count,
+        serial_boundary=a.serial_boundary)
     if a.json:
         print(json.dumps(out))
     else:

@@ -1,0 +1,83 @@
+"""Round-5 host-side benchmark campaign -> BENCH_host_r05.json.
+
+Captures, on THIS build host (real hardware, no synthetic SlowHandle):
+  * cpu_adam fused C++ vs numpy (now with 2 vCPUs / OpenMP, vs r3's 1)
+  * NVMe-swapped optimizer pipeline vs serial (benchmarks.offload)
+  * param-stream GAS-boundary threaded pipeline vs serial walk, and the
+    streamed writeback vs serial D2H/Adam/upload
+    (benchmarks.param_stream_boundary) — round-4 verdict, next #4.
+
+Run:  python scripts/host_bench_r05.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_host_r05.json")
+
+
+def _run(mod, args, timeout=1200):
+    cmd = [sys.executable, "-m", mod] + args
+    p = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                       cwd=REPO)
+    rows = []
+    for line in p.stdout.splitlines():
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return rows, p.returncode, (p.stderr or "")[-500:]
+
+
+def main():
+    nproc = os.cpu_count()
+    out = {
+        "description": "Host-side benchmark artifact (round-5): cpu_adam "
+                       "fused pass, NVMe offload pipeline, param-stream "
+                       "boundary pipeline + streamed writeback. All on the "
+                       "real build host (no synthetic stores).",
+        "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "environment": {"nproc": nproc},
+    }
+
+    rows, rc, err = _run("deepspeed_tpu.benchmarks.cpu_adam",
+                         ["--numel", "50000000", "--reps", "3"])
+    out["cpu_adam"] = {"rows": rows, "rc": rc, **({"err": err} if rc else {})}
+
+    rows, rc, err = _run("deepspeed_tpu.benchmarks.offload",
+                         ["--numel", "100000000", "--sub-groups", "8",
+                          "--reps", "3"])
+    out["offload_nvme_pipeline"] = {"rows": rows, "rc": rc,
+                                    **({"err": err} if rc else {})}
+
+    rows, rc, err = _run("deepspeed_tpu.benchmarks.param_stream_boundary",
+                         ["--cpu", "--hidden", "2048", "--layers", "16",
+                          "--vocab", "32768", "--numel", "200000000",
+                          "--reps", "3"], timeout=2400)
+    out["param_stream_boundary"] = {"rows": rows, "rc": rc,
+                                    **({"err": err} if rc else {})}
+
+    summary = {}
+    for row in out["param_stream_boundary"]["rows"]:
+        if row.get("section") == "summary":
+            summary = row
+    out["summary"] = {
+        "boundary_pipeline_speedup_x": summary.get("boundary_speedup_x"),
+        "writeback_speedup_x": summary.get("writeback_speedup_x"),
+        "note": "boundary >= 1.25x is the round-4 verdict #4 bar; the "
+                "writeback pipeline's win is chip-side (real H2D/D2H DMA) "
+                "— on the CPU backend transfers are host memcpys, so ~1.0x "
+                "here is expected and the on-chip program re-measures it.",
+    }
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out["summary"]))
+
+
+if __name__ == "__main__":
+    main()

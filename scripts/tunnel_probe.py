@@ -1,0 +1,30 @@
+"""Background tunnel probe.
+
+Appends one JSON line per attempt to /root/repo/tunnel_status.jsonl and
+creates /root/repo/TUNNEL_UP the moment jax.devices() reports a TPU.
+Run under nohup; exits after the first success.
+"""
+import json
+import os
+import subprocess
+import time
+
+os.chdir('/root/repo')
+while True:
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            ['python', '-c', 'import jax; d=jax.devices(); print(d[0].platform, len(d))'],
+            capture_output=True, text=True, timeout=300)
+        rc, out, err = p.returncode, p.stdout.strip()[-200:], p.stderr.strip()[-200:]
+    except subprocess.TimeoutExpired:
+        rc, out, err = -9, '', 'probe timeout 300s'
+    line = {"t": time.strftime('%Y-%m-%dT%H:%M:%S'), "dt": round(time.time() - t0, 1),
+            "rc": rc, "out": out, "err": err}
+    with open('tunnel_status.jsonl', 'a') as f:
+        f.write(json.dumps(line) + '\n')
+    if rc == 0 and 'tpu' in out.lower():
+        with open('TUNNEL_UP', 'w') as f:
+            f.write(line['t'])
+        break
+    time.sleep(60)

@@ -10,7 +10,7 @@ in the loop:
 
 Each probe is a subprocess with a hard timeout (the axon backend hangs
 forever rather than failing).  On the first healthy probe it runs
-``scripts/onchip_r03.py --only <steps>`` and exits.
+``scripts/onchip_r05.py --only <steps>`` and exits.
 """
 
 import argparse
@@ -38,8 +38,8 @@ def probe(timeout_s: int) -> bool:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", default="probe,serving,bench",
-                    help="comma list forwarded to onchip_r03.py --only")
+    ap.add_argument("--steps", default="",
+                    help="comma list forwarded to onchip_r05.py --only (empty = all steps, priority order)")
     ap.add_argument("--interval", type=int, default=300)
     ap.add_argument("--probe-timeout", type=int, default=150)
     ap.add_argument("--max-hours", type=float, default=10.0)
@@ -54,7 +54,7 @@ def main():
               flush=True)
         if ok:
             rc = subprocess.call(
-                [sys.executable, "scripts/onchip_r03.py",
+                [sys.executable, "scripts/onchip_r05.py",
                  "--only", args.steps], cwd=REPO)
             print(f"[watcher] onchip program exited rc={rc}", flush=True)
             return rc
