@@ -93,6 +93,18 @@ def _alloc(shape, dtype, nvme_dir: Optional[str], name: str) -> np.ndarray:
     return mm
 
 
+def _chunked_sq(arr: np.ndarray, chunk: int = 1 << 24) -> float:
+    """Sum of squares with fp32 upcast in bounded chunks — a bf16 grad
+    accumulator never materialises a whole-unit fp32 copy just for the
+    norm."""
+    flat = arr.reshape(-1)
+    total = 0.0
+    for i in range(0, flat.size, chunk):
+        c = flat[i:i + chunk].astype(np.float32, copy=False)
+        total += float(np.dot(c, c))
+    return total
+
+
 def _tail_align_spec(spec: Optional[P], ndim: int) -> Optional[P]:
     """Align a tp-rule PartitionSpec written for STACKED leaves
     (leading n_layers dim) to a single-layer leaf: keep the LAST ndim
@@ -135,6 +147,7 @@ class HostParamStore:
         self.opt_name = opt_name
         self.n_moments = 1 if opt_name == "adagrad" else 2
         self.step_count = 0
+        self._sq_cache: Dict[int, float] = {}
         self.compute_dtype = _np_dtype(compute_dtype)
         self.grad_dtype = _np_dtype(grad_dtype)
         self.nvme_dir = nvme_dir
@@ -241,6 +254,7 @@ class HostParamStore:
                    casting="unsafe")
 
     def zero_grads(self):
+        self._sq_cache.clear()
         self.res_gacc[:] = 0
         if self.homogeneous:
             self.gaccs[:] = 0
@@ -248,13 +262,20 @@ class HostParamStore:
             for g in self.gaccs:
                 g[:] = 0
 
+    def cache_unit_sq(self, l: int):
+        """Record unit ``l``'s squared-norm contribution NOW (called as its
+        final gradient lands, so the norm pass overlaps the remaining D2H
+        stream instead of re-reading every accumulator at the boundary)."""
+        self._sq_cache[l] = _chunked_sq(self._gacc(l))
+
     def grad_sq_norm(self) -> float:
         """Squared global norm of the ACCUMULATED grads (host pass — the
-        offloaded analogue of the engine's fp32 ``_global_norm_f32``)."""
+        offloaded analogue of the engine's fp32 ``_global_norm_f32``).
+        Units cached by :meth:`cache_unit_sq` are not re-read."""
         total = 0.0
         for l in range(-1, self.n_layers):
-            g = self._gacc(l).astype(np.float32, copy=False)
-            total += float(np.dot(g, g))
+            total += (self._sq_cache[l] if l in self._sq_cache
+                      else _chunked_sq(self._gacc(l)))
         return total
 
     # -- optimizer -----------------------------------------------------
@@ -289,6 +310,7 @@ class HostParamStore:
         if l >= 0:
             self.mirrors[l][:] = p.astype(self.compute_dtype)
         self._gacc(l)[:] = 0
+        self._sq_cache.pop(l, None)
 
     # -- checkpoint ----------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -605,16 +627,24 @@ class ParamStreamRunner:
 
         loss_sum = jnp.float32(0.0)
         finite_all = jnp.asarray(True)
-        pending: List[Tuple[int, Any]] = []   # (unit, dev grad tree)
+        # (unit, dev grad tree, appended-during-final-microbatch)
+        pending: List[Tuple[int, Any, bool]] = []
         landed: set = set()
 
         def flush_pending(max_keep: int):
             while len(pending) > max_keep:
-                ul, tree = pending.pop(0)
+                ul, tree, fin = pending.pop(0)
                 lay = (self.store.res_layout if ul < 0
                        else self.store.layouts[ul])
                 self._land(ul, tree, lay, ul not in landed)
                 landed.add(ul)
+                if fin:
+                    # this entry IS the unit's last accumulation — fold its
+                    # norm contribution in now, under the D2H stream of
+                    # later-landing units (entries carried over from the
+                    # previous microbatch skip this: their value would only
+                    # be recomputed when the final entry lands)
+                    self.store.cache_unit_sq(ul)
 
         win_dev = (jnp.asarray(win) if win is not None else None)
 
@@ -660,7 +690,7 @@ class ParamStreamRunner:
                 stash[l] = None
                 finite_all = jnp.logical_and(finite_all, fin)
                 self._start_d2h(dlayer)
-                pending.append((l, dlayer))
+                pending.append((l, dlayer, m == gas - 1))
                 flush_pending(self.buffer_count)
                 self._evict(list(range(l - bc + 1, l + 1)))
 
@@ -671,7 +701,7 @@ class ParamStreamRunner:
                               b.astype(jnp.float32)).astype(a.dtype),
                 dres_h, dres_e)
             self._start_d2h(dres)
-            pending.append((-1, dres))
+            pending.append((-1, dres, m == gas - 1))
             flush_pending(0 if m == gas - 1 else self.buffer_count)
 
         # ---- boundary: overflow check, norm/clip, host Adam ----

@@ -22,15 +22,23 @@ OUT = os.path.join(REPO, "BENCH_host_r05.json")
 
 def _run(mod, args, timeout=1200):
     cmd = [sys.executable, "-m", mod] + args
-    p = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
-                       cwd=REPO)
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+        stdout, rc, err = p.stdout, p.returncode, (p.stderr or "")[-500:]
+    except subprocess.TimeoutExpired as e:
+        # keep what the section printed before stalling; the campaign (and
+        # its final artifact write) must survive one slow section
+        stdout = (e.stdout.decode() if isinstance(e.stdout, bytes)
+                  else (e.stdout or ""))
+        rc, err = -9, f"timeout after {timeout}s"
     rows = []
-    for line in p.stdout.splitlines():
+    for line in (stdout or "").splitlines():
         try:
             rows.append(json.loads(line))
         except json.JSONDecodeError:
             continue
-    return rows, p.returncode, (p.stderr or "")[-500:]
+    return rows, rc, err
 
 
 def main():

@@ -149,6 +149,8 @@ def main(argv=None):
                "device_kind": getattr(jax.devices()[0], "device_kind", "")}
     print(json.dumps(summary))
     if args.json_out:
+        out_dir = os.path.dirname(os.path.abspath(args.json_out))
+        os.makedirs(out_dir, exist_ok=True)
         with open(args.json_out, "w") as f:
             json.dump({"rows": rows, "summary": summary}, f, indent=1)
     return 0 if summary["all_ok"] else 1

@@ -79,9 +79,22 @@ def run(step, cmd, timeout, env=None):
             cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO,
             env={**os.environ, "JAX_COMPILATION_CACHE_DIR": CACHE,
                  **(env or {})})
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # journal the partial stdout: per-step JSON rows emitted before the
+        # stall are exactly the artifacts this program exists to capture
+        partial = (e.stdout.decode(errors="replace")
+                   if isinstance(e.stdout, bytes) else (e.stdout or ""))
+        jsons = []
+        for line in partial.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    jsons.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
         log(step, status="timeout", timeout_s=round(timeout),
-            cmd=" ".join(cmd))
+            cmd=" ".join(cmd), results=jsons or None,
+            stdout=None if jsons else partial[-2000:])
         return None
     dt = time.time() - t0
     tail = (out.stdout or "")[-4000:]

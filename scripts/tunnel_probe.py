@@ -7,6 +7,7 @@ Run under nohup; exits after the first success.
 import json
 import os
 import subprocess
+import sys
 import time
 
 os.chdir('/root/repo')
@@ -14,7 +15,8 @@ while True:
     t0 = time.time()
     try:
         p = subprocess.run(
-            ['python', '-c', 'import jax; d=jax.devices(); print(d[0].platform, len(d))'],
+            [sys.executable, '-c',
+             'import jax; d=jax.devices(); print(d[0].platform, len(d))'],
             capture_output=True, text=True, timeout=300)
         rc, out, err = p.returncode, p.stdout.strip()[-200:], p.stderr.strip()[-200:]
     except subprocess.TimeoutExpired:
