@@ -26,10 +26,83 @@ from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
 from deepspeed_tpu.utils.logging import logger
 
 
+def _insert(root: dict, keys, val):
+    cur = root
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    cur[keys[-1]] = val
+
+
+def _unflatten_meta(flat: np.ndarray, leaves_meta) -> dict:
+    """Rebuild a nested dict from a flat fp32 master + the leaf metadata
+    the param-stream runner saved (flatten order).  Non-float leaves are
+    restored from the values the sidecar carries."""
+    tree: dict = {}
+    off = 0
+    for lm in leaves_meta:
+        if not lm["float"]:
+            if "value" in lm:
+                _insert(tree, lm["path"],
+                        np.asarray(lm["value"],
+                                   lm.get("dtype")).reshape(lm["shape"]))
+            continue
+        size = int(np.prod(lm["shape"])) if lm["shape"] else 1
+        _insert(tree, lm["path"],
+                np.asarray(flat[off:off + size],
+                           np.float32).reshape(lm["shape"]))
+        off += size
+    return tree
+
+
+def _param_stream_state_dict(npz_path: str, meta_path: str) -> Dict[str, Any]:
+    """Consolidate a param-stream host checkpoint (training-time parameter
+    offload) into the full nested fp32 params tree — no model needed, the
+    ``.meta.json`` sidecar carries the structure.  Only the masters are
+    read from the npz (np.load is lazy per key): the Adam moments would
+    triple peak host RAM on exactly the beyond-HBM models this path is
+    for."""
+    import json
+    with open(meta_path) as f:
+        meta = json.load(f)
+    L = int(meta["n_layers"])
+    with np.load(npz_path) as z:
+        params = _unflatten_meta(z["res_master"], meta["resident"])
+        if meta["homogeneous"]:
+            masters = z["masters"]
+            per = [_unflatten_meta(masters[l], meta["layer"])
+                   for l in range(L)]
+        else:
+            per = [_unflatten_meta(z[f"master{l}"], meta["layer_list"][l])
+                   for l in range(L)]
+    if meta.get("stacked"):
+        layers = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *per)
+    else:
+        layers = per
+    params[meta.get("layers_key", "layers")] = layers
+    return params
+
+
 def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
                                              tag: Optional[str] = None
                                              ) -> Dict[str, Any]:
     tag = tag or read_latest_tag(ckpt_dir)
+    # param-stream (training-time parameter offload): the host npz IS the
+    # fp32 master of the WHOLE model — the orbax state holds no full tree
+    ps = sorted(glob.glob(os.path.join(ckpt_dir, tag or "",
+                                       "zero_param_stream_rank*.npz")))
+    if ps:
+        meta = ps[0][:-len(".npz")] + ".meta.json"
+        if not os.path.exists(meta):
+            # the orbax state in param-stream mode holds NO full params —
+            # "falling back to the device state" would silently write an
+            # empty tree
+            raise RuntimeError(
+                f"{ps[0]} has no .meta.json structure sidecar (checkpoint "
+                "saved by an older param-stream version).  Re-save it from "
+                "a running engine (engine.save_checkpoint writes the "
+                "sidecar) or export engine.module_state_dict() directly.")
+        logger.info(f"consolidating from param-stream master {ps[0]}")
+        return _param_stream_state_dict(ps[0], meta)
     # ZeRO-Offload: the flat fp32 master on the host side is authoritative
     off = sorted(glob.glob(os.path.join(ckpt_dir, tag or "",
                                         "zero_offload_rank*.npz")))

@@ -77,15 +77,25 @@ def debug_report():
     return rows
 
 
-def main(verbose=False):
+def main(verbose=False, kernel_gate=False):
     op_report(verbose=verbose)
     debug_report()
+    if kernel_gate:
+        # lower+compile every Pallas kernel variant against the current
+        # backend (reference: is_compatible probes surfaced by ds_report;
+        # our risk is Mosaic lowering, which interpret-mode can't see)
+        import subprocess
+        print("\nkernel compile-gate (Mosaic):")
+        return subprocess.call(
+            [sys.executable, "-m", "deepspeed_tpu.ops.kernel_gate"])
     return 0
 
 
 def cli_main():  # console entry point
-    sys.exit(main())
+    kernel_gate = "--kernel-gate" in sys.argv
+    verbose = "-v" in sys.argv or "--verbose" in sys.argv
+    sys.exit(main(verbose=verbose, kernel_gate=kernel_gate))
 
 
 if __name__ == "__main__":
-    main()
+    cli_main()

@@ -1,0 +1,154 @@
+"""Mosaic compile-gate: lower + compile EVERY Pallas kernel variant.
+
+Parity: reference ``op_builder/builder.py:112`` (``is_compatible`` probes an
+op before use, surfaced by ds_report).  Our equivalent risk is Mosaic
+lowering failures on the real TPU backend — interpret-mode green does NOT
+imply Mosaic green (round-3 caught ALiBi/window variants only because a
+journaled run happened to execute them).  This gate is compile-only (no
+numerics, minutes not hours) and journals one JSON line per variant:
+
+    python -m deepspeed_tpu.ops.kernel_gate                # default backend
+    python -m deepspeed_tpu.ops.kernel_gate --json-out gate.json
+    ds_report --kernel-gate                                # same, via CLI
+
+Run it FIRST in every on-chip program.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _gate(name, fn, *args):
+    t0 = time.time()
+    try:
+        jax.jit(fn).lower(*args).compile()
+        out = {"variant": name, "ok": True,
+               "wall_s": round(time.time() - t0, 1)}
+    except Exception as e:   # noqa: BLE001 — journal every failure mode
+        out = {"variant": name, "ok": False, "error": str(e)[-600:],
+               "wall_s": round(time.time() - t0, 1)}
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--interpret", action="store_true",
+                    help="Pallas interpreter instead of Mosaic (CPU smoke "
+                         "test of the gate's plumbing only — interpret "
+                         "green does NOT imply Mosaic green)")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    interp = bool(args.interpret)
+
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention_pallas, paged_attention_pallas)
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.ops.pallas.fused_adam import fused_adam_pallas
+    from deepspeed_tpu.ops.pallas.sparse_attention import \
+        sparse_attention_pallas
+
+    B, S, H, D = 2, args.seq, 8, 64
+    rng = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (B, S, H, D),
+                                 jnp.bfloat16) for i in range(3))
+    kg, vg = (jax.random.normal(jax.random.fold_in(rng, i), (B, S, 2, D),
+                                jnp.bfloat16) for i in range(3, 5))
+    slopes = alibi_slopes(H)
+    rows = []
+
+    def flash_fwd(name, **kw):
+        rows.append(_gate(
+            f"flash_fwd_{name}",
+            lambda q, k, v: flash_attention(q, k, v, interpret=interp, **kw),
+            q, k, v))
+
+    def flash_bwd(name, kk=k, vv=v, **kw):
+        def f(q, k, v):
+            return flash_attention(q, k, v, interpret=interp,
+                                   **kw).astype(jnp.float32).sum()
+        rows.append(_gate(f"flash_bwd_{name}",
+                          jax.value_and_grad(f, argnums=(0, 1, 2)),
+                          q, kk, vv))
+
+    flash_fwd("causal", causal=True)
+    flash_fwd("full", causal=False)
+    flash_fwd("alibi", causal=True, alibi_slopes=slopes)
+    flash_fwd("window", causal=True, window=256)
+    flash_fwd("alibi_window", causal=True, alibi_slopes=slopes, window=256)
+    rows.append(_gate("flash_fwd_gqa",
+                      lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                      interpret=interp),
+                      q, kg, vg))
+    flash_bwd("causal", causal=True)
+    flash_bwd("alibi", causal=True, alibi_slopes=slopes)
+    flash_bwd("window", causal=True, window=256)
+    flash_bwd("gqa", kk=kg, vv=vg, causal=True)
+
+    # decode: contiguous + paged caches (serving path)
+    qd = jax.random.normal(rng, (B, 1, H, D), jnp.bfloat16)
+    kc = jax.random.normal(rng, (B, 2, S, D), jnp.bfloat16)
+    lengths = jnp.full((B,), S // 2, jnp.int32)
+    rows.append(_gate("decode_contiguous",
+                      lambda q, k, v, ln: decode_attention_pallas(
+                          q, k, v, ln, interpret=interp),
+                      qd, kc, kc, lengths))
+    page, npages = 128, S // 128
+    kp = jax.random.normal(rng, (npages * B, 2, page, D), jnp.bfloat16)
+    tables = jnp.arange(B * npages, dtype=jnp.int32).reshape(B, npages)
+    rows.append(_gate("decode_paged",
+                      lambda q, kp, vp, t, ln: paged_attention_pallas(
+                          q, kp, vp, t, ln, interpret=interp),
+                      qd, kp, kp, tables, lengths))
+
+    # sparse attention (fixed local+global layout)
+    block, nb = 128, S // 128
+    layout = np.zeros((H, nb, nb), np.int64)
+    for i in range(nb):
+        layout[:, i, max(0, i - 2):i + 1] = 1
+        layout[:, i, 0] = 1
+    rows.append(_gate("sparse_fixed",
+                      lambda q, k, v: sparse_attention_pallas(
+                          q, k, v, layout, block, causal=True,
+                          interpret=interp),
+                      q, k, v))
+
+    # fused Adam (flat update kernel)
+    from deepspeed_tpu.ops.adam import AdamState
+    n = 1 << 20
+    p = jnp.zeros((n,), jnp.float32)
+    st = AdamState(m=jnp.zeros((n,), jnp.float32),
+                   v=jnp.zeros((n,), jnp.float32),
+                   step=jnp.asarray(0, jnp.int32))
+    rows.append(_gate("fused_adam",
+                      lambda p, g, st: fused_adam_pallas(
+                          p, g, st, interpret=interp),
+                      p, p, st))
+
+    summary = {"all_ok": all(r["ok"] for r in rows),
+               "n_variants": len(rows),
+               "failed": [r["variant"] for r in rows if not r["ok"]],
+               "backend": jax.devices()[0].platform,
+               "device_kind": getattr(jax.devices()[0], "device_kind", "")}
+    print(json.dumps(summary))
+    if args.json_out:
+        out_dir = os.path.dirname(os.path.abspath(args.json_out))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.json_out, "w") as f:
+            json.dump({"rows": rows, "summary": summary}, f, indent=1)
+    return 0 if summary["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

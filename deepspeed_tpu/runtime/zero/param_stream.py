@@ -446,13 +446,15 @@ class ParamStreamRunner:
         self._adam_ex: Optional[ThreadPoolExecutor] = None
         self.boundary_pipelined = True   # ablation knob (benchmarks)
 
-    def _adam_pool(self) -> ThreadPoolExecutor:
-        """Single-worker pool for boundary Adam updates: one worker keeps
-        unit updates in submission order while freeing the main thread to
-        dispatch H2D uploads under them."""
+    def _xfer_pool(self) -> ThreadPoolExecutor:
+        """Single-worker pool for boundary H2D uploads: the fused C++ Adam
+        keeps the MAIN thread (full OpenMP width — measured: moving Adam to
+        a worker starves it of cores on small hosts), while the worker
+        drains the memory-bound ``device_put`` copies of already-updated
+        units underneath it."""
         if self._adam_ex is None:
             self._adam_ex = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="param_stream_adam")
+                max_workers=1, thread_name_prefix="param_stream_xfer")
         return self._adam_ex
 
     # -- placement -----------------------------------------------------
@@ -725,12 +727,12 @@ class ParamStreamRunner:
                         gas: int, pipelined: bool = True):
         """GAS-boundary optimizer walk + H2D mirror refresh.
 
-        ``pipelined`` (default): ONE worker thread runs the fused C++ Adam
-        unit-by-unit in submission order (ctypes/OpenMP release the GIL, so
-        it truly runs beside the main thread), while the main thread issues
-        the async H2D re-upload of each unit the moment its update lands —
-        the H2D of unit l rides under the Adam of unit l+1
-        (``offload.py step_streamed``'s pattern applied to the layer walk).
+        ``pipelined`` (default): the fused C++ Adam runs unit-by-unit on
+        the MAIN thread (full OpenMP width), and as each unit's update
+        lands its H2D re-upload is handed to ONE worker thread — the
+        memory-bound ``device_put`` of unit l rides under the Adam of unit
+        l+1 (``offload.py step_streamed``'s pattern applied to the layer
+        walk) without stealing compute cores from the update itself.
         ``pipelined=False`` is the serial reference walk, kept as the
         benchmark ablation (``benchmarks/param_stream_boundary``).
         """
@@ -748,18 +750,26 @@ class ParamStreamRunner:
                            min(self.buffer_count, L)):
                 self._ensure(l)   # warm next step's first window
             return
-        ex = self._adam_pool()
-        futs = [ex.submit(self.store.apply_unit, u, lr, clip_coef, gas)
-                for u in [-1] + list(range(L))]
-        futs[0].result()
-        self.resident_dev = self._upload_resident()
+        ex = self._xfer_pool()
+        store = self.store
+        self.store.apply_unit(-1, lr, clip_coef, gas)
+        res_fut = ex.submit(
+            jax.device_put,
+            store.resident_tree(dtype=store.compute_dtype),
+            self._res_shardings)
+        up_futs = []
         for l in range(L):
-            futs[l + 1].result()
+            store.apply_unit(l, lr, clip_coef, gas)
+            if l < self.resident_layers or l < self.buffer_count:
+                up_futs.append((l, ex.submit(
+                    jax.device_put, store.mirror_tree(l),
+                    self._layer_shardings[l])))
+        self.resident_dev = res_fut.result()
+        for l, fut in up_futs:
             if l < self.resident_layers:
-                self._pinned[l] = jax.device_put(
-                    self.store.mirror_tree(l), self._layer_shardings[l])
-            elif l < self.buffer_count:
-                self._ensure(l)   # warm next step's first window
+                self._pinned[l] = fut.result()
+            else:
+                self._dev[l] = fut.result()   # warm next step's window
 
     # -- eval ----------------------------------------------------------
     def eval_loss(self, batch, rng=None) -> float:
@@ -806,12 +816,60 @@ class ParamStreamRunner:
             layers = layer_trees
         return self.model.stream_join(resident, layers)
 
+    @staticmethod
+    def _leaf_meta(tree) -> List[dict]:
+        """Path/shape/dtype per leaf, in ``FlatLayout`` flatten order —
+        enough for OFFLINE reconstruction of the nested tree from the flat
+        master (``checkpoint/zero_to_fp32.py`` consumes this)."""
+        out = []
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in flat:
+            keys = []
+            for p in path:
+                if hasattr(p, "key"):
+                    keys.append(p.key)
+                elif hasattr(p, "idx"):
+                    keys.append(int(p.idx))
+                else:
+                    keys.append(str(p))
+            arr = np.asarray(leaf)
+            is_float = bool(jnp.issubdtype(arr.dtype, jnp.floating))
+            lm = {"path": keys, "shape": list(arr.shape),
+                  "float": is_float, "dtype": str(arr.dtype)}
+            if not is_float and arr.size <= 65536:
+                # non-float leaves are not in the flat master; carry their
+                # values so offline consolidation restores the full tree
+                lm["value"] = arr.reshape(-1).tolist()
+            out.append(lm)
+        return out
+
     def save(self, save_dir: str, tag: str):
+        import json
         path = os.path.join(save_dir, tag)
         os.makedirs(path, exist_ok=True)
+        rank = jax.process_index()
         np.savez(os.path.join(
-            path, f"zero_param_stream_rank{jax.process_index()}.npz"),
+            path, f"zero_param_stream_rank{rank}.npz"),
             **self.store.state_dict())
+        # structure sidecar: lets zero_to_fp32 consolidate WITHOUT the
+        # model (the reference's per-rank shards carry param names the
+        # same way)
+        store = self.store
+        meta = {"homogeneous": store.homogeneous,
+                "n_layers": store.n_layers,
+                "stacked": self.stacked,
+                "layers_key": "layers",
+                "resident": self._leaf_meta(store.resident_tree())}
+        if store.homogeneous:
+            meta["layer"] = self._leaf_meta(
+                store.layouts[0].unflatten(store.masters[0]))
+        else:
+            meta["layer_list"] = [
+                self._leaf_meta(store.layouts[l].unflatten(store.masters[l]))
+                for l in range(store.n_layers)]
+        with open(os.path.join(
+                path, f"zero_param_stream_rank{rank}.meta.json"), "w") as f:
+            json.dump(meta, f)
 
     def load(self, load_dir: str, tag: str,
              load_optimizer_states: bool = True) -> bool:
