@@ -310,6 +310,26 @@ class Autotuner:
             json.dump(out, f, indent=1)
         return out
 
+    @staticmethod
+    def skip_template_knob(path: str, ds_config: Dict) -> bool:
+        """A template knob is skipped when every candidate would be a no-op
+        re-measurement of the incumbent under a new name: moment_dtype is
+        read only by the Adam family, and the param-stream dials only
+        exist when the base config actually streams params (the engine
+        enables param-stream at ANY stage when offload_param is set)."""
+        opt_type = str((ds_config.get("optimizer") or {})
+                       .get("type", "adamw")).lower()
+        if path == "optimizer/params/moment_dtype" and \
+                opt_type not in ("adam", "adamw"):
+            return True
+        if path.startswith("zero_optimization/offload_param/"):
+            ps_device = str(((ds_config.get("zero_optimization") or {})
+                             .get("offload_param") or {})
+                            .get("device", "none"))
+            if ps_device in ("none", "None"):
+                return True
+        return False
+
     def _tune_templates(self, best: Experiment, run_fn,
                         model_knobs: bool = True,
                         model_spec=None) -> Experiment:
@@ -326,13 +346,9 @@ class Autotuner:
             return ResourceManager.best_of([best] + exps,
                                            self.at_config.metric) or best
 
-        opt_type = str((best.ds_config.get("optimizer") or {})
-                       .get("type", "adamw")).lower()
         for path, candidates in tmpl["ds"].items():
-            if path == "optimizer/params/moment_dtype" and \
-                    opt_type not in ("adam", "adamw"):
-                continue   # only the Adam family reads moment_dtype — a
-                # trial would re-measure the incumbent under a new name
+            if self.skip_template_knob(path, best.ds_config):
+                continue
             exps = []
             for v in candidates:
                 if v == get_ds_path(best.ds_config, path):

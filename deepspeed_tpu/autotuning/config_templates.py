@@ -28,6 +28,13 @@ _COMMON_DS: Dict[str, List[Any]] = {
     # tuner must be able to rediscover that configuration
     "optimizer/params/moment_dtype": ["float32", "bfloat16"],
     "data_types/grad_accum_dtype": [None, "bfloat16"],
+    # param-stream dials — in the COMMON set because the engine streams
+    # params at ANY stage when offload_param is configured; searched only
+    # when the base config streams (Autotuner.skip_template_knob):
+    # pinned layers trade HBM for fewer uploads; the window deepens the
+    # prefetch pipeline
+    "zero_optimization/offload_param/resident_layers": [0, 4, 8],
+    "zero_optimization/offload_param/buffer_count": [2, 3, 5],
 }
 
 # model-config knobs common to every stage (TPU-native)
@@ -60,6 +67,8 @@ KNOB_DEFAULTS: Dict[str, Any] = {
     "optimizer/params/moment_dtype": "float32",
     "data_types/grad_accum_dtype": None,
     "zero_optimization/offload_optimizer": None,
+    "zero_optimization/offload_param/resident_layers": 0,
+    "zero_optimization/offload_param/buffer_count": 2,
     "remat_policy": "nothing_saveable",   # TransformerConfig defaults
     "attn_blocks": (512, 512),
 }

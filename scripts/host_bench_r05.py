@@ -62,24 +62,40 @@ def main():
     out["offload_nvme_pipeline"] = {"rows": rows, "rc": rc,
                                     **({"err": err} if rc else {})}
 
-    rows, rc, err = _run("deepspeed_tpu.benchmarks.param_stream_boundary",
-                         ["--cpu", "--hidden", "2048", "--layers", "16",
-                          "--vocab", "32768", "--numel", "200000000",
-                          "--reps", "3"], timeout=2400)
-    out["param_stream_boundary"] = {"rows": rows, "rc": rc,
-                                    **({"err": err} if rc else {})}
+    boundary = {}
+    for label, hidden, layers, vocab in (("137m", "1024", "8", "16384"),
+                                         ("956m", "2048", "16", "32768")):
+        rows, rc, err = _run(
+            "deepspeed_tpu.benchmarks.param_stream_boundary",
+            ["--cpu", "--hidden", hidden, "--layers", layers,
+             "--vocab", vocab, "--numel", "100000000", "--reps", "3"],
+            timeout=2400)
+        boundary[label] = {"rows": rows, "rc": rc,
+                           **({"err": err} if rc else {})}
+    out["param_stream_boundary"] = boundary
 
-    summary = {}
-    for row in out["param_stream_boundary"]["rows"]:
-        if row.get("section") == "summary":
-            summary = row
+    speedups = {}
+    wb = {}
+    for label, sec in boundary.items():
+        for row in sec["rows"]:
+            if row.get("section") == "boundary":
+                speedups[label] = row.get("speedup_x")
+            if row.get("section") == "writeback":
+                wb[label] = row.get("speedup_x")
     out["summary"] = {
-        "boundary_pipeline_speedup_x": summary.get("boundary_speedup_x"),
-        "writeback_speedup_x": summary.get("writeback_speedup_x"),
-        "note": "boundary >= 1.25x is the round-4 verdict #4 bar; the "
-                "writeback pipeline's win is chip-side (real H2D/D2H DMA) "
-                "— on the CPU backend transfers are host memcpys, so ~1.0x "
-                "here is expected and the on-chip program re-measures it.",
+        "boundary_pipeline_speedup_x": speedups,
+        # worst case across sizes: the honest number against the 1.25x bar
+        "boundary_min_x": min([s for s in speedups.values() if s],
+                              default=None),
+        "writeback_speedup_x": wb,
+        "note": "boundary >= 1.25x is the round-4 verdict #4 bar. On this "
+                "2-core build host every stage is memory-bandwidth-bound, "
+                "so the upload-under-Adam overlap is partial and shrinks "
+                "as the model grows; the writeback pipeline's win is "
+                "chip-side (real H2D/D2H DMA) — on the CPU backend "
+                "transfers are host memcpys, so ~1.0x here is expected. "
+                "The on-chip program re-measures both on the real chip "
+                "(onchip_r05 boundary step).",
     }
     with open(OUT, "w") as f:
         json.dump(out, f, indent=1)

@@ -320,3 +320,37 @@ def test_launcher_style_namespace_entry(tmp_path):
     assert best["zero_optimization"]["stage"] == 0
     with pytest.raises(ValueError, match="deepspeed_config"):
         Autotuner(types.SimpleNamespace())
+
+
+def test_param_stream_knobs_gated_and_nested():
+    """The param-stream dials are in EVERY stage's template (the engine
+    streams at any stage when offload_param is set); the tuner's
+    skip_template_knob gates them on the base config actually streaming,
+    and setting the nested path preserves sibling keys (device) without
+    mutating the original."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.autotuning.config_templates import (
+        TEMPLATES, get_ds_path, set_ds_path)
+    for stage in (0, 1, 2, 3):
+        t = TEMPLATES[stage]["ds"]
+        assert "zero_optimization/offload_param/resident_layers" in t
+        assert "zero_optimization/offload_param/buffer_count" in t
+    path = "zero_optimization/offload_param/resident_layers"
+    streaming = {"zero_optimization": {"stage": 0,
+                                       "offload_param": {"device": "cpu"}}}
+    plain = {"zero_optimization": {"stage": 3}}
+    assert not Autotuner.skip_template_knob(path, streaming)
+    assert Autotuner.skip_template_knob(path, plain)
+    # moment_dtype gating rides the same helper
+    assert Autotuner.skip_template_knob(
+        "optimizer/params/moment_dtype",
+        {"optimizer": {"type": "Lamb"}})
+    assert not Autotuner.skip_template_knob(
+        "optimizer/params/moment_dtype", {})
+    c2 = set_ds_path(streaming, path, 8)
+    assert c2["zero_optimization"]["offload_param"] == {
+        "device": "cpu", "resident_layers": 8}
+    assert streaming["zero_optimization"]["offload_param"] == {
+        "device": "cpu"}
+    assert get_ds_path(
+        streaming, "zero_optimization/offload_param/buffer_count") == 2
