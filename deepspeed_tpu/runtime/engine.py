@@ -404,10 +404,15 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.zero.param_stream import ParamStreamRunner
         cfg = self._config
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "offload_param streaming is single-controller for now; "
-                "multi-host pods should use ZeRO-3 (fsdp sharding) whose "
-                "aggregate HBM usually removes the need")
+            # multi-host: the host store is REPLICATED per process (grads
+            # come back fully-replicated from the layer programs — XLA
+            # all-reduces over ICI — so every process lands identical
+            # grads and applies the identical deterministic update).
+            # Host RAM cost is the full model per host; the reference
+            # shards its CPU partitions instead, a documented trade.
+            log_dist("param-stream multi-host: host master/moments are "
+                     "replicated per process (full model per host)",
+                     ranks=[0])
         if cfg.compression_config:
             raise NotImplementedError(
                 "compression/MoQ does not compose with offload_param "

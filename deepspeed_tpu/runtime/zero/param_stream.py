@@ -68,6 +68,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.ops import cpu_adam
 from deepspeed_tpu.runtime.zero.config import OffloadDeviceEnum
 from deepspeed_tpu.runtime.zero.offload import FlatLayout
+from deepspeed_tpu.runtime.zero.stage_plan import device_put_global
 from deepspeed_tpu.utils.logging import logger
 
 STREAM_SUBDIR = "zero_param_stream"
@@ -477,12 +478,12 @@ class ParamStreamRunner:
 
     def _upload_resident(self):
         host = self.store.resident_tree(dtype=self.store.compute_dtype)
-        return jax.device_put(host, self._res_shardings)
+        return device_put_global(host, self._res_shardings)
 
     def _upload_pinned(self):
         for l in range(self.resident_layers):
-            self._pinned[l] = jax.device_put(self.store.mirror_tree(l),
-                                             self._layer_shardings[l])
+            self._pinned[l] = device_put_global(self.store.mirror_tree(l),
+                                                self._layer_shardings[l])
 
     def _ensure(self, l: int):
         """Working set for layer ``l`` (device).  Issues the async upload if
@@ -492,8 +493,8 @@ class ParamStreamRunner:
         if l < self.resident_layers:
             return self._pinned[l]
         if l not in self._dev:
-            self._dev[l] = jax.device_put(self.store.mirror_tree(l),
-                                          self._layer_shardings[l])
+            self._dev[l] = device_put_global(self.store.mirror_tree(l),
+                                             self._layer_shardings[l])
         return self._dev[l]
 
     def _evict(self, keep: List[int]):
@@ -535,6 +536,24 @@ class ParamStreamRunner:
             [jnp.all(jnp.isfinite(g.astype(jnp.float32)))
              for t in trees for g in jax.tree_util.tree_leaves(t)]))
 
+    def _unscale_grads(self, tree, scale, gdt):
+        """The per-program grad tail, in ONE place: unscale in fp32, store
+        at grad dtype, and — multi-host only — constrain to REPLICATED so
+        XLA inserts the cross-device reduction (all-reduce over ICI)
+        inside the program and the result is host-readable on every
+        process (each process lands identical grads and applies the
+        identical update).  Single-process runs skip the constraint:
+        ``device_get`` assembles sharded grads locally, and forcing
+        full-size replicated grad buffers would cost the HBM headroom
+        param-stream exists to create."""
+        tree = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) / scale).astype(gdt), tree)
+        if jax.process_count() == 1:
+            return tree
+        repl = NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda g: jax.lax.with_sharding_constraint(g, repl), tree)
+
     def _head_fwd_bwd(self):
         model = self.model
         gdt = jnp.dtype(self.store.grad_dtype.name)
@@ -545,8 +564,7 @@ class ParamStreamRunner:
                 return model.stream_head_loss(res, xx, mb)
             ce, vjp = jax.vjp(loss_f, resident, x)
             dres, dx = vjp(scale.astype(jnp.float32))
-            dres = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / scale).astype(gdt), dres)
+            dres = self._unscale_grads(dres, scale, gdt)
             return ce, dres, dx, self._finite([dres, dx], fp16)
         return self._jit("head_fwd_bwd", f)
 
@@ -563,11 +581,8 @@ class ParamStreamRunner:
             (x_out, aux), vjp = jax.vjp(fwd, layer, x_in)
             dlayer, dx_in = vjp((dx_out,
                                  (scale * aux_coef).astype(aux.dtype)))
-            # unscale in fp32, store at grad dtype (the engine's exact
-            # grad pipeline, per layer); cotangent chain stays scaled
-            dlayer = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / scale).astype(gdt),
-                dlayer)
+            # cotangent chain stays scaled; the stored grad is unscaled
+            dlayer = self._unscale_grads(dlayer, scale, gdt)
             return dx_in, dlayer, self._finite([dlayer], fp16)
         return self._jit("layer_bwd", f)
 
@@ -581,8 +596,7 @@ class ParamStreamRunner:
                 return model.stream_embed(res, mb, rng=rng)[0]
             _, vjp = jax.vjp(fwd, resident)
             (dres,) = vjp(dx)
-            dres = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / scale).astype(gdt), dres)
+            dres = self._unscale_grads(dres, scale, gdt)
             return dres, self._finite([dres], fp16)
         return self._jit("embed_bwd", f)
 
@@ -754,7 +768,7 @@ class ParamStreamRunner:
         store = self.store
         self.store.apply_unit(-1, lr, clip_coef, gas)
         res_fut = ex.submit(
-            jax.device_put,
+            device_put_global,
             store.resident_tree(dtype=store.compute_dtype),
             self._res_shardings)
         up_futs = []
@@ -762,7 +776,7 @@ class ParamStreamRunner:
             store.apply_unit(l, lr, clip_coef, gas)
             if l < self.resident_layers or l < self.buffer_count:
                 up_futs.append((l, ex.submit(
-                    jax.device_put, store.mirror_tree(l),
+                    device_put_global, store.mirror_tree(l),
                     self._layer_shardings[l])))
         self.resident_dev = res_fut.result()
         for l, fut in up_futs:

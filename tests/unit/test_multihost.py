@@ -188,3 +188,38 @@ def test_sharded_flat_layout_roundtrip():
     assert all(offs[i][0] + offs[i][1] == offs[i + 1][0]
                for i in range(len(offs) - 1))
     groups.reset_mesh()
+
+
+@pytest.mark.slow
+def test_two_process_param_stream():
+    """Multi-host param-stream: host master/moments replicated per
+    process; grads come back fully-replicated from the layer programs
+    (XLA all-reduces over ICI), so every process applies the identical
+    host Adam update — trajectories must match across hosts, and a
+    checkpoint save/load continues identically."""
+    extra = textwrap.dedent("""\
+        assert engine._param_stream is not None
+    """)
+    port = _free_port()
+    post = textwrap.dedent(f"""\
+        ckpt = "/tmp/ds_mh_pstream_ckpt_{port}"
+        engine.save_checkpoint(ckpt, tag="t")
+        engine.load_checkpoint(ckpt, tag="t")
+        loss = engine.train_batch(
+            batch={{"input_ids": rng.integers(0, cfg.vocab_size, (4, 32))}})
+        losses.append(float(loss))
+        import shutil
+        if pid == 0:
+            shutil.rmtree(ckpt, ignore_errors=True)
+    """)
+    script = _WORKER_TEMPLATE.format(
+        port=port,
+        zero='{"stage": 3, '
+             '"offload_param": {"device": "cpu"}, '
+             '"offload_optimizer": {"device": "cpu"}, '
+             '"stage3_param_persistence_threshold": 0}',
+        extra=extra, post=post)
+    outs = _run_two_procs(script)
+    l0, l1 = _losses(outs[0]), _losses(outs[1])
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+    assert l0[-1] < l0[0] + 0.5
