@@ -133,8 +133,11 @@ class DSElasticAgent:
     # multi-host process supervision (launcher path)
     # ------------------------------------------------------------------
     def run_procs(self, cmd_for: Callable[[int, int, Dict], Sequence[str]],
-                  heartbeat_dir: str, heartbeat_timeout_s: float = 60.0,
-                  poll_s: float = 1.0) -> int:
+                  heartbeat_dir: str,
+                  heartbeat_timeout_s: Optional[float] = 60.0,
+                  poll_s: float = 1.0,
+                  env_for: Optional[Callable[[int, int], Dict]] = None
+                  ) -> int:
         """Supervise one subprocess per worker with liveness detection.
 
         ``cmd_for(rank, world_size, ds_config)`` returns the argv for one
@@ -144,8 +147,14 @@ class DSElasticAgent:
         whose heartbeat goes stale past ``heartbeat_timeout_s``, is a
         membership change: the surviving generation is torn down and
         restarted at the new world size (reference
-        ``_invoke_run``'s monitor loop → ``_restart_workers``).  Returns 0
-        when every worker of a generation exits cleanly."""
+        ``_invoke_run``'s monitor loop → ``_restart_workers``).
+        ``heartbeat_timeout_s`` of ``None`` OR ``0`` disables staleness
+        detection (exit codes only — for workers that never call
+        ``beat()``).
+        ``env_for(rank, world_size)`` supplies extra per-rank env
+        (coordinator address, JAX process trio, ...).  Returns 0 when
+        every worker of a generation exits cleanly."""
+        hb_enabled = bool(heartbeat_timeout_s)
         while True:
             batch, valid, micro = compute_elastic_config(
                 self.ds_config, world_size=self.world_size)
@@ -153,11 +162,14 @@ class DSElasticAgent:
             cfg["train_batch_size"] = batch
             cfg["train_micro_batch_size_per_gpu"] = micro
             hb = HeartbeatMonitor(heartbeat_dir, self.world_size,
-                                  timeout_s=heartbeat_timeout_s)
+                                  timeout_s=heartbeat_timeout_s or 60.0)
             procs = []
             for r in range(self.world_size):
                 env = dict(os.environ, RANK=str(r),
                            WORLD_SIZE=str(self.world_size))
+                if env_for is not None:
+                    env.update({k: str(v) for k, v in
+                                env_for(r, self.world_size).items()})
                 env[HEARTBEAT_ENV] = hb.path(r)
                 procs.append(subprocess.Popen(
                     list(cmd_for(r, self.world_size, cfg)), env=env))
@@ -168,7 +180,7 @@ class DSElasticAgent:
                     rcs = [p.poll() for p in procs]
                     dead = [r for r, rc in enumerate(rcs)
                             if rc is not None and rc != 0]
-                    if not dead:
+                    if not dead and hb_enabled:
                         dead = [r for r in hb.dead_ranks()
                                 if rcs[r] is None]   # silent, not exited
                     if dead:

@@ -32,6 +32,22 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="localhost")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--dry_run", action="store_true")
+    parser.add_argument("--enable_elastic_training", action="store_true",
+                        help="supervise this node's workers with the "
+                             "elastic agent: a dead (or, with "
+                             "--heartbeat_timeout, silently hung) worker "
+                             "restarts the node's generation at the "
+                             "surviving world size (reference: "
+                             "torch-elastic LocalElasticAgent)")
+    parser.add_argument("--ds_config", type=str, default=None,
+                        help="DeepSpeed config json with the 'elasticity' "
+                             "section (required with elastic training)")
+    parser.add_argument("--heartbeat_timeout", type=float, default=0,
+                        help="seconds without a worker heartbeat "
+                             "($DS_ELASTIC_HEARTBEAT_FILE touch) before a "
+                             "silent worker counts as dead; 0 = exit-code "
+                             "liveness only")
+    parser.add_argument("--max_elastic_restarts", type=int, default=100)
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER, default=[])
     return parser.parse_args(args=args)
@@ -73,11 +89,69 @@ def main(args=None):
             print(json.dumps(env))
         return 0
 
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+
+    if args.enable_elastic_training:
+        # SINGLE-NODE elastic supervision (the role of torch-elastic's
+        # LocalElasticAgent the reference extends): the agent owns the
+        # spawn/monitor/restart loop; the env trio + the recomputed
+        # elastic batch config ($DS_ELASTIC_CONFIG) are regenerated per
+        # generation for the surviving world size.  Multi-node elastic
+        # needs a cross-node rendezvous this launcher does not provide —
+        # use the cooperative ScaleEvent path (DSElasticAgent.run) there.
+        assert len(world_info) == 1, \
+            "--enable_elastic_training supervises ONE node's workers; " \
+            f"got {len(world_info)} hosts in --world_info"
+        assert args.ds_config, "--enable_elastic_training needs --ds_config"
+        with open(args.ds_config) as f:
+            ds_config = json.load(f)
+        from deepspeed_tpu.elasticity import DSElasticAgent
+
+        work_dir = os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                                f"ds_elastic_{os.getpid()}")
+        os.makedirs(work_dir, exist_ok=True)
+        gen_cfg = os.path.join(work_dir, "ds_elastic_config.json")
+
+        def cmd_for(rank, ws, cfg):
+            # the batch config recomputed for THIS generation's world
+            # size; workers read it from $DS_ELASTIC_CONFIG (or recompute
+            # via compute_elastic_config from $WORLD_SIZE)
+            if rank == 0:
+                with open(gen_cfg, "w") as f:
+                    json.dump(cfg, f)
+            return cmd
+
+        def env_for(rank, ws):
+            return {"LOCAL_RANK": rank,
+                    "MASTER_ADDR": args.master_addr,
+                    "MASTER_PORT": args.master_port,
+                    "JAX_COORDINATOR_ADDRESS":
+                        f"{args.master_addr}:{args.master_port}",
+                    "JAX_NUM_PROCESSES": ws,
+                    "JAX_PROCESS_ID": rank,
+                    "DS_ELASTIC_CONFIG": gen_cfg}
+
+        # parity with the non-elastic path's sigkill_handler: a terminated
+        # launcher must not orphan its workers — SystemExit unwinds
+        # through run_procs' finally, which terminates the generation
+        def _on_signal(sig, frame):
+            sys.exit(128 + sig)
+        signal.signal(signal.SIGINT, _on_signal)
+        signal.signal(signal.SIGTERM, _on_signal)
+
+        agent = DSElasticAgent(ds_config,
+                               start_world_size=len(process_envs),
+                               max_restarts=args.max_elastic_restarts)
+        return agent.run_procs(
+            cmd_for,
+            heartbeat_dir=os.path.join(work_dir, "hb"),
+            heartbeat_timeout_s=args.heartbeat_timeout,
+            env_for=env_for)
+
     procs = []
     for env_overrides in process_envs:
         env = os.environ.copy()
         env.update(env_overrides)
-        cmd = [sys.executable, "-u", args.user_script] + args.user_args
         logger.info(f"launching rank {env_overrides['RANK']}: {' '.join(cmd)}")
         procs.append(subprocess.Popen(cmd, env=env))
 

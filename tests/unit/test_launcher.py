@@ -222,3 +222,39 @@ def test_runner_user_arg_config_helpers():
     assert out[1] == "best.json" and out[0] == "--deepspeed_config"
     out2 = _replace_user_arg(["--ds_config=x.json"], names, "best.json")
     assert out2 == ["--ds_config=best.json"]
+
+
+def test_launch_elastic_restarts_node_generation(tmp_path):
+    """--enable_elastic_training: a worker exiting nonzero restarts the
+    node's generation at the surviving world size; the regenerated env
+    trio reflects the new world (reference: LocalElasticAgent)."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(_json.dumps({
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                       "max_gpus": 4, "version": 0.2,
+                       "num_gpus_per_node": 1,
+                       "ignore_non_elastic_batch_info": True}}))
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "ws = int(os.environ['WORLD_SIZE'])\n"
+        "assert os.environ['JAX_NUM_PROCESSES'] == str(ws)\n"
+        "assert os.environ['JAX_PROCESS_ID'] == os.environ['RANK']\n"
+        "gen = json.load(open(os.environ['DS_ELASTIC_CONFIG']))\n"
+        "assert gen['train_batch_size'] % ws == 0, gen\n"
+        "if ws == 2 and os.environ['RANK'] == '1':\n"
+        "    sys.exit(3)\n"
+        "print('GEN', ws, flush=True)\n")
+    info = encode_world_info({"localhost": [0, 1]})
+    p = subprocess.run(
+        [_sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--world_info", info, "--node_rank", "0",
+         "--enable_elastic_training", "--ds_config", str(cfg),
+         str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-1500:]
+    assert "GEN 1" in p.stdout   # the restarted world-size-1 generation
