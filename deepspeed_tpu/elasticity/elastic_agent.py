@@ -101,17 +101,29 @@ class DSElasticAgent:
         self.restart_delay_s = restart_delay_s
         self.restarts = 0
 
+    def _generation_config(self) -> Dict:
+        """The ds_config for one generation: the elastic batch triangle
+        RESOLVED for the current world size, with
+        ``ignore_non_elastic_batch_info`` set so a worker re-parsing this
+        config (``DeepSpeedConfig._maybe_apply_elasticity`` /
+        ``compute_elastic_config``) does not reject its own injected
+        batch keys as a fixed-vs-elastic conflict."""
+        batch, valid, micro = compute_elastic_config(
+            self.ds_config, world_size=self.world_size)
+        cfg = dict(self.ds_config)
+        cfg["train_batch_size"] = batch
+        cfg["train_micro_batch_size_per_gpu"] = micro
+        cfg["elasticity"] = dict(cfg.get("elasticity", {}),
+                                 ignore_non_elastic_batch_info=True)
+        return cfg
+
     def run(self, train_fn: Callable[[Dict, int], Optional[int]]):
         """``train_fn(ds_config, world_size)`` runs training; return value
         is the exit status (None/0 = done).  Raising ``ScaleEvent`` (or any
         exception, up to ``max_restarts``) re-enters with refreshed elastic
         batch settings."""
         while True:
-            batch, valid, micro = compute_elastic_config(
-                self.ds_config, world_size=self.world_size)
-            cfg = dict(self.ds_config)
-            cfg["train_batch_size"] = batch
-            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg = self._generation_config()
             try:
                 return train_fn(cfg, self.world_size)
             except ScaleEvent as ev:
@@ -156,26 +168,25 @@ class DSElasticAgent:
         every worker of a generation exits cleanly."""
         hb_enabled = bool(heartbeat_timeout_s)
         while True:
-            batch, valid, micro = compute_elastic_config(
-                self.ds_config, world_size=self.world_size)
-            cfg = dict(self.ds_config)
-            cfg["train_batch_size"] = batch
-            cfg["train_micro_batch_size_per_gpu"] = micro
+            cfg = self._generation_config()
             hb = HeartbeatMonitor(heartbeat_dir, self.world_size,
                                   timeout_s=heartbeat_timeout_s or 60.0)
-            procs = []
-            for r in range(self.world_size):
-                env = dict(os.environ, RANK=str(r),
-                           WORLD_SIZE=str(self.world_size))
-                if env_for is not None:
-                    env.update({k: str(v) for k, v in
-                                env_for(r, self.world_size).items()})
-                env[HEARTBEAT_ENV] = hb.path(r)
-                procs.append(subprocess.Popen(
-                    list(cmd_for(r, self.world_size, cfg)), env=env))
-            hb.start()
+            procs: List[subprocess.Popen] = []
             dead: List[int] = []
+            # the try starts BEFORE the spawn loop: a signal (SystemExit)
+            # landing mid-spawn must still terminate the workers already
+            # started, or the launcher orphans them
             try:
+                for r in range(self.world_size):
+                    env = dict(os.environ, RANK=str(r),
+                               WORLD_SIZE=str(self.world_size))
+                    if env_for is not None:
+                        env.update({k: str(v) for k, v in
+                                    env_for(r, self.world_size).items()})
+                    env[HEARTBEAT_ENV] = hb.path(r)
+                    procs.append(subprocess.Popen(
+                        list(cmd_for(r, self.world_size, cfg)), env=env))
+                hb.start()
                 while True:
                     rcs = [p.poll() for p in procs]
                     dead = [r for r, rc in enumerate(rcs)

@@ -192,6 +192,21 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
         cfg.micro_batches, cfg.max_acceptable_batch_size,
         cfg.min_gpus, cfg.max_gpus, cfg.prefer_larger_batch_size)
     logger.info(f"elasticity v0.1: batch={batch} valid_gpus={valid}")
+    if world_size or return_microbatch:
+        # v0.1 with a live world: pick the preferred micro batch that
+        # divides the final batch at this world size (the 3-tuple contract
+        # every runtime caller — DeepSpeedConfig, the elastic agent —
+        # relies on; previously v0.1 returned a 2-tuple and crashed them)
+        order = sorted(cfg.micro_batches,
+                       reverse=cfg.prefer_larger_batch_size)
+        micro = next((m for m in order
+                      if not world_size or batch % (m * world_size) == 0),
+                     None)
+        if micro is None:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} has no compatible micro batch "
+                f"in {cfg.micro_batches} for final batch {batch}")
+        return batch, valid, micro
     return batch, valid
 
 

@@ -122,14 +122,14 @@ def main(args=None):
             return cmd
 
         def env_for(rank, ws):
-            return {"LOCAL_RANK": rank,
-                    "MASTER_ADDR": args.master_addr,
-                    "MASTER_PORT": args.master_port,
-                    "JAX_COORDINATOR_ADDRESS":
-                        f"{args.master_addr}:{args.master_port}",
-                    "JAX_NUM_PROCESSES": ws,
-                    "JAX_PROCESS_ID": rank,
-                    "DS_ELASTIC_CONFIG": gen_cfg}
+            # ONE source of truth for the distributed env contract: the
+            # same builder the static path uses, on a synthetic ws-slot
+            # single-node world
+            env = build_process_envs({"localhost": list(range(ws))}, 0,
+                                     args.master_addr,
+                                     args.master_port)[rank]
+            env["DS_ELASTIC_CONFIG"] = gen_cfg
+            return env
 
         # parity with the non-elastic path's sigkill_handler: a terminated
         # launcher must not orphan its workers — SystemExit unwinds

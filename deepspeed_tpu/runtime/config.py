@@ -156,6 +156,7 @@ class DeepSpeedConfig:
         self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE)
         self.train_micro_batch_size_per_gpu = pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
         self.gradient_accumulation_steps = pd.get(C.GRADIENT_ACCUMULATION_STEPS)
+        self._maybe_apply_elasticity(pd)
         self._configure_train_batch_size()
 
         self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT,
@@ -297,6 +298,29 @@ class DeepSpeedConfig:
         sec = MeshSection(mesh_dict)
         return TopologyConfig(pp=sec.pp, dp=sec.dp, fsdp=sec.fsdp,
                               sp=sec.sp, tp=sec.tp, ep=sec.ep)
+
+    def _maybe_apply_elasticity(self, pd):
+        """Elastic mode resolves the batch triangle FOR THE CURRENT WORLD
+        SIZE during config parsing (parity: reference runtime/config.py
+        766-806 — compute_elastic_config runs inside DeepSpeedConfig, so a
+        restarted worker at a new world size gets the right batch without
+        touching its config file)."""
+        esec = pd.get(C.ELASTICITY, {})
+        if not esec.get("enabled", False):
+            return
+        from deepspeed_tpu.elasticity import compute_elastic_config
+        # pass the FULL param dict: compute_elastic_config also validates
+        # that fixed batch keys don't conflict with elastic mode.
+        # world_size is the TOTAL chip count (the solver divides by its
+        # own model_parallel_size — which should match the mesh's tp so
+        # the derived micro batch lines up with our dp degree)
+        batch, valid, micro = compute_elastic_config(
+            pd, world_size=max(1, self.world_size))
+        self.train_batch_size = batch
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = None   # triangle derives it
+        logger.info(f"elasticity: batch={batch} micro={micro} for "
+                    f"world={self.world_size}")
 
     # ------------------------------------------------------------------
     # Batch-size triangle: train = micro × gas × dp_world

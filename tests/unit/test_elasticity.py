@@ -170,7 +170,49 @@ def test_agent_detects_silent_hang_via_heartbeat(tmp_path):
     keep-alive timeout).  The timeout is generous so interpreter startup
     under a loaded CI host can't trip healthy ranks — only the genuinely
     silent rank goes stale."""
-    agent, rc = _run_agent(tmp_path, "hang", timeout_s=20.0)
+    agent, rc = _run_agent(tmp_path, "hang", timeout_s=30.0)
     assert rc == 0
     assert agent.world_size == 1
     assert agent.restarts == 1
+
+
+def test_config_resolves_elastic_batch_at_parse_time():
+    """Elastic mode resolves the batch triangle for the current world size
+    inside DeepSpeedConfig (reference runtime/config.py:766) — a restarted
+    worker at a new world size gets the right batch from the SAME config
+    file."""
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    base = {"elasticity": {**V2["elasticity"]},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    c2 = DeepSpeedConfig(dict(base), world_size=2)
+    c1 = DeepSpeedConfig(dict(base), world_size=1)
+    assert c2.train_batch_size % 2 == 0
+    assert c2.train_batch_size == (c2.train_micro_batch_size_per_gpu *
+                                   c2.gradient_accumulation_steps * 2)
+    assert c1.train_batch_size == (c1.train_micro_batch_size_per_gpu *
+                                   c1.gradient_accumulation_steps * 1)
+    # fixed batch keys conflict with elastic mode (reference semantics) —
+    # unless the config opts out via ignore_non_elastic_batch_info
+    strict_es = {k: v for k, v in V2["elasticity"].items()
+                 if k != "ignore_non_elastic_batch_info"}
+    with pytest.raises(Exception, match="train_batch_size"):
+        DeepSpeedConfig(dict(base, elasticity=strict_es,
+                             train_batch_size=128), world_size=2)
+
+
+def test_v01_resolves_microbatch_for_world():
+    """v0.1 configs resolve a micro batch for a live world size too (the
+    3-tuple contract every runtime caller relies on)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    _, valid = compute_elastic_config(BASE)
+    w = valid[len(valid) // 2]
+    batch, _, micro = compute_elastic_config(BASE, world_size=w)
+    assert batch % (micro * w) == 0
+    cfg = DeepSpeedConfig({"elasticity": dict(BASE["elasticity"]),
+                           "optimizer": {"type": "AdamW",
+                                         "params": {"lr": 1e-3}}},
+                          world_size=w)
+    assert cfg.train_batch_size == batch
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(BASE, world_size=7)   # 7 divides nothing
