@@ -36,7 +36,8 @@ def print_latency(latency_set: List[float], title: str, warmup: int = 3):
 
 
 def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
-                  max_new_tokens=64, trials=10, quant=False, tp=1):
+                  max_new_tokens=64, trials=10, quant=False, tp=1,
+                  zero_stream=False):
     import jax
 
     import deepspeed_tpu
@@ -49,10 +50,29 @@ def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
         "gpt2-1.5b": TransformerConfig.gpt2_1_5b,
         "llama2-7b": TransformerConfig.llama2_7b,
     }
+    import jax.numpy as jnp
+
     cfg = presets[model_size](remat=False)
     model = CausalTransformerLM(cfg)
-    params = model.init(jax.random.key(0))
+    if zero_stream:
+        if quant or tp > 1:
+            # the streaming engine bypasses the quant branch and uploads
+            # unsharded layers; accepting these flags would journal a
+            # configuration that never ran
+            raise ValueError(
+                "--zero-stream does not compose with --int8/--tp: the "
+                "streaming path uploads bf16 per-layer working sets")
+        # ZeRO-Inference: weights live on the host and stream per layer —
+        # init must run on the HOST backend so a beyond-HBM model never
+        # materialises on the chip (the engine host-casts the layer stack
+        # itself; no extra host copy here)
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = model.init(jax.random.key(0), dtype=jnp.bfloat16)
+    else:
+        params = model.init(jax.random.key(0))
     kwargs = {"dtype": dtype}
+    if zero_stream:
+        kwargs["zero"] = {"offload_param": {"device": "cpu"}}
     if quant:
         kwargs["quant"] = {"enabled": True, "num_bits": 8}
     if tp > 1:
@@ -65,7 +85,6 @@ def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
 
     # calibrate the host↔device round-trip floor (remote tunnels add a
     # fixed RPC cost per pulled result that is not model time)
-    import jax.numpy as jnp
     tiny = jax.jit(lambda x: x + 1)
     np.asarray(tiny(jnp.ones(4)))
     t0 = time.time()
@@ -99,6 +118,7 @@ def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
     # one machine-readable line so harnesses (scripts/onchip_r03.py) can
     # journal the result without scraping the human table
     record = {"model": model_size, "dtype": dtype, "int8": bool(quant),
+              "zero_stream": bool(zero_stream),
               "batch": batch, "prompt_len": prompt_len,
               "max_new_tokens": max_new_tokens,
               "rpc_floor_ms": round(rpc_floor * 1000, 2),
@@ -122,9 +142,13 @@ def main():
     ap.add_argument("--trials", type=int, default=10)
     ap.add_argument("--int8", action="store_true")
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--zero-stream", action="store_true",
+                    help="ZeRO-Inference: host-resident weights streamed "
+                         "per layer (beyond-HBM models)")
     args = ap.parse_args()
     run_benchmark(args.model, args.dtype, args.batch, args.prompt_len,
                   args.max_new_tokens, args.trials, quant=args.int8,
+                  zero_stream=args.zero_stream,
                   tp=args.tp)
 
 

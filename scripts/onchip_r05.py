@@ -254,6 +254,13 @@ def main():
                  "--requests", "16", "--max-batch", "8",
                  "--prompt-len", "128", "--gen", "64",
                  "--decode-chunk", str(chunk)], timeout=2400)
+        # beyond-HBM inference: 6.7B llama through ZeRO-Inference weight
+        # streaming (host-resident params, per-layer H2D) — the inference
+        # twin of the param-stream training claim
+        run("infer_7b_zero_stream",
+            [py, "bin/ds_bench", "inference", "--model", "llama2-7b",
+             "--batch", "1", "--prompt-len", "128", "--max-new-tokens",
+             "32", "--trials", "5", "--zero-stream"], timeout=3000)
 
     if "tune" in steps:
         spec = {"kind": "causal_lm",
