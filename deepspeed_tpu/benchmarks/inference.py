@@ -55,13 +55,12 @@ def run_benchmark(model_size="tiny", dtype="bf16", batch=1, prompt_len=128,
     cfg = presets[model_size](remat=False)
     model = CausalTransformerLM(cfg)
     if zero_stream:
-        if quant or tp > 1:
-            # the streaming engine bypasses the quant branch and uploads
-            # unsharded layers; accepting these flags would journal a
-            # configuration that never ran
+        if tp > 1:
+            # the streaming engine uploads unsharded layers; accepting
+            # --tp would journal a configuration that never ran
             raise ValueError(
-                "--zero-stream does not compose with --int8/--tp: the "
-                "streaming path uploads bf16 per-layer working sets")
+                "--zero-stream does not compose with --tp: the streaming "
+                "path uploads unsharded per-layer working sets")
         # ZeRO-Inference: weights live on the host and stream per layer —
         # init must run on the HOST backend so a beyond-HBM model never
         # materialises on the chip (the engine host-casts the layer stack

@@ -261,6 +261,13 @@ def main():
             [py, "bin/ds_bench", "inference", "--model", "llama2-7b",
              "--batch", "1", "--prompt-len", "128", "--max-new-tokens",
              "32", "--trials", "5", "--zero-stream"], timeout=3000)
+        # int8 weight streaming halves the per-layer H2D — the streamed-
+        # inference bottleneck; compare tokens/s against the bf16 stream
+        run("infer_7b_zero_stream_int8",
+            [py, "bin/ds_bench", "inference", "--model", "llama2-7b",
+             "--batch", "1", "--prompt-len", "128", "--max-new-tokens",
+             "32", "--trials", "5", "--zero-stream", "--int8"],
+            timeout=3000)
 
     if "tune" in steps:
         spec = {"kind": "causal_lm",
