@@ -72,8 +72,12 @@ def _promote_cached(this_run):
         return this_run
     age_h = round((time.time() - int(cached["captured_unix"])) / 3600.0, 1)
     if age_h > _MAX_CACHE_AGE_H:
-        this_run["last_known_onchip"] = cached
-        this_run["cache_age_hours"] = age_h
+        # the age belongs to the cached record, not this run's metrics —
+        # nest it, and mark the non-promotion explicitly
+        stale = dict(cached)
+        stale["cache_age_hours"] = age_h
+        this_run["last_known_onchip"] = stale
+        this_run["cache_too_stale"] = True
         return this_run
     out = dict(cached)
     out["fallback"] = "cached_onchip"

@@ -48,6 +48,11 @@ class CommsLogger:
         self.prof_ops = prof_ops or []
 
     def append(self, op_name, size_bytes, axis):
+        # unified telemetry census rides every traced op, independent of the
+        # comms_logger's own enabled/prof_ops filters (no-op when telemetry
+        # is off — one flag check inside comm())
+        from deepspeed_tpu.monitor.telemetry import get_telemetry
+        get_telemetry().comm(op_name, size_bytes, axis)
         if not self.enabled:
             return
         if self.prof_ops and op_name not in self.prof_ops:

@@ -1,1 +1,3 @@
-from .monitor import Monitor, MonitorMaster
+from .monitor import JsonlMonitor, Monitor, MonitorMaster
+from .telemetry import (JsonlEventSink, MetricsRegistry, StepStallWatchdog,
+                        Telemetry, get_telemetry)

@@ -93,6 +93,27 @@ class csvMonitor(Monitor):
                 w.writerow([step, value])
 
 
+class JsonlMonitor(Monitor):
+    """Fourth writer: scalar monitor events land in the unified telemetry
+    JSONL stream (``monitor/telemetry.py``) as ``gauge`` events, so the
+    training curves and the comm/HBM/stall telemetry share one sink."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        from deepspeed_tpu.monitor.telemetry import get_telemetry
+        self._telemetry = get_telemetry()
+        if cfg.enabled and not self._telemetry.enabled:
+            # standalone MonitorMaster use (no engine ran configure yet)
+            self._telemetry.configure(cfg)
+        self.enabled = cfg.enabled and self._telemetry.enabled
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self._telemetry.gauge(name, float(value), step=int(step))
+
+
 class MonitorMaster(Monitor):
 
     def __init__(self, monitor_config):
@@ -102,6 +123,7 @@ class MonitorMaster(Monitor):
         self.tb_monitor = None
         self.wandb_monitor = None
         self.csv_monitor = None
+        self.jsonl_monitor = None
         if rank == 0 and monitor_config:
             if monitor_config["tensorboard"].enabled:
                 self.tb_monitor = TensorBoardMonitor(monitor_config["tensorboard"])
@@ -109,11 +131,17 @@ class MonitorMaster(Monitor):
                 self.wandb_monitor = WandbMonitor(monitor_config["wandb"])
             if monitor_config["csv_monitor"].enabled:
                 self.csv_monitor = csvMonitor(monitor_config["csv_monitor"])
-        self.enabled = any([self.tb_monitor, self.wandb_monitor, self.csv_monitor])
+            tel_cfg = monitor_config.get("telemetry") \
+                if hasattr(monitor_config, "get") else None
+            if tel_cfg is not None and tel_cfg.enabled:
+                self.jsonl_monitor = JsonlMonitor(tel_cfg)
+        self.enabled = any([self.tb_monitor, self.wandb_monitor,
+                            self.csv_monitor, self.jsonl_monitor])
 
     def write_events(self, event_list):
         if not event_list:
             return
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
+                  self.jsonl_monitor):
             if m is not None:
                 m.write_events(event_list)

@@ -1,0 +1,426 @@
+"""Unified telemetry spine: structured run-event stream, metrics registry,
+xprof spans, and a step-stall watchdog.
+
+The reference threads observability through every layer (``monitor/``,
+``utils/timer.py``, ``comms_logger``, flops profiler) but each fragment has
+its own sink.  Here every subsystem writes into ONE process-local
+:class:`Telemetry` object:
+
+* :class:`MetricsRegistry` — counters, gauges (with peak tracking), and
+  time-window histograms, safe to touch from worker threads (param-stream
+  H2D drain, the watchdog).
+* :meth:`Telemetry.span` — a context manager that times its body, records
+  the duration into a histogram, emits a structured ``span`` event, and
+  opens a ``jax.profiler.TraceAnnotation`` so the same region shows up in
+  an xprof capture (no-op fallback when the profiler is unavailable).
+* :class:`JsonlEventSink` — rank-0-gated JSONL stream with size-based
+  rotation.  ``MonitorMaster`` gains it as a fourth writer, so scalar
+  monitor events, comm census, HBM gauges, heartbeats and stalls all land
+  in the same replayable stream.
+* :class:`StepStallWatchdog` — a daemon thread fed a heartbeat from every
+  engine ``step()``; when the gap since the last beat exceeds a
+  configurable multiple of the rolling-median step time it logs and emits
+  a structured ``stall`` event.  This turns the silent-hang failure class
+  (ROUND5_NOTES: 88 consecutive probe timeouts with zero in-band evidence)
+  into an observable one.
+
+Every event is one JSON object per line with at minimum ``ts`` (unix
+seconds), ``kind`` and ``name``.  The frozen per-kind schema lives in
+``scripts/check_telemetry_schema.py`` and is enforced by a tier-1 test.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager, nullcontext
+
+from deepspeed_tpu.utils.logging import logger
+
+# The closed set of event kinds.  Adding a kind means updating the frozen
+# schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
+EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
+               "meta")
+
+
+def _profiler_annotation(name):
+    """An xprof trace annotation for ``name`` — host-side TraceMe, visible
+    in a ``jax.profiler`` capture.  Falls back to a no-op off-TPU / when
+    the profiler is unavailable."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()
+
+
+# ----------------------------------------------------------------------
+# metrics registry
+# ----------------------------------------------------------------------
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "value", "peak")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+        self.peak = float("-inf")
+
+    def set(self, value):
+        value = float(value)
+        self.value = value
+        if value > self.peak:
+            self.peak = value
+
+
+class Histogram:
+    """Time-window histogram: keeps ``(t, value)`` samples no older than
+    ``window_secs`` (bounded by ``max_samples``); percentile queries prune
+    lazily."""
+
+    __slots__ = ("name", "window_secs", "_samples")
+
+    def __init__(self, name, window_secs=600.0, max_samples=4096):
+        self.name = name
+        self.window_secs = float(window_secs)
+        self._samples = deque(maxlen=max_samples)
+
+    def observe(self, value, now=None):
+        self._samples.append((now if now is not None else time.monotonic(),
+                              float(value)))
+
+    def _prune(self, now=None):
+        now = now if now is not None else time.monotonic()
+        cutoff = now - self.window_secs
+        while self._samples and self._samples[0][0] < cutoff:
+            self._samples.popleft()
+
+    def values(self, now=None):
+        self._prune(now)
+        return [v for _, v in self._samples]
+
+    def percentile(self, q, now=None):
+        vals = sorted(self.values(now))
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+        return vals[idx]
+
+    def summary(self, now=None):
+        vals = sorted(self.values(now))
+        if not vals:
+            return {"count": 0}
+        n = len(vals)
+
+        def pct(q):
+            return vals[min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))]
+        return {"count": n, "min": vals[0], "max": vals[-1],
+                "mean": sum(vals) / n, "p50": pct(50), "p90": pct(90),
+                "p99": pct(99)}
+
+
+class MetricsRegistry:
+    """Process-local named counters / gauges / time-window histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {}
+        self.gauges = {}
+        self.histograms = {}
+
+    def counter(self, name) -> Counter:
+        with self._lock:
+            if name not in self.counters:
+                self.counters[name] = Counter(name)
+            return self.counters[name]
+
+    def gauge(self, name) -> Gauge:
+        with self._lock:
+            if name not in self.gauges:
+                self.gauges[name] = Gauge(name)
+            return self.gauges[name]
+
+    def histogram(self, name, window_secs=600.0) -> Histogram:
+        with self._lock:
+            if name not in self.histograms:
+                self.histograms[name] = Histogram(name,
+                                                  window_secs=window_secs)
+            return self.histograms[name]
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self.counters.items()},
+                "gauges": {n: {"value": g.value, "peak": g.peak}
+                           for n, g in self.gauges.items()},
+                "histograms": {n: h.summary()
+                               for n, h in self.histograms.items()},
+            }
+
+    def reset(self):
+        with self._lock:
+            self.counters = {}
+            self.gauges = {}
+            self.histograms = {}
+
+
+# ----------------------------------------------------------------------
+# JSONL sink with size-based rotation
+# ----------------------------------------------------------------------
+class JsonlEventSink:
+    """Append-only ``events.jsonl`` with size-based rotation: when the live
+    file exceeds ``max_bytes`` it is renamed to ``events.jsonl.1`` (older
+    generations shift up, the oldest beyond ``max_files`` is dropped)."""
+
+    def __init__(self, output_dir, filename="events.jsonl",
+                 max_bytes=64 * 1024 * 1024, max_files=4):
+        self.output_dir = output_dir
+        self.path = os.path.join(output_dir, filename)
+        self.max_bytes = int(max_bytes)
+        self.max_files = max(1, int(max_files))
+        os.makedirs(output_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._file = open(self.path, "a")
+
+    def emit(self, event: dict):
+        line = json.dumps(event, default=str)
+        with self._lock:
+            if self._file is None:
+                return
+            self._file.write(line + "\n")
+            self._file.flush()
+            if self._file.tell() >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self):
+        self._file.close()
+        for i in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        os.replace(self.path, f"{self.path}.1")
+        self._file = open(self.path, "a")
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# ----------------------------------------------------------------------
+# the telemetry object
+# ----------------------------------------------------------------------
+class Telemetry:
+    """Process-local telemetry: registry + (rank-0) JSONL sink + spans.
+
+    Disabled by default; every hot-path caller is expected to gate on
+    ``telemetry.enabled`` (one attribute read) so a disabled run pays a
+    single flag check per step and nothing else.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self.registry = MetricsRegistry()
+        self.sink = None
+        self.config = None
+
+    def configure(self, config=None, rank=None):
+        """(Re)configure from a ``TelemetryConfig``-shaped object.  The sink
+        is rank-0-gated; non-zero ranks keep the registry and spans (xprof
+        annotations are per-host) but write no events."""
+        if self.sink is not None:
+            self.sink.close()
+            self.sink = None
+        self.config = config
+        self.enabled = bool(config is not None and config.enabled)
+        if not self.enabled:
+            return self
+        if rank is None:
+            try:
+                import jax
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        if rank == 0:
+            out_dir = os.path.join(config.output_path or "./telemetry",
+                                   config.job_name)
+            self.sink = JsonlEventSink(
+                out_dir,
+                max_bytes=int(float(config.max_file_mb) * 1024 * 1024),
+                max_files=config.max_files)
+        return self
+
+    # -- events --------------------------------------------------------
+    def emit(self, kind, name, **fields):
+        if not self.enabled or self.sink is None:
+            return
+        event = {"ts": round(time.time(), 6), "kind": kind, "name": name}
+        event.update({k: v for k, v in fields.items() if v is not None})
+        self.sink.emit(event)
+
+    @contextmanager
+    def span(self, name, step=None, attrs=None):
+        """Timed structured event + xprof trace annotation around the body.
+        The duration also lands in histogram ``span/<name>``."""
+        if not self.enabled:
+            yield
+            return
+        with _profiler_annotation(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                dur_ms = (time.perf_counter() - t0) * 1000.0
+                self.registry.histogram(f"span/{name}").observe(dur_ms)
+                self.emit("span", name, dur_ms=round(dur_ms, 3), step=step,
+                          attrs=attrs or None)
+
+    def gauge(self, name, value, step=None):
+        """Set gauge ``name`` (peak-tracked) and emit a ``gauge`` event."""
+        if not self.enabled:
+            return
+        g = self.registry.gauge(name)
+        g.set(value)
+        self.emit("gauge", name, value=float(value),
+                  peak=round(g.peak, 6), step=step)
+
+    def count(self, name, n=1):
+        if not self.enabled:
+            return
+        self.registry.counter(name).inc(n)
+
+    def comm(self, op_name, size_bytes, axis):
+        """Per-op comm census (trace-time: a shape traces once, executes
+        many times — counts are per-trace like ``CommsLogger``)."""
+        if not self.enabled:
+            return
+        self.registry.counter(f"comm/{op_name}/calls").inc()
+        self.registry.counter(f"comm/{op_name}/bytes").inc(int(size_bytes))
+        self.emit("comm", op_name, bytes=int(size_bytes), axis=str(axis))
+
+    def close(self):
+        if self.sink is not None:
+            self.sink.close()
+            self.sink = None
+        self.enabled = False
+
+
+_telemetry = Telemetry()
+
+
+def get_telemetry() -> Telemetry:
+    """The process-global telemetry instance (engine init configures it)."""
+    return _telemetry
+
+
+# ----------------------------------------------------------------------
+# step-stall watchdog
+# ----------------------------------------------------------------------
+class StepStallWatchdog:
+    """Detects hung training steps.
+
+    The engine calls :meth:`beat` at every completed ``step()``; a daemon
+    thread polls and, when the gap since the last beat exceeds
+    ``max(stall_factor * rolling_median_step, min_stall_secs)``, logs a
+    warning and emits a structured ``stall`` event — once per stalled step,
+    so a long hang produces one event, not a flood.
+    """
+
+    def __init__(self, telemetry: Telemetry, stall_factor=10.0,
+                 poll_interval_secs=1.0, min_stall_secs=1.0, window=64):
+        self.telemetry = telemetry
+        self.stall_factor = float(stall_factor)
+        self.poll_interval_secs = float(poll_interval_secs)
+        self.min_stall_secs = float(min_stall_secs)
+        self._lock = threading.Lock()
+        self._durations = deque(maxlen=window)
+        self._last_beat = None
+        self._last_step = -1
+        self._stall_reported = False
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="ds-stall-watchdog")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def beat(self, step):
+        """Record a completed step; emits a ``heartbeat`` event carrying the
+        measured step wall time."""
+        now = time.monotonic()
+        with self._lock:
+            step_s = (now - self._last_beat
+                      if self._last_beat is not None else None)
+            if step_s is not None:
+                self._durations.append(step_s)
+            self._last_beat = now
+            self._last_step = int(step)
+            self._stall_reported = False
+        self.telemetry.emit(
+            "heartbeat", "engine/step", step=int(step),
+            step_ms=(round(step_s * 1000.0, 3)
+                     if step_s is not None else None))
+
+    def median_step_secs(self):
+        with self._lock:
+            if not self._durations:
+                return None
+            vals = sorted(self._durations)
+            return vals[len(vals) // 2]
+
+    def check(self, now=None):
+        """One watchdog evaluation (the poll thread calls this; tests may
+        call it directly for determinism).  Returns True if a stall event
+        was emitted."""
+        now = now if now is not None else time.monotonic()
+        with self._lock:
+            if self._last_beat is None or len(self._durations) < 2 or \
+                    self._stall_reported:
+                return False
+            last_beat, last_step = self._last_beat, self._last_step
+            vals = sorted(self._durations)
+            median = vals[len(vals) // 2]
+        threshold = max(self.stall_factor * median, self.min_stall_secs)
+        gap = now - last_beat
+        if gap <= threshold:
+            return False
+        with self._lock:
+            self._stall_reported = True
+        logger.warning(
+            f"step stall: {gap:.1f}s since step {last_step} completed "
+            f"(rolling-median step {median:.3f}s, threshold {threshold:.1f}s)")
+        self.telemetry.emit(
+            "stall", "engine/step", step=last_step, gap_s=round(gap, 3),
+            median_step_s=round(median, 6), threshold_s=round(threshold, 3))
+        return True
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval_secs):
+            try:
+                self.check()
+            except Exception as e:  # never kill the host process
+                logger.warning(f"stall watchdog check failed: {e}")

@@ -20,6 +20,7 @@ from abc import ABC, abstractmethod
 
 import jax
 
+from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -57,6 +58,10 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return os.path.join(os.path.abspath(save_dir), tag)
 
     def save(self, state, save_dir, tag, client_state=None):
+        with get_telemetry().span("checkpoint/save", attrs={"tag": str(tag)}):
+            return self._save(state, save_dir, tag, client_state)
+
+    def _save(self, state, save_dir, tag, client_state=None):
         ocp = self._ocp
         path = self._path(save_dir, tag)
         os.makedirs(path, exist_ok=True)
@@ -77,6 +82,12 @@ class OrbaxCheckpointEngine(CheckpointEngine):
 
     def load(self, template_state, load_dir, tag, mesh,
              load_optimizer_states=True, load_module_only=False):
+        with get_telemetry().span("checkpoint/load", attrs={"tag": str(tag)}):
+            return self._load(template_state, load_dir, tag, mesh,
+                              load_optimizer_states, load_module_only)
+
+    def _load(self, template_state, load_dir, tag, mesh,
+              load_optimizer_states=True, load_module_only=False):
         ocp = self._ocp
         path = self._path(load_dir, tag)
         # Restore with the *current* shardings as target: orbax reshards,

@@ -70,6 +70,21 @@ class CSVConfig(MonitorConfig):
     pass
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """``"telemetry"`` block: the unified JSONL event stream
+    (``monitor/telemetry.py``) plus the step-stall watchdog."""
+    enabled = False
+    output_path = ""                # dir for events.jsonl ("" -> ./telemetry)
+    job_name = "DeepSpeedJobName"
+    max_file_mb = 64                # size-based rotation threshold
+    max_files = 4                   # rotated generations kept
+    hbm_gauges = True               # per-step device memory_stats() gauges
+    stall_watchdog = True
+    stall_factor = 10.0             # stall when gap > factor * median step
+    stall_min_secs = 1.0            # floor on the stall threshold
+    stall_poll_secs = 1.0           # watchdog poll interval
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled = False
     profile_step = 1
@@ -195,10 +210,13 @@ class DeepSpeedConfig:
         self.scheduler_config = SchedulerConfig(sched_dict) if sched_dict else None
 
         self.comms_config = CommsConfig(pd.get(C.COMMS_LOGGER, {}))
+        self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
         self.monitor_config = {
             "tensorboard": TensorBoardConfig(pd.get(C.MONITOR_TENSORBOARD, {})),
             "wandb": WandbConfig(pd.get(C.MONITOR_WANDB, {})),
             "csv_monitor": CSVConfig(pd.get(C.MONITOR_CSV, {})),
+            # the JSONL fourth writer shares the telemetry sink/config
+            "telemetry": self.telemetry_config,
         }
         self.flops_profiler_config = FlopsProfilerConfig(pd.get(C.FLOPS_PROFILER, {}))
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
@@ -228,7 +246,7 @@ class DeepSpeedConfig:
         C.STEPS_PER_PRINT, C.WALL_CLOCK_BREAKDOWN, C.DUMP_STATE,
         C.SPARSE_GRADIENTS, C.ZERO_OPTIMIZATION, C.COMMS_LOGGER, C.MESH,
         C.ACTIVATION_CHECKPOINTING, C.FLOPS_PROFILER,
-        C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV,
+        C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV, C.TELEMETRY,
         C.DATA_EFFICIENCY, C.CURRICULUM_LEARNING_LEGACY, C.CHECKPOINT,
         C.ELASTICITY, C.COMPRESSION_TRAINING,
         C.PIPELINE, C.SEED, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,

@@ -80,6 +80,8 @@ MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 
+TELEMETRY = "telemetry"  # unified JSONL event stream + stall watchdog
+
 GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
 TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
 TRAIN_BATCH_SIZE_DEFAULT = None

@@ -30,6 +30,7 @@ The model contract is functional: ``model`` is a callable
 """
 
 import os
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -42,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.monitor.monitor import MonitorMaster
+from deepspeed_tpu.monitor.telemetry import StepStallWatchdog, get_telemetry
 from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.parallel.topology import build_mesh
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
@@ -103,6 +105,16 @@ def moq_anneal_step(state: "TrainState") -> jnp.ndarray:
     quantizer.transform call site (train, eval, pipeline) must use this one
     definition or their quantization bits desynchronize."""
     return state.global_step - state.skipped_steps
+
+
+def _batch_token_count(batch):
+    """Tokens per global batch: the size of the first integer leaf (token
+    ids).  Dense/regression batches have no integer leaf — returns None and
+    throughput telemetry falls back to samples/s."""
+    for leaf in jax.tree_util.tree_leaves(batch):
+        if jnp.issubdtype(leaf.dtype, jnp.integer):
+            return int(np.prod(leaf.shape))
+    return None
 
 
 class DeepSpeedEngine:
@@ -243,14 +255,39 @@ class DeepSpeedEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size,
             steps_per_output=config.steps_per_print)
+        # unified telemetry spine (monitor/telemetry.py): configure the
+        # process-global sink BEFORE MonitorMaster so its JSONL fourth
+        # writer attaches to the same stream
+        tc = config.telemetry_config
+        self.telemetry = get_telemetry().configure(tc)
+        self._tel_enabled = self.telemetry.enabled
+        self._watchdog = None
+        if self._tel_enabled and tc.stall_watchdog:
+            self._watchdog = StepStallWatchdog(
+                self.telemetry, stall_factor=tc.stall_factor,
+                poll_interval_secs=tc.stall_poll_secs,
+                min_stall_secs=tc.stall_min_secs).start()
+        self._last_batch_tokens = None
         self.monitor = MonitorMaster(config.monitor_config)
+        if self._tel_enabled:
+            self.telemetry.emit(
+                "meta", "engine/init",
+                attrs={"zero_stage": self.zero_stage,
+                       "dtype": self.compute_dtype.__name__,
+                       "mesh": {k: int(v) for k, v in self.mesh.shape.items()},
+                       "micro_batch": config.train_micro_batch_size_per_gpu,
+                       "gas": config.gradient_accumulation_steps,
+                       "train_batch": config.train_batch_size})
 
         self.training_dataloader = None
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(
                 training_data, collate_fn=collate_fn)
 
-        self._compiled_train_step = None
+        # compiled-step caches are keyed on gas: a later call with a
+        # different gas must not silently reuse a closure over a stale one
+        self._compiled_train_step = {}
+        self._compiled_offload_grad = {}
         self._compiled_fwd_bwd = None
         self._compiled_apply = None
         self._batch_ndim = None
@@ -691,16 +728,16 @@ class DeepSpeedEngine:
         return train_step
 
     def _get_compiled_train_step(self, gas: int):
-        if self._compiled_train_step is None:
+        if gas not in self._compiled_train_step:
             step = self._build_train_step(gas)
-            self._compiled_train_step = jax.jit(step, donate_argnums=(0,))
-        return self._compiled_train_step
+            self._compiled_train_step[gas] = jax.jit(step, donate_argnums=(0,))
+        return self._compiled_train_step[gas]
 
     # ------------------------------------------------------------------
     # ZeRO-Offload step path: device computes grads, host applies Adam
     # ------------------------------------------------------------------
     def _get_compiled_offload_grad_step(self, gas: int):
-        if getattr(self, "_compiled_offload_grad", None) is None:
+        if gas not in self._compiled_offload_grad:
             fp16 = self._config.fp16_enabled
 
             def grad_step(state: TrainState, batch):
@@ -716,8 +753,8 @@ class DeepSpeedEngine:
                             else jnp.asarray(False))
                 grad_norm = _global_norm_f32(grads)
                 return loss, grads, overflow, grad_norm, rng
-            self._compiled_offload_grad = jax.jit(grad_step)
-        return self._compiled_offload_grad
+            self._compiled_offload_grad[gas] = jax.jit(grad_step)
+        return self._compiled_offload_grad[gas]
 
     def _offload_host_apply(self, grads, overflow, grad_norm):
         """Host tail of the offload step: stream grads D2H, fused C++ Adam on
@@ -776,6 +813,12 @@ class DeepSpeedEngine:
     def forward(self, batch, rng=None):
         """Computes loss (and, functionally, gradients — cached for
         ``backward``).  Returns the unscaled loss."""
+        if not self._tel_enabled:
+            return self._forward_inner(batch, rng)
+        with self.telemetry.span("engine/forward", step=self.global_steps):
+            return self._forward_inner(batch, rng)
+
+    def _forward_inner(self, batch, rng=None):
         if self._param_stream is not None:
             raise NotImplementedError(
                 "offload_param streaming runs whole optimizer steps; use "
@@ -810,6 +853,12 @@ class DeepSpeedEngine:
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
         """Accumulates the gradients computed by the latest ``forward``.
         Parity: reference ``backward:1931`` (scaling by 1/GAS happens here)."""
+        if not self._tel_enabled:
+            return self._backward_inner(loss)
+        with self.telemetry.span("engine/backward", step=self.global_steps):
+            return self._backward_inner(loss)
+
+    def _backward_inner(self, loss=None):
         assert self._cached is not None, "backward() called before forward()"
         self.timers(BACKWARD_GLOBAL_TIMER).start()
         _, grads, overflow = self._cached
@@ -834,6 +883,14 @@ class DeepSpeedEngine:
     def step(self):
         """Applies the optimizer update at the GAS boundary.
         Parity: reference ``step:2142`` → ``_take_model_step:2074``."""
+        if not self._tel_enabled:
+            return self._step_inner()
+        with self.telemetry.span("engine/step", step=self.global_steps):
+            self._step_inner()
+        if self._step_applied:
+            self._emit_step_telemetry()
+
+    def _step_inner(self):
         self._step_applied = False
         if not self.is_gradient_accumulation_boundary():
             return
@@ -870,6 +927,17 @@ class DeepSpeedEngine:
         """One full training step (GAS microbatches) as a single compiled
         program.  Parity with ``PipelineEngine.train_batch`` semantics: returns
         the mean loss over the global batch."""
+        if not self._tel_enabled:
+            return self._train_batch_inner(data_iter, batch)
+        t0 = time.perf_counter()
+        with self.telemetry.span("engine/train_batch",
+                                 step=self.global_steps):
+            loss = self._train_batch_inner(data_iter, batch)
+        self._emit_step_telemetry(step_secs=time.perf_counter() - t0,
+                                  metrics=self._last_metrics)
+        return loss
+
+    def _train_batch_inner(self, data_iter=None, batch=None):
         gas = self.gradient_accumulation_steps_
         if batch is None:
             if data_iter is None:
@@ -890,6 +958,8 @@ class DeepSpeedEngine:
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
+        if self._tel_enabled:
+            self._last_batch_tokens = _batch_token_count(batch)
         self._maybe_profile_flops(batch, gas)
         if self._param_stream is not None:
             cfg = self._config
@@ -1044,6 +1114,47 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # monitor / introspection parity accessors
     # ------------------------------------------------------------------
+    def _emit_step_telemetry(self, step_secs=None, metrics=None):
+        """Per-step telemetry tail (telemetry-enabled runs only): heartbeat
+        for the stall watchdog, loss/grad-norm/loss-scale + throughput
+        gauges, and device-memory gauges with peak tracking."""
+        tel = self.telemetry
+        step = self.global_steps
+        if self._watchdog is not None:
+            self._watchdog.beat(step)
+        if metrics is not None:
+            tel.gauge("engine/loss", float(metrics.loss), step=step)
+            tel.gauge("engine/grad_norm", float(metrics.grad_norm), step=step)
+            if self._config.fp16_enabled:
+                tel.gauge("engine/loss_scale", float(metrics.loss_scale),
+                          step=step)
+        elif self._global_grad_norm is not None:
+            tel.gauge("engine/grad_norm", float(self._global_grad_norm),
+                      step=step)
+        if step_secs is not None and step_secs > 0:
+            tel.gauge("engine/samples_per_sec",
+                      self._config.train_batch_size / step_secs, step=step)
+            if self._last_batch_tokens:
+                tel.gauge("engine/tokens_per_sec",
+                          self._last_batch_tokens / step_secs, step=step)
+        if self._config.telemetry_config.hbm_gauges:
+            self._emit_hbm_gauges(step)
+
+    def _emit_hbm_gauges(self, step):
+        """HBM pressure gauges from ``jax.Device.memory_stats()`` (None on
+        backends without allocator stats — skip quietly)."""
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return
+        for key in ("bytes_in_use", "peak_bytes_in_use",
+                    "largest_alloc_size", "bytes_limit"):
+            if key in stats:
+                self.telemetry.gauge(f"hbm/{key}", float(stats[key]),
+                                     step=step)
+
     def _write_monitor(self, metrics=None):
         if not self.monitor.enabled:
             return

@@ -58,6 +58,30 @@ class PipelineEngine(DeepSpeedEngine):
             f"micro_batches={self.micro_batches} "
             f"bubble={(self.num_stages - 1) / (self.micro_batches + self.num_stages - 1):.2f}",
             ranks=[0])
+        if self._tel_enabled:
+            self._emit_schedule_telemetry()
+
+    def _emit_schedule_telemetry(self):
+        """One ``meta`` event per stage describing the schedule phases the
+        compiled scan realises (fill/active/drain tick counts plus an
+        instruction census from :class:`TrainSchedule`).  The per-phase
+        spans *inside* the step are the trace-time ``pipe/*`` named scopes
+        (see ``pipe/pipeline.py``) — visible in xprof, not host-timeable,
+        because the whole clock is one XLA program."""
+        M, P = self.micro_batches, self.num_stages
+        for s in range(P):
+            counts = {}
+            for cmds in TrainSchedule(micro_batches=M, stages=P, stage_id=s):
+                for c in cmds:
+                    k = type(c).__name__
+                    counts[k] = counts.get(k, 0) + 1
+            self.telemetry.emit(
+                "meta", f"pipe/schedule/stage{s}",
+                attrs={"stage": s, "stages": P, "micro_batches": M,
+                       "fill_ticks": s, "active_ticks": M,
+                       "drain_ticks": P - 1 - s,
+                       "bubble": (P - 1) / (M + P - 1),
+                       "instructions": counts})
 
     # the compiled step: ONE loss call over the microbatch stack — the
     # microbatch dim is the pipeline clock, not a grad-accumulation scan
@@ -83,7 +107,7 @@ class PipelineEngine(DeepSpeedEngine):
     # step from the pipelined loss directly.  The host tail (streamed D2H /
     # C++ Adam / streamed H2D, engine._offload_host_apply) is shared.
     def _get_compiled_offload_grad_step(self, gas: int):
-        if getattr(self, "_compiled_offload_grad", None) is None:
+        if gas not in self._compiled_offload_grad:
             from deepspeed_tpu.runtime.engine import (_global_norm_f32,
                                                       constrain,
                                                       has_inf_or_nan)
@@ -104,8 +128,8 @@ class PipelineEngine(DeepSpeedEngine):
                             else jnp.asarray(False))
                 grad_norm = _global_norm_f32(grads)
                 return loss, grads, overflow, grad_norm, rng
-            self._compiled_offload_grad = jax.jit(grad_step)
-        return self._compiled_offload_grad
+            self._compiled_offload_grad[gas] = jax.jit(grad_step)
+        return self._compiled_offload_grad[gas]
 
     def _model_scaled_loss(self, p_c, batch, rng, loss_scale):
         """Scale AT THE SOURCE: the interleaved 1F1B backward runs inside
@@ -113,7 +137,8 @@ class PipelineEngine(DeepSpeedEngine):
         (reference scales the loss before backward; multiplying afterwards
         in the outer vjp would let small fp16 cotangents flush to zero
         inside the scan)."""
-        scaled = self.module.loss(p_c, batch, rng, loss_scale=loss_scale)
+        with jax.named_scope("pipe/train_clock"):
+            scaled = self.module.loss(p_c, batch, rng, loss_scale=loss_scale)
         return scaled.astype(jnp.float32), scaled / loss_scale
 
     # the 3-call API is train-schedule-incompatible with pipelining

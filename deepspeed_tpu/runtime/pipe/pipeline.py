@@ -131,7 +131,8 @@ def pipeline_spmd(stage_fn: Callable,
         buf = jax.lax.dynamic_update_index_in_dim(buf, slot0, 0, 0)
         buf = maybe_constrain(buf, _buf_spec(buf.ndim))
         # ForwardPass on every stage (stage s holds microbatch t - s)
-        y, aux_s = vstage(stage_params, buf)
+        with jax.named_scope("pipe/forward_pass"):
+            y, aux_s = vstage(stage_params, buf)
         y = maybe_constrain(y, _buf_spec(y.ndim))
         # aux only from slots holding a REAL microbatch (warmup/drain slots
         # run on zero/stale activations — their gate stats are garbage)
@@ -141,7 +142,9 @@ def pipeline_spmd(stage_fn: Callable,
         # SendActivation/RecvActivation: shift one slot down the pipe
         # (roll over the pp-sharded dim → CollectivePermute); the last
         # stage's output is this tick's exit (microbatch t - (P-1))
-        return jnp.roll(y, 1, axis=0), (y[Pn - 1], aux_t)
+        with jax.named_scope("pipe/send_activation"):
+            shifted = jnp.roll(y, 1, axis=0)
+        return shifted, (y[Pn - 1], aux_t)
 
     if schedule == "gpipe":
         _, (ys, auxs) = jax.lax.scan(tick, buf, jnp.arange(T))
@@ -385,7 +388,8 @@ def _interleaved_1f1b(stage_fn, head_fn, num_stages, stage_params,
         slot0 = jnp.where(t < M, inp, fbuf[0])
         fbuf = jax.lax.dynamic_update_index_in_dim(fbuf, slot0, 0, 0)
         fbuf = maybe_constrain(fbuf, _buf_spec(fbuf.ndim))
-        parts = vparts(stage_params, fbuf)
+        with jax.named_scope("pipe/fwd_subtick"):
+            parts = vparts(stage_params, fbuf)
         y = parts[0]
         y = maybe_constrain(y, _buf_spec(y.ndim))
         new_consts = list(parts[1:])
@@ -413,8 +417,9 @@ def _interleaved_1f1b(stage_fn, head_fn, num_stages, stage_params,
         # seed the backward with the loss scale: fp16 cotangents must be
         # amplified BEFORE they enter the pipe, not after (reference
         # scales the loss pre-backward)
-        loss_m, ghead_m, gy, gmb_f = head_vjp(
-            y[Pn - 1], mb_leaves, jnp.asarray(loss_ct, jnp.float32))
+        with jax.named_scope("pipe/loss_head"):
+            loss_m, ghead_m, gy, gmb_f = head_vjp(
+                y[Pn - 1], mb_leaves, jnp.asarray(loss_ct, jnp.float32))
         gy = jnp.where(head_valid, gy, jnp.zeros_like(gy))
         gmb_f = tuple(jnp.where(head_valid, g, jnp.zeros_like(g))
                       for g in gmb_f)
@@ -449,8 +454,9 @@ def _interleaved_1f1b(stage_fn, head_fn, num_stages, stage_params,
         # NB: conv is a PURE function of its consts — re-deriving it per
         # body trace just rebuilds the same jaxpr; the x passed here only
         # shapes the trace and is never read by conv
-        gsp_t, gx_t = jax.vmap(stage_bwd)(stage_params, fbuf, bct,
-                                          *consts_now)
+        with jax.named_scope("pipe/bwd_subtick"):
+            gsp_t, gx_t = jax.vmap(stage_bwd)(stage_params, fbuf, bct,
+                                              *consts_now)
         gx_t = maybe_constrain(gx_t, _buf_spec(gx_t.ndim))
 
         mb_b = t - 2 * (Pn - 1) + stage_ids

@@ -57,6 +57,7 @@ ZeRO-Offload via ``offload_optimizer`` instead (``pipe/engine.py``).
 
 import math
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -65,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.ops import cpu_adam
 from deepspeed_tpu.runtime.zero.config import OffloadDeviceEnum
 from deepspeed_tpu.runtime.zero.offload import FlatLayout
@@ -77,6 +79,12 @@ STREAM_SUBDIR = "zero_param_stream"
 def _np_dtype(dtype) -> np.dtype:
     return np.dtype(jnp.dtype(dtype).name) if not isinstance(dtype, np.dtype) \
         else dtype
+
+
+def _tree_bytes(tree) -> int:
+    """Total byte size of a pytree's leaves (host or device arrays)."""
+    return sum(int(l.size) * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(tree))
 
 
 def _alloc(shape, dtype, nvme_dir: Optional[str], name: str) -> np.ndarray:
@@ -446,6 +454,7 @@ class ParamStreamRunner:
         self._jits: Dict[str, Any] = {}
         self._adam_ex: Optional[ThreadPoolExecutor] = None
         self.boundary_pipelined = True   # ablation knob (benchmarks)
+        self._tel = get_telemetry()
 
     def _xfer_pool(self) -> ThreadPoolExecutor:
         """Single-worker pool for boundary H2D uploads: the fused C++ Adam
@@ -493,8 +502,11 @@ class ParamStreamRunner:
         if l < self.resident_layers:
             return self._pinned[l]
         if l not in self._dev:
-            self._dev[l] = device_put_global(self.store.mirror_tree(l),
-                                             self._layer_shardings[l])
+            host = self.store.mirror_tree(l)
+            if self._tel.enabled:
+                self._tel.count("param_stream/h2d_calls")
+                self._tel.count("param_stream/h2d_bytes", _tree_bytes(host))
+            self._dev[l] = device_put_global(host, self._layer_shardings[l])
         return self._dev[l]
 
     def _evict(self, keep: List[int]):
@@ -609,9 +621,21 @@ class ParamStreamRunner:
     def _land(self, l: int, tree, layout: FlatLayout, first: bool):
         """Fetch a grad tree to host (transfer already in flight) and
         accumulate into unit ``l``'s buffer."""
+        tel = self._tel if self._tel.enabled else None
+        t0 = time.perf_counter() if tel else 0.0
         flat = layout.flatten(jax.tree_util.tree_map(
             lambda x: np.asarray(jax.device_get(x), np.float32), tree))
         self.store.accumulate(l, flat, first)
+        if tel:
+            nbytes = _tree_bytes(tree)
+            dt = time.perf_counter() - t0
+            tel.count("param_stream/d2h_calls")
+            tel.count("param_stream/d2h_bytes", nbytes)
+            if dt > 0:
+                # device_get blocks on the (already in-flight) transfer, so
+                # this is an observed landing rate, not raw link bandwidth
+                tel.registry.gauge("param_stream/d2h_mbps").set(
+                    nbytes / dt / 1e6)
 
     # -- the step ------------------------------------------------------
     def train_step(self, batch, gas: int, lr: float, loss_scale,
@@ -623,7 +647,7 @@ class ParamStreamRunner:
         else a single microbatch.  Returns (mean unscaled loss, grad norm,
         overflow).
         """
-        with self.mesh:
+        with self.mesh, self._tel.span("param_stream/train_step"):
             return self._train_step_in_mesh(batch, gas, lr, loss_scale,
                                             fp16, clip, rng)
 
@@ -766,24 +790,41 @@ class ParamStreamRunner:
             return
         ex = self._xfer_pool()
         store = self.store
+        tel = self._tel if self._tel.enabled else None
+        t0 = time.perf_counter() if tel else 0.0
+        h2d_bytes = 0
         self.store.apply_unit(-1, lr, clip_coef, gas)
-        res_fut = ex.submit(
-            device_put_global,
-            store.resident_tree(dtype=store.compute_dtype),
-            self._res_shardings)
+        res_host = store.resident_tree(dtype=store.compute_dtype)
+        if tel:
+            h2d_bytes += _tree_bytes(res_host)
+        res_fut = ex.submit(device_put_global, res_host, self._res_shardings)
         up_futs = []
         for l in range(L):
             store.apply_unit(l, lr, clip_coef, gas)
             if l < self.resident_layers or l < self.buffer_count:
+                mirror = store.mirror_tree(l)
+                if tel:
+                    h2d_bytes += _tree_bytes(mirror)
                 up_futs.append((l, ex.submit(
-                    device_put_global, store.mirror_tree(l),
-                    self._layer_shardings[l])))
+                    device_put_global, mirror, self._layer_shardings[l])))
         self.resident_dev = res_fut.result()
         for l, fut in up_futs:
             if l < self.resident_layers:
                 self._pinned[l] = fut.result()
             else:
                 self._dev[l] = fut.result()   # warm next step's window
+        if tel:
+            dt = time.perf_counter() - t0
+            tel.count("param_stream/boundary_h2d_bytes", h2d_bytes)
+            if dt > 0:
+                # uploads drain under the Adam walk; this is the boundary's
+                # effective refresh rate, not raw link bandwidth
+                tel.registry.gauge("param_stream/boundary_h2d_mbps").set(
+                    h2d_bytes / dt / 1e6)
+            tel.registry.histogram("span/param_stream/boundary").observe(
+                dt * 1000.0)
+            tel.emit("span", "param_stream/boundary",
+                     dur_ms=round(dt * 1000.0, 3))
 
     # -- eval ----------------------------------------------------------
     def eval_loss(self, batch, rng=None) -> float:

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Frozen schema for the unified telemetry JSONL event stream.
+
+Every line ``deepspeed_tpu/monitor/telemetry.py`` emits must validate
+against the per-kind schema below.  The schema is FROZEN: adding an event
+kind or a field means editing this file in the same change, and the tier-1
+test (``tests/unit/test_telemetry_schema.py``) diffs ``EVENT_KINDS``
+against the telemetry module so the two cannot drift silently.
+
+Usage:
+    python scripts/check_telemetry_schema.py <events.jsonl> [more.jsonl ...]
+
+Exit code 0 when every event on every file validates; 1 otherwise (each
+offending line is reported with its file:lineno).
+"""
+
+import json
+import sys
+
+# required: field -> allowed types.  optional: same, may be absent.
+# Unknown kinds AND unknown fields are rejected — the stream is a contract.
+_NUM = (int, float)
+
+SCHEMA = {
+    "span": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "dur_ms": _NUM},
+        "optional": {"step": int, "attrs": dict},
+    },
+    "gauge": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "value": _NUM,
+                     "peak": _NUM},
+        "optional": {"step": int},
+    },
+    "counter": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "value": _NUM},
+        "optional": {"step": int},
+    },
+    "comm": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "bytes": int,
+                     "axis": str},
+        "optional": {},
+    },
+    "heartbeat": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "step": int},
+        "optional": {"step_ms": _NUM},
+    },
+    "stall": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "step": int,
+                     "gap_s": _NUM, "median_step_s": _NUM,
+                     "threshold_s": _NUM},
+        "optional": {},
+    },
+    "meta": {
+        "required": {"ts": _NUM, "kind": str, "name": str},
+        "optional": {"attrs": dict, "step": int},
+    },
+}
+
+EVENT_KINDS = tuple(SCHEMA)
+
+
+def validate_event(event):
+    """Validate one decoded event dict.  Returns a list of problem strings
+    (empty = valid)."""
+    problems = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not an object"]
+    kind = event.get("kind")
+    if kind not in SCHEMA:
+        return [f"unknown kind {kind!r}"]
+    spec = SCHEMA[kind]
+    for field, types in spec["required"].items():
+        if field not in event:
+            problems.append(f"{kind}: missing required field {field!r}")
+        elif not isinstance(event[field], types) or \
+                isinstance(event[field], bool):
+            problems.append(
+                f"{kind}: field {field!r} has type "
+                f"{type(event[field]).__name__}")
+    allowed = set(spec["required"]) | set(spec["optional"])
+    for field, value in event.items():
+        if field not in allowed:
+            problems.append(f"{kind}: unknown field {field!r}")
+        elif field in spec["optional"] and (
+                not isinstance(value, spec["optional"][field])
+                or isinstance(value, bool)):
+            problems.append(
+                f"{kind}: optional field {field!r} has type "
+                f"{type(value).__name__}")
+    return problems
+
+
+def validate_stream(lines):
+    """Validate an iterable of JSONL lines.  Yields (lineno, problem)
+    pairs; empty/whitespace lines are skipped."""
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = json.loads(line)
+        except ValueError as e:
+            yield i, f"not valid JSON: {e}"
+            continue
+        for p in validate_event(event):
+            yield i, p
+
+
+def validate_file(path):
+    with open(path) as f:
+        return list(validate_stream(f))
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 2
+    bad = 0
+    total = 0
+    for path in argv:
+        with open(path) as f:
+            for i, line in enumerate(f, start=1):
+                if not line.strip():
+                    continue
+                total += 1
+                try:
+                    event = json.loads(line)
+                    problems = validate_event(event)
+                except ValueError as e:
+                    problems = [f"not valid JSON: {e}"]
+                for p in problems:
+                    print(f"{path}:{i}: {p}")
+                    bad += 1
+    if bad:
+        print(f"FAIL: {bad} problem(s) across {total} event(s)")
+        return 1
+    print(f"OK: {total} event(s) validated")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Aggregate a unified-telemetry JSONL stream into human-readable tables.
+
+Reads the ``events.jsonl`` (plus rotated ``events.jsonl.N`` generations,
+oldest first) written by ``deepspeed_tpu/monitor/telemetry.py`` and prints:
+
+* per-span latency percentiles (count / mean / p50 / p90 / p99 / max),
+* comm volume per op (traced calls, total bytes, axes),
+* gauge last/peak table (HBM bytes-in-use, tokens/s, loss, ...),
+* heartbeat summary (steps seen, median step time) and any stall events.
+
+Usage:
+    python scripts/ds_telemetry_report.py <telemetry_dir_or_events.jsonl>
+    python scripts/ds_telemetry_report.py --json run/telemetry/MyJob
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def discover_files(target):
+    """events.jsonl + rotated generations for a path that may be a dir, the
+    live file, or a glob; ordered oldest -> newest so replay is in time
+    order."""
+    if os.path.isdir(target):
+        live = os.path.join(target, "events.jsonl")
+    else:
+        live = target
+    rotated = sorted(
+        glob.glob(live + ".*"),
+        key=lambda p: int(p.rsplit(".", 1)[1])
+        if p.rsplit(".", 1)[1].isdigit() else 0,
+        reverse=True)
+    files = [p for p in rotated if p.rsplit(".", 1)[1].isdigit()]
+    if os.path.exists(live):
+        files.append(live)
+    return files
+
+
+def load_events(files):
+    for path in files:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    continue  # torn tail line from a live writer
+
+
+def _pct(sorted_vals, q):
+    n = len(sorted_vals)
+    return sorted_vals[min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))]
+
+
+def aggregate(events):
+    spans = {}       # name -> [dur_ms]
+    comms = {}       # op -> {calls, bytes, axes}
+    gauges = {}      # name -> {last, peak, n}
+    heartbeats = []  # step_ms values
+    steps = set()
+    stalls = []
+    metas = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "span":
+            spans.setdefault(ev["name"], []).append(float(ev["dur_ms"]))
+        elif kind == "comm":
+            rec = comms.setdefault(ev["name"],
+                                   {"calls": 0, "bytes": 0, "axes": set()})
+            rec["calls"] += 1
+            rec["bytes"] += int(ev["bytes"])
+            rec["axes"].add(ev.get("axis", "?"))
+        elif kind == "gauge":
+            g = gauges.setdefault(ev["name"],
+                                  {"last": None, "peak": None, "n": 0})
+            g["last"] = ev["value"]
+            g["peak"] = ev.get("peak", ev["value"])
+            g["n"] += 1
+        elif kind == "heartbeat":
+            steps.add(ev.get("step"))
+            if ev.get("step_ms") is not None:
+                heartbeats.append(float(ev["step_ms"]))
+        elif kind == "stall":
+            stalls.append(ev)
+        elif kind == "meta":
+            metas.append(ev)
+    return {"spans": spans, "comms": comms, "gauges": gauges,
+            "heartbeats": heartbeats, "steps": steps, "stalls": stalls,
+            "metas": metas}
+
+
+def summarize(agg):
+    """JSON-friendly summary of an aggregate()."""
+    span_rows = {}
+    for name, durs in sorted(agg["spans"].items()):
+        vals = sorted(durs)
+        span_rows[name] = {
+            "count": len(vals),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p50_ms": round(_pct(vals, 50), 3),
+            "p90_ms": round(_pct(vals, 90), 3),
+            "p99_ms": round(_pct(vals, 99), 3),
+            "max_ms": round(vals[-1], 3),
+        }
+    comm_rows = {
+        op: {"calls": rec["calls"], "bytes": rec["bytes"],
+             "axes": sorted(rec["axes"])}
+        for op, rec in sorted(agg["comms"].items())}
+    gauge_rows = {
+        name: {"last": g["last"], "peak": g["peak"], "samples": g["n"]}
+        for name, g in sorted(agg["gauges"].items())}
+    hb = sorted(agg["heartbeats"])
+    heartbeat = {"steps": len(agg["steps"]),
+                 "median_step_ms": round(_pct(hb, 50), 3) if hb else None}
+    return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
+            "heartbeat": heartbeat,
+            "stalls": [{k: v for k, v in s.items() if k != "kind"}
+                       for s in agg["stalls"]]}
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}"
+
+
+def print_tables(summary, out=sys.stdout):
+    w = out.write
+    if summary["spans"]:
+        w("== span latency (ms) ==\n")
+        w(f"{'span':<36}{'count':>7}{'mean':>10}{'p50':>10}"
+          f"{'p90':>10}{'p99':>10}{'max':>10}\n")
+        for name, r in summary["spans"].items():
+            w(f"{name:<36}{r['count']:>7}{r['mean_ms']:>10}{r['p50_ms']:>10}"
+              f"{r['p90_ms']:>10}{r['p99_ms']:>10}{r['max_ms']:>10}\n")
+        w("\n")
+    if summary["comms"]:
+        w("== comm census (traced calls) ==\n")
+        w(f"{'op':<24}{'calls':>7}{'bytes':>14}  axes\n")
+        for op, r in summary["comms"].items():
+            w(f"{op:<24}{r['calls']:>7}{_fmt_bytes(r['bytes']):>14}  "
+              f"{','.join(r['axes'])}\n")
+        w("\n")
+    if summary["gauges"]:
+        w("== gauges (last / peak) ==\n")
+        w(f"{'gauge':<36}{'last':>16}{'peak':>16}{'samples':>9}\n")
+        for name, r in summary["gauges"].items():
+            last, peak = r["last"], r["peak"]
+            if name.startswith("hbm/"):
+                last, peak = _fmt_bytes(last), _fmt_bytes(peak)
+            else:
+                last = round(last, 4) if isinstance(last, float) else last
+                peak = round(peak, 4) if isinstance(peak, float) else peak
+            w(f"{name:<36}{last:>16}{peak:>16}{r['samples']:>9}\n")
+        w("\n")
+    hb = summary["heartbeat"]
+    w(f"== heartbeat ==\nsteps: {hb['steps']}  "
+      f"median step: {hb['median_step_ms']} ms\n\n")
+    if summary["stalls"]:
+        w(f"== stalls ({len(summary['stalls'])}) ==\n")
+        for s in summary["stalls"]:
+            w(f"  step {s.get('step')}: gap {s.get('gap_s')}s "
+              f"(median {s.get('median_step_s')}s, "
+              f"threshold {s.get('threshold_s')}s)\n")
+    else:
+        w("== stalls ==\nnone\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Aggregate a telemetry JSONL stream into tables.")
+    ap.add_argument("target",
+                    help="telemetry dir (containing events.jsonl) or the "
+                         "events.jsonl path itself")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of tables")
+    args = ap.parse_args(argv)
+    files = discover_files(args.target)
+    if not files:
+        print(f"no events.jsonl under {args.target!r}", file=sys.stderr)
+        return 1
+    summary = summarize(aggregate(load_events(files)))
+    if args.json:
+        json.dump(summary, sys.stdout, indent=2)
+        print()
+    else:
+        print_tables(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -74,3 +74,26 @@ def test_promote_cached_without_artifact_returns_this_run(tmp_path,
     monkeypatch.setattr(bench, "_ONCHIP_CACHE", str(tmp_path / "nope.json"))
     this_run = {"metric": "m", "vs_baseline": 0.0}
     assert bench._promote_cached(this_run) is this_run
+
+
+def test_stale_cache_attached_not_promoted(tmp_path, monkeypatch):
+    """Past the staleness cap the cached record is attached but NOT
+    promoted: ``cache_too_stale`` marks the decision explicitly and the
+    age rides inside ``last_known_onchip`` (it describes the cached
+    record, not this run's metrics)."""
+    import time
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_ONCHIP_CACHE", str(tmp_path / "c.json"))
+    stale = {"metric": "m", "value": 2.0, "vs_baseline": 1.4,
+             "captured_unix": int(
+                 time.time() - 3600 * (bench._MAX_CACHE_AGE_H + 10))}
+    (tmp_path / "c.json").write_text(json.dumps(stale))
+    this_run = {"metric": "m", "vs_baseline": 0.0}
+    out = bench._promote_cached(this_run)
+    assert out is this_run
+    assert out["cache_too_stale"] is True
+    assert "fallback" not in out
+    assert "cache_age_hours" not in out  # nested, not top-level
+    lk = out["last_known_onchip"]
+    assert lk["value"] == 2.0
+    assert lk["cache_age_hours"] > bench._MAX_CACHE_AGE_H

@@ -1,0 +1,85 @@
+"""Frozen-schema enforcement for the telemetry JSONL event stream.
+
+Every event the telemetry spine can emit must validate against
+``scripts/check_telemetry_schema.py``, and the script's kind set must stay
+in lockstep with ``deepspeed_tpu.monitor.telemetry.EVENT_KINDS`` — the
+stream is a contract, so drift fails tier-1."""
+
+import importlib.util
+import os
+
+import pytest
+
+from deepspeed_tpu.monitor.telemetry import (EVENT_KINDS, StepStallWatchdog,
+                                             Telemetry)
+from deepspeed_tpu.runtime.config import TelemetryConfig
+
+
+def _load_checker():
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", "check_telemetry_schema.py")
+    spec = importlib.util.spec_from_file_location("check_telemetry_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def checker():
+    return _load_checker()
+
+
+def test_kind_sets_in_lockstep(checker):
+    assert set(checker.EVENT_KINDS) == set(EVENT_KINDS)
+
+
+def test_rejects_unknown_kind_and_fields(checker):
+    assert checker.validate_event({"ts": 1.0, "kind": "bogus", "name": "x"})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "span", "name": "x", "dur_ms": 1.0,
+         "surprise": 1})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "x"})  # missing value/peak
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "comm", "name": "x", "bytes": "4",
+         "axis": "dp"})  # wrong type
+    assert checker.validate_event([1, 2])  # not an object
+
+
+def test_accepts_every_emitter(checker, tmp_path):
+    """Drive every emit path in the telemetry module and validate the
+    resulting stream line-by-line — the live emitters ARE the schema."""
+    tel = Telemetry().configure(
+        TelemetryConfig({"enabled": True, "output_path": str(tmp_path),
+                         "job_name": "schema"}), rank=0)
+    with tel.span("engine/step", step=1, attrs={"zero_stage": 2}):
+        pass
+    with tel.span("checkpoint/save"):
+        pass
+    tel.gauge("hbm/bytes_in_use", 123456.0, step=1)
+    tel.gauge("engine/loss", 0.5)
+    tel.comm("all_reduce", 1 << 20, "dp")
+    tel.emit("meta", "engine/init", attrs={"mesh": {"dp": 8}})
+    wd = StepStallWatchdog(tel, stall_factor=1.0, min_stall_secs=0.0)
+    wd.beat(0)
+    wd.beat(1)
+    wd.beat(2)
+    import time
+    assert wd.check(now=time.monotonic() + 1e6)  # forced stall event
+    tel.close()
+    problems = checker.validate_file(
+        os.path.join(str(tmp_path), "schema", "events.jsonl"))
+    assert problems == []
+
+
+def test_cli_exit_codes(checker, tmp_path, capsys):
+    good = tmp_path / "good.jsonl"
+    good.write_text('{"ts": 1.0, "kind": "meta", "name": "ok"}\n\n')
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ts": 1.0, "kind": "nope", "name": "x"}\nnot json\n')
+    assert checker.main([str(good)]) == 0
+    assert checker.main([str(good), str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "unknown kind" in out and "not valid JSON" in out
