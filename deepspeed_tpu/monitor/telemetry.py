@@ -424,3 +424,115 @@ class StepStallWatchdog:
                 self.check()
             except Exception as e:  # never kill the host process
                 logger.warning(f"stall watchdog check failed: {e}")
+
+
+# ----------------------------------------------------------------------
+# non-blocking metric readback
+# ----------------------------------------------------------------------
+class MetricsDrain:
+    """Defers device→host metric readback off the dispatch hot path.
+
+    The engine pushes each step's metric scalars as DEVICE values (no
+    ``float()``, no ``device_get``) — they stay enqueued as in-flight array
+    references while dispatch runs ahead.  Readback happens either
+
+    * on a ``sync_interval`` boundary: every K-th ``push`` fetches all
+      pending steps with ONE batched ``jax.device_get`` (K device hops
+      collapse to one, amortized across the interval), or
+    * on a drainer thread (``use_thread=True``): ``push`` hands the device
+      refs to a daemon that blocks on them off-thread, so the training
+      loop never waits at all.  The hand-off queue is bounded and lossy
+      (``drain/dropped`` counts discards) — a slow drainer must never
+      backpressure the step loop.
+
+    ``emit_fn(step, {name: float})`` receives host values in step order.
+    All readback funnels through ``jax.device_get`` so tests can assert
+    the hot loop performs none (monkeypatch-count).
+    """
+
+    def __init__(self, emit_fn, sync_interval=1, use_thread=False,
+                 max_pending=256):
+        self.emit_fn = emit_fn
+        self.sync_interval = max(1, int(sync_interval))
+        self.use_thread = bool(use_thread)
+        self._pending = []  # [(step, {name: device_scalar})]
+        self._dropped = 0
+        self._queue = None
+        self._thread = None
+        self._stop = None
+        if self.use_thread:
+            import queue as queue_lib
+            self._queue = queue_lib.Queue(maxsize=max(1, int(max_pending)))
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._drain_loop, daemon=True, name="ds-metrics-drain")
+            self._thread.start()
+
+    # -- hot path (no device sync) -------------------------------------
+    def push(self, step, values):
+        """Queue one step's device metric scalars; returns immediately."""
+        if self.use_thread:
+            import queue as queue_lib
+            try:
+                self._queue.put_nowait((int(step), values))
+            except queue_lib.Full:
+                self._dropped += 1  # never block the step loop
+            return
+        self._pending.append((int(step), values))
+        if len(self._pending) >= self.sync_interval:
+            self.flush()
+
+    @property
+    def pending(self):
+        return len(self._pending)
+
+    @property
+    def dropped(self):
+        return self._dropped
+
+    # -- readback ------------------------------------------------------
+    def _fetch_and_emit(self, batch):
+        """One batched transfer for every pending step, then per-step emit."""
+        if not batch:
+            return
+        import jax
+        flat = [v for _, vals in batch for v in vals.values()]
+        host = iter(jax.device_get(flat))
+        for step, vals in batch:
+            self.emit_fn(step, {k: float(next(host)) for k in vals})
+
+    def flush(self):
+        """Fetch + emit everything pending (interval mode; thread mode
+        drains via its worker — flush just waits for the queue to empty)."""
+        if self.use_thread:
+            if self._queue is not None:
+                self._queue.join()
+            return
+        batch, self._pending = self._pending, []
+        self._fetch_and_emit(batch)
+
+    def _drain_loop(self):
+        import queue as queue_lib
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue_lib.Empty:
+                continue
+            try:
+                self._fetch_and_emit([item])
+            except Exception as e:
+                logger.warning(f"metrics drain failed: {e}")
+            finally:
+                self._queue.task_done()
+
+    def close(self):
+        """Flush remaining metrics and stop the drainer."""
+        if self.use_thread:
+            if self._queue is not None:
+                self._queue.join()
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+            return
+        self.flush()

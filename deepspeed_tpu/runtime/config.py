@@ -85,6 +85,27 @@ class TelemetryConfig(DeepSpeedConfigModel):
     stall_poll_secs = 1.0           # watchdog poll interval
 
 
+class AsyncPipelineConfig(DeepSpeedConfigModel):
+    """``"async_pipeline"`` block: keeps the step loop's host side off the
+    dispatch critical path — a background thread prefetches + shards batch
+    n+k while step n runs, and metric readback is deferred to a
+    ``sync_interval`` boundary (or a drainer thread) instead of a per-step
+    device sync."""
+    enabled = False
+    prefetch_depth = 2     # device batches parked ahead of the consumer
+    sync_interval = 1      # steps between batched metric readbacks
+    io_workers = 0         # host-side sample-fetch threads (collate pool)
+    drain_thread = False   # drain metrics from a thread instead of on-interval
+
+    def _validate(self):
+        if int(self.prefetch_depth) < 1:
+            raise ValueError("async_pipeline.prefetch_depth must be >= 1")
+        if int(self.sync_interval) < 1:
+            raise ValueError("async_pipeline.sync_interval must be >= 1")
+        if int(self.io_workers) < 0:
+            raise ValueError("async_pipeline.io_workers must be >= 0")
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled = False
     profile_step = 1
@@ -211,6 +232,8 @@ class DeepSpeedConfig:
 
         self.comms_config = CommsConfig(pd.get(C.COMMS_LOGGER, {}))
         self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
+        self.async_pipeline_config = AsyncPipelineConfig(
+            pd.get(C.ASYNC_PIPELINE, {}))
         self.monitor_config = {
             "tensorboard": TensorBoardConfig(pd.get(C.MONITOR_TENSORBOARD, {})),
             "wandb": WandbConfig(pd.get(C.MONITOR_WANDB, {})),
@@ -247,6 +270,7 @@ class DeepSpeedConfig:
         C.SPARSE_GRADIENTS, C.ZERO_OPTIMIZATION, C.COMMS_LOGGER, C.MESH,
         C.ACTIVATION_CHECKPOINTING, C.FLOPS_PROFILER,
         C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV, C.TELEMETRY,
+        C.ASYNC_PIPELINE,
         C.DATA_EFFICIENCY, C.CURRICULUM_LEARNING_LEGACY, C.CHECKPOINT,
         C.ELASTICITY, C.COMPRESSION_TRAINING,
         C.PIPELINE, C.SEED, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,

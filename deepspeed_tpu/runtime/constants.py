@@ -82,6 +82,8 @@ MONITOR_CSV = "csv_monitor"
 
 TELEMETRY = "telemetry"  # unified JSONL event stream + stall watchdog
 
+ASYNC_PIPELINE = "async_pipeline"  # prefetched input feed + metric drain
+
 GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
 TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
 TRAIN_BATCH_SIZE_DEFAULT = None

@@ -7,9 +7,19 @@ the engine shards them onto the mesh with ``device_put`` (the device transfer
 is where "distribution" happens — there is no per-rank sampler state to keep
 in sync).  For multi-host, each process yields its process-local slice
 (``process_index``-strided), matching ``DistributedSampler`` semantics.
+
+Async input feed: :class:`DevicePrefetchIterator` moves the whole host side
+of the step — sample fetch, collate, gas-stack, curriculum transform and the
+sharded ``device_put`` — onto a background thread that works on batch *n+k*
+while step *n* runs, so the training loop's only input cost is a queue pop.
+This is the input-channel analogue of the param-stream overlap
+(ZeRO-Infinity's "keep every transfer channel busy under compute").
 """
 
 import math
+import queue as queue_lib
+import threading
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -43,11 +53,14 @@ class DeepSpeedDataLoader:
 
     dataset: a sequence of samples; each sample is an array or a pytree of
     arrays (dicts/tuples).  ``collate_fn`` overrides the default np.stack.
+    ``num_workers`` > 1 fetches the samples of each batch through a thread
+    pool (the reference's ``num_local_io_workers``) — ``pool.map`` preserves
+    index order, so worker count never changes the produced batches.
     """
 
     def __init__(self, dataset, batch_size, collate_fn=None, seed=0,
                  shuffle=True, drop_last=True, num_processes=None,
-                 process_index=None):
+                 process_index=None, num_workers=0):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or self._default_collate
@@ -58,6 +71,8 @@ class DeepSpeedDataLoader:
                               else jax.process_count())
         self.process_index = (process_index if process_index is not None
                               else jax.process_index())
+        self.num_workers = int(num_workers or 0)
+        self._pool = None
         self.epoch = 0
         assert batch_size % self.num_processes == 0, \
             "global batch must divide across processes"
@@ -69,6 +84,18 @@ class DeepSpeedDataLoader:
     @staticmethod
     def _default_collate(samples):
         return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *samples)
+
+    def _fetch(self, indices):
+        if self.num_workers > 1 and len(indices) > 1:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.num_workers,
+                    thread_name_prefix="ds-io-worker")
+            samples = list(self._pool.map(self.dataset.__getitem__,
+                                          [int(i) for i in indices]))
+        else:
+            samples = [self.dataset[int(i)] for i in indices]
+        return self.collate_fn(samples)
 
     def __len__(self):
         n = len(self.dataset) // self.batch_size
@@ -89,5 +116,156 @@ class DeepSpeedDataLoader:
             if len(idx) < self.batch_size and self.drop_last:
                 break
             local = idx[self.process_index::self.num_processes]
-            yield self.collate_fn([self.dataset[int(i)] for i in local])
+            yield self._fetch(local)
         self.epoch += 1
+
+
+class DevicePrefetchIterator:
+    """Device-prefetched input feed.
+
+    A daemon worker pulls ``gas`` microbatches from ``source``, stacks them
+    (gas>1), applies ``transform`` (curriculum truncation / data routing) and
+    ``shard_fn`` (the engine's sharded device_put), and parks the finished
+    device batch in a bounded queue of ``depth`` while earlier steps run.
+    The consumer's ``next()`` is a queue pop — zero host-side input work on
+    the hot path once the queue is warm.
+
+    Termination is explicit and loss-free: ``StopIteration`` from the source
+    drains through the queue as a sentinel (every already-prefetched batch
+    is still delivered first), and a worker exception is re-raised in the
+    consumer at the position it occurred.  ``close()`` stops the worker and
+    releases queued device batches.
+    """
+
+    _END = object()
+
+    def __init__(self, source, gas=1, shard_fn=None, transform=None,
+                 depth=2, start_index=0, name="input-feed"):
+        self._source = iter(source)
+        self._gas = max(1, int(gas))
+        self._shard_fn = shard_fn
+        self._transform = transform
+        self._index = int(start_index)
+        self._queue = queue_lib.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"ds-prefetch-{name}")
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------
+    def _produce_one(self):
+        micro = [next(self._source) for _ in range(self._gas)]
+        leading = self._gas > 1
+        batch = (jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro)
+                 if leading else micro[0])
+        if self._transform is not None:
+            batch = self._transform(batch, self._index, leading)
+        if self._shard_fn is not None:
+            batch = self._shard_fn(batch, leading_gas_dim=leading)
+        self._index += 1
+        return batch
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self._produce_one()
+                except StopIteration:
+                    self._put((self._END, None))
+                    return
+                if not self._put(("ok", batch)):
+                    return
+        except BaseException as exc:  # re-raised in the consumer
+            self._put(("err", exc))
+
+    # -- consumer ------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._closed:
+            raise StopIteration
+        while True:
+            try:
+                kind, payload = self._queue.get(timeout=0.5)
+            except queue_lib.Empty:
+                if not self._thread.is_alive() and self._queue.empty():
+                    # can't happen through the normal paths (the worker
+                    # always parks a sentinel) — defensive, not expected
+                    raise RuntimeError("prefetch worker died without a "
+                                       "sentinel")
+                continue
+            if kind is self._END:
+                self._exhausted = True
+                raise StopIteration
+            if kind == "err":
+                self._exhausted = True
+                raise payload
+            return payload
+
+    def qsize(self):
+        """Device batches parked and ready (host-side; sync-free)."""
+        return self._queue.qsize()
+
+    def close(self):
+        """Stop the worker and drop queued batches.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # unblock a worker stuck in put() and release device references
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue_lib.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PrefetchingDataLoader:
+    """What ``deepspeed_io`` returns when ``async_pipeline`` is enabled:
+    iterating it yields PRE-SHARDED device train batches (gas-stacked)
+    produced by a :class:`DevicePrefetchIterator`, so ``train_batch``
+    consumes them with no host-side input work.  Starting a new epoch
+    (``iter()``) closes the previous prefetcher first."""
+
+    def __init__(self, loader, make_prefetcher):
+        self.loader = loader
+        self._make_prefetcher = make_prefetcher
+        self._active = None
+
+    def __len__(self):
+        return len(self.loader)
+
+    def set_epoch(self, epoch):
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __iter__(self):
+        if self._active is not None:
+            self._active.close()
+        self._active = self._make_prefetcher(iter(self.loader))
+        return self._active
+
+    def close(self):
+        if self._active is not None:
+            self._active.close()
+            self._active = None

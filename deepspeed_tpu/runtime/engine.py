@@ -43,11 +43,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.accelerator import get_accelerator
 from deepspeed_tpu.monitor.monitor import MonitorMaster
-from deepspeed_tpu.monitor.telemetry import StepStallWatchdog, get_telemetry
+from deepspeed_tpu.monitor.telemetry import (MetricsDrain, StepStallWatchdog,
+                                             get_telemetry)
 from deepspeed_tpu.parallel import groups
 from deepspeed_tpu.parallel.topology import build_mesh
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
-from deepspeed_tpu.runtime.loss_scaler import (LossScaleState,
+from deepspeed_tpu.runtime.loss_scaler import (HostLossScale, LossScaleState,
                                                dynamic_loss_scale_state,
                                                has_inf_or_nan,
                                                static_loss_scale_state,
@@ -251,16 +252,46 @@ class DeepSpeedEngine:
                 self._compression = spec
                 self.compression_scheduler = CompressionScheduler(spec)
 
+        # async step pipeline (config "async_pipeline"): prefetched input
+        # feed + deferred metric readback.  When on, nothing in the steady
+        # hot loop may block on the device — the throughput timer trusts
+        # host wall-clock instead of issuing a per-step barrier.
+        ap = config.async_pipeline_config
+        self._async_enabled = bool(ap.enabled)
+        self._prefetcher = None       # engine-owned DevicePrefetchIterator
+        self._prefetch_source = None  # the caller iterator it wraps
+        self._default_iter = None     # persistent no-arg train_batch iter
+        self._host_lr_cache = None    # (step, float lr)
+        fc = config.fp16_config
+        if config.fp16_enabled and config.dynamic_loss_scale:
+            self._host_ls = HostLossScale(
+                config.initial_dynamic_scale, dynamic=True,
+                scale_window=fc.loss_scale_window,
+                min_scale=fc.min_loss_scale, hysteresis=fc.hysteresis)
+        else:
+            self._host_ls = HostLossScale(
+                config.loss_scale if config.fp16_enabled else 1.0,
+                dynamic=False)
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size,
-            steps_per_output=config.steps_per_print)
+            steps_per_output=config.steps_per_print,
+            sync=not self._async_enabled)
         # unified telemetry spine (monitor/telemetry.py): configure the
         # process-global sink BEFORE MonitorMaster so its JSONL fourth
         # writer attaches to the same stream
         tc = config.telemetry_config
         self.telemetry = get_telemetry().configure(tc)
         self._tel_enabled = self.telemetry.enabled
+        # deferred metric readback: device scalars queue here; readback is
+        # one batched device_get per sync_interval (or a drainer thread)
+        self._metrics_drain = None
+        if self._tel_enabled:
+            self._metrics_drain = MetricsDrain(
+                self._drain_emit,
+                sync_interval=ap.sync_interval if self._async_enabled else 1,
+                use_thread=self._async_enabled and ap.drain_thread)
         self._watchdog = None
         if self._tel_enabled and tc.stall_watchdog:
             self._watchdog = StepStallWatchdog(
@@ -906,7 +937,9 @@ class DeepSpeedEngine:
             with self.mesh:
                 self.state, grad_norm = self._compiled_apply(
                     self.state, self._accum_grads, self._accum_overflow)
-        self._global_grad_norm = float(grad_norm)
+        # kept as a device scalar: get_global_grad_norm() floats on demand,
+        # so the 3-call API doesn't serialize dispatch every step either
+        self._global_grad_norm = grad_norm
         self._accum_grads = None
         self._accum_count = 0
         self._step_applied = True
@@ -939,25 +972,49 @@ class DeepSpeedEngine:
 
     def _train_batch_inner(self, data_iter=None, batch=None):
         gas = self.gradient_accumulation_steps_
+        presharded = False
         if batch is None:
-            if data_iter is None:
+            owns_iter = data_iter is None
+            if owns_iter:
                 assert self.training_dataloader is not None, \
                     "train_batch needs data_iter, batch=, or training_data"
-                data_iter = iter(self.training_dataloader)
-            micro_batches = [next(data_iter) for _ in range(gas)]
-            if gas > 1:
-                batch = jax.tree_util.tree_map(
-                    lambda *xs: np.stack(xs), *micro_batches)
+                data_iter = self._default_data_iter()
+            if self._async_enabled:
+                data_iter = self._wrap_prefetch(data_iter)
+            from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+            if isinstance(data_iter, DevicePrefetchIterator):
+                # the worker already collated, gas-stacked, curriculum-
+                # transformed and sharded this batch — just pop it
+                try:
+                    if self._tel_enabled:
+                        with self.telemetry.span(
+                                "engine/input_wait", step=self.global_steps,
+                                attrs={"queued": data_iter.qsize()}):
+                            batch = next(data_iter)
+                    else:
+                        batch = next(data_iter)
+                except StopIteration:
+                    if owns_iter:
+                        self._default_iter = None
+                    self._release_prefetcher(data_iter)
+                    raise
+                presharded = True
             else:
-                batch = micro_batches[0]
+                micro_batches = [next(data_iter) for _ in range(gas)]
+                if gas > 1:
+                    batch = jax.tree_util.tree_map(
+                        lambda *xs: np.stack(xs), *micro_batches)
+                else:
+                    batch = micro_batches[0]
         self.tput_timer.start()
         if self.compression_scheduler is not None:
             self.compression_scheduler.check(self.global_steps)
-        if self.curriculum_scheduler_ is not None:
+        if self.curriculum_scheduler_ is not None and not presharded:
             batch = self._apply_curriculum(batch, leading_gas_dim=gas > 1)
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
-        batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
+        if not presharded:
+            batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
         if self._tel_enabled:
             self._last_batch_tokens = _batch_token_count(batch)
         self._maybe_profile_flops(batch, gas)
@@ -965,19 +1022,23 @@ class DeepSpeedEngine:
             cfg = self._config
             fp16 = cfg.fp16_enabled
             rng, step_rng = jax.random.split(self.state.rng)
-            lr_now = float(jax.device_get(
-                jnp.asarray(self._schedule_fn(self.state.global_step))))
-            scale = (float(jax.device_get(self.state.loss_scale.cur_scale))
-                     if fp16 else 1.0)
+            # lr from the HOST step counter and scale from the host
+            # loss-scale mirror: neither reads the in-flight device state,
+            # so this host-orchestrated path stops paying two device
+            # round-trips per step just to learn values it already knows
+            lr_now = self._host_schedule_value(self.global_steps)
+            scale = self._host_ls.cur_scale if fp16 else 1.0
             loss_f, gnorm, overflow_b = self._param_stream.train_step(
                 batch, gas, lr_now, scale, fp16,
                 cfg.gradient_clipping, step_rng)
+            # device automaton stays updated in lockstep (checkpoint parity)
             new_ls = update_scale(
                 self.state.loss_scale, jnp.asarray(overflow_b),
                 dynamic=fp16 and cfg.dynamic_loss_scale,
                 scale_window=cfg.fp16_config.loss_scale_window,
                 min_scale=cfg.fp16_config.min_loss_scale,
                 hysteresis=cfg.fp16_config.hysteresis)
+            self._host_ls.update(bool(overflow_b))
             self.state = self.state.replace(
                 rng=rng, loss_scale=new_ls,
                 global_step=self.state.global_step + 1,
@@ -1015,12 +1076,14 @@ class DeepSpeedEngine:
         self._write_monitor(metrics)
         return metrics.loss
 
-    def _apply_curriculum(self, batch, leading_gas_dim=False):
+    def _apply_curriculum(self, batch, leading_gas_dim=False, step=None):
         """Truncate sequences to the curriculum difficulty (reference
         ``engine.py:1820-1826`` curriculum_seqlen slicing).  Each difficulty
         milestone is a new static shape → one recompile, amortised over the
-        steps at that difficulty."""
-        seqlen = self.curriculum_scheduler_.update_difficulty(self.global_steps)
+        steps at that difficulty.  ``step`` overrides the difficulty clock
+        for the prefetch worker, which transforms batches ahead of time."""
+        seqlen = self.curriculum_scheduler_.update_difficulty(
+            self.global_steps if step is None else step)
         dim = 2 if leading_gas_dim else 1
 
         def trunc(x):
@@ -1102,14 +1165,84 @@ class DeepSpeedEngine:
                      num_local_io_workers=None, data_sampler=None,
                      route=None):
         """Parity: reference ``deepspeed_io:1678`` — builds the distributed
-        dataloader (global batches; sharding happens at device_put)."""
-        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+        dataloader (global batches; sharding happens at device_put).
+        ``num_local_io_workers`` sizes the host-side sample-fetch pool
+        (falls back to ``async_pipeline.io_workers``); with the async
+        pipeline enabled the loader is wrapped so iteration yields
+        pre-sharded device batches from a background prefetcher."""
+        from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                      PrefetchingDataLoader)
+        ap = self._config.async_pipeline_config
         if batch_size is None:
             batch_size = (self.train_micro_batch_size_per_gpu() *
                           groups.get_data_parallel_world_size())
-        return DeepSpeedDataLoader(dataset, batch_size=batch_size,
-                                   collate_fn=collate_fn,
-                                   seed=self._config.seed)
+        io_workers = (num_local_io_workers if num_local_io_workers is not None
+                      else ap.io_workers)
+        loader = DeepSpeedDataLoader(dataset, batch_size=batch_size,
+                                     collate_fn=collate_fn,
+                                     seed=self._config.seed,
+                                     num_workers=io_workers)
+        if self._async_enabled:
+            return PrefetchingDataLoader(loader, self._make_prefetcher)
+        return loader
+
+    # -- async input feed ----------------------------------------------
+    def _default_data_iter(self):
+        """The iterator behind no-arg ``train_batch()``.  Sync path keeps
+        the historical fresh-``iter()``-per-call behavior; async keeps ONE
+        persistent iterator so a single prefetch worker spans steps (a
+        fresh prefetcher per call could never run ahead)."""
+        if not self._async_enabled:
+            return iter(self.training_dataloader)
+        if self._default_iter is None:
+            self._default_iter = iter(self.training_dataloader)
+        return self._default_iter
+
+    def _wrap_prefetch(self, data_iter):
+        """Wrap a host-batch iterator in the engine-owned prefetcher
+        (identity-cached: repeated calls with the same iterator reuse the
+        running worker; a new iterator retires the old prefetcher)."""
+        from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+        if isinstance(data_iter, DevicePrefetchIterator):
+            return data_iter
+        if self._prefetch_source is not data_iter:
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+            self._prefetcher = self._make_prefetcher(data_iter)
+            self._prefetch_source = data_iter
+        return self._prefetcher
+
+    def _make_prefetcher(self, source):
+        from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+        ap = self._config.async_pipeline_config
+        return DevicePrefetchIterator(
+            source, gas=self.gradient_accumulation_steps_,
+            shard_fn=self._shard_batch,
+            transform=(self._prefetch_transform
+                       if self.curriculum_scheduler_ is not None else None),
+            depth=ap.prefetch_depth,
+            start_index=self.global_steps)
+
+    def _prefetch_transform(self, batch, index, leading_gas_dim):
+        # runs on the prefetch worker: curriculum difficulty is keyed to
+        # the step the batch will be CONSUMED at, not the current step
+        return self._apply_curriculum(batch, leading_gas_dim=leading_gas_dim,
+                                      step=index)
+
+    def _release_prefetcher(self, prefetcher):
+        prefetcher.close()
+        if self._prefetcher is prefetcher:
+            self._prefetcher = None
+            self._prefetch_source = None
+
+    def _host_schedule_value(self, step):
+        """lr at host ``step`` as a python float, cached per step.  The
+        schedule runs on a concrete int, so any device work is a tiny
+        fresh computation — never a sync against the in-flight train step."""
+        if self._host_lr_cache is None or self._host_lr_cache[0] != step:
+            val = self._schedule_fn(step)
+            self._host_lr_cache = (step, float(jax.device_get(val)))
+        return self._host_lr_cache[1]
 
     # ------------------------------------------------------------------
     # monitor / introspection parity accessors
@@ -1117,20 +1250,25 @@ class DeepSpeedEngine:
     def _emit_step_telemetry(self, step_secs=None, metrics=None):
         """Per-step telemetry tail (telemetry-enabled runs only): heartbeat
         for the stall watchdog, loss/grad-norm/loss-scale + throughput
-        gauges, and device-memory gauges with peak tracking."""
+        gauges, and device-memory gauges with peak tracking.
+
+        Sync-free by construction: the heartbeat and throughput gauges are
+        host-clock, HBM gauges read allocator stats, and the device metric
+        scalars go through :class:`MetricsDrain` — readback happens on the
+        ``sync_interval`` boundary (or a drainer thread), not here."""
         tel = self.telemetry
         step = self.global_steps
         if self._watchdog is not None:
             self._watchdog.beat(step)
         if metrics is not None:
-            tel.gauge("engine/loss", float(metrics.loss), step=step)
-            tel.gauge("engine/grad_norm", float(metrics.grad_norm), step=step)
+            vals = {"engine/loss": metrics.loss,
+                    "engine/grad_norm": metrics.grad_norm}
             if self._config.fp16_enabled:
-                tel.gauge("engine/loss_scale", float(metrics.loss_scale),
-                          step=step)
+                vals["engine/loss_scale"] = metrics.loss_scale
+            self._metrics_drain.push(step, vals)
         elif self._global_grad_norm is not None:
-            tel.gauge("engine/grad_norm", float(self._global_grad_norm),
-                      step=step)
+            self._metrics_drain.push(
+                step, {"engine/grad_norm": self._global_grad_norm})
         if step_secs is not None and step_secs > 0:
             tel.gauge("engine/samples_per_sec",
                       self._config.train_batch_size / step_secs, step=step)
@@ -1139,6 +1277,17 @@ class DeepSpeedEngine:
                           self._last_batch_tokens / step_secs, step=step)
         if self._config.telemetry_config.hbm_gauges:
             self._emit_hbm_gauges(step)
+
+    def _drain_emit(self, step, host_vals):
+        """MetricsDrain callback: host floats for one step, in step order."""
+        for name, value in host_vals.items():
+            self.telemetry.gauge(name, value, step=step)
+
+    def flush_telemetry(self):
+        """Force readback + emit of any metrics still queued in the drain
+        (checkpoint boundaries, end of training, tests)."""
+        if self._metrics_drain is not None:
+            self._metrics_drain.flush()
 
     def _emit_hbm_gauges(self, step):
         """HBM pressure gauges from ``jax.Device.memory_stats()`` (None on
@@ -1383,6 +1532,12 @@ class DeepSpeedEngine:
                                                  out=self._offload.master)
         self.global_steps = client_state.get("global_steps", 0)
         self.micro_steps = client_state.get("micro_steps", 0)
+        # resync the host loss-scale mirror from the restored device
+        # automaton (one-time device_get at a checkpoint boundary)
+        ls = jax.device_get(self.state.loss_scale)
+        self._host_ls.load(ls.cur_scale, ls.cur_hysteresis,
+                           ls.last_overflow_iter, ls.iteration)
+        self._host_lr_cache = None
         if load_lr_scheduler_states and self.lr_scheduler is not None and \
                 client_state.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])

@@ -69,6 +69,7 @@ class PipelineEngine(DeepSpeedEngine):
         (see ``pipe/pipeline.py``) — visible in xprof, not host-timeable,
         because the whole clock is one XLA program."""
         M, P = self.micro_batches, self.num_stages
+        ap = self._config.async_pipeline_config
         for s in range(P):
             counts = {}
             for cmds in TrainSchedule(micro_batches=M, stages=P, stage_id=s):
@@ -81,7 +82,12 @@ class PipelineEngine(DeepSpeedEngine):
                        "fill_ticks": s, "active_ticks": M,
                        "drain_ticks": P - 1 - s,
                        "bubble": (P - 1) / (M + P - 1),
-                       "instructions": counts})
+                       "instructions": counts,
+                       # whether the microbatch stack arrives prefetched
+                       # and how often metric readback syncs the host
+                       "async_pipeline": bool(ap.enabled),
+                       "prefetch_depth": int(ap.prefetch_depth),
+                       "sync_interval": int(ap.sync_interval)})
 
     # the compiled step: ONE loss call over the microbatch stack — the
     # microbatch dim is the pipeline clock, not a grad-accumulation scan

@@ -123,7 +123,10 @@ class ThroughputTimer:
     """
 
     def __init__(self, batch_size, start_step=2, steps_per_output=None,
-                 monitor_memory=False, logging_fn=None):
+                 monitor_memory=False, logging_fn=None, sync=True):
+        # sync=False: trust host wall-clock instead of a device barrier —
+        # the async step pipeline must not serialize dispatch per step
+        self.sync = sync
         self.start_time = 0
         self.end_time = 0
         self.started = False
@@ -150,7 +153,8 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            _device_sync()
+            if self.sync:
+                _device_sync()
             self.start_time = time.time()
 
     def stop(self, global_step=False, report_speed=True):
@@ -161,7 +165,8 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _device_sync()
+            if self.sync:
+                _device_sync()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

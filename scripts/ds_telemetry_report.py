@@ -120,8 +120,38 @@ def summarize(agg):
                  "median_step_ms": round(_pct(hb, 50), 3) if hb else None}
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
+            "input_feed": _input_feed_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+# a warm prefetch queue pops in microseconds — any input wait past this is
+# a dispatch stall (the feed couldn't keep ahead of compute)
+STALL_WAIT_MS = 1.0
+
+
+def _input_feed_summary(agg):
+    """Input-wait / dispatch-stall digest from the ``engine/input_wait``
+    spans (emitted around the prefetched-batch pop when the async pipeline
+    is on), sized against total ``engine/train_batch`` time."""
+    waits = agg["spans"].get("engine/input_wait")
+    if not waits:
+        return None
+    vals = sorted(waits)
+    total_wait = sum(vals)
+    total_step = sum(agg["spans"].get("engine/train_batch", [])) or None
+    return {
+        "waits": len(vals),
+        "total_wait_ms": round(total_wait, 3),
+        "mean_ms": round(total_wait / len(vals), 3),
+        "p50_ms": round(_pct(vals, 50), 3),
+        "p99_ms": round(_pct(vals, 99), 3),
+        "max_ms": round(vals[-1], 3),
+        "stalled_steps": sum(1 for v in vals if v > STALL_WAIT_MS),
+        "stall_threshold_ms": STALL_WAIT_MS,
+        "wait_fraction_of_step": (round(total_wait / total_step, 4)
+                                  if total_step else None),
+    }
 
 
 def _fmt_bytes(n):
@@ -161,6 +191,18 @@ def print_tables(summary, out=sys.stdout):
                 peak = round(peak, 4) if isinstance(peak, float) else peak
             w(f"{name:<36}{last:>16}{peak:>16}{r['samples']:>9}\n")
         w("\n")
+    feed = summary.get("input_feed")
+    if feed:
+        w("== input feed (engine/input_wait) ==\n")
+        w(f"waits: {feed['waits']}  total: {feed['total_wait_ms']} ms  "
+          f"mean: {feed['mean_ms']}  p50: {feed['p50_ms']}  "
+          f"p99: {feed['p99_ms']}  max: {feed['max_ms']}\n")
+        w(f"dispatch stalls (> {feed['stall_threshold_ms']} ms): "
+          f"{feed['stalled_steps']}")
+        if feed["wait_fraction_of_step"] is not None:
+            w(f"  |  wait fraction of train_batch: "
+              f"{feed['wait_fraction_of_step'] * 100:.2f}%")
+        w("\n\n")
     hb = summary["heartbeat"]
     w(f"== heartbeat ==\nsteps: {hb['steps']}  "
       f"median step: {hb['median_step_ms']} ms\n\n")
