@@ -41,7 +41,7 @@ from deepspeed_tpu.utils.logging import logger
 # The closed set of event kinds.  Adding a kind means updating the frozen
 # schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
 EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
-               "meta")
+               "meta", "fault")
 
 
 def _profiler_annotation(name):
@@ -303,6 +303,16 @@ class Telemetry:
         if not self.enabled:
             return
         self.registry.counter(name).inc(n)
+
+    def fault(self, name, step=None, attrs=None):
+        """Structured fault-tolerance event (runtime/resilience.py): I/O
+        retries, checkpoint fallbacks, preemptions, divergence trips.  Each
+        also bumps counter ``<name>/count`` so the registry shows fault
+        totals without replaying the stream."""
+        if not self.enabled:
+            return
+        self.registry.counter(f"{name}/count").inc()
+        self.emit("fault", name, step=step, attrs=attrs or None)
 
     def comm(self, op_name, size_bytes, axis):
         """Per-op comm census (trace-time: a shape traces once, executes

@@ -57,6 +57,28 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def _path(self, save_dir, tag):
         return os.path.join(os.path.abspath(save_dir), tag)
 
+    # Typed PRNG keys (dtype key<fry>) are not serializable by orbax's
+    # array handler: unwrap to raw uint32 key data on save and re-wrap
+    # (preserving the impl from the template state) on restore.
+    @staticmethod
+    def _is_typed_key(x):
+        return isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key)
+
+    @classmethod
+    def _unwrap_keys(cls, tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.random.key_data(x) if cls._is_typed_key(x) else x,
+            tree)
+
+    @classmethod
+    def _rewrap_keys(cls, template, restored):
+        return jax.tree_util.tree_map(
+            lambda t, r: jax.random.wrap_key_data(
+                r, impl=jax.random.key_impl(t))
+            if cls._is_typed_key(t) else r,
+            template, restored)
+
     def save(self, state, save_dir, tag, client_state=None):
         with get_telemetry().span("checkpoint/save", attrs={"tag": str(tag)}):
             return self._save(state, save_dir, tag, client_state)
@@ -72,12 +94,17 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             ckptr = self._async_ckptr
         else:
             ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.join(path, "state"), state, force=True)
+        ckptr.save(os.path.join(path, "state"), self._unwrap_keys(state),
+                   force=True)
+        if not self.use_async:
+            # StandardCheckpointer commits in a background thread (it is an
+            # AsyncCheckpointer in orbax>=0.5); a sync engine must not
+            # return before the payload is durable — the resilience layer
+            # writes the manifest + commit marker right after this call.
+            ckptr.wait_until_finished()
         if jax.process_index() == 0 and client_state is not None:
             with open(os.path.join(path, "client_state.json"), "w") as f:
                 json.dump(client_state, f, default=str)
-        if not self.use_async:
-            ckptr.wait_until_finished() if hasattr(ckptr, "wait_until_finished") else None
         return True
 
     def load(self, template_state, load_dir, tag, mesh,
@@ -92,12 +119,19 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         path = self._path(load_dir, tag)
         # Restore with the *current* shardings as target: orbax reshards,
         # giving elastic ZeRO checkpoints (save at dp=8, load at dp=2) for free.
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-            if isinstance(x, jax.Array) else x,
-            template_state)
+        def _abstract(x):
+            if not isinstance(x, jax.Array):
+                return x
+            if self._is_typed_key(x):
+                data = jax.eval_shape(jax.random.key_data, x)
+                return jax.ShapeDtypeStruct(data.shape, data.dtype,
+                                            sharding=x.sharding)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+
+        abstract = jax.tree_util.tree_map(_abstract, template_state)
         ckptr = ocp.StandardCheckpointer()
         restored = ckptr.restore(os.path.join(path, "state"), abstract)
+        restored = self._rewrap_keys(template_state, restored)
         if load_module_only or not load_optimizer_states:
             restored = template_state.replace(params=restored.params)
         client_state = {}
@@ -105,6 +139,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         if os.path.exists(cs_path):
             with open(cs_path) as f:
                 client_state = json.load(f)
+        client_state = broadcast_client_state(client_state)
         return restored, client_state
 
     def commit(self, tag):
@@ -123,12 +158,72 @@ class NebulaCheckpointEngine(OrbaxCheckpointEngine):
 
 TorchCheckpointEngine = OrbaxCheckpointEngine  # parity alias
 
+
+def broadcast_client_state(client_state):
+    """Broadcast process 0's ``client_state`` dict to every host.
+
+    ``save`` writes ``client_state.json`` only on process 0, so on shared
+    filesystems every host reads it, but on node-local storage non-zero
+    hosts would silently see ``{}`` and resume from step 0.  Serialize to
+    JSON bytes and broadcast length + payload from the coordinator.
+    """
+    if jax.process_count() <= 1:
+        return client_state
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(
+        json.dumps(client_state, default=str).encode("utf-8"), dtype=np.uint8)
+    length = int(multihost_utils.broadcast_one_to_all(
+        np.asarray(payload.size, dtype=np.int64)))
+    buf = np.zeros(length, dtype=np.uint8)
+    buf[:min(payload.size, length)] = payload[:length]
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    return json.loads(bytes(buf).decode("utf-8"))
+
+
+_ENGINE_NAMES = {
+    "sync": OrbaxCheckpointEngine,
+    "orbax": OrbaxCheckpointEngine,
+    "torch": TorchCheckpointEngine,
+    "async": NebulaCheckpointEngine,
+    "nebula": NebulaCheckpointEngine,
+}
+
 _engine = None
 
 
+def _engine_cls_from_config(config_params):
+    name = "sync"
+    if config_params is None:
+        name = "sync"
+    elif hasattr(config_params, "checkpoint_config"):  # DeepSpeedConfig
+        name = getattr(config_params.checkpoint_config, "engine", "sync")
+    elif isinstance(config_params, dict):
+        name = config_params.get("checkpoint", {}).get("engine", "sync")
+    cls = _ENGINE_NAMES.get(str(name).lower())
+    if cls is None:
+        logger.warning(f"unknown checkpoint engine {name!r}; using sync orbax")
+        cls = OrbaxCheckpointEngine
+    return cls
+
+
 def get_checkpoint_engine(config_params=None):
+    """Return the process-wide checkpoint engine.
+
+    With ``config_params`` (a DeepSpeedConfig or raw config dict), the
+    engine class is resolved from ``checkpoint.engine`` ("sync" |
+    "async"/"nebula") and the cached engine is **rebuilt when the
+    requested type differs** — earlier revisions cached the first engine
+    forever and silently ignored later configs.  A no-arg call returns
+    the existing engine (or the sync default).
+    """
     global _engine
-    if _engine is None:
+    if config_params is not None:
+        cls = _engine_cls_from_config(config_params)
+        if type(_engine) is not cls:
+            _engine = cls(config_params)
+    elif _engine is None:
         _engine = OrbaxCheckpointEngine(config_params)
     return _engine
 

@@ -106,6 +106,43 @@ class AsyncPipelineConfig(DeepSpeedConfigModel):
             raise ValueError("async_pipeline.io_workers must be >= 0")
 
 
+class ResilienceConfig(DeepSpeedConfigModel):
+    """``"resilience"`` block: the fault-tolerance layer
+    (``runtime/resilience.py``) — durable atomic checkpoints with
+    validation + fallback, retry policy for checkpoint/host-fs I/O,
+    preemption handling, the divergence sentinel, and the deterministic
+    fault-injection harness."""
+    enabled = True                  # durable ckpt protocol + retries
+    max_retries = 3                 # checkpoint/fs I/O retry budget
+    retry_backoff_secs = 0.5        # first-retry backoff
+    retry_backoff_max_secs = 30.0   # backoff cap
+    retry_jitter = 0.25             # jitter fraction on each delay
+    keep_last = 0                   # committed tags retained (0 = all)
+    checksum = False                # per-leaf crc32 in the manifest
+    preemption_handler = False      # hook SIGTERM/SIGINT
+    ckpt_dir = ""                   # emergency-save / auto-restore dir
+    divergence_sentinel = False     # watch loss / overflow streaks
+    max_consecutive_skips = 8       # fp16 skip streak that counts as divergence
+    sentinel_interval = 1           # steps between sentinel host readbacks
+    on_divergence = "halt"          # "halt" | "restore"
+    dataloader_max_retries = 2      # prefetch-worker transient retry budget
+    dataloader_retry_backoff_secs = 0.05
+    fault_injection = {}            # deterministic FaultInjector spec
+
+    def _validate(self):
+        if int(self.max_retries) < 0:
+            raise ValueError("resilience.max_retries must be >= 0")
+        if int(self.keep_last) < 0:
+            raise ValueError("resilience.keep_last must be >= 0")
+        if self.on_divergence not in ("halt", "restore"):
+            raise ValueError("resilience.on_divergence must be 'halt' or "
+                             "'restore'")
+        if int(self.sentinel_interval) < 1:
+            raise ValueError("resilience.sentinel_interval must be >= 1")
+        if int(self.dataloader_max_retries) < 0:
+            raise ValueError("resilience.dataloader_max_retries must be >= 0")
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled = False
     profile_step = 1
@@ -131,6 +168,18 @@ class CheckpointConfig(DeepSpeedConfigModel):
     load_universal = False
     use_node_local_storage = False
     parallel_write = {}
+    # which checkpoint engine backs save/load: "sync" (blocking orbax
+    # StandardCheckpointer) or "async"/"nebula" (orbax AsyncCheckpointer —
+    # the reference NebulaCheckpointEngine's background-snapshot semantics;
+    # the durable commit protocol waits for the flush before the marker)
+    engine = "sync"
+
+    def _validate(self):
+        if str(self.engine).lower() not in ("sync", "async", "nebula",
+                                            "torch", "orbax"):
+            raise ValueError(
+                "checkpoint.engine must be one of sync|async|nebula "
+                f"(got {self.engine!r})")
 
 
 class MeshSection(DeepSpeedConfigModel):
@@ -245,6 +294,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             pd.get(C.ACTIVATION_CHECKPOINTING, {}))
         self.checkpoint_config = CheckpointConfig(pd.get(C.CHECKPOINT, {}))
+        self.resilience_config = ResilienceConfig(pd.get(C.RESILIENCE, {}))
 
         self.elasticity_enabled = bool(pd.get(C.ELASTICITY, {}).get("enabled", False))
         self.data_efficiency_config = pd.get(C.DATA_EFFICIENCY, {})
@@ -270,7 +320,7 @@ class DeepSpeedConfig:
         C.SPARSE_GRADIENTS, C.ZERO_OPTIMIZATION, C.COMMS_LOGGER, C.MESH,
         C.ACTIVATION_CHECKPOINTING, C.FLOPS_PROFILER,
         C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV, C.TELEMETRY,
-        C.ASYNC_PIPELINE,
+        C.ASYNC_PIPELINE, C.RESILIENCE,
         C.DATA_EFFICIENCY, C.CURRICULUM_LEARNING_LEGACY, C.CHECKPOINT,
         C.ELASTICITY, C.COMPRESSION_TRAINING,
         C.PIPELINE, C.SEED, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,

@@ -84,6 +84,8 @@ TELEMETRY = "telemetry"  # unified JSONL event stream + stall watchdog
 
 ASYNC_PIPELINE = "async_pipeline"  # prefetched input feed + metric drain
 
+RESILIENCE = "resilience"  # durable ckpts, retries, preemption, fault injection
+
 GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
 TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
 TRAIN_BATCH_SIZE_DEFAULT = None

@@ -135,12 +135,24 @@ class DevicePrefetchIterator:
     is still delivered first), and a worker exception is re-raised in the
     consumer at the position it occurred.  ``close()`` stops the worker and
     releases queued device batches.
+
+    Fault tolerance (``resilience.dataloader_max_retries``): a transient
+    worker exception (OSError family — flaky storage, timeouts) is
+    retried up to ``max_retries`` times with exponential backoff before
+    it becomes fatal; non-I/O exceptions propagate immediately; ``injector`` hooks the
+    deterministic ``dataloader_next`` fault site *before* the source
+    iterator is consumed, so a retried attempt re-produces the same batch
+    and ordering is preserved exactly.  A fatal exception still drains
+    through the queue in order — every batch prefetched before it is
+    delivered first, then the error re-raises in the consumer.
     """
 
     _END = object()
 
     def __init__(self, source, gas=1, shard_fn=None, transform=None,
-                 depth=2, start_index=0, name="input-feed"):
+                 depth=2, start_index=0, name="input-feed",
+                 max_retries=0, retry_backoff_secs=0.05, injector=None,
+                 telemetry=None):
         self._source = iter(source)
         self._gas = max(1, int(gas))
         self._shard_fn = shard_fn
@@ -150,12 +162,20 @@ class DevicePrefetchIterator:
         self._stop = threading.Event()
         self._exhausted = False
         self._closed = False
+        self._max_retries = max(0, int(max_retries))
+        self._retry_backoff = float(retry_backoff_secs)
+        self._injector = injector
+        self._telemetry = telemetry
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"ds-prefetch-{name}")
         self._thread.start()
 
     # -- worker --------------------------------------------------------
     def _produce_one(self):
+        if self._injector is not None:
+            # the fault site sits BEFORE next(source): a retried attempt
+            # re-produces the identical batch, never skips one
+            self._injector.check("dataloader_next")
         micro = [next(self._source) for _ in range(self._gas)]
         leading = self._gas > 1
         batch = (jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro)
@@ -177,13 +197,36 @@ class DevicePrefetchIterator:
         return False
 
     def _run(self):
+        retries = 0
         try:
             while not self._stop.is_set():
                 try:
                     batch = self._produce_one()
+                    retries = 0
                 except StopIteration:
                     self._put((self._END, None))
                     return
+                except Exception as exc:
+                    # Only OSError-family failures are transient (flaky
+                    # storage, timeouts).  Anything else — including an
+                    # exception raised inside a generator source, which
+                    # is closed by the raise and would silently yield
+                    # StopIteration on retry — propagates immediately.
+                    if not isinstance(exc, OSError) or \
+                            retries >= self._max_retries:
+                        self._put(("err", exc))
+                        return
+                    retries += 1
+                    if self._telemetry is not None:
+                        self._telemetry.fault(
+                            "fault/dataloader_retry",
+                            attrs={"attempt": retries,
+                                   "max_retries": self._max_retries,
+                                   "error": repr(exc)[:200]})
+                    delay = self._retry_backoff * (2.0 ** (retries - 1))
+                    if delay > 0:
+                        self._stop.wait(delay)  # interruptible backoff
+                    continue
                 if not self._put(("ok", batch)):
                     return
         except BaseException as exc:  # re-raised in the consumer

@@ -56,6 +56,18 @@ from deepspeed_tpu.runtime.loss_scaler import (HostLossScale, LossScaleState,
 from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, build_schedule,
                                                 one_cycle_mom)
 from deepspeed_tpu.runtime.optimizers import build_optimizer
+from deepspeed_tpu.runtime.resilience import (CheckpointTransaction,
+                                              CheckpointCorruptError,
+                                              DivergenceError,
+                                              DivergenceSentinel,
+                                              FaultInjector,
+                                              PreemptionHandler, RetryPolicy,
+                                              TrainingPreempted, COMMITTED,
+                                              LEGACY, atomic_write_text,
+                                              build_manifest, gc_tags,
+                                              poison_tree, retry_io,
+                                              scan_tags, validate_tag,
+                                              verify_restored)
 from deepspeed_tpu.runtime.zero.stage_plan import (ZeroShardingPlan,
                                                    constrain,
                                                    device_put_global)
@@ -299,6 +311,34 @@ class DeepSpeedEngine:
                 poll_interval_secs=tc.stall_poll_secs,
                 min_stall_secs=tc.stall_min_secs).start()
         self._last_batch_tokens = None
+        # fault-tolerance layer (config "resilience", runtime/resilience.py):
+        # durable checkpoint transactions + retry policy are always wired
+        # (rc.enabled gates the durable protocol); preemption handler and
+        # divergence sentinel are opt-in.  The fault injector is explicit
+        # plumbing — engine-owned, handed to the prefetch worker and the
+        # checkpoint paths — never process-global, so tests stay isolated.
+        rc = config.resilience_config
+        self._resilience = rc
+        self._injector = FaultInjector.from_config(rc.fault_injection)
+        self._retry_policy = RetryPolicy.from_config(rc)
+        self._last_good_ckpt = None   # (dir, tag) of last committed/loaded
+        self._preempt = None
+        if rc.preemption_handler:
+            self._preempt = PreemptionHandler(
+                telemetry=self.telemetry).install()
+        self._sentinel = None
+        if rc.divergence_sentinel:
+            self._sentinel = DivergenceSentinel(
+                max_consecutive_skips=rc.max_consecutive_skips,
+                interval=rc.sentinel_interval,
+                action=rc.on_divergence,
+                telemetry=self.telemetry)
+        # resolve the process checkpoint engine from config (sync orbax vs
+        # async Nebula-style) — save/load then use whatever is current, so
+        # set_checkpoint_engine() overrides still stick
+        from deepspeed_tpu.runtime.checkpoint_engine import \
+            get_checkpoint_engine
+        get_checkpoint_engine(config)
         self.monitor = MonitorMaster(config.monitor_config)
         if self._tel_enabled:
             self.telemetry.emit(
@@ -960,14 +1000,25 @@ class DeepSpeedEngine:
         """One full training step (GAS microbatches) as a single compiled
         program.  Parity with ``PipelineEngine.train_batch`` semantics: returns
         the mean loss over the global batch."""
+        if self._preempt is not None and self._preempt.requested:
+            self._handle_preemption()
         if not self._tel_enabled:
-            return self._train_batch_inner(data_iter, batch)
-        t0 = time.perf_counter()
-        with self.telemetry.span("engine/train_batch",
-                                 step=self.global_steps):
             loss = self._train_batch_inner(data_iter, batch)
-        self._emit_step_telemetry(step_secs=time.perf_counter() - t0,
-                                  metrics=self._last_metrics)
+        else:
+            t0 = time.perf_counter()
+            with self.telemetry.span("engine/train_batch",
+                                     step=self.global_steps):
+                loss = self._train_batch_inner(data_iter, batch)
+            self._emit_step_telemetry(step_secs=time.perf_counter() - t0,
+                                      metrics=self._last_metrics)
+        # step-boundary fault-tolerance hooks: divergence sentinel first
+        # (its restore path clears state a preemption save would persist),
+        # then preemption — a signal delivered mid-step is honored here
+        # rather than a full step later
+        if self._sentinel is not None:
+            self._handle_sentinel()
+        if self._preempt is not None and self._preempt.requested:
+            self._handle_preemption()
         return loss
 
     def _train_batch_inner(self, data_iter=None, batch=None):
@@ -1017,6 +1068,17 @@ class DeepSpeedEngine:
             batch = self._shard_batch(batch, leading_gas_dim=gas > 1)
         if self._tel_enabled:
             self._last_batch_tokens = _batch_token_count(batch)
+        if self._injector is not None and \
+                self._injector.poison_grads(self.global_steps):
+            # deterministic divergence trigger: NaN the float batch inputs
+            # (falling back to params when the batch is all-integer, e.g.
+            # token ids) so this step's gradients go non-finite
+            batch, n_poisoned = poison_tree(batch)
+            if n_poisoned == 0:
+                self.state = self.state.replace(
+                    params=poison_tree(self.state.params)[0])
+            logger.warning(f"fault injector: poisoned gradients at step "
+                           f"{self.global_steps}")
         self._maybe_profile_flops(batch, gas)
         if self._param_stream is not None:
             cfg = self._config
@@ -1070,6 +1132,12 @@ class DeepSpeedEngine:
         self.global_steps += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        if self._sentinel is not None:
+            # device references only — the sentinel batches its own
+            # device_get every `interval` pushes, keeping the hot loop
+            # sync-free
+            self._sentinel.push(self.global_steps, loss=metrics.loss,
+                                overflow=metrics.overflow)
         self._last_metrics = metrics
         self._global_grad_norm = metrics.grad_norm
         self.tput_timer.stop(global_step=True)
@@ -1215,13 +1283,18 @@ class DeepSpeedEngine:
     def _make_prefetcher(self, source):
         from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
         ap = self._config.async_pipeline_config
+        rc = self._resilience
         return DevicePrefetchIterator(
             source, gas=self.gradient_accumulation_steps_,
             shard_fn=self._shard_batch,
             transform=(self._prefetch_transform
                        if self.curriculum_scheduler_ is not None else None),
             depth=ap.prefetch_depth,
-            start_index=self.global_steps)
+            start_index=self.global_steps,
+            max_retries=rc.dataloader_max_retries,
+            retry_backoff_secs=rc.dataloader_retry_backoff_secs,
+            injector=self._injector,
+            telemetry=self.telemetry)
 
     def _prefetch_transform(self, batch, index, leading_gas_dim):
         # runs on the prefetch worker: curriculum difficulty is keyed to
@@ -1445,6 +1518,65 @@ class DeepSpeedEngine:
             return jax.jit(lambda x: x, out_shardings=repl)(tree)
 
     # ------------------------------------------------------------------
+    # fault tolerance (runtime/resilience.py)
+    # ------------------------------------------------------------------
+    def _shutdown_workers(self):
+        """Drain the engine's worker threads cleanly: close the prefetcher
+        (its daemon worker exits on the queue sentinel) and flush any
+        device metrics still queued in the drain."""
+        if self._prefetcher is not None:
+            self._release_prefetcher(self._prefetcher)
+        self._default_iter = None
+        self.flush_telemetry()
+
+    def _handle_preemption(self):
+        """Step-boundary response to SIGTERM/SIGINT: emergency checkpoint
+        (when ``resilience.ckpt_dir`` is set), clean worker drain, then
+        :class:`TrainingPreempted` so the caller unwinds instead of being
+        killed mid-write."""
+        rc = self._resilience
+        tag = f"emergency_step{self.global_steps}" if rc.ckpt_dir else None
+        if tag is not None:
+            try:
+                self.save_checkpoint(rc.ckpt_dir, tag=tag)
+            except Exception as exc:
+                logger.error(f"emergency checkpoint failed: {exc!r}")
+                tag = None
+        self._shutdown_workers()
+        self.telemetry.fault("fault/preempted", step=self.global_steps,
+                             attrs={"tag": tag, "dir": rc.ckpt_dir or None})
+        self._preempt.uninstall()
+        self._preempt.clear()
+        where = f"; emergency checkpoint {rc.ckpt_dir}/{tag}" if tag else ""
+        raise TrainingPreempted(
+            f"training preempted at step {self.global_steps}{where}")
+
+    def _handle_sentinel(self):
+        """Act on a tripped divergence sentinel: auto-restore from the last
+        good checkpoint when configured (and one exists), else drain and
+        halt with :class:`DivergenceError`."""
+        action = self._sentinel.poll()
+        if action is None:
+            return
+        if action == "restore" and self._last_good_ckpt is not None:
+            load_dir, tag = self._last_good_ckpt
+            logger.warning(
+                f"divergence ({self._sentinel.reason} at step "
+                f"{self._sentinel.trip_step}): auto-restoring {load_dir}/{tag}")
+            self.load_checkpoint(load_dir, tag=tag)
+            self.telemetry.fault("fault/auto_restore", step=self.global_steps,
+                                 attrs={"dir": load_dir, "tag": tag,
+                                        "reason": self._sentinel.reason})
+            self._sentinel.reset()
+            return
+        reason, step = self._sentinel.reason, self._sentinel.trip_step
+        self._shutdown_workers()
+        raise DivergenceError(
+            f"training diverged at step {step}: {reason} "
+            f"(no checkpoint to restore)" if action == "restore" else
+            f"training diverged at step {step}: {reason}")
+
+    # ------------------------------------------------------------------
     # checkpointing (parity: save_checkpoint:3084 / load_checkpoint:2724)
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
@@ -1460,33 +1592,164 @@ class DeepSpeedEngine:
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler else None),
         })
-        eng.save(self.state, save_dir, tag, client_state=client_state)
-        if self._param_stream is not None:
-            self._param_stream.save(save_dir, tag)
-        if self._offload is not None:
-            self._offload.save(save_dir, tag)
+        rc = self._resilience
+        if not rc.enabled:
+            # legacy in-place path: no tmp dir, no marker, no retries
+            eng.save(self.state, save_dir, tag, client_state=client_state)
+            if self._param_stream is not None:
+                self._param_stream.save(save_dir, tag)
+            if self._offload is not None:
+                self._offload.save(save_dir, tag)
+            if save_latest and jax.process_index() == 0:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(tag)
+            dist.barrier()
+            return True
+        # durable protocol: every writer (orbax engine, param-stream host
+        # store, offload host shards) targets the dot-prefixed tmp tag —
+        # invisible to tag scans — then commit() fsyncs and atomically
+        # renames it into place with a manifest + marker.  The whole
+        # attempt (including the rename) sits under the retry policy; the
+        # injector's "ckpt_save" site is consumed by the same retries.
+        txn = CheckpointTransaction(
+            save_dir, tag,
+            is_coordinator=jax.process_index() == 0,
+            barrier_fn=dist.barrier if jax.process_count() > 1 else None)
+
+        def _attempt():
+            txn.begin()
+            eng.save(self.state, save_dir, txn.tmp_tag,
+                     client_state=client_state)
+            if self._param_stream is not None:
+                self._param_stream.save(save_dir, txn.tmp_tag)
+            if self._offload is not None:
+                self._offload.save(save_dir, txn.tmp_tag)
+            # async (Nebula-style) engines flush their background write
+            # here — the commit marker must never precede the payload
+            eng.commit(txn.tmp_tag)
+            return txn.commit(build_manifest(self.state, tag,
+                                             self.global_steps,
+                                             checksum=rc.checksum))
+
+        retry_io(_attempt, self._retry_policy, telemetry=self.telemetry,
+                 op=f"ckpt_save[{tag}]", injector=self._injector,
+                 site="ckpt_save", cleanup=txn.abort)
+        self._last_good_ckpt = (save_dir, tag)
         if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
+            retry_io(
+                lambda: atomic_write_text(
+                    os.path.join(save_dir, "latest"), tag),
+                self._retry_policy, telemetry=self.telemetry,
+                op=f"latest[{tag}]", injector=self._injector, site="fs")
+        if rc.keep_last > 0 and jax.process_index() == 0:
+            gc_tags(save_dir, rc.keep_last, protect=(tag,),
+                    telemetry=self.telemetry)
+        self.telemetry.emit("meta", "ckpt/committed",
+                            attrs={"dir": os.path.abspath(save_dir),
+                                   "tag": tag, "step": self.global_steps})
         dist.barrier()
         return True
+
+    def _load_candidates(self, load_dir, tag):
+        """Ordered list of loadable tags ``[(tag, status, manifest,
+        is_fallback)]``.  An explicit ``tag`` is honored or rejected — no
+        silent substitution; ``tag=None`` resolves the ``latest`` pointer
+        and falls back to the newest COMMITTED tag when the pointed-to
+        checkpoint is missing, torn, or corrupt."""
+        if tag is not None:
+            status, manifest = validate_tag(os.path.join(load_dir, tag))
+            if status == LEGACY:
+                logger.warning(f"checkpoint {load_dir}/{tag} predates the "
+                               "durable-commit protocol; loading unvalidated")
+            elif status != COMMITTED:
+                raise CheckpointCorruptError(
+                    f"checkpoint {load_dir}/{tag} failed validation: "
+                    f"{status}")
+            return [(tag, status, manifest, False)]
+        latest_tag = None
+        latest = os.path.join(load_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                latest_tag = f.read().strip()
+        tags = scan_tags(load_dir)
+        by_tag = {t: (s, m) for t, s, m in tags}
+        out = []
+        if latest_tag:
+            status, manifest = by_tag.get(latest_tag, (None, None))
+            if status is None:
+                status, manifest = validate_tag(
+                    os.path.join(load_dir, latest_tag))
+            if status in (COMMITTED, LEGACY):
+                out.append((latest_tag, status, manifest, False))
+            else:
+                logger.error(f"latest checkpoint {load_dir}/{latest_tag} is "
+                             f"{status}; scanning for newest valid tag")
+        for t, s, m in tags:
+            if s == COMMITTED and t != latest_tag:
+                out.append((t, s, m, True))
+        return out
 
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True,
                         load_module_strict=True, load_module_only=False):
         from deepspeed_tpu.runtime.checkpoint_engine import get_checkpoint_engine
         eng = get_checkpoint_engine()
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                logger.warning(f"no 'latest' file at {load_dir}")
+        rc = self._resilience
+        if not rc.enabled:
+            if tag is None:
+                latest = os.path.join(load_dir, "latest")
+                if not os.path.exists(latest):
+                    logger.warning(f"no 'latest' file at {load_dir}")
+                    return None, {}
+                with open(latest) as f:
+                    tag = f.read().strip()
+            candidates = [(tag, LEGACY, None, False)]
+        else:
+            candidates = self._load_candidates(load_dir, tag)
+            if not candidates:
+                logger.warning(f"no loadable checkpoint under {load_dir}")
                 return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
-        state, client_state = eng.load(
-            self.state, load_dir, tag, self.mesh,
-            load_optimizer_states=load_optimizer_states,
-            load_module_only=load_module_only)
+        state = client_state = None
+        chosen = None
+        last_exc = None
+        for cand_tag, status, manifest, is_fallback in candidates:
+            if is_fallback:
+                self.telemetry.fault(
+                    "fault/ckpt_fallback",
+                    attrs={"dir": os.path.abspath(load_dir),
+                           "to": cand_tag,
+                           "step": (manifest or {}).get("global_step")})
+                logger.warning(f"falling back to checkpoint {cand_tag}")
+            try:
+                def _attempt():
+                    return eng.load(
+                        self.state, load_dir, cand_tag, self.mesh,
+                        load_optimizer_states=load_optimizer_states,
+                        load_module_only=load_module_only)
+                if rc.enabled:
+                    state, client_state = retry_io(
+                        _attempt, self._retry_policy,
+                        telemetry=self.telemetry,
+                        op=f"ckpt_load[{cand_tag}]",
+                        injector=self._injector, site="ckpt_load")
+                else:
+                    state, client_state = _attempt()
+                verify_restored(state, manifest)
+                chosen = cand_tag
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as exc:
+                last_exc = exc
+                logger.error(f"loading checkpoint {load_dir}/{cand_tag} "
+                             f"failed: {exc!r}")
+                state = client_state = None
+        if chosen is None:
+            if last_exc is not None:
+                raise last_exc
+            logger.warning(f"no loadable checkpoint under {load_dir}")
+            return None, {}
+        tag = chosen
         self.state = state
         if self._param_stream is not None:
             if not self._param_stream.load(
@@ -1541,4 +1804,6 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and \
                 client_state.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        if rc.enabled:
+            self._last_good_ckpt = (load_dir, tag)
         return load_dir, client_state

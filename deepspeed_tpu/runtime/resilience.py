@@ -1,0 +1,737 @@
+"""Fault-tolerance layer: durable checkpoints, retries, preemption handling,
+divergence detection, and a deterministic fault-injection harness.
+
+Parity rationale: the reference DeepSpeed survives real fleets because its
+checkpoint/commit path (Nebula async commit, per-rank shard validation) and
+overflow machinery tolerate partial failures.  At the scale ZeRO/ZeRO-Infinity
+target (Rajbhandari et al., 1910.02054, 2104.07857) preemptions and I/O faults
+are the common case, not the exception — this module gives the TPU port the
+same survival properties on top of the orbax engine:
+
+* **Durable checkpoints** — :class:`CheckpointTransaction` implements
+  write-to-tmp → fsync → commit-marker → atomic-rename.  A tag directory is
+  *committed* iff its ``.ds_commit`` marker matches the digest of its
+  ``ds_manifest.json`` (tree structure, shapes/dtypes, file list + sizes,
+  optional per-leaf checksums).  Everything else — torn writes, truncated
+  dirs, crashed-mid-save tmp dirs — is detectably invalid and skipped by
+  the load-time scan.
+* **Retry with exponential backoff + jitter** — :func:`retry_io` wraps
+  checkpoint and host-filesystem I/O; every retry emits a structured
+  ``fault/retry`` telemetry event.
+* **Preemption handling** — :class:`PreemptionHandler` converts SIGTERM /
+  SIGINT into a flag the engine polls at step boundaries, so an eviction
+  notice becomes an emergency checkpoint plus a clean thread drain instead
+  of a corrupt half-written state dir.
+* **Divergence sentinel** — :class:`DivergenceSentinel` watches the fp32
+  loss for non-finite values and the fp16 automaton for K consecutive
+  overflow-skips, without adding a per-step device sync (device scalars are
+  batched through one ``device_get`` per ``interval`` steps).
+* **Deterministic fault injection** — :class:`FaultInjector` fails/delays
+  checkpoint writes, raises in the dataloader worker, and poisons gradients
+  at a chosen step, driven by config or tests, so every recovery path above
+  is exercised in tier-1 CPU tests (no flaky sleeps, no real signals
+  required).
+
+All telemetry from this module rides the frozen ``fault`` event kind
+(``scripts/check_telemetry_schema.py``).
+"""
+
+import hashlib
+import json
+import os
+import random
+import shutil
+import signal
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+# on-disk protocol names (docs/resilience.md documents the layout)
+COMMIT_MARKER = ".ds_commit"
+MANIFEST_NAME = "ds_manifest.json"
+TMP_SUFFIX = ".tmp"
+MANIFEST_VERSION = 1
+
+# tag-dir validation statuses
+COMMITTED = "committed"      # marker + manifest present and consistent
+NO_MARKER = "no_marker"      # manifest but no (or torn) commit marker
+BAD_MANIFEST = "bad_manifest"  # unparseable / digest-mismatched manifest
+PARTIAL = "partial"          # manifest-listed payload missing or truncated
+LEGACY = "legacy"            # pre-resilience checkpoint (no protocol files)
+MISSING = "missing"          # no such tag directory
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint tag failed validation (marker / manifest / payload)."""
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised at a step boundary after a preemption signal was handled
+    (emergency checkpoint written, worker threads drained)."""
+
+
+class DivergenceError(RuntimeError):
+    """Raised when the divergence sentinel trips and the configured action
+    is ``halt`` (or auto-restore is impossible)."""
+
+
+# ----------------------------------------------------------------------
+# retry with exponential backoff + jitter
+# ----------------------------------------------------------------------
+class RetryPolicy:
+    """Exponential backoff with deterministic jitter.
+
+    The jitter stream is seeded so a faulted test run produces the same
+    delays every time — determinism is a feature of the whole harness, not
+    just the injector.  ``sleep_fn`` is injectable for tests.
+    """
+
+    def __init__(self, max_retries=3, backoff_secs=0.5, backoff_max_secs=30.0,
+                 jitter=0.25, sleep_fn=time.sleep, seed=0xD5):
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_secs = float(backoff_secs)
+        self.backoff_max_secs = float(backoff_max_secs)
+        self.jitter = float(jitter)
+        self.sleep_fn = sleep_fn
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def from_config(cls, rc, sleep_fn=time.sleep):
+        return cls(max_retries=rc.max_retries,
+                   backoff_secs=rc.retry_backoff_secs,
+                   backoff_max_secs=rc.retry_backoff_max_secs,
+                   jitter=rc.retry_jitter, sleep_fn=sleep_fn)
+
+    def delay(self, attempt):
+        """Backoff for retry ``attempt`` (1-based): ``base * 2^(a-1)``
+        capped at ``backoff_max_secs``, stretched by up to ``jitter``."""
+        base = min(self.backoff_max_secs,
+                   self.backoff_secs * (2.0 ** (attempt - 1)))
+        return base * (1.0 + self.jitter * self._rng.random())
+
+
+def retry_io(fn, policy, telemetry=None, op="io", injector=None, site=None,
+             cleanup=None):
+    """Run ``fn`` with bounded retries under ``policy``.
+
+    ``injector``/``site`` hook the deterministic fault injector in *front*
+    of every attempt (so configured failures are consumed by retries, like
+    a flaky filesystem would be).  ``cleanup`` runs between attempts and
+    before the final re-raise — checkpoint transactions use it to clear
+    their tmp dir.  Every retry emits a ``fault/retry`` event.
+    """
+    attempt = 0
+    while True:
+        try:
+            if injector is not None and site is not None:
+                injector.check(site)
+            return fn()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:
+            attempt += 1
+            if cleanup is not None:
+                try:
+                    cleanup()
+                except Exception as ce:
+                    logger.warning(f"{op}: cleanup after failure raised {ce}")
+            if attempt > policy.max_retries:
+                logger.error(f"{op}: failed after {policy.max_retries} "
+                             f"retries: {exc!r}")
+                raise
+            delay = policy.delay(attempt)
+            logger.warning(f"{op}: attempt {attempt}/{policy.max_retries} "
+                           f"failed ({exc!r}); retrying in {delay:.2f}s")
+            if telemetry is not None:
+                telemetry.fault(
+                    "fault/retry",
+                    attrs={"op": op, "attempt": attempt,
+                           "max_retries": policy.max_retries,
+                           "error": repr(exc)[:200],
+                           "delay_s": round(delay, 3)})
+            if delay > 0:
+                policy.sleep_fn(delay)
+
+
+# ----------------------------------------------------------------------
+# deterministic fault injection
+# ----------------------------------------------------------------------
+_EXC_TABLE = {
+    "OSError": OSError,
+    "IOError": OSError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "TimeoutError": TimeoutError,
+    "CheckpointCorruptError": CheckpointCorruptError,
+}
+
+# the sites the runtime consults; check() on anything else is a no-op, so
+# configs stay forward-compatible with new sites
+FAULT_SITES = ("ckpt_save", "ckpt_load", "fs", "dataloader_next")
+
+
+class FaultInjector:
+    """Deterministic, config- and test-driven fault injection.
+
+    Spec (the ``resilience.fault_injection`` block)::
+
+        {"ckpt_save":       {"fail_times": 2, "exc": "OSError"},
+         "dataloader_next": {"fail_at": [3], "msg": "transient read"},
+         "fs":              {"delay_secs": 0.01},
+         "poison_grads_at": [5]}
+
+    Per-site semantics — each site keeps a 0-based invocation counter:
+
+    * ``fail_times: N`` — the first N calls raise.
+    * ``fail_at: [i, ...]`` — calls with those indices raise.
+    * ``delay_secs: s`` — every call sleeps first (I/O latency injection).
+    * ``exc`` / ``msg`` — exception class name and message to raise.
+
+    ``poison_grads_at`` lists engine steps whose gradients are poisoned
+    (NaN-filled float inputs, falling back to params when the batch has no
+    float leaves) — the deterministic trigger for the divergence sentinel.
+    Counters are lock-protected: the dataloader site is hit from the
+    prefetch worker thread.
+    """
+
+    def __init__(self, spec=None):
+        spec = dict(spec or {})
+        self.poison_steps = set(int(s) for s in
+                                spec.pop("poison_grads_at", []) or [])
+        self._spec = {site: dict(cfg) for site, cfg in spec.items()}
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._poisoned = set()
+
+    @classmethod
+    def from_config(cls, fault_injection):
+        if not fault_injection:
+            return None
+        return cls(fault_injection)
+
+    def calls(self, site):
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def check(self, site):
+        """Consume one invocation of ``site``; sleeps and/or raises per the
+        spec.  Unknown sites count but never fire."""
+        cfg = self._spec.get(site)
+        with self._lock:
+            idx = self._counts.get(site, 0)
+            self._counts[site] = idx + 1
+        if not cfg:
+            return
+        delay = float(cfg.get("delay_secs", 0) or 0)
+        if delay > 0:
+            time.sleep(delay)
+        fail = False
+        if idx < int(cfg.get("fail_times", 0) or 0):
+            fail = True
+        if idx in set(cfg.get("fail_at", []) or []):
+            fail = True
+        if fail:
+            exc_cls = _EXC_TABLE.get(str(cfg.get("exc", "OSError")), OSError)
+            raise exc_cls(cfg.get("msg",
+                                  f"injected fault at {site}[{idx}]"))
+
+    def poison_grads(self, step):
+        """True exactly once for each step listed in ``poison_grads_at``."""
+        step = int(step)
+        with self._lock:
+            if step in self.poison_steps and step not in self._poisoned:
+                self._poisoned.add(step)
+                return True
+        return False
+
+    def reset(self):
+        with self._lock:
+            self._counts = {}
+            self._poisoned = set()
+
+
+def poison_tree(tree):
+    """NaN-fill every floating leaf of ``tree`` (numpy or jax arrays; jax
+    leaves keep their sharding — ``x * nan`` is elementwise).  Returns
+    ``(poisoned_tree, n_leaves_poisoned)``."""
+    import jax
+    import jax.numpy as jnp
+    count = [0]
+
+    def f(x):
+        dt = getattr(x, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            count[0] += 1
+            if isinstance(x, np.ndarray):
+                return np.full_like(x, np.nan)
+            return x * float("nan")
+        return x
+    out = jax.tree_util.tree_map(f, tree)
+    return out, count[0]
+
+
+# ----------------------------------------------------------------------
+# durable checkpoint protocol
+# ----------------------------------------------------------------------
+def _fsync_path(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_tree(root):
+    """fsync every file under ``root`` then every directory bottom-up, so
+    the subsequent rename publishes fully-persisted bytes."""
+    for dirpath, _, filenames in os.walk(root, topdown=False):
+        for fn in filenames:
+            try:
+                _fsync_path(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+        try:
+            _fsync_path(dirpath)
+        except OSError:
+            pass
+
+
+def atomic_write_text(path, text, fsync=True):
+    """Write ``text`` to ``path`` via tmp-file + atomic rename (the
+    ``latest`` pointer must never be observable half-written)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        try:
+            _fsync_path(os.path.dirname(os.path.abspath(path)))
+        except OSError:
+            pass
+
+
+def _manifest_digest(body):
+    """sha256 over the canonical JSON of the manifest body (digest field
+    excluded)."""
+    data = {k: v for k, v in body.items() if k != "digest"}
+    blob = json.dumps(data, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _leaf_entries(state, checksum=False):
+    """Flatten ``state`` into manifest leaf records: keypath, shape, dtype,
+    and (on request) crc32 of the host bytes.  Checksums force a device_get
+    per leaf — a deliberate cost, gated by ``resilience.checksum``."""
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    entries = []
+    for path, leaf in leaves:
+        rec = {"path": jax.tree_util.keystr(path),
+               "shape": list(np.shape(leaf)),
+               "dtype": str(getattr(leaf, "dtype", type(leaf).__name__))}
+        if checksum:
+            rec["crc32"] = leaf_crc32(leaf)
+        entries.append(rec)
+    return entries
+
+
+def leaf_crc32(leaf):
+    import jax
+    if isinstance(leaf, jax.Array) and jax.dtypes.issubdtype(
+            leaf.dtype, jax.dtypes.prng_key):
+        leaf = jax.random.key_data(leaf)  # typed keys have no numpy view
+    host = np.asarray(jax.device_get(leaf))
+    return zlib.crc32(np.ascontiguousarray(host).tobytes()) & 0xFFFFFFFF
+
+
+def build_manifest(state, tag, global_step, checksum=False, extra=None):
+    """The manifest body (files are appended at commit time when the full
+    payload is on disk)."""
+    body = {"version": MANIFEST_VERSION,
+            "tag": str(tag),
+            "global_step": int(global_step),
+            "created": round(time.time(), 6),
+            "leaves": _leaf_entries(state, checksum=checksum),
+            "checksum": bool(checksum)}
+    if extra:
+        body.update(extra)
+    return body
+
+
+def _payload_files(tag_dir):
+    """Relative paths + sizes of everything in the tag dir except the
+    protocol files themselves."""
+    skip = {COMMIT_MARKER, MANIFEST_NAME}
+    files = []
+    for dirpath, _, filenames in os.walk(tag_dir):
+        for fn in filenames:
+            rel = os.path.relpath(os.path.join(dirpath, fn), tag_dir)
+            if rel in skip:
+                continue
+            files.append({"path": rel,
+                          "bytes": os.path.getsize(
+                              os.path.join(dirpath, fn))})
+    files.sort(key=lambda f: f["path"])
+    return files
+
+
+class CheckpointTransaction:
+    """Write-to-tmp → fsync → marker → atomic-rename for one tag.
+
+    All writers (orbax engine, ZeRO-Offload host shards, param-stream host
+    store) target ``tmp_tag`` — a dot-prefixed sibling directory invisible
+    to tag scans.  ``commit()`` then:
+
+    1. records the payload file list + sizes into the manifest,
+    2. writes ``ds_manifest.json`` (self-digested) and the ``.ds_commit``
+       marker carrying that digest,
+    3. fsyncs the whole tree,
+    4. atomically renames ``.{tag}.tmp`` → ``{tag}``.
+
+    A crash at any point leaves either the previous committed tag intact or
+    an ignorable tmp dir — never a half-visible checkpoint.  On multi-host,
+    every process writes its shards into the shared tmp dir; only the
+    coordinator performs steps 1–4, bracketed by ``barrier_fn``.
+    """
+
+    def __init__(self, save_dir, tag, is_coordinator=True, barrier_fn=None,
+                 fsync=True):
+        self.save_dir = os.path.abspath(save_dir)
+        self.tag = str(tag)
+        self.tmp_tag = f".{self.tag}{TMP_SUFFIX}"
+        self.is_coordinator = is_coordinator
+        self.barrier_fn = barrier_fn
+        self.fsync = fsync
+
+    @property
+    def tmp_path(self):
+        return os.path.join(self.save_dir, self.tmp_tag)
+
+    @property
+    def final_path(self):
+        return os.path.join(self.save_dir, self.tag)
+
+    def begin(self):
+        """Clear any stale tmp dir from a previous crashed/failed attempt
+        and create a fresh one."""
+        if self.is_coordinator:
+            if os.path.isdir(self.tmp_path):
+                shutil.rmtree(self.tmp_path, ignore_errors=True)
+            os.makedirs(self.tmp_path, exist_ok=True)
+        if self.barrier_fn is not None:
+            self.barrier_fn()
+        return self
+
+    def commit(self, manifest):
+        """Publish the tmp dir as ``tag``.  ``manifest`` is the body from
+        :func:`build_manifest`; the payload file list is appended here."""
+        if self.barrier_fn is not None:
+            self.barrier_fn()  # every process finished writing its shards
+        if self.is_coordinator:
+            manifest = dict(manifest)
+            manifest["files"] = _payload_files(self.tmp_path)
+            manifest["digest"] = _manifest_digest(manifest)
+            with open(os.path.join(self.tmp_path, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            with open(os.path.join(self.tmp_path, COMMIT_MARKER), "w") as f:
+                f.write(manifest["digest"])
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            if self.fsync:
+                fsync_tree(self.tmp_path)
+            # replacing an existing tag: move it aside first (os.replace
+            # cannot atomically swap non-empty directories)
+            if os.path.isdir(self.final_path):
+                old = f"{self.final_path}.replaced.{os.getpid()}"
+                os.replace(self.final_path, old)
+                shutil.rmtree(old, ignore_errors=True)
+            os.replace(self.tmp_path, self.final_path)
+            if self.fsync:
+                try:
+                    _fsync_path(self.save_dir)
+                except OSError:
+                    pass
+        if self.barrier_fn is not None:
+            self.barrier_fn()  # commit visible everywhere before returning
+        return self.final_path
+
+    def abort(self):
+        """Remove the tmp dir (between retries / on final failure)."""
+        if self.is_coordinator and os.path.isdir(self.tmp_path):
+            shutil.rmtree(self.tmp_path, ignore_errors=True)
+
+
+def validate_tag(tag_dir):
+    """Classify one tag directory.  Returns ``(status, manifest_or_None)``
+    — :data:`COMMITTED` means marker and manifest agree and every
+    manifest-listed payload file exists at its recorded size."""
+    if not os.path.isdir(tag_dir):
+        return MISSING, None
+    marker_path = os.path.join(tag_dir, COMMIT_MARKER)
+    manifest_path = os.path.join(tag_dir, MANIFEST_NAME)
+    has_marker = os.path.exists(marker_path)
+    has_manifest = os.path.exists(manifest_path)
+    if not has_marker and not has_manifest:
+        return LEGACY, None
+    if not has_manifest:
+        return BAD_MANIFEST, None
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        digest = _manifest_digest(manifest)
+        if manifest.get("digest") != digest:
+            return BAD_MANIFEST, None
+    except (ValueError, OSError):
+        return BAD_MANIFEST, None
+    if not has_marker:
+        return NO_MARKER, manifest
+    try:
+        with open(marker_path) as f:
+            marker_digest = f.read().strip()
+    except OSError:
+        return NO_MARKER, manifest
+    if marker_digest != manifest.get("digest"):
+        return NO_MARKER, manifest
+    for rec in manifest.get("files", []):
+        p = os.path.join(tag_dir, rec["path"])
+        if not os.path.exists(p) or os.path.getsize(p) != rec["bytes"]:
+            return PARTIAL, manifest
+    return COMMITTED, manifest
+
+
+def scan_tags(root):
+    """All non-tmp tag dirs under ``root`` with their validation status:
+    ``[(tag, status, manifest)]`` sorted newest-first (manifest
+    ``global_step`` desc, then mtime desc)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        path = os.path.join(root, name)
+        if name.startswith(".") or not os.path.isdir(path):
+            continue
+        status, manifest = validate_tag(path)
+        out.append((name, status, manifest))
+
+    def key(item):
+        _, _, manifest = item
+        step = (manifest or {}).get("global_step", -1)
+        try:
+            mtime = os.path.getmtime(os.path.join(root, item[0]))
+        except OSError:
+            mtime = 0.0
+        return (step, mtime)
+    out.sort(key=key, reverse=True)
+    return out
+
+
+def verify_restored(state, manifest):
+    """Per-leaf checksum verification of a *restored* state against the
+    manifest (only when the manifest carries checksums).  Raises
+    :class:`CheckpointCorruptError` on the first mismatch."""
+    if not manifest or not manifest.get("checksum"):
+        return True
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    by_path = {r["path"]: r for r in manifest.get("leaves", [])}
+    for path, leaf in leaves:
+        key = jax.tree_util.keystr(path)
+        rec = by_path.get(key)
+        if rec is None or "crc32" not in rec:
+            continue
+        got = leaf_crc32(leaf)
+        if got != rec["crc32"]:
+            raise CheckpointCorruptError(
+                f"leaf {key}: checksum mismatch (manifest "
+                f"{rec['crc32']:#010x}, restored {got:#010x})")
+    return True
+
+
+def gc_tags(root, keep_last, protect=(), telemetry=None):
+    """Retention: keep the newest ``keep_last`` COMMITTED tags, delete the
+    rest (plus stale tmp dirs).  Non-committed tags are never deleted —
+    they are evidence, and ``ds_ckpt_fsck`` reports them.  Tags in
+    ``protect`` are always kept."""
+    if keep_last <= 0:
+        return []
+    removed = []
+    committed = [t for t, s, _ in scan_tags(root) if s == COMMITTED]
+    for tag in committed[keep_last:]:
+        if tag in protect:
+            continue
+        shutil.rmtree(os.path.join(root, tag), ignore_errors=True)
+        removed.append(tag)
+        logger.info(f"checkpoint GC: removed {tag} (keep_last={keep_last})")
+    for name in os.listdir(root):
+        if name.startswith(".") and name.endswith(TMP_SUFFIX):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    if removed and telemetry is not None:
+        telemetry.emit("meta", "ckpt/gc",
+                       attrs={"removed": removed, "keep_last": keep_last})
+    return removed
+
+
+# ----------------------------------------------------------------------
+# preemption handling
+# ----------------------------------------------------------------------
+class PreemptionHandler:
+    """SIGTERM/SIGINT → a flag the engine polls at step boundaries.
+
+    The signal handler itself does the minimum legal work (set a flag, log,
+    emit ``fault/preempt_requested``); the engine then writes an emergency
+    checkpoint at the next boundary and drains its worker threads.  A
+    second signal restores the original handlers and re-raises — an
+    operator double-Ctrl-C still kills the process immediately.
+    """
+
+    def __init__(self, telemetry=None, signals=(signal.SIGTERM,
+                                                signal.SIGINT)):
+        self.telemetry = telemetry
+        self.signals = tuple(signals)
+        self._requested = threading.Event()
+        self._prev = {}
+        self._installed = False
+
+    def install(self):
+        try:
+            for sig in self.signals:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            self._installed = True
+        except ValueError:
+            # signal.signal only works in the main thread — degrade to
+            # manual request() (tests, embedded runtimes)
+            logger.warning("preemption handler: not in main thread; "
+                           "signals not hooked (manual request() only)")
+        return self
+
+    def uninstall(self):
+        if self._installed:
+            for sig, prev in self._prev.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, TypeError):
+                    pass
+            self._prev = {}
+            self._installed = False
+
+    def _on_signal(self, signum, frame):
+        if self._requested.is_set():
+            # second signal: get out of the way and re-deliver
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self.request(signum=signum)
+
+    def request(self, signum=None):
+        """Flag a preemption (signal handler or tests)."""
+        self._requested.set()
+        logger.warning(
+            f"preemption requested (signal={signum}); emergency checkpoint "
+            "at the next step boundary")
+        if self.telemetry is not None:
+            self.telemetry.fault(
+                "fault/preempt_requested",
+                attrs={"signal": int(signum) if signum is not None else None})
+
+    @property
+    def requested(self):
+        return self._requested.is_set()
+
+    def clear(self):
+        self._requested.clear()
+
+
+# ----------------------------------------------------------------------
+# divergence sentinel
+# ----------------------------------------------------------------------
+class DivergenceSentinel:
+    """Non-finite fp32 loss or K consecutive fp16 overflow-skips → trip.
+
+    The engine ``push()``es each step's loss / overflow as *device* scalars
+    (no sync); every ``interval`` pushes the sentinel fetches the pending
+    batch with one ``device_get`` and evaluates.  ``poll()`` returns the
+    configured action (``"halt"`` / ``"restore"``) once per trip; the
+    engine acts on its own thread at the step boundary.
+    """
+
+    def __init__(self, max_consecutive_skips=0, check_nonfinite=True,
+                 interval=1, action="halt", telemetry=None):
+        self.max_consecutive_skips = int(max_consecutive_skips)
+        self.check_nonfinite = bool(check_nonfinite)
+        self.interval = max(1, int(interval))
+        self.action = action
+        self.telemetry = telemetry
+        self._pending = []      # [(step, loss_or_None, overflow_or_None)]
+        self._skip_streak = 0
+        self.tripped = False
+        self.reason = None
+        self.trip_step = None
+        self._delivered = False
+
+    def push(self, step, loss=None, overflow=None):
+        if self.tripped:
+            return
+        self._pending.append((int(step), loss, overflow))
+
+    def _evaluate(self, step, loss_f, overflow_b):
+        if overflow_b is not None and self.max_consecutive_skips > 0:
+            self._skip_streak = self._skip_streak + 1 if overflow_b else 0
+            if self._skip_streak >= self.max_consecutive_skips:
+                self._trip(step, "overflow_streak",
+                           {"consecutive_skips": self._skip_streak})
+                return
+        if self.check_nonfinite and loss_f is not None and \
+                not np.isfinite(loss_f):
+            self._trip(step, "nonfinite_loss", {"loss": repr(loss_f)})
+
+    def _trip(self, step, reason, attrs):
+        self.tripped = True
+        self.reason = reason
+        self.trip_step = int(step)
+        logger.error(f"divergence sentinel tripped at step {step}: {reason} "
+                     f"{attrs} (action={self.action})")
+        if self.telemetry is not None:
+            self.telemetry.fault(
+                "fault/divergence", step=int(step),
+                attrs=dict(attrs, reason=reason, action=self.action))
+
+    def poll(self, force=False):
+        """Fetch + evaluate pending observations when due.  Returns the
+        action string exactly once after a trip, else None."""
+        if not self.tripped and self._pending and \
+                (force or len(self._pending) >= self.interval):
+            batch, self._pending = self._pending, []
+            import jax
+            refs = [v for _, loss, ovf in batch for v in (loss, ovf)
+                    if v is not None]
+            host = iter(jax.device_get(refs)) if refs else iter(())
+            for step, loss, ovf in batch:
+                loss_f = float(next(host)) if loss is not None else None
+                ovf_b = bool(next(host)) if ovf is not None else None
+                self._evaluate(step, loss_f, ovf_b)
+                if self.tripped:
+                    break
+        if self.tripped and not self._delivered:
+            self._delivered = True
+            return self.action
+        return None
+
+    def reset(self):
+        """Re-arm after a successful auto-restore."""
+        self._pending = []
+        self._skip_streak = 0
+        self.tripped = False
+        self.reason = None
+        self.trip_step = None
+        self._delivered = False

@@ -54,6 +54,15 @@ SCHEMA = {
         "required": {"ts": _NUM, "kind": str, "name": str},
         "optional": {"attrs": dict, "step": int},
     },
+    # fault-tolerance events (runtime/resilience.py): I/O retries
+    # ("fault/retry", "fault/dataloader_retry"), checkpoint fallback
+    # ("fault/ckpt_fallback"), preemption ("fault/preempt_requested",
+    # "fault/preempted"), divergence ("fault/divergence",
+    # "fault/auto_restore")
+    "fault": {
+        "required": {"ts": _NUM, "kind": str, "name": str},
+        "optional": {"attrs": dict, "step": int},
+    },
 }
 
 EVENT_KINDS = tuple(SCHEMA)

@@ -191,6 +191,43 @@ def test_sharded_flat_layout_roundtrip():
 
 
 @pytest.mark.slow
+def test_two_process_client_state_broadcast():
+    """Checkpoint ``client_state`` reaches every host after load.
+
+    ``save`` writes ``client_state.json`` on process 0 only; on node-local
+    storage the other hosts cannot read it, so ``load`` broadcasts process
+    0's dict (``broadcast_client_state``).  Each process feeds a different
+    dict into the broadcast and must come out holding process 0's; the
+    end-to-end save→load then has to agree on ``global_steps`` everywhere."""
+    port = _free_port()
+    post = textwrap.dedent(f"""\
+        from deepspeed_tpu.runtime.checkpoint_engine import \\
+            broadcast_client_state
+        fed = {{"global_steps": 41, "src": "p0"}} if pid == 0 \\
+            else {{"stale": True}}
+        got = broadcast_client_state(fed)
+        assert got == {{"global_steps": 41, "src": "p0"}}, (pid, got)
+        ckpt = "/tmp/ds_mh_cs_ckpt_{port}"
+        engine.save_checkpoint(ckpt, tag="t")
+        path, client = engine.load_checkpoint(ckpt, tag="t")
+        assert path is not None, (pid, path)
+        assert int(client["global_steps"]) == engine.global_steps == 5, \\
+            (pid, client)
+        loss = engine.train_batch(
+            batch={{"input_ids": rng.integers(0, cfg.vocab_size, (4, 32))}})
+        losses.append(float(loss))
+        import shutil
+        if pid == 0:
+            shutil.rmtree(ckpt, ignore_errors=True)
+    """)
+    script = _WORKER_TEMPLATE.format(port=port, zero='{"stage": 3}',
+                                     extra="", post=post)
+    outs = _run_two_procs(script)
+    l0, l1 = _losses(outs[0]), _losses(outs[1])
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+
+@pytest.mark.slow
 def test_two_process_param_stream():
     """Multi-host param-stream: host master/moments replicated per
     process; grads come back fully-replicated from the layer programs

@@ -1,0 +1,690 @@
+"""Fault-tolerance layer tests (``deepspeed_tpu/runtime/resilience.py``).
+
+Every recovery path is driven by the deterministic :class:`FaultInjector` —
+no flaky sleeps, no real signals, no random corruption.  The acceptance
+test at the bottom is the ISSUE's train→save→kill→resume cycle with
+injected write failures and a corrupted newest tag, asserting a
+bit-identical fp32 trajectory against an unfaulted run.
+"""
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.telemetry import get_telemetry
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.resilience import (BAD_MANIFEST, COMMITTED, LEGACY,
+                                              MISSING, NO_MARKER, PARTIAL,
+                                              CheckpointCorruptError,
+                                              CheckpointTransaction,
+                                              DivergenceError,
+                                              DivergenceSentinel,
+                                              FaultInjector, RetryPolicy,
+                                              TrainingPreempted,
+                                              atomic_write_text,
+                                              build_manifest, gc_tags,
+                                              poison_tree, retry_io,
+                                              scan_tags, validate_tag,
+                                              verify_restored)
+from unit.simple_model import SimpleModel, base_config, random_batch
+
+HIDDEN = 16
+
+
+def _engine(stage=0, **overrides):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    params = model.init(jax.random.key(0))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=base_config(stage, **overrides))
+    return engine
+
+
+def _telemetry_cfg(tmp_path, job):
+    return {"enabled": True, "output_path": str(tmp_path), "job_name": job}
+
+
+def _events(tmp_path, job):
+    path = os.path.join(str(tmp_path), job, "events.jsonl")
+    get_telemetry().close()  # flush/close the sink before reading
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ----------------------------------------------------------------------
+# retry policy + fault injector
+# ----------------------------------------------------------------------
+def test_retry_policy_deterministic_backoff():
+    a = RetryPolicy(max_retries=5, backoff_secs=0.5, backoff_max_secs=4.0,
+                    jitter=0.25, seed=7)
+    b = RetryPolicy(max_retries=5, backoff_secs=0.5, backoff_max_secs=4.0,
+                    jitter=0.25, seed=7)
+    da = [a.delay(i) for i in range(1, 6)]
+    db = [b.delay(i) for i in range(1, 6)]
+    assert da == db                      # seeded jitter is reproducible
+    # exponential base under the cap, jitter only stretches
+    assert 0.5 <= da[0] <= 0.5 * 1.25
+    assert 1.0 <= da[1] <= 1.0 * 1.25
+    assert da[4] <= 4.0 * 1.25           # capped at backoff_max_secs
+
+
+def test_retry_io_retries_then_succeeds():
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "done"
+
+    policy = RetryPolicy(max_retries=3, backoff_secs=0.01, jitter=0.0,
+                         sleep_fn=sleeps.append)
+    assert retry_io(flaky, policy, op="t") == "done"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2              # slept between the failed attempts
+
+
+def test_retry_io_exhausts_and_runs_cleanup():
+    cleanups = []
+    policy = RetryPolicy(max_retries=2, backoff_secs=0.0, jitter=0.0,
+                         sleep_fn=lambda s: None)
+
+    def always_fail():
+        raise OSError("disk on fire")
+
+    with pytest.raises(OSError, match="disk on fire"):
+        retry_io(always_fail, policy, op="t",
+                 cleanup=lambda: cleanups.append(1))
+    assert len(cleanups) == 3            # after every attempt incl. the last
+
+
+def test_fault_injector_sites_and_counters():
+    inj = FaultInjector({"ckpt_save": {"fail_times": 2, "exc": "OSError"},
+                         "ckpt_load": {"fail_at": [1],
+                                       "exc": "RuntimeError",
+                                       "msg": "torn read"},
+                         "poison_grads_at": [3, 5]})
+    with pytest.raises(OSError):
+        inj.check("ckpt_save")
+    with pytest.raises(OSError):
+        inj.check("ckpt_save")
+    inj.check("ckpt_save")               # third call clean
+    assert inj.calls("ckpt_save") == 3
+    inj.check("ckpt_load")               # call 0 clean
+    with pytest.raises(RuntimeError, match="torn read"):
+        inj.check("ckpt_load")           # call 1 fails
+    inj.check("unknown_site")            # unknown sites never fire
+    assert inj.calls("unknown_site") == 1
+    assert not inj.poison_grads(2)
+    assert inj.poison_grads(3)
+    assert not inj.poison_grads(3)       # fires exactly once per step
+    inj.reset()
+    assert inj.calls("ckpt_save") == 0
+    assert inj.poison_grads(3)
+
+
+def test_fault_injector_from_config_empty_is_none():
+    assert FaultInjector.from_config({}) is None
+    assert FaultInjector.from_config(None) is None
+    assert FaultInjector.from_config({"fs": {"fail_times": 1}}) is not None
+
+
+def test_poison_tree():
+    tree = {"a": np.ones((2, 2), np.float32), "b": np.arange(3),
+            "c": {"d": np.ones(4, np.float64)}}
+    out, n = poison_tree(tree)
+    assert n == 2
+    assert np.isnan(out["a"]).all() and np.isnan(out["c"]["d"]).all()
+    np.testing.assert_array_equal(out["b"], np.arange(3))  # ints untouched
+
+
+# ----------------------------------------------------------------------
+# durable checkpoint protocol (filesystem level)
+# ----------------------------------------------------------------------
+def _toy_state():
+    return {"w": np.arange(8, dtype=np.float32),
+            "step": np.asarray(3, np.int32)}
+
+
+def _commit_toy_tag(root, tag, step=3, checksum=False):
+    state = _toy_state()
+    txn = CheckpointTransaction(str(root), tag).begin()
+    np.savez(os.path.join(txn.tmp_path, "payload.npz"), **state)
+    txn.commit(build_manifest(state, tag, step, checksum=checksum))
+    return state
+
+
+def test_transaction_commit_and_validate(tmp_path):
+    _commit_toy_tag(tmp_path, "t1")
+    status, manifest = validate_tag(str(tmp_path / "t1"))
+    assert status == COMMITTED
+    assert manifest["global_step"] == 3
+    assert [f["path"] for f in manifest["files"]] == ["payload.npz"]
+    assert not (tmp_path / ".t1.tmp").exists()   # tmp renamed away
+
+
+def test_validate_tag_corruption_taxonomy(tmp_path):
+    assert validate_tag(str(tmp_path / "nope"))[0] == MISSING
+
+    _commit_toy_tag(tmp_path, "no_marker")
+    os.remove(tmp_path / "no_marker" / ".ds_commit")
+    assert validate_tag(str(tmp_path / "no_marker"))[0] == NO_MARKER
+
+    _commit_toy_tag(tmp_path, "bad_manifest")
+    mpath = tmp_path / "bad_manifest" / "ds_manifest.json"
+    m = json.loads(mpath.read_text())
+    m["global_step"] = 999                       # content no longer matches
+    mpath.write_text(json.dumps(m))              # the self-digest
+    assert validate_tag(str(tmp_path / "bad_manifest"))[0] == BAD_MANIFEST
+
+    _commit_toy_tag(tmp_path, "partial")
+    os.remove(tmp_path / "partial" / "payload.npz")
+    assert validate_tag(str(tmp_path / "partial"))[0] == PARTIAL
+
+    _commit_toy_tag(tmp_path, "truncated")
+    p = tmp_path / "truncated" / "payload.npz"
+    p.write_bytes(p.read_bytes()[:10])           # torn write: wrong size
+    assert validate_tag(str(tmp_path / "truncated"))[0] == PARTIAL
+
+    (tmp_path / "legacy").mkdir()
+    (tmp_path / "legacy" / "state.bin").write_bytes(b"old world")
+    assert validate_tag(str(tmp_path / "legacy"))[0] == LEGACY
+
+
+def test_scan_tags_orders_newest_first_and_skips_tmp(tmp_path):
+    _commit_toy_tag(tmp_path, "a", step=1)
+    _commit_toy_tag(tmp_path, "b", step=5)
+    _commit_toy_tag(tmp_path, "c", step=3)
+    os.makedirs(tmp_path / ".d.tmp")             # crashed save: invisible
+    got = [(t, s) for t, s, _ in scan_tags(str(tmp_path))]
+    assert got == [("b", COMMITTED), ("c", COMMITTED), ("a", COMMITTED)]
+
+
+def test_manifest_checksum_verify(tmp_path):
+    state = _toy_state()
+    manifest = build_manifest(state, "t", 1, checksum=True)
+    manifest["digest"] = "x"                     # digest not needed here
+    assert verify_restored(state, manifest)
+    state["w"] = state["w"] + 1                  # silent bit-flip analogue
+    with pytest.raises(CheckpointCorruptError, match="checksum mismatch"):
+        verify_restored(state, manifest)
+    # manifests without checksums always pass (no device_get cost paid)
+    assert verify_restored(state, build_manifest(state, "t", 1))
+
+
+def test_gc_keeps_last_k_committed_only(tmp_path):
+    for i, tag in enumerate(["t1", "t2", "t3", "t4"]):
+        _commit_toy_tag(tmp_path, tag, step=i + 1)
+    _commit_toy_tag(tmp_path, "torn", step=99)
+    os.remove(tmp_path / "torn" / ".ds_commit")  # evidence: never GC'd
+    os.makedirs(tmp_path / ".stale.tmp")
+    removed = gc_tags(str(tmp_path), keep_last=2)
+    assert sorted(removed) == ["t1", "t2"]
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["t3", "t4", "torn"]          # stale tmp swept too
+
+
+def test_atomic_write_text(tmp_path):
+    path = tmp_path / "latest"
+    atomic_write_text(str(path), "tag1")
+    atomic_write_text(str(path), "tag2")
+    assert path.read_text() == "tag2"
+    assert list(tmp_path.iterdir()) == [path]    # no tmp residue
+
+
+# ----------------------------------------------------------------------
+# engine integration: durable save, retry, fallback
+# ----------------------------------------------------------------------
+def test_save_checkpoint_commits_durable_tag(tmp_path):
+    engine = _engine(0)
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    status, manifest = validate_tag(str(tmp_path / "global_step1"))
+    assert status == COMMITTED
+    assert manifest["global_step"] == 1
+    assert manifest["leaves"]                    # tree structure recorded
+    assert (tmp_path / "latest").read_text() == "global_step1"
+
+
+def test_save_retries_injected_failures_and_emits_fault_events(tmp_path):
+    engine = _engine(0, telemetry=_telemetry_cfg(tmp_path, "retryjob"),
+                     resilience={"retry_backoff_secs": 0.0,
+                                 "retry_jitter": 0.0,
+                                 "fault_injection": {
+                                     "ckpt_save": {"fail_times": 2}}})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    ckpt = tmp_path / "ckpt"
+    engine.save_checkpoint(str(ckpt))
+    assert engine._injector.calls("ckpt_save") == 3   # 2 failures + success
+    assert validate_tag(str(ckpt / "global_step1"))[0] == COMMITTED
+    retries = [e for e in _events(tmp_path, "retryjob")
+               if e["kind"] == "fault" and e["name"] == "fault/retry"]
+    assert [r["attrs"]["attempt"] for r in retries] == [1, 2]
+
+
+def test_latest_pointer_write_retried_via_fs_site(tmp_path):
+    engine = _engine(0, resilience={"retry_backoff_secs": 0.0,
+                                    "retry_jitter": 0.0,
+                                    "fault_injection": {
+                                        "fs": {"fail_times": 1}}})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    assert engine._injector.calls("fs") == 2     # 1 failure + success
+    assert (tmp_path / "latest").read_text() == "global_step1"
+
+
+def test_save_fails_after_retry_budget(tmp_path):
+    engine = _engine(0, resilience={"max_retries": 1,
+                                    "retry_backoff_secs": 0.0,
+                                    "fault_injection": {
+                                        "ckpt_save": {"fail_times": 5}}})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    with pytest.raises(OSError):
+        engine.save_checkpoint(str(tmp_path))
+    # failed transaction leaves no tmp dir and no visible tag
+    assert [p.name for p in tmp_path.iterdir()] == []
+
+
+@pytest.mark.parametrize("corruption",
+                         ["no_marker", "bad_manifest", "truncated"])
+def test_fallback_restores_previous_tag(tmp_path, corruption):
+    ckpt = tmp_path / "ckpt"
+    engine = _engine(0)
+    b = [random_batch(32, HIDDEN, seed=i) for i in range(4)]
+    engine.train_batch(batch=b[0])
+    engine.train_batch(batch=b[1])
+    engine.save_checkpoint(str(ckpt))            # global_step2 (good)
+    engine.train_batch(batch=b[2])
+    engine.train_batch(batch=b[3])
+    engine.save_checkpoint(str(ckpt))            # global_step4 (newest)
+
+    bad = ckpt / "global_step4"
+    if corruption == "no_marker":
+        os.remove(bad / ".ds_commit")
+    elif corruption == "bad_manifest":
+        (bad / "ds_manifest.json").write_text("{not json")
+    else:                                        # truncated state dir
+        m = json.loads((bad / "ds_manifest.json").read_text())
+        victim = bad / m["files"][0]["path"]
+        os.remove(victim)
+
+    groups.reset_mesh()
+    engine2 = _engine(0, telemetry=_telemetry_cfg(tmp_path, "fbjob"))
+    path, client = engine2.load_checkpoint(str(ckpt))
+    assert path is not None
+    assert engine2.global_steps == 2             # previous valid tag
+    faults = [e for e in _events(tmp_path, "fbjob")
+              if e["kind"] == "fault" and e["name"] == "fault/ckpt_fallback"]
+    assert len(faults) == 1
+    assert faults[0]["attrs"]["to"] == "global_step2"
+
+
+def test_explicit_corrupt_tag_raises_not_substitutes(tmp_path):
+    engine = _engine(0)
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    shutil.copytree(tmp_path / "good", tmp_path / "bad")
+    os.remove(tmp_path / "bad" / ".ds_commit")
+    groups.reset_mesh()
+    engine2 = _engine(0)
+    with pytest.raises(CheckpointCorruptError):
+        engine2.load_checkpoint(str(tmp_path), tag="bad")
+
+
+def test_load_retries_injected_load_faults(tmp_path):
+    engine = _engine(0)
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    groups.reset_mesh()
+    engine2 = _engine(0, resilience={"retry_backoff_secs": 0.0,
+                                     "fault_injection": {
+                                         "ckpt_load": {"fail_times": 2}}})
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert engine2.global_steps == 1
+    assert engine2._injector.calls("ckpt_load") == 3
+
+
+def test_keep_last_retention(tmp_path):
+    engine = _engine(0, resilience={"keep_last": 2})
+    for i in range(4):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=i))
+        engine.save_checkpoint(str(tmp_path))
+    tags = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert tags == ["global_step3", "global_step4"]
+
+
+def test_checksummed_roundtrip(tmp_path):
+    engine = _engine(0, resilience={"checksum": True})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    _, manifest = validate_tag(str(tmp_path / "global_step1"))
+    assert manifest["checksum"] and \
+        all("crc32" in r for r in manifest["leaves"])
+    groups.reset_mesh()
+    engine2 = _engine(0, resilience={"checksum": True})
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None                      # checksums verified on load
+
+
+def test_legacy_checkpoint_still_loads(tmp_path):
+    """Pre-resilience checkpoints (no manifest/marker) stay loadable."""
+    engine = _engine(0, resilience={"enabled": False})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    assert validate_tag(str(tmp_path / "global_step1"))[0] == LEGACY
+    groups.reset_mesh()
+    engine2 = _engine(0)                         # resilience ON by default
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert engine2.global_steps == 1
+
+
+def test_broadcast_client_state_single_process_passthrough():
+    """The multihost broadcast is an identity on one process (the 2-proc
+    path is covered by the slow test in ``test_multihost.py``)."""
+    from deepspeed_tpu.runtime.checkpoint_engine import broadcast_client_state
+    cs = {"global_steps": 3, "nested": {"tag": "t"}}
+    assert broadcast_client_state(cs) is cs
+
+
+# ----------------------------------------------------------------------
+# checkpoint engine selection (satellite: config was silently ignored)
+# ----------------------------------------------------------------------
+def test_checkpoint_engine_selected_from_config():
+    from deepspeed_tpu.runtime import checkpoint_engine as ce
+    e1 = ce.get_checkpoint_engine({"checkpoint": {"engine": "async"}})
+    assert isinstance(e1, ce.NebulaCheckpointEngine)
+    # a later config with a different engine type rebuilds the cache
+    e2 = ce.get_checkpoint_engine({"checkpoint": {"engine": "sync"}})
+    assert type(e2) is ce.OrbaxCheckpointEngine
+    assert e2 is not e1
+    # no-arg call returns the current engine unchanged
+    assert ce.get_checkpoint_engine() is e2
+    # same type requested again: cached instance is reused
+    assert ce.get_checkpoint_engine({"checkpoint": {"engine": "sync"}}) is e2
+
+
+def test_async_engine_roundtrip(tmp_path):
+    engine = _engine(0, checkpoint={"engine": "async"})
+    from deepspeed_tpu.runtime import checkpoint_engine as ce
+    assert isinstance(ce.get_checkpoint_engine(),
+                      ce.NebulaCheckpointEngine)
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine.save_checkpoint(str(tmp_path))
+    # the async flush happened before the marker: the tag is committed
+    assert validate_tag(str(tmp_path / "global_step1"))[0] == COMMITTED
+    groups.reset_mesh()
+    engine2 = _engine(0)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None and engine2.global_steps == 1
+
+
+# ----------------------------------------------------------------------
+# preemption handling
+# ----------------------------------------------------------------------
+def test_preemption_emergency_checkpoint(tmp_path):
+    engine = _engine(0, resilience={"preemption_handler": True,
+                                    "ckpt_dir": str(tmp_path)})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine._preempt.request()                    # deterministic signal stand-in
+    with pytest.raises(TrainingPreempted):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=1))
+    status, manifest = validate_tag(str(tmp_path / "emergency_step1"))
+    assert status == COMMITTED
+    assert manifest["global_step"] == 1
+    groups.reset_mesh()
+    engine2 = _engine(0)
+    engine2.load_checkpoint(str(tmp_path), tag="emergency_step1")
+    assert engine2.global_steps == 1
+
+
+def test_preemption_without_ckpt_dir_still_unwinds():
+    engine = _engine(0, resilience={"preemption_handler": True})
+    engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+    engine._preempt.request()
+    with pytest.raises(TrainingPreempted):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=1))
+
+
+# ----------------------------------------------------------------------
+# divergence sentinel
+# ----------------------------------------------------------------------
+def test_sentinel_overflow_streak_unit():
+    s = DivergenceSentinel(max_consecutive_skips=3, interval=1)
+    for step in range(1, 3):
+        s.push(step, loss=np.float32(1.0), overflow=np.asarray(True))
+        assert s.poll() is None
+    s.push(3, loss=np.float32(1.0), overflow=np.asarray(True))
+    assert s.poll() == "halt"
+    assert s.reason == "overflow_streak" and s.trip_step == 3
+    assert s.poll() is None                      # delivered exactly once
+    s.reset()
+    s.push(4, loss=np.float32(1.0), overflow=np.asarray(False))
+    assert s.poll() is None                      # streak cleared
+
+
+def test_sentinel_interval_batches_readback():
+    s = DivergenceSentinel(max_consecutive_skips=0, interval=4)
+    s.push(1, loss=np.float32(np.nan), overflow=None)
+    assert s.poll() is None                      # below interval: no fetch
+    for step in (2, 3, 4):
+        s.push(step, loss=np.float32(1.0), overflow=None)
+    assert s.poll() == "halt"                    # batch fetched, NaN found
+    assert s.trip_step == 1
+
+
+def test_poisoned_step_trips_sentinel_halt():
+    engine = _engine(0, resilience={"divergence_sentinel": True,
+                                    "fault_injection": {
+                                        "poison_grads_at": [0]}})
+    with pytest.raises(DivergenceError, match="nonfinite_loss"):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+
+
+def test_poisoned_step_auto_restores(tmp_path):
+    engine = _engine(0, resilience={"divergence_sentinel": True,
+                                    "on_divergence": "restore",
+                                    "fault_injection": {
+                                        "poison_grads_at": [2]}})
+    b = [random_batch(32, HIDDEN, seed=i) for i in range(4)]
+    engine.train_batch(batch=b[0])
+    engine.train_batch(batch=b[1])
+    engine.save_checkpoint(str(tmp_path))        # last-good at step 2
+    good = jax.device_get(engine.module_state_dict())
+    engine.train_batch(batch=b[2])               # poisoned -> auto-restore
+    assert engine.global_steps == 2              # rolled back
+    restored = jax.device_get(engine.module_state_dict())
+    np.testing.assert_array_equal(good["layer_0"]["w"],
+                                  restored["layer_0"]["w"])
+    # poison fired once: the retried step is clean and training continues
+    loss = float(engine.train_batch(batch=b[2]))
+    assert np.isfinite(loss)
+    assert engine.global_steps == 3
+
+
+def test_divergence_halts_when_no_restore_point():
+    engine = _engine(0, resilience={"divergence_sentinel": True,
+                                    "on_divergence": "restore",
+                                    "fault_injection": {
+                                        "poison_grads_at": [0]}})
+    with pytest.raises(DivergenceError):
+        engine.train_batch(batch=random_batch(32, HIDDEN, seed=0))
+
+
+# ----------------------------------------------------------------------
+# dataloader worker retry + ordered drain-through
+# ----------------------------------------------------------------------
+def _seq_source(n):
+    return iter([{"x": np.full((4,), i, np.float32)} for i in range(n)])
+
+
+def test_prefetch_retry_preserves_order_exactly():
+    from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+    inj = FaultInjector({"dataloader_next": {"fail_at": [2, 5]}})
+    it = DevicePrefetchIterator(_seq_source(6), max_retries=2, injector=inj)
+    got = [int(b["x"][0]) for b in it]
+    assert got == [0, 1, 2, 3, 4, 5]             # nothing skipped or reordered
+    assert inj.calls("dataloader_next") >= 8     # 6 batches + 2 retries
+    it.close()
+
+
+def test_prefetch_non_io_exception_is_never_retried():
+    """A non-OSError from the source is not transient: retrying a raised
+    generator would surface as a silent StopIteration (truncated epoch)."""
+    from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+
+    def feed():
+        yield {"x": np.zeros(4, np.float32)}
+        raise ValueError("boom in the feed")
+
+    it = DevicePrefetchIterator(feed(), max_retries=5)
+    next(it)
+    with pytest.raises(ValueError, match="boom in the feed"):
+        next(it)
+    it.close()
+
+
+def test_prefetch_fatal_after_retry_budget_drains_in_order():
+    from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+    # calls 0,1 produce batches; calls 2 and 3 both fail -> one retry
+    # (budget 1) then fatal.  The two prefetched batches must still be
+    # delivered, in order, before the error surfaces.
+    inj = FaultInjector({"dataloader_next": {"fail_at": [2, 3],
+                                             "exc": "OSError"}})
+    it = DevicePrefetchIterator(_seq_source(6), depth=4, max_retries=1,
+                                injector=inj)
+    assert int(next(it)["x"][0]) == 0
+    assert int(next(it)["x"][0]) == 1
+    with pytest.raises(OSError):
+        next(it)
+    it.close()
+
+
+def test_prefetch_zero_retries_is_immediately_fatal():
+    from deepspeed_tpu.runtime.dataloader import DevicePrefetchIterator
+    inj = FaultInjector({"dataloader_next": {"fail_at": [0]}})
+    it = DevicePrefetchIterator(_seq_source(3), max_retries=0, injector=inj)
+    with pytest.raises(OSError):
+        next(it)
+    it.close()
+
+
+def test_engine_prefetcher_survives_transient_worker_fault(tmp_path):
+    """End-to-end: async pipeline on, injector raising once in the worker —
+    training proceeds through the fault with the retry absorbing it."""
+    from unit.simple_model import random_dataset
+    engine = _engine(
+        0,
+        train_micro_batch_size_per_gpu=4,
+        async_pipeline={"enabled": True, "prefetch_depth": 2},
+        resilience={"dataloader_max_retries": 2,
+                    "dataloader_retry_backoff_secs": 0.0,
+                    "fault_injection": {
+                        "dataloader_next": {"fail_at": [1]}}})
+    data = random_dataset(256, HIDDEN, seed=0)
+    loader = engine.deepspeed_io(data)
+    it = iter(loader)
+    losses = [float(engine.train_batch(data_iter=it)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert engine._injector.calls("dataloader_next") >= 5
+    loader.close()
+
+
+# ----------------------------------------------------------------------
+# offline fsck
+# ----------------------------------------------------------------------
+def _load_fsck():
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", "ds_ckpt_fsck.py")
+    spec = importlib.util.spec_from_file_location("ds_ckpt_fsck", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fsck_reports_statuses_and_exit_codes(tmp_path, capsys):
+    fsck = _load_fsck()
+    _commit_toy_tag(tmp_path, "good", step=2)
+    _commit_toy_tag(tmp_path, "torn", step=4)
+    os.remove(tmp_path / "torn" / ".ds_commit")
+    os.makedirs(tmp_path / ".crash.tmp")
+    atomic_write_text(str(tmp_path / "latest"), "good")
+    assert fsck.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "good" in out and "no_marker" in out and "stale-tmp" in out
+
+    report = fsck.fsck(str(tmp_path), deep=True)
+    assert report["ok"]
+    assert report["latest_status"] == COMMITTED
+    assert {t["tag"]: t["status"] for t in report["tags"]} == {
+        "good": COMMITTED, "torn": NO_MARKER}
+
+    # point latest at the torn tag -> NOT OK, exit 1
+    atomic_write_text(str(tmp_path / "latest"), "torn")
+    assert fsck.main([str(tmp_path)]) == 1
+    # deep mode catches silently-shortened payloads behind a valid size?
+    # no — deep re-reads bytes; truncate below recorded size:
+    p = tmp_path / "good" / "payload.npz"
+    p.write_bytes(p.read_bytes()[:4])
+    report = fsck.fsck(str(tmp_path), deep=False)
+    assert {t["tag"]: t["status"] for t in report["tags"]}["good"] == PARTIAL
+
+
+# ----------------------------------------------------------------------
+# ACCEPTANCE: faulted train -> save -> kill -> resume, bit-identical fp32
+# ----------------------------------------------------------------------
+def test_acceptance_faulted_save_kill_resume_bitwise(tmp_path):
+    """ISSUE acceptance criterion: the fault injector fails the first two
+    checkpoint writes and the newest tag is corrupted post-hoc; a fresh
+    process restores the newest *valid* checkpoint and continues with a
+    trajectory bit-identical to an unfaulted run."""
+    ckpt = tmp_path / "ckpt"
+    batches = [random_batch(32, HIDDEN, seed=i) for i in range(6)]
+
+    # unfaulted reference: 2 steps, then record steps 3..6
+    ref_engine = _engine(0)
+    for b in batches[:2]:
+        ref_engine.train_batch(batch=b)
+    ref_tail = np.asarray(
+        [float(ref_engine.train_batch(batch=b)) for b in batches[2:]],
+        dtype=np.float32)
+
+    # faulted run: first two ckpt_save attempts fail (retries absorb them)
+    groups.reset_mesh()
+    engine = _engine(0, telemetry=_telemetry_cfg(tmp_path, "acceptjob"),
+                     resilience={"retry_backoff_secs": 0.0,
+                                 "retry_jitter": 0.0,
+                                 "fault_injection": {
+                                     "ckpt_save": {"fail_times": 2}}})
+    for b in batches[:2]:
+        engine.train_batch(batch=b)
+    engine.save_checkpoint(str(ckpt))            # global_step2: 3rd try wins
+    assert engine._injector.calls("ckpt_save") == 3
+    for b in batches[2:4]:
+        engine.train_batch(batch=b)
+    engine.save_checkpoint(str(ckpt))            # global_step4 (newest)
+    # corrupt the newest tag (torn commit: marker lost)
+    os.remove(ckpt / "global_step4" / ".ds_commit")
+
+    # "kill": a brand-new engine resumes from scratch
+    groups.reset_mesh()
+    resumed = _engine(0, telemetry=_telemetry_cfg(tmp_path, "resumejob"))
+    path, _ = resumed.load_checkpoint(str(ckpt))
+    assert path is not None
+    assert resumed.global_steps == 2             # newest VALID tag
+    got_tail = np.asarray(
+        [float(resumed.train_batch(batch=b)) for b in batches[2:]],
+        dtype=np.float32)
+    np.testing.assert_array_equal(got_tail, ref_tail)  # bit-identical fp32
+    faults = [e["name"] for e in _events(tmp_path, "resumejob")
+              if e["kind"] == "fault"]
+    assert "fault/ckpt_fallback" in faults

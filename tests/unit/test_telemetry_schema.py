@@ -62,6 +62,11 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.gauge("engine/loss", 0.5)
     tel.comm("all_reduce", 1 << 20, "dp")
     tel.emit("meta", "engine/init", attrs={"mesh": {"dp": 8}})
+    tel.fault("fault/retry", attrs={"op": "ckpt_save[t1]", "attempt": 1,
+                                    "max_retries": 3, "error": "OSError()",
+                                    "delay_s": 0.5})
+    tel.fault("fault/ckpt_fallback", step=4, attrs={"to": "global_step2"})
+    tel.fault("fault/preempt_requested")
     wd = StepStallWatchdog(tel, stall_factor=1.0, min_stall_secs=0.0)
     wd.beat(0)
     wd.beat(1)
