@@ -380,6 +380,102 @@ def _worker_dispatch(spec):
     print(json.dumps(_dispatch_bench(spec)))
 
 
+def _serving_bench(spec=None):
+    """CPU-runnable serving-overload micro-bench (returns a dict so tests
+    can call it in-process; the ``serving`` worker prints it).
+
+    Drives the continuous-batching engine at an offered load well above
+    capacity (``arrivals_per_step`` new requests per decode step against a
+    small batch) with a bounded queue and the shed-oldest policy, and
+    measures what the hardening layer is FOR: the shed rate under overload
+    and the served-step latency tail (p50/p99) — plus a drive-by leak
+    audit, which must come back empty."""
+    spec = spec or {}
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.robustness import RequestRejected
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    n_requests = int(spec.get("requests", 48))
+    arrivals = int(spec.get("arrivals_per_step", 3))
+    max_new = int(spec.get("max_new_tokens", 8))
+    warmup_steps = int(spec.get("warmup_steps", 3))
+    policy = spec.get("policy", "shed-oldest")
+
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    tmp = tempfile.mkdtemp(prefix="serving_bench_")
+    tel = Telemetry().configure(
+        TelemetryConfig({"enabled": True, "output_path": tmp,
+                         "job_name": "serving_bench"}), rank=0)
+    eng = ServingEngine(
+        model, params, max_batch=4, page_size=8, max_seq=64,
+        dtype=jnp.float32, telemetry=tel,
+        serving={"max_queue": int(spec.get("max_queue", 8)),
+                 "overload_policy": policy,
+                 "queue_high_watermark": 6, "queue_low_watermark": 2})
+    rng = np.random.default_rng(0)
+    # prompt lengths 3..7 share one prefill bucket (8), so the latency
+    # tail measures scheduling, not a late XLA compile of a new shape
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).tolist()
+               for n in rng.integers(3, 8, n_requests)]
+    rejected = 0
+    step_ms = []
+    finished = {}
+    next_req, si = 0, 0
+    while next_req < n_requests or eng.queue or eng.n_active:
+        for _ in range(arrivals):
+            if next_req >= n_requests:
+                break
+            try:
+                eng.add_request(next_req, prompts[next_req],
+                                max_new_tokens=max_new)
+            except RequestRejected:
+                rejected += 1
+            next_req += 1
+        t0 = time.perf_counter()
+        finished.update(eng.step())
+        dt = (time.perf_counter() - t0) * 1000.0
+        if si >= warmup_steps:
+            step_ms.append(dt)
+        si += 1
+    health = eng.health()
+    tel.close()
+    vals = sorted(step_ms) or [0.0]
+
+    def pct(q):
+        return vals[min(len(vals) - 1,
+                        max(0, int(round(q / 100.0 * (len(vals) - 1)))))]
+
+    shed = eng.stats["shed"]
+    return {
+        "offered_requests": n_requests,
+        "served": eng.stats["finished"],
+        "shed": shed,
+        "rejected": rejected,
+        "shed_rate": round((shed + rejected) / max(1, n_requests), 3),
+        "step_p50_ms": round(pct(50), 2),
+        "step_p99_ms": round(pct(99), 2),
+        "steps": si,
+        "policy": policy,
+        "leaks": eng.leak_report(),
+        "oldest_request_age_s": health["oldest_request_age_s"],
+    }
+
+
+def _worker_serving(spec):
+    print(json.dumps(_serving_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -437,6 +533,23 @@ def _attach_dispatch(out):
     return out
 
 
+def _attach_serving(out):
+    """Attach the serving-overload micro-bench under the stable key
+    ``cpu_serving`` (CPU-runnable like the dispatch bench, so the
+    shed-rate / tail-latency trajectory grows even when the TPU tunnel is
+    down).  Budget-gated; a failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "serving", {}, timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_serving"] = res
+    else:
+        out.setdefault("notes", {})["serving"] = (err or "")[:200]
+    return out
+
+
 def main():
     errors = {}
 
@@ -463,7 +576,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_attach_dispatch(_promote_cached(out))))
+            print(json.dumps(_attach_serving(_attach_dispatch(_promote_cached(out)))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -551,7 +664,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_attach_dispatch(_promote_cached(out))))
+        print(json.dumps(_attach_serving(_attach_dispatch(_promote_cached(out)))))
         return
 
     tps = train["tokens_per_sec"]
@@ -626,7 +739,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_attach_dispatch(result)))
+    print(json.dumps(_attach_serving(_attach_dispatch(result))))
 
 
 if __name__ == "__main__":
@@ -649,6 +762,8 @@ if __name__ == "__main__":
             _worker_params_probe(spec)
         elif which == "dispatch":
             _worker_dispatch(spec)
+        elif which == "serving":
+            _worker_serving(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

@@ -2,6 +2,13 @@
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.robustness import (AdmissionController,
+                                                RequestRejected,
+                                                RequestResult,
+                                                ServingRobustnessConfig,
+                                                ServingStalled)
 from deepspeed_tpu.inference.serving import ServingEngine
 
-__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "ServingEngine"]
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "ServingEngine",
+           "RequestRejected", "RequestResult", "ServingRobustnessConfig",
+           "ServingStalled", "AdmissionController"]

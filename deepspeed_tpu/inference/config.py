@@ -45,6 +45,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # ZeRO-Inference (reference engine.py:1581 offload-for-inference):
     # {"offload_param": {"device": "cpu"|"nvme", "nvme_path": ...}}
     zero = {}
+    # serving hardening (inference/robustness.py): admission control,
+    # deadlines, load shedding, fault injection for the serving engine
+    serving = {}
 
     def _validate(self):
         if isinstance(self.tensor_parallel, dict):
@@ -53,6 +56,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
             self.tensor_parallel.tp_size = self.mp_size
         if isinstance(self.quant, dict):
             self.quant = QuantizationConfig(self.quant)
+        if isinstance(self.serving, dict):
+            from deepspeed_tpu.inference.robustness import \
+                ServingRobustnessConfig
+            self.serving = ServingRobustnessConfig(self.serving)
 
     @property
     def tp_size(self):

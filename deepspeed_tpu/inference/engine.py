@@ -463,6 +463,36 @@ class InferenceEngine:
     _generate = generate  # parity alias
 
     # ------------------------------------------------------------------
+    def create_serving_engine(self, max_batch: int = 8,
+                              page_size: int = 128,
+                              num_pages: Optional[int] = None,
+                              max_seq: int = 2048,
+                              eos_token_id: Optional[Any] = None,
+                              decode_chunk: int = 1, **kwargs):
+        """Build a continuous-batching ``ServingEngine`` over this
+        engine's model/params, wiring the config's ``serving`` hardening
+        block (admission control, deadlines, load shedding, fault
+        injection).  Not available for weight-streaming or quantized
+        engines — the paged decode step consumes raw dense weights."""
+        if self._streaming:
+            raise NotImplementedError(
+                "paged serving does not compose with ZeRO-Inference "
+                "weight streaming")
+        if getattr(self, "_quantized", False):
+            raise NotImplementedError(
+                "paged serving expects dense weights; disable weight-only "
+                "quantization")
+        from deepspeed_tpu.inference.serving import ServingEngine
+        kwargs.setdefault("serving", getattr(self._config, "serving", None))
+        return ServingEngine(self.module, self.params,
+                             max_batch=max_batch, page_size=page_size,
+                             num_pages=num_pages, max_seq=max_seq,
+                             dtype=self.dtype, eos_token_id=eos_token_id,
+                             tp_size=max(1, self._config.tp_size),
+                             ep_size=max(1, self._config.ep_size),
+                             decode_chunk=decode_chunk, **kwargs)
+
+    # ------------------------------------------------------------------
     def profile_model_time(self, use_cuda_events=False):
         logger.warning("use jax.profiler for per-op timing")
 

@@ -1,0 +1,178 @@
+"""Serving hardening layer: typed rejection, admission control, deadlines,
+load shedding, fault isolation, and graceful drain for ``ServingEngine``.
+
+Parity rationale: the training side got its fault-tolerance layer in
+``runtime/resilience.py`` (durable checkpoints, preemption, deterministic
+fault injection); this module applies the same discipline to the inference
+path.  The ragged-paged-attention serving design (PAPERS.md "Ragged Paged
+Attention") assumes the slot/page bookkeeping survives hostile traffic,
+and TPU serving comparisons measure *tail latency under load* — which
+requires shedding requests with typed reasons, not crashing the batch.
+
+What lives here (the host-control-flow half; ``inference/serving.py``
+wires it into the decode loop):
+
+* :class:`RequestRejected` — structured admission-time rejection (oversized
+  prompt, infeasible page reservation, duplicate id, bad sampling params,
+  bounded-queue overflow, draining).  One bad request can never take down
+  the batch.
+* :class:`ServingRobustnessConfig` — the ``serving`` config block: bounded
+  wait queue, high/low watermarks on queue depth and free KV pages, the
+  overload policy (``reject`` | ``shed-oldest`` | ``block``), default
+  deadlines, and the serving fault-injection spec.
+* :class:`AdmissionController` — hysteresis watermark tracking: overload
+  engages at the high watermark (queue) / low watermark (free pages) and
+  releases only once pressure drops past the low/high side, so admission
+  doesn't flap at the boundary.
+* :class:`RequestResult` — the typed terminal record for every request
+  that did NOT finish normally (shed / deadline / evicted / drained),
+  carrying partial output.
+* :class:`ServingStalled` — the typed ``generate()`` stall error carrying
+  every already-completed result plus a diagnostic snapshot, replacing the
+  result-destroying ``assert``.
+
+All telemetry from this layer rides the frozen ``serve`` event kind
+(``scripts/check_telemetry_schema.py``): ``serve/admit``, ``serve/reject``,
+``serve/shed``, ``serve/deadline``, ``serve/evict``, ``serve/drain``,
+``serve/finish``, ``serve/fault``.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, List
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+# ----------------------------------------------------------------------
+# typed reasons (frozen vocabulary: telemetry attrs + docs/serving.md)
+# ----------------------------------------------------------------------
+# admission-time rejections (RequestRejected.reason)
+REJECT_OVERSIZED = "oversized_prompt"     # prompt + budget exceeds max_seq
+REJECT_INFEASIBLE = "infeasible_pages"    # reservation can never fit pool
+REJECT_DUPLICATE = "duplicate_id"         # req_id already queued/active
+REJECT_BAD_SAMPLING = "bad_sampling"      # top_k/top_p/temperature invalid
+REJECT_BAD_REQUEST = "bad_request"        # empty prompt / non-positive budget
+REJECT_QUEUE_FULL = "queue_full"          # bounded queue at hard cap
+REJECT_OVERLOADED = "overloaded"          # watermark overload, policy=reject
+REJECT_DRAINING = "draining"              # drain() stopped admission
+
+# post-admission terminations (RequestResult.reason)
+SHED_OLDEST = "shed_oldest"               # displaced by newer arrival
+SHED_DEADLINE = "deadline"                # TTL expired (queued or mid-flight)
+SHED_DRAIN = "drain"                      # drain() gave up on it
+EVICT_FAULT = "fault"                     # per-slot failure isolated
+
+REJECT_REASONS = (REJECT_OVERSIZED, REJECT_INFEASIBLE, REJECT_DUPLICATE,
+                  REJECT_BAD_SAMPLING, REJECT_BAD_REQUEST, REJECT_QUEUE_FULL,
+                  REJECT_OVERLOADED, REJECT_DRAINING)
+TERMINAL_REASONS = (SHED_OLDEST, SHED_DEADLINE, SHED_DRAIN, EVICT_FAULT)
+
+OVERLOAD_POLICIES = ("reject", "shed-oldest", "block")
+
+
+class RequestRejected(Exception):
+    """``add_request`` refused this request — the engine state is untouched
+    and every other request keeps serving.  ``reason`` is one of
+    :data:`REJECT_REASONS`; ``detail`` is the human-readable specifics."""
+
+    def __init__(self, req_id, reason: str, detail: str = ""):
+        self.req_id = req_id
+        self.reason = reason
+        self.detail = detail
+        super().__init__(
+            f"request {req_id!r} rejected ({reason})"
+            + (f": {detail}" if detail else ""))
+
+
+class ServingStalled(RuntimeError):
+    """``generate()`` (or ``drain``) could not make progress within its
+    step budget.  Unlike the assert it replaces, every already-completed
+    result survives in ``partial`` and the stuck state is reported."""
+
+    def __init__(self, partial, stuck_req_ids, free_pages, queue_depth,
+                 steps):
+        self.partial = dict(partial)
+        self.stuck_req_ids = list(stuck_req_ids)
+        self.free_pages = int(free_pages)
+        self.queue_depth = int(queue_depth)
+        self.steps = int(steps)
+        super().__init__(
+            f"serving stalled after {steps} steps: "
+            f"{len(self.partial)} finished, stuck={self.stuck_req_ids}, "
+            f"free_pages={free_pages}, queue_depth={queue_depth}")
+
+
+@dataclass
+class RequestResult:
+    """Terminal record for a request that did not finish normally.
+    ``tokens`` is the partial output (prompt + everything generated before
+    termination); ``status`` is one of ``shed`` / ``deadline`` /
+    ``evicted`` / ``drained``."""
+    req_id: Any
+    status: str
+    reason: str
+    tokens: List[int] = field(default_factory=list)
+    n_generated: int = 0
+    detail: str = ""
+
+
+class ServingRobustnessConfig(DeepSpeedConfigModel):
+    """The ``serving`` config block (``DeepSpeedInferenceConfig.serving``
+    or the ``ServingEngine(serving=...)`` kwarg).  Defaults preserve the
+    pre-hardening behaviour: unbounded queue, no deadlines, no shedding —
+    only the typed validation is always on."""
+
+    max_queue = 0                   # hard queue cap (0 = unbounded)
+    queue_high_watermark = 0        # overload engages at this depth (0=off)
+    queue_low_watermark = 0         # ...and releases at this depth
+    free_page_low_watermark = 0     # overload engages at <= this many free
+    overload_policy = "reject"      # "reject" | "shed-oldest" | "block"
+    block_max_steps = 256           # policy=block: step budget before reject
+    default_deadline_s = 0.0        # TTL applied when add_request has none
+    max_prompt_tokens = 0           # extra prompt cap under max_seq (0=off)
+    step_fault_limit = 8            # consecutive serve_step faults -> raise
+    fault_injection = {}            # FaultInjector spec (serving sites)
+
+    def _validate(self):
+        if self.overload_policy not in OVERLOAD_POLICIES:
+            raise ValueError(
+                f"serving.overload_policy must be one of {OVERLOAD_POLICIES}")
+        for k in ("max_queue", "queue_high_watermark", "queue_low_watermark",
+                  "free_page_low_watermark", "block_max_steps",
+                  "max_prompt_tokens", "step_fault_limit"):
+            if int(getattr(self, k)) < 0:
+                raise ValueError(f"serving.{k} must be >= 0")
+        if float(self.default_deadline_s) < 0:
+            raise ValueError("serving.default_deadline_s must be >= 0")
+        if self.queue_high_watermark and \
+                int(self.queue_low_watermark) > int(self.queue_high_watermark):
+            raise ValueError("serving.queue_low_watermark must be <= "
+                             "queue_high_watermark")
+
+
+class AdmissionController:
+    """Watermark hysteresis over (queue depth, free KV pages).
+
+    Overload engages when the queue reaches ``queue_high_watermark`` OR
+    free pages fall to ``free_page_low_watermark``; it releases only when
+    the queue is back at ``queue_low_watermark`` AND free pages are above
+    the page watermark — so one request finishing at the boundary doesn't
+    flap admission open and shut."""
+
+    def __init__(self, cfg: ServingRobustnessConfig):
+        self.cfg = cfg
+        self.overloaded = False
+
+    def update(self, queue_depth: int, free_pages: int) -> bool:
+        """Re-evaluate and return the overload state."""
+        qhi = int(self.cfg.queue_high_watermark)
+        qlo = int(self.cfg.queue_low_watermark)
+        plo = int(self.cfg.free_page_low_watermark)
+        if not self.overloaded:
+            if (qhi and queue_depth >= qhi) or (plo and free_pages <= plo):
+                self.overloaded = True
+        else:
+            queue_ok = (not qhi) or queue_depth <= qlo
+            pages_ok = (not plo) or free_pages > plo
+            if queue_ok and pages_ok:
+                self.overloaded = False
+        return self.overloaded

@@ -14,9 +14,21 @@ host control flow (``PagedAllocator``); prefill and the batched decode
 step are jitted device programs over ``CausalTransformerLM.
 apply_with_paged_cache``.  Prefill lengths are bucketed to powers of two
 to bound recompilation.
+
+Hardening (``inference/robustness.py``): ``add_request`` raises typed
+:class:`RequestRejected` instead of asserts; a bounded queue with
+watermark admission control sheds/rejects/blocks under overload;
+per-request deadlines cancel queued and mid-flight work at step
+boundaries; a per-slot fault (sampler exception or injected
+``serve_sample``) evicts ONE request with its partial output while the
+rest of the batch keeps serving; ``drain()`` quiesces the engine and
+``health()`` snapshots its state onto the telemetry registry.  Injected
+``serve_step`` / ``page_alloc`` faults are retried without mutating any
+request state, so recovered requests stay bit-identical.
 """
 
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -24,7 +36,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.ops.paged_attention import PagedAllocator
+from deepspeed_tpu.inference.robustness import (
+    EVICT_FAULT, REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING, REJECT_DRAINING,
+    REJECT_DUPLICATE, REJECT_INFEASIBLE, REJECT_OVERLOADED,
+    REJECT_OVERSIZED, REJECT_QUEUE_FULL, SHED_DEADLINE, SHED_DRAIN,
+    SHED_OLDEST, AdmissionController, RequestRejected, RequestResult,
+    ServingRobustnessConfig, ServingStalled)
+from deepspeed_tpu.monitor.telemetry import get_telemetry
+from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
+                                               PagedAllocator)
+from deepspeed_tpu.runtime.resilience import FaultInjector
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -39,6 +60,8 @@ class _Request:
     top_p: float = 1.0          # 1.0 = off
     out: List[int] = field(default_factory=list)
     last_token: Optional[int] = None
+    submit_time: float = 0.0
+    deadline: float = 0.0       # absolute clock time; 0.0 = no deadline
 
 
 class ServingEngine:
@@ -55,7 +78,15 @@ class ServingEngine:
                  page_size: int = 128, num_pages: Optional[int] = None,
                  max_seq: int = 2048, dtype=jnp.bfloat16,
                  eos_token_id: Optional[int] = None, tp_size: int = 1,
-                 ep_size: int = 1, decode_chunk: int = 1):
+                 ep_size: int = 1, decode_chunk: int = 1,
+                 serving=None, telemetry=None, injector=None, clock=None):
+        """``serving``: a :class:`ServingRobustnessConfig` or its dict —
+        defaults keep pre-hardening behaviour (unbounded queue, no
+        deadlines).  ``injector``: a ``FaultInjector`` for the serving
+        sites (built from ``serving.fault_injection`` when omitted).
+        ``clock``: monotonic-seconds callable, injectable so deadline
+        tests don't sleep.  ``telemetry``: explicit Telemetry instance;
+        None uses the process singleton at event time."""
         self.model = model
         self.config = model.config
         self.max_batch = max_batch
@@ -94,9 +125,18 @@ class ServingEngine:
                                           P(None, None, "tp", None, None)))
         self.params = params
         self.caches = caches
+        if isinstance(serving, ServingRobustnessConfig):
+            self.serving = serving
+        else:
+            self.serving = ServingRobustnessConfig(serving or {})
+        if injector is None:
+            injector = FaultInjector.from_config(
+                self.serving.fault_injection)
+        self.injector = injector
         self.alloc = PagedAllocator(num_pages, page_size,
                                     self.max_pages_per_seq,
-                                    reserve_scratch=True)
+                                    reserve_scratch=True,
+                                    injector=injector)
         self.eos = eos_token_id
         if not self.config.use_rope and not self.config.use_alibi:
             # learned positions: gathers past the table CLAMP under jit
@@ -109,6 +149,10 @@ class ServingEngine:
         self.slots: List[Optional[_Request]] = [None] * max_batch
         self.queue: List[_Request] = []
         self.finished: Dict[Any, List[int]] = {}
+        # terminal records for requests that did NOT finish normally
+        # (shed / deadline / evicted / drained) — the caller's delivery
+        # channel for partial outputs; drain with pop_terminated()
+        self.terminated: Dict[Any, RequestResult] = {}
         self.lengths = np.zeros(max_batch, np.int32)
         # +1 overrun column, permanently the scratch page (page 0): when a
         # reservation fills the whole table (prompt + max_new == max_seq),
@@ -132,31 +176,195 @@ class ServingEngine:
         assert self.decode_chunk >= 1
         self._chunk_fns = {}   # use_filters(bool) -> compiled chunk fn
 
+        self._clock = clock if clock is not None else time.monotonic
+        self._telemetry = telemetry
+        self._admission = AdmissionController(self.serving)
+        self._consec_step_faults = 0
+        self.draining = False
+        self.stats = {"admitted": 0, "rejected": 0, "shed": 0,
+                      "deadline": 0, "evicted": 0, "finished": 0,
+                      "step_faults": 0, "drains": 0}
+
+    # -- telemetry -------------------------------------------------------
+    @property
+    def telemetry(self):
+        return self._telemetry if self._telemetry is not None \
+            else get_telemetry()
+
+    def _serve_event(self, name, **attrs):
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        clean = {k: (v if isinstance(v, (int, float, str)) else str(v))
+                 for k, v in attrs.items() if v is not None and v != ""}
+        tel.serve(name, attrs=clean or None)
+
     # -- host control flow ---------------------------------------------
+    def _reject(self, req_id, reason, detail=""):
+        self.stats["rejected"] += 1
+        self._serve_event("serve/reject", req_id=req_id, reason=reason,
+                          detail=detail)
+        raise RequestRejected(req_id, reason, detail)
+
     def add_request(self, req_id, prompt_ids, max_new_tokens: int = 32,
                     temperature: float = 0.0, seed: int = 0,
-                    top_k: int = 0, top_p: float = 1.0):
+                    top_k: int = 0, top_p: float = 1.0,
+                    deadline_s: Optional[float] = None):
+        """Validate and enqueue one request.  Raises
+        :class:`RequestRejected` (typed reason, engine state untouched)
+        instead of asserting; ``deadline_s`` is a TTL from now — the
+        request is cancelled at the next step boundary once it expires,
+        queued or mid-flight."""
+        cfg = self.serving
+        if self.draining:
+            self._reject(req_id, REJECT_DRAINING,
+                         "engine is draining; admission stopped")
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
-        assert len(prompt) + max_new_tokens <= self.max_seq, \
-            f"request {req_id} exceeds max_seq {self.max_seq}"
+        if not prompt or int(max_new_tokens) <= 0:
+            self._reject(req_id, REJECT_BAD_REQUEST,
+                         f"prompt len {len(prompt)}, "
+                         f"max_new_tokens {max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.max_seq:
+            self._reject(req_id, REJECT_OVERSIZED,
+                         f"prompt {len(prompt)} + budget {max_new_tokens} "
+                         f"exceeds max_seq {self.max_seq}")
+        if cfg.max_prompt_tokens and len(prompt) > int(cfg.max_prompt_tokens):
+            self._reject(req_id, REJECT_OVERSIZED,
+                         f"prompt {len(prompt)} exceeds "
+                         f"serving.max_prompt_tokens {cfg.max_prompt_tokens}")
         total = len(prompt) + max_new_tokens
         bucket = min(self._bucket(len(prompt)), self.max_seq)
         need = -(-max(total, bucket) // self.page_size)
         usable = self.alloc.num_pages - 1   # minus the scratch page
-        assert need <= usable, (
-            f"request {req_id} needs {need} pages but the pool only has "
-            f"{usable}; it would deadlock the queue head-of-line")
-        assert req_id not in self.alloc.seq_pages and \
-            req_id not in self.finished and \
-            all(r.req_id != req_id for r in self.queue), \
-            f"duplicate req_id {req_id!r}"
-        assert 0.0 < top_p <= 1.0 and top_k >= 0, (top_k, top_p)
+        if need > usable:
+            self._reject(req_id, REJECT_INFEASIBLE,
+                         f"needs {need} pages but the pool only has "
+                         f"{usable}; it would deadlock the queue "
+                         "head-of-line")
+        if req_id in self.alloc.seq_pages or req_id in self.finished or \
+                any(r.req_id == req_id for r in self.queue):
+            self._reject(req_id, REJECT_DUPLICATE,
+                         "req_id already queued, active, or undelivered")
+        if not (0.0 < top_p <= 1.0) or top_k < 0 or temperature < 0.0:
+            self._reject(req_id, REJECT_BAD_SAMPLING,
+                         f"top_k={top_k}, top_p={top_p}, "
+                         f"temperature={temperature}")
+        self._apply_admission_policy(req_id)
+        now = self._clock()
+        ttl = deadline_s if deadline_s is not None \
+            else (float(cfg.default_deadline_s) or None)
         self.queue.append(_Request(req_id, prompt, max_new_tokens,
-                                   temperature, seed, top_k, top_p))
+                                   temperature, seed, top_k, top_p,
+                                   submit_time=now,
+                                   deadline=(now + ttl) if ttl else 0.0))
+        self.stats["admitted"] += 1
+        self._serve_event("serve/admit", req_id=req_id,
+                          queue_depth=len(self.queue),
+                          free_pages=self.alloc.free_page_count)
         self._admit()
+
+    def _admission_pressure(self):
+        cfg = self.serving
+        hard_full = bool(cfg.max_queue) and \
+            len(self.queue) >= int(cfg.max_queue)
+        overloaded = self._admission.update(len(self.queue),
+                                            self.alloc.free_page_count)
+        return hard_full, overloaded
+
+    def _apply_admission_policy(self, req_id):
+        """Admission control for one arrival: no-op until the hard queue
+        cap or a watermark trips, then apply ``serving.overload_policy``
+        — ``reject`` raises, ``shed-oldest`` displaces the oldest queued
+        request, ``block`` synchronously steps the engine until pressure
+        clears or ``block_max_steps`` is spent (then rejects)."""
+        hard_full, overloaded = self._admission_pressure()
+        if not hard_full and not overloaded:
+            return
+        policy = self.serving.overload_policy
+        if policy == "block":
+            for _ in range(int(self.serving.block_max_steps)):
+                if not (self.queue or self.n_active):
+                    break
+                # requests finishing while the arrival blocks stay
+                # retrievable from ``finished`` — the caller isn't in its
+                # step() loop to catch them
+                self.finished.update(self.step())
+                hard_full, overloaded = self._admission_pressure()
+                if not hard_full and not overloaded:
+                    return
+        elif policy == "shed-oldest" and self.queue:
+            # the newcomer displaces the oldest QUEUED request (head of
+            # line), so queue depth is unchanged and admission proceeds;
+            # pure page-pressure overload with an empty queue still
+            # rejects — shedding queued work frees no pages
+            victim = self.queue.pop(0)
+            self._terminate(victim, "shed", SHED_OLDEST,
+                            detail=f"displaced by {req_id!r}")
+            self.stats["shed"] += 1
+            self._serve_event("serve/shed", req_id=victim.req_id,
+                              reason=SHED_OLDEST)
+            return
+        reason = REJECT_QUEUE_FULL if hard_full else REJECT_OVERLOADED
+        self._reject(req_id, reason,
+                     f"queue_depth={len(self.queue)}, "
+                     f"free_pages={self.alloc.free_page_count}, "
+                     f"policy={policy}")
 
     def _bucket(self, n: int) -> int:
         return 1 << max(3, math.ceil(math.log2(max(n, 1))))
+
+    def _terminate(self, req: _Request, status: str, reason: str,
+                   detail: str = ""):
+        """Record the typed terminal result for a request leaving the
+        engine abnormally; the partial output (prompt + generated) rides
+        in the record.  Pages are the caller's job (queued requests own
+        none)."""
+        self._rng.pop(req.req_id, None)
+        self.terminated[req.req_id] = RequestResult(
+            req_id=req.req_id, status=status, reason=reason,
+            tokens=list(req.prompt) + list(req.out),
+            n_generated=len(req.out), detail=detail)
+
+    def _evict_slot(self, slot: int, status: str, reason: str,
+                    detail: str = ""):
+        """Remove ONE active request: free its pages immediately, zero its
+        table row and length, record the terminal result.  The rest of the
+        batch is untouched."""
+        req = self.slots[slot]
+        self.alloc.free_sequence(req.req_id)
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        self.tables[slot, :] = 0
+        self._terminate(req, status, reason, detail)
+
+    def _expire_deadlines(self):
+        """Cancel every expired request at this step boundary — queued
+        requests are dropped from the queue, mid-flight ones are evicted
+        with their pages freed immediately."""
+        now = self._clock()
+        keep, expired = [], []
+        for req in self.queue:
+            (expired if req.deadline and now >= req.deadline
+             else keep).append(req)
+        self.queue = keep
+        for req in expired:
+            self._terminate(req, "deadline", SHED_DEADLINE,
+                            detail="expired while queued")
+            self.stats["deadline"] += 1
+            self._serve_event("serve/deadline", req_id=req.req_id,
+                              reason=SHED_DEADLINE, where="queued")
+        evicted = False
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.deadline and now >= req.deadline:
+                rid = req.req_id
+                self._evict_slot(slot, "deadline", SHED_DEADLINE,
+                                 detail="expired mid-flight")
+                self.stats["deadline"] += 1
+                self._serve_event("serve/deadline", req_id=rid,
+                                  reason=SHED_DEADLINE, where="active")
+                evicted = True
+        if evicted:
+            self._admit()
 
     def _admit(self):
         for slot in range(self.max_batch):
@@ -168,17 +376,35 @@ class ServingEngine:
             need_pages = -(-max(total, bucket) // self.page_size)
             if not self.alloc.can_allocate(need_pages):
                 return          # head-of-line: keep FIFO order
-            self.queue.pop(0)
             # full reservation (prompt + budget) at admission: an admitted
             # request can NEVER deadlock on pages mid-flight (no vLLM-style
             # preemption/recompute machinery needed); only bucket-padding
-            # surplus is returned after prefill
-            pages = self.alloc.allocate(req.req_id, max(total, bucket))
+            # surplus is returned after prefill.  Allocate BEFORE popping:
+            # an injected page_alloc fault leaves nothing mutated and the
+            # request retries from the queue on the next step, unchanged.
+            try:
+                pages = self.alloc.allocate(req.req_id, max(total, bucket))
+            except PageAllocationError as e:
+                self.stats["step_faults"] += 1
+                self._serve_event("serve/fault", req_id=req.req_id,
+                                  site="page_alloc", error=str(e))
+                return
+            self.queue.pop(0)
             self.tables[slot, :] = 0
             self.tables[slot, :len(pages)] = pages
             self.lengths[slot] = 0
             self.slots[slot] = req
-            self._prefill(slot, req, bucket)
+            try:
+                self._prefill(slot, req, bucket)
+            except Exception as e:   # fault isolation: only THIS request
+                logger.warning(f"evicting request {req.req_id!r} after "
+                               f"prefill fault: {e}")
+                self._evict_slot(slot, "evicted", EVICT_FAULT,
+                                 detail=str(e))
+                self.stats["evicted"] += 1
+                self._serve_event("serve/evict", req_id=req.req_id,
+                                  reason=EVICT_FAULT, error=str(e))
+                continue
             if bucket > total:
                 self.alloc.shrink(req.req_id, total)
                 pages = self.alloc.seq_pages[req.req_id]
@@ -205,6 +431,8 @@ class ServingEngine:
             req, np.asarray(logits[0, len(req.prompt) - 1]))
 
     def _sample(self, req: _Request, logits: np.ndarray) -> int:
+        if self.injector is not None:
+            self.injector.check("serve_sample")
         if req.temperature <= 0.0:
             return int(np.argmax(logits))
         rng = self._rng.setdefault(req.req_id,
@@ -240,6 +468,9 @@ class ServingEngine:
         self.slots[slot] = None
         self.lengths[slot] = 0
         self.tables[slot, :] = 0
+        self.stats["finished"] += 1
+        self._serve_event("serve/finish", req_id=req.req_id,
+                          n_generated=len(req.out))
         self._admit()
 
     @property
@@ -370,7 +601,25 @@ class ServingEngine:
     def step(self) -> Dict[Any, List[int]]:
         """Advance every active request by one token (``decode_chunk``
         tokens when configured); returns ONLY the requests that finished
-        during this step (req_id → full tokens)."""
+        during this step (req_id → full tokens).  Expired deadlines are
+        cancelled first; an injected ``serve_step`` fault returns {}
+        WITHOUT mutating any request (the retry serves identically), and
+        raises only after ``serving.step_fault_limit`` consecutive
+        faults."""
+        self._expire_deadlines()
+        if self.injector is not None:
+            try:
+                self.injector.check("serve_step")
+            except Exception as e:
+                self._consec_step_faults += 1
+                self.stats["step_faults"] += 1
+                self._serve_event("serve/fault", site="serve_step",
+                                  error=str(e))
+                if self._consec_step_faults > \
+                        int(self.serving.step_fault_limit):
+                    raise
+                return {}
+            self._consec_step_faults = 0
         self._admit()
         if self.n_active == 0:
             return {}
@@ -388,7 +637,7 @@ class ServingEngine:
         # finishing frees slots, which admits (and PREFILLS) queued
         # requests — defer that until after the loop so a mid-loop
         # admission is never mistaken for a slot this decode step served
-        done_slots = []
+        done_slots, fault_slots = [], []
         done_now = {}
         for slot, req in enumerate(self.slots):
             if req is None:
@@ -400,7 +649,20 @@ class ServingEngine:
             if ended or len(req.out) >= req.max_new_tokens:
                 done_slots.append(slot)
             else:
-                req.last_token = self._sample(req, logits_np[slot])
+                try:
+                    req.last_token = self._sample(req, logits_np[slot])
+                except Exception as e:   # per-slot fault isolation
+                    fault_slots.append((slot, str(e)))
+        for slot, err in fault_slots:
+            rid = self.slots[slot].req_id
+            logger.warning(f"evicting request {rid!r} after sampler "
+                           f"fault: {err}")
+            self._evict_slot(slot, "evicted", EVICT_FAULT, detail=err)
+            self.stats["evicted"] += 1
+            self._serve_event("serve/evict", req_id=rid,
+                              reason=EVICT_FAULT, error=err)
+        if fault_slots:
+            self._admit()
         for slot in done_slots:
             rid = self.slots[slot].req_id
             self._finish(slot)
@@ -409,15 +671,127 @@ class ServingEngine:
             done_now[rid] = self.finished.pop(rid)
         return done_now
 
+    # -- lifecycle / introspection --------------------------------------
+    def pop_terminated(self) -> Dict[Any, RequestResult]:
+        """Hand back (and clear) every terminal :class:`RequestResult`
+        accumulated since the last call — the shed/deadline/evicted
+        counterpart of the per-step finished dict."""
+        out = self.terminated
+        self.terminated = {}
+        return out
+
+    def drain(self, timeout_s: Optional[float] = None,
+              max_steps: Optional[int] = None) -> Dict[str, Any]:
+        """Gracefully quiesce: stop admission, shed everything still
+        queued, then step until in-flight work finishes or the budget
+        (``max_steps``, default = the largest remaining token budget;
+        ``timeout_s`` wall-clock) runs out — whatever is left is shed
+        with its partial output.  Returns
+        ``{"finished", "shed", "steps", "health"}``; afterwards the
+        engine holds zero active slots and zero allocated pages."""
+        self.draining = True
+        shed_ids = []
+        for req in list(self.queue):
+            self._terminate(req, "drained", SHED_DRAIN,
+                            detail="shed from queue by drain()")
+            self.stats["shed"] += 1
+            self._serve_event("serve/shed", req_id=req.req_id,
+                              reason=SHED_DRAIN)
+            shed_ids.append(req.req_id)
+        self.queue = []
+        if max_steps is None:
+            remaining = [r.max_new_tokens - len(r.out)
+                         for r in self.slots if r is not None]
+            max_steps = (-(-max(remaining) // self.decode_chunk) + 4) \
+                if remaining else 0
+        start = self._clock()
+        finished: Dict[Any, List[int]] = {}
+        steps = 0
+        while self.n_active and steps < max_steps:
+            if timeout_s is not None and \
+                    self._clock() - start >= timeout_s:
+                break
+            finished.update(self.step())
+            steps += 1
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                rid = req.req_id
+                self._evict_slot(slot, "drained", SHED_DRAIN,
+                                 detail="drain budget exhausted")
+                self.stats["shed"] += 1
+                self._serve_event("serve/shed", req_id=rid,
+                                  reason=SHED_DRAIN)
+                shed_ids.append(rid)
+        self.stats["drains"] += 1
+        self._serve_event("serve/drain", finished=len(finished),
+                          shed=len(shed_ids), steps=steps)
+        return {"finished": finished, "shed": shed_ids, "steps": steps,
+                "health": self.health()}
+
+    def health(self) -> Dict[str, Any]:
+        """Operational snapshot; gauges are mirrored onto the telemetry
+        registry (``serving/*``) so scrapers see them without calling
+        in."""
+        now = self._clock()
+        live = list(self.queue) + [r for r in self.slots if r is not None]
+        snap = {
+            "free_pages": self.alloc.free_page_count,
+            "total_pages": self.alloc.num_pages - 1,
+            "queue_depth": len(self.queue),
+            "active_slots": self.n_active,
+            "max_batch": self.max_batch,
+            "oldest_request_age_s": float(max(
+                (now - r.submit_time for r in live), default=0.0)),
+            "draining": self.draining,
+            "overloaded": self._admission.overloaded,
+            "undelivered_terminated": len(self.terminated),
+            "counters": dict(self.stats),
+        }
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            for key in ("free_pages", "queue_depth", "active_slots",
+                        "oldest_request_age_s"):
+                tel.registry.gauge(f"serving/{key}").set(snap[key])
+        return snap
+
+    def leak_report(self) -> Dict[str, Any]:
+        """Invariant audit: every page, RNG stream, and table row must be
+        owned by a live slot, and page accounting must balance.  Returns
+        {} when clean — every exit path (finish, shed, deadline, evict,
+        drain) must keep it that way."""
+        active = {r.req_id for r in self.slots if r is not None}
+        leaks: Dict[str, Any] = {}
+        stray_pages = sorted(set(self.alloc.seq_pages) - active, key=str)
+        if stray_pages:
+            leaks["stray_page_owners"] = stray_pages
+        stray_rng = sorted(set(self._rng) - active, key=str)
+        if stray_rng:
+            leaks["stray_rng"] = stray_rng
+        in_use = sum(len(p) for p in self.alloc.seq_pages.values())
+        if in_use + self.alloc.free_page_count != self.alloc.num_pages - 1:
+            leaks["page_accounting"] = {
+                "in_use": in_use, "free": self.alloc.free_page_count,
+                "pool": self.alloc.num_pages - 1}
+        dirty = [s for s in range(self.max_batch)
+                 if self.slots[s] is None and
+                 (self.lengths[s] != 0 or self.tables[s].any())]
+        if dirty:
+            leaks["dirty_inactive_slots"] = dirty
+        return leaks
+
     # -- convenience ----------------------------------------------------
     def generate(self, prompts, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0) -> List[List[int]]:
         """Serve a list of prompts (continuous batching when
-        len(prompts) > max_batch); returns full token lists in order."""
+        len(prompts) > max_batch); returns full token lists in order.
+        Requests terminated mid-flight (deadline/eviction) contribute
+        their partial tokens in place; a genuine stall raises
+        :class:`ServingStalled` carrying every already-completed result
+        instead of destroying them."""
         for i, p in enumerate(prompts):
             self.add_request(i, p, max_new_tokens, temperature,
-                            top_k=top_k, top_p=top_p)
+                             top_k=top_k, top_p=top_p)
         steps = 0
         results: Dict[Any, List[int]] = {}
         limit = (max(len(p) for p in prompts) + max_new_tokens + 4) * \
@@ -425,5 +799,18 @@ class ServingEngine:
         while (self.queue or self.n_active) and steps < limit:
             results.update(self.step())
             steps += 1
-        assert not self.queue and self.n_active == 0, "serving stalled"
-        return [results[i] for i in range(len(prompts))]
+        if self.queue or self.n_active:
+            stuck = [r.req_id for r in self.queue] + \
+                [r.req_id for r in self.slots if r is not None]
+            raise ServingStalled(results, stuck,
+                                 self.alloc.free_page_count,
+                                 len(self.queue), steps)
+        out = []
+        for i in range(len(prompts)):
+            if i in results:
+                out.append(results[i])
+            elif i in self.finished:   # finished inside a blocked add
+                out.append(self.finished.pop(i))
+            else:   # terminated mid-flight: partial tokens, in place
+                out.append(self.terminated.pop(i).tokens)
+        return out

@@ -41,7 +41,7 @@ from deepspeed_tpu.utils.logging import logger
 # The closed set of event kinds.  Adding a kind means updating the frozen
 # schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
 EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
-               "meta", "fault")
+               "meta", "fault", "serve")
 
 
 def _profiler_annotation(name):
@@ -313,6 +313,17 @@ class Telemetry:
             return
         self.registry.counter(f"{name}/count").inc()
         self.emit("fault", name, step=step, attrs=attrs or None)
+
+    def serve(self, name, step=None, attrs=None):
+        """Structured serving-robustness event (inference/robustness.py):
+        admissions, typed rejections, load shedding, deadline cancels,
+        per-slot evictions, drains.  Like :meth:`fault`, each also bumps
+        counter ``<name>/count`` so the registry carries serving totals
+        without replaying the stream."""
+        if not self.enabled:
+            return
+        self.registry.counter(f"{name}/count").inc()
+        self.emit("serve", name, step=step, attrs=attrs or None)
 
     def comm(self, op_name, size_bytes, axis):
         """Per-op comm census (trace-time: a shape traces once, executes

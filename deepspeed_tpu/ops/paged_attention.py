@@ -124,31 +124,56 @@ def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
     return out.astype(q.dtype)   # impl-independent output dtype
 
 
+class PageAllocationError(RuntimeError):
+    """Typed allocator failure (pool exhausted, per-sequence cap exceeded,
+    or an injected ``page_alloc`` fault): callers turn it into a structured
+    rejection / retry instead of an engine-killing assert."""
+
+
 class PagedAllocator:
     """Host-side page bookkeeping (the control-flow half of vLLM's block
     manager): per-sequence page lists over a fixed pool, with free-list
     reuse."""
 
     def __init__(self, num_pages: int, page_size: int,
-                 max_pages_per_seq: int, reserve_scratch: bool = False):
+                 max_pages_per_seq: int, reserve_scratch: bool = False,
+                 injector=None):
         """``reserve_scratch``: keep page 0 out of the pool — serving
         engines point INACTIVE batch slots' tables at page 0 so their
-        dummy-token writes land in a sacrificial page."""
+        dummy-token writes land in a sacrificial page.  ``injector``: a
+        ``runtime.resilience.FaultInjector`` consulted at the ``page_alloc``
+        site before any page leaves the free list (so an injected fault
+        never half-allocates)."""
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         self.free: List[int] = list(range(1 if reserve_scratch else 0,
                                           num_pages))
         self.seq_pages = {}
+        self.injector = injector
 
     def can_allocate(self, n_pages: int) -> bool:
         return len(self.free) >= n_pages
 
+    @property
+    def free_page_count(self) -> int:
+        return len(self.free)
+
     def allocate(self, seq_id, n_tokens: int) -> List[int]:
         need = -(-n_tokens // self.page_size)
-        assert need <= self.max_pages_per_seq, \
-            f"{n_tokens} tokens exceed max_pages_per_seq"
-        assert self.can_allocate(need), "out of KV pages"
+        if need > self.max_pages_per_seq:
+            raise PageAllocationError(
+                f"{n_tokens} tokens exceed max_pages_per_seq "
+                f"({self.max_pages_per_seq})")
+        if not self.can_allocate(need):
+            raise PageAllocationError(
+                f"out of KV pages: need {need}, free {len(self.free)}")
+        if self.injector is not None:
+            try:
+                self.injector.check("page_alloc")
+            except Exception as e:
+                raise PageAllocationError(
+                    f"injected page_alloc fault: {e}") from e
         pages = [self.free.pop() for _ in range(need)]
         self.seq_pages[seq_id] = pages
         return pages
@@ -158,11 +183,23 @@ class PagedAllocator:
         pages as it crosses page boundaries."""
         pages = self.seq_pages[seq_id]
         need = -(-total_tokens // self.page_size)
-        assert need <= self.max_pages_per_seq, \
-            f"{total_tokens} tokens exceed max_pages_per_seq"
-        while len(pages) < need:
-            assert self.free, "out of KV pages"
-            pages.append(self.free.pop())
+        if need > self.max_pages_per_seq:
+            raise PageAllocationError(
+                f"{total_tokens} tokens exceed max_pages_per_seq "
+                f"({self.max_pages_per_seq})")
+        if len(pages) < need:
+            if not self.can_allocate(need - len(pages)):
+                raise PageAllocationError(
+                    f"out of KV pages: need {need - len(pages)} more, "
+                    f"free {len(self.free)}")
+            if self.injector is not None:
+                try:
+                    self.injector.check("page_alloc")
+                except Exception as e:
+                    raise PageAllocationError(
+                        f"injected page_alloc fault: {e}") from e
+            while len(pages) < need:
+                pages.append(self.free.pop())
         return pages
 
     def shrink(self, seq_id, total_tokens: int):

@@ -170,8 +170,12 @@ _EXC_TABLE = {
 }
 
 # the sites the runtime consults; check() on anything else is a no-op, so
-# configs stay forward-compatible with new sites
-FAULT_SITES = ("ckpt_save", "ckpt_load", "fs", "dataloader_next")
+# configs stay forward-compatible with new sites.  serve_step / serve_sample
+# / page_alloc are the serving-side sites (inference/robustness.py): the
+# whole-batch decode dispatch, the per-request host sampler, and the KV
+# page allocator.
+FAULT_SITES = ("ckpt_save", "ckpt_load", "fs", "dataloader_next",
+               "serve_step", "serve_sample", "page_alloc")
 
 
 class FaultInjector:

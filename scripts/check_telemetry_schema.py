@@ -63,6 +63,16 @@ SCHEMA = {
         "required": {"ts": _NUM, "kind": str, "name": str},
         "optional": {"attrs": dict, "step": int},
     },
+    # serving-robustness events (inference/robustness.py): admission
+    # ("serve/admit"), typed rejection ("serve/reject"), load shedding
+    # ("serve/shed"), deadline cancels ("serve/deadline"), per-slot fault
+    # eviction ("serve/evict"), graceful drain ("serve/drain"), normal
+    # completion ("serve/finish"), and recovered transient faults
+    # ("serve/fault").  Typed reasons ride in attrs["reason"].
+    "serve": {
+        "required": {"ts": _NUM, "kind": str, "name": str},
+        "optional": {"attrs": dict, "step": int},
+    },
 }
 
 EVENT_KINDS = tuple(SCHEMA)

@@ -66,6 +66,7 @@ def aggregate(events):
     steps = set()
     stalls = []
     metas = []
+    serves = {}      # event name -> {count, reasons: {reason: n}}
     for ev in events:
         kind = ev.get("kind")
         if kind == "span":
@@ -90,9 +91,15 @@ def aggregate(events):
             stalls.append(ev)
         elif kind == "meta":
             metas.append(ev)
+        elif kind == "serve":
+            rec = serves.setdefault(ev["name"], {"count": 0, "reasons": {}})
+            rec["count"] += 1
+            reason = (ev.get("attrs") or {}).get("reason")
+            if reason:
+                rec["reasons"][reason] = rec["reasons"].get(reason, 0) + 1
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "steps": steps, "stalls": stalls,
-            "metas": metas}
+            "metas": metas, "serves": serves}
 
 
 def summarize(agg):
@@ -118,9 +125,14 @@ def summarize(agg):
     hb = sorted(agg["heartbeats"])
     heartbeat = {"steps": len(agg["steps"]),
                  "median_step_ms": round(_pct(hb, 50), 3) if hb else None}
+    serve_rows = {
+        name: {"count": rec["count"],
+               "reasons": dict(sorted(rec["reasons"].items()))}
+        for name, rec in sorted(agg.get("serves", {}).items())}
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
             "input_feed": _input_feed_summary(agg),
+            "serving": serve_rows,
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
 
@@ -203,6 +215,14 @@ def print_tables(summary, out=sys.stdout):
             w(f"  |  wait fraction of train_batch: "
               f"{feed['wait_fraction_of_step'] * 100:.2f}%")
         w("\n\n")
+    serving = summary.get("serving")
+    if serving:
+        w("== serving events ==\n")
+        w(f"{'event':<24}{'count':>7}  reasons\n")
+        for name, r in serving.items():
+            reasons = ", ".join(f"{k}={v}" for k, v in r["reasons"].items())
+            w(f"{name:<24}{r['count']:>7}  {reasons}\n")
+        w("\n")
     hb = summary["heartbeat"]
     w(f"== heartbeat ==\nsteps: {hb['steps']}  "
       f"median step: {hb['median_step_ms']} ms\n\n")

@@ -27,6 +27,17 @@ def _reset_parallel_state():
     groups.reset_mesh()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables once a module's tests are done.  Every
+    jitted program holds mmap'd code + constants; across the full suite
+    the process otherwise accumulates tens of thousands of maps and
+    segfaults into ``vm.max_map_count`` on default-tuned hosts.  Live
+    arrays are untouched and later modules simply recompile."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def mesh_1d():
     """All 8 devices on the fsdp axis (pure ZeRO topology)."""

@@ -151,11 +151,13 @@ def test_bucket_surplus_pages_returned_after_prefill(tiny):
 
 
 def test_request_exceeding_max_seq_rejected(tiny):
+    from deepspeed_tpu.inference.robustness import RequestRejected
     cfg, model, params = tiny
     eng = ServingEngine(model, params, max_batch=1, page_size=8,
                         max_seq=32, dtype=jnp.float32)
-    with pytest.raises(AssertionError, match="max_seq"):
+    with pytest.raises(RequestRejected, match="oversized") as ei:
         eng.add_request("big", list(range(30)), max_new_tokens=10)
+    assert "max_seq" in ei.value.detail
 
 
 def test_temperature_sampling_reproducible(tiny):

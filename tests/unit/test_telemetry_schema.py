@@ -67,6 +67,18 @@ def test_accepts_every_emitter(checker, tmp_path):
                                     "delay_s": 0.5})
     tel.fault("fault/ckpt_fallback", step=4, attrs={"to": "global_step2"})
     tel.fault("fault/preempt_requested")
+    tel.serve("serve/admit", attrs={"req_id": "r1", "queue_depth": 2,
+                                    "free_pages": 14})
+    tel.serve("serve/reject", attrs={"req_id": "r2",
+                                     "reason": "queue_full"})
+    tel.serve("serve/shed", attrs={"req_id": "r0", "reason": "shed_oldest"})
+    tel.serve("serve/deadline", attrs={"req_id": "r3", "reason": "deadline",
+                                       "where": "active"})
+    tel.serve("serve/evict", attrs={"req_id": "r4", "reason": "fault",
+                                    "error": "boom"})
+    tel.serve("serve/fault", attrs={"site": "serve_step", "error": "inj"})
+    tel.serve("serve/finish", attrs={"req_id": "r1", "n_generated": 8})
+    tel.serve("serve/drain", attrs={"finished": 3, "shed": 1, "steps": 12})
     wd = StepStallWatchdog(tel, stall_factor=1.0, min_stall_secs=0.0)
     wd.beat(0)
     wd.beat(1)
