@@ -2,6 +2,9 @@
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.prefix_cache import (PrefixCache,
+                                                  PrefixCacheConfig,
+                                                  PrefixMatch)
 from deepspeed_tpu.inference.robustness import (AdmissionController,
                                                 RequestRejected,
                                                 RequestResult,
@@ -11,4 +14,5 @@ from deepspeed_tpu.inference.serving import ServingEngine
 
 __all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "ServingEngine",
            "RequestRejected", "RequestResult", "ServingRobustnessConfig",
-           "ServingStalled", "AdmissionController"]
+           "ServingStalled", "AdmissionController", "PrefixCache",
+           "PrefixCacheConfig", "PrefixMatch"]

@@ -68,6 +68,24 @@ TERMINAL_REASONS = (SHED_OLDEST, SHED_DEADLINE, SHED_DRAIN, EVICT_FAULT)
 
 OVERLOAD_POLICIES = ("reject", "shed-oldest", "block")
 
+# The FROZEN vocabulary of serve-kind event names — every ``serve`` event
+# the engine emits must use one of these, and the telemetry schema
+# (``scripts/check_telemetry_schema.py``) validates streams against the
+# same tuple (a tier-1 test diffs the two).  Adding an event name means
+# editing both files in the same change.
+SERVE_EVENTS = (
+    "serve/admit", "serve/reject", "serve/shed", "serve/deadline",
+    "serve/evict", "serve/drain", "serve/finish", "serve/fault",
+    # prefix-cache subsystem (inference/prefix_cache.py): a lookup that
+    # attached cached pages ("serve/prefix_hit", attrs: pages_reused /
+    # tokens_reused / cow), a copy-on-write page copy ("serve/prefix_cow"),
+    # pages newly indexed after prefill or finish ("serve/prefix_insert"),
+    # and a reclaimable page surrendered back to the free list
+    # ("serve/prefix_evict")
+    "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
+    "serve/prefix_evict",
+)
+
 
 class RequestRejected(Exception):
     """``add_request`` refused this request — the engine state is untouched
@@ -131,8 +149,15 @@ class ServingRobustnessConfig(DeepSpeedConfigModel):
     max_prompt_tokens = 0           # extra prompt cap under max_seq (0=off)
     step_fault_limit = 8            # consecutive serve_step faults -> raise
     fault_injection = {}            # FaultInjector spec (serving sites)
+    # content-hashed KV-page reuse (inference/prefix_cache.py):
+    # {"enabled": bool, "max_cached_pages": int, "min_prefix_tokens": int}
+    prefix_cache = {}
 
     def _validate(self):
+        if isinstance(self.prefix_cache, dict):
+            from deepspeed_tpu.inference.prefix_cache import \
+                PrefixCacheConfig
+            self.prefix_cache = PrefixCacheConfig(self.prefix_cache)
         if self.overload_policy not in OVERLOAD_POLICIES:
             raise ValueError(
                 f"serving.overload_policy must be one of {OVERLOAD_POLICIES}")

@@ -42,6 +42,7 @@ from deepspeed_tpu.inference.robustness import (
     REJECT_OVERSIZED, REJECT_QUEUE_FULL, SHED_DEADLINE, SHED_DRAIN,
     SHED_OLDEST, AdmissionController, RequestRejected, RequestResult,
     ServingRobustnessConfig, ServingStalled)
+from deepspeed_tpu.inference.prefix_cache import PrefixCache, PrefixMatch
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
                                                PagedAllocator)
@@ -137,6 +138,27 @@ class ServingEngine:
                                     self.max_pages_per_seq,
                                     reserve_scratch=True,
                                     injector=injector)
+        # content-hashed KV-page reuse (inference/prefix_cache.py): the
+        # namespace pins cached pages to this model shape / cache dtype /
+        # page size, so a differently-configured engine can never attach
+        # a foreign page even through a shared registry
+        self.prefix_cache = None
+        pc_cfg = self.serving.prefix_cache
+        if getattr(pc_cfg, "enabled", False):
+            mc = self.config
+            ns = (f"{type(model).__name__}/"
+                  f"L{getattr(mc, 'n_layers', 0)}"
+                  f"h{getattr(mc, 'hidden_size', 0)}"
+                  f"q{getattr(mc, 'n_heads', 0)}"
+                  f"kv{getattr(mc, 'kv_heads', 0)}"
+                  f"v{getattr(mc, 'vocab_size', 0)}/"
+                  f"{jnp.dtype(dtype).name}/page{page_size}")
+            self.prefix_cache = PrefixCache(
+                self.alloc, page_size, namespace=ns,
+                max_cached_pages=int(pc_cfg.max_cached_pages),
+                min_prefix_tokens=int(pc_cfg.min_prefix_tokens),
+                on_evict=self._on_prefix_evict)
+        self._copy_page_fn = None   # compiled COW page copy (lazy)
         self.eos = eos_token_id
         if not self.config.use_rope and not self.config.use_alibi:
             # learned positions: gathers past the table CLAMP under jit
@@ -183,7 +205,8 @@ class ServingEngine:
         self.draining = False
         self.stats = {"admitted": 0, "rejected": 0, "shed": 0,
                       "deadline": 0, "evicted": 0, "finished": 0,
-                      "step_faults": 0, "drains": 0}
+                      "step_faults": 0, "drains": 0, "prefix_hits": 0,
+                      "prefix_cow_copies": 0, "prefix_evictions": 0}
 
     # -- telemetry -------------------------------------------------------
     @property
@@ -267,8 +290,11 @@ class ServingEngine:
         cfg = self.serving
         hard_full = bool(cfg.max_queue) and \
             len(self.queue) >= int(cfg.max_queue)
+        # reclaimable (cached, ref-0) pages are one eviction away from the
+        # free list — counting them stops a warm prefix cache from reading
+        # as page pressure and shedding admissible traffic
         overloaded = self._admission.update(len(self.queue),
-                                            self.alloc.free_page_count)
+                                            self.alloc.available_page_count)
         return hard_full, overloaded
 
     def _apply_admission_policy(self, req_id):
@@ -372,30 +398,68 @@ class ServingEngine:
                 continue
             req = self.queue[0]
             total = len(req.prompt) + req.max_new_tokens
-            bucket = min(self._bucket(len(req.prompt)), self.max_seq)
-            need_pages = -(-max(total, bucket) // self.page_size)
-            if not self.alloc.can_allocate(need_pages):
+            # prefix cache: attach every fully-cached prefix page without
+            # prefill; a partial next-page match copies on write.  The
+            # lookup is a pure read — nothing is pinned until allocate().
+            match = (self.prefix_cache.lookup(req.prompt)
+                     if self.prefix_cache is not None else PrefixMatch())
+            cached = match.cached_tokens(self.page_size)
+            bucket = min(self._bucket(len(req.prompt) - cached),
+                         self.max_seq)
+            # reservation covers the budget AND the padded suffix prefill;
+            # the cap keeps an unaligned cached prefix from pushing the
+            # bucket past the table — padding writes past the reservation
+            # land on the sacrificial scratch page (clamped/zero columns)
+            need_tokens = min(max(total, cached + bucket),
+                              self.max_pages_per_seq * self.page_size)
+            shared = list(match.pages)
+            protect = (match.cow_src,) if match.cow_src is not None else ()
+            need_fresh = -(-need_tokens // self.page_size) - len(shared)
+            pinned = set(shared) | set(protect)
+            evictable = sum(1 for p in self.alloc.reclaimable
+                            if p not in pinned)
+            if need_fresh > self.alloc.free_page_count + evictable:
                 return          # head-of-line: keep FIFO order
             # full reservation (prompt + budget) at admission: an admitted
             # request can NEVER deadlock on pages mid-flight (no vLLM-style
             # preemption/recompute machinery needed); only bucket-padding
             # surplus is returned after prefill.  Allocate BEFORE popping:
-            # an injected page_alloc fault leaves nothing mutated and the
-            # request retries from the queue on the next step, unchanged.
+            # an injected page_alloc fault leaves nothing mutated — shared
+            # refcounts untouched, nothing half-attached — and the request
+            # retries from the queue on the next step, unchanged.
             try:
-                pages = self.alloc.allocate(req.req_id, max(total, bucket))
+                pages = self.alloc.allocate(req.req_id, need_tokens,
+                                            shared=shared, protect=protect)
             except PageAllocationError as e:
                 self.stats["step_faults"] += 1
                 self._serve_event("serve/fault", req_id=req.req_id,
                                   site="page_alloc", error=str(e))
                 return
+            if cached:
+                self.stats["prefix_hits"] += 1
+                self._serve_event("serve/prefix_hit", req_id=req.req_id,
+                                  pages_reused=len(shared),
+                                  tokens_reused=cached,
+                                  cow=int(match.cow_src is not None))
             self.queue.pop(0)
             self.tables[slot, :] = 0
             self.tables[slot, :len(pages)] = pages
             self.lengths[slot] = 0
             self.slots[slot] = req
             try:
-                self._prefill(slot, req, bucket)
+                if match.cow_src is not None:
+                    # the request's first owned page inherits the partial
+                    # match's content; its divergent tail is overwritten
+                    # by the suffix prefill, so the shared source page is
+                    # never touched
+                    self._copy_page(match.cow_src, pages[len(shared)])
+                    self.stats["prefix_cow_copies"] += 1
+                    self._serve_event("serve/prefix_cow",
+                                      req_id=req.req_id,
+                                      src=int(match.cow_src),
+                                      dst=int(pages[len(shared)]),
+                                      tokens=int(match.cow_tokens))
+                self._prefill(slot, req, bucket, cached)
             except Exception as e:   # fault isolation: only THIS request
                 logger.warning(f"evicting request {req.req_id!r} after "
                                f"prefill fault: {e}")
@@ -405,11 +469,17 @@ class ServingEngine:
                 self._serve_event("serve/evict", req_id=req.req_id,
                                   reason=EVICT_FAULT, error=str(e))
                 continue
-            if bucket > total:
+            if need_tokens > total:
                 self.alloc.shrink(req.req_id, total)
                 pages = self.alloc.seq_pages[req.req_id]
                 self.tables[slot, :] = 0
                 self.tables[slot, :len(pages)] = pages
+            if self.prefix_cache is not None:
+                added = self.prefix_cache.insert(
+                    req.prompt, self.alloc.seq_pages[req.req_id])
+                if added:
+                    self._serve_event("serve/prefix_insert",
+                                      req_id=req.req_id, pages=added)
 
     def _run_step(self, ids, tables, lengths):
         if self.mesh is not None:
@@ -418,17 +488,50 @@ class ServingEngine:
                                      tables, lengths)
         return self._step_fn(self.params, ids, self.caches, tables, lengths)
 
-    def _prefill(self, slot: int, req: _Request, bucket: int):
-        T = bucket
-        ids = np.zeros((1, T), np.int32)
-        ids[0, :len(req.prompt)] = req.prompt
+    # -- prefix-cache plumbing ------------------------------------------
+    def _on_prefix_evict(self, page: int):
+        """Allocator reclaimed a cached page for a fresh allocation (the
+        cache already dropped its index entries)."""
+        self.stats["prefix_evictions"] += 1
+        self._serve_event("serve/prefix_evict", page=int(page))
+
+    def _copy_page(self, src: int, dst: int):
+        """Copy-on-write: device-copy one KV page (every layer, every
+        cache leaf) into the request's own fresh page.  Donating the
+        cache buffers makes this an in-place page write, not a full-cache
+        copy."""
+        if self._copy_page_fn is None:
+            def copy(caches, src, dst):
+                return jax.tree_util.tree_map(
+                    lambda leaf: leaf.at[:, dst].set(leaf[:, src]), caches)
+            self._copy_page_fn = jax.jit(copy, donate_argnums=(0,))
+        if self.mesh is not None:
+            with self.mesh:
+                self.caches = self._copy_page_fn(
+                    self.caches, jnp.int32(src), jnp.int32(dst))
+        else:
+            self.caches = self._copy_page_fn(
+                self.caches, jnp.int32(src), jnp.int32(dst))
+
+    def _prefill(self, slot: int, req: _Request, bucket: int,
+                 cached: int = 0):
+        """Prefill the UNCACHED suffix: the first ``cached`` prompt tokens
+        already sit in attached (or COW-copied) pages, so the device step
+        runs only the remaining tokens at start position ``cached`` —
+        causal attention reads the cached pages through the block table,
+        so the result is bit-identical to a full prefill.  ``cached`` is
+        capped at ``len(prompt) - 1`` upstream: the last prompt token
+        always prefills, because its logits seed sampling."""
+        suffix = req.prompt[cached:]
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :len(suffix)] = suffix
         logits, self.caches, _ = self._run_step(
             jnp.asarray(ids),
             jnp.asarray(self.tables[slot:slot + 1]),
-            jnp.zeros((1,), jnp.int32))
+            jnp.full((1,), cached, jnp.int32))
         self.lengths[slot] = len(req.prompt)
         req.last_token = self._sample(
-            req, np.asarray(logits[0, len(req.prompt) - 1]))
+            req, np.asarray(logits[0, len(suffix) - 1]))
 
     def _sample(self, req: _Request, logits: np.ndarray) -> int:
         if self.injector is not None:
@@ -463,6 +566,17 @@ class ServingEngine:
     def _finish(self, slot: int):
         req = self.slots[slot]
         self.finished[req.req_id] = req.prompt + req.out
+        if self.prefix_cache is not None:
+            # index the finished sequence's full pages (prompt AND
+            # generated tokens — an agent turn's output is the next turn's
+            # prompt) BEFORE the refcounts drop, so they park in the
+            # reclaimable tier instead of dissolving into the free list
+            added = self.prefix_cache.insert(
+                req.prompt + req.out, self.alloc.seq_pages[req.req_id])
+            if added:
+                self._serve_event("serve/prefix_insert",
+                                  req_id=req.req_id, pages=added,
+                                  at="finish")
         self.alloc.free_sequence(req.req_id)
         self._rng.pop(req.req_id, None)
         self.slots[slot] = None
@@ -736,6 +850,8 @@ class ServingEngine:
         live = list(self.queue) + [r for r in self.slots if r is not None]
         snap = {
             "free_pages": self.alloc.free_page_count,
+            # free + reclaimable: what admission actually sees
+            "available_pages": self.alloc.available_page_count,
             "total_pages": self.alloc.num_pages - 1,
             "queue_depth": len(self.queue),
             "active_slots": self.n_active,
@@ -747,18 +863,34 @@ class ServingEngine:
             "undelivered_terminated": len(self.terminated),
             "counters": dict(self.stats),
         }
+        if self.prefix_cache is not None:
+            snap["prefix_cache"] = self.prefix_cache.snapshot()
         tel = self.telemetry
         if tel is not None and tel.enabled:
-            for key in ("free_pages", "queue_depth", "active_slots",
-                        "oldest_request_age_s"):
+            for key in ("free_pages", "available_pages", "queue_depth",
+                        "active_slots", "oldest_request_age_s"):
                 tel.registry.gauge(f"serving/{key}").set(snap[key])
+            if self.prefix_cache is not None:
+                pc = snap["prefix_cache"]
+                # frozen serve/* gauge names (docs/serving.md)
+                for gauge, key in (("serve/prefix_hit_rate", "hit_rate"),
+                                   ("serve/prefix_tokens_reused",
+                                    "tokens_reused"),
+                                   ("serve/prefix_cow_copies", "cow_copies"),
+                                   ("serve/prefix_evictions", "evictions"),
+                                   ("serve/prefix_cached_pages",
+                                    "cached_pages")):
+                    tel.registry.gauge(gauge).set(pc[key])
         return snap
 
     def leak_report(self) -> Dict[str, Any]:
         """Invariant audit: every page, RNG stream, and table row must be
-        owned by a live slot, and page accounting must balance.  Returns
-        {} when clean — every exit path (finish, shed, deadline, evict,
-        drain) must keep it that way."""
+        owned by a live slot, refcounts must match the held multiplicity
+        (pages are SHARED under the prefix cache, so naive page counting
+        would double-book them), and the prefix-cache index must agree
+        with the allocator's cached set.  Returns {} when clean — every
+        exit path (finish, shed, deadline, evict, drain) must keep it
+        that way."""
         active = {r.req_id for r in self.slots if r is not None}
         leaks: Dict[str, Any] = {}
         stray_pages = sorted(set(self.alloc.seq_pages) - active, key=str)
@@ -767,11 +899,9 @@ class ServingEngine:
         stray_rng = sorted(set(self._rng) - active, key=str)
         if stray_rng:
             leaks["stray_rng"] = stray_rng
-        in_use = sum(len(p) for p in self.alloc.seq_pages.values())
-        if in_use + self.alloc.free_page_count != self.alloc.num_pages - 1:
-            leaks["page_accounting"] = {
-                "in_use": in_use, "free": self.alloc.free_page_count,
-                "pool": self.alloc.num_pages - 1}
+        leaks.update(self.alloc.audit())
+        if self.prefix_cache is not None:
+            leaks.update(self.prefix_cache.audit())
         dirty = [s for s in range(self.max_batch)
                  if self.slots[s] is None and
                  (self.lengths[s] != 0 or self.tables[s].any())]

@@ -22,6 +22,7 @@ because it is control flow, not compute.
 """
 
 import math
+from collections import OrderedDict
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -133,7 +134,20 @@ class PageAllocationError(RuntimeError):
 class PagedAllocator:
     """Host-side page bookkeeping (the control-flow half of vLLM's block
     manager): per-sequence page lists over a fixed pool, with free-list
-    reuse."""
+    reuse.
+
+    Pages are REFCOUNTED so the prefix cache
+    (``inference/prefix_cache.py``) can attach one physical page to many
+    sequences' block tables: ``allocate(..., shared=pages)`` bumps the
+    shared pages' refcounts instead of taking fresh ones, and a page only
+    returns to circulation when its last reference drops.  Pages the cache
+    has registered (``mark_cached``) don't go back to the free list on
+    release — they park in an LRU "reclaimable" tier, still holding their
+    KV content for future hits, and are evicted back into the free list
+    (oldest first, ``evict_hook`` notified so the cache can drop its index
+    entries) only when an allocation outgrows the free list.  With no
+    cache layered on top every refcount is 1 and the reclaimable tier
+    stays empty — the original allocator semantics."""
 
     def __init__(self, num_pages: int, page_size: int,
                  max_pages_per_seq: int, reserve_scratch: bool = False,
@@ -147,34 +161,145 @@ class PagedAllocator:
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
+        self.scratch_reserved = bool(reserve_scratch)
         self.free: List[int] = list(range(1 if reserve_scratch else 0,
                                           num_pages))
         self.seq_pages = {}
         self.injector = injector
+        self.ref = {}                       # page -> live-sequence refcount
+        self.cached = set()                 # pages the prefix cache indexed
+        self.reclaimable = OrderedDict()    # ref==0 cached pages, LRU order
+        self.evict_hook = None              # called with each evicted page
+        self.pages_taken = 0                # fresh pages handed out (stats)
+        self.reclaim_evictions = 0          # reclaimable pages surrendered
 
     def can_allocate(self, n_pages: int) -> bool:
-        return len(self.free) >= n_pages
+        return self.available_page_count >= n_pages
 
     @property
     def free_page_count(self) -> int:
         return len(self.free)
 
-    def allocate(self, seq_id, n_tokens: int) -> List[int]:
-        need = -(-n_tokens // self.page_size)
-        if need > self.max_pages_per_seq:
-            raise PageAllocationError(
-                f"{n_tokens} tokens exceed max_pages_per_seq "
-                f"({self.max_pages_per_seq})")
-        if not self.can_allocate(need):
-            raise PageAllocationError(
-                f"out of KV pages: need {need}, free {len(self.free)}")
+    @property
+    def available_page_count(self) -> int:
+        """Pages an allocation can actually obtain: the free list plus the
+        reclaimable tier (cached pages evictable on demand)."""
+        return len(self.free) + len(self.reclaimable)
+
+    # -- refcount plumbing ----------------------------------------------
+    def _ref_page(self, page: int):
+        self.ref[page] = self.ref.get(page, 0) + 1
+        self.reclaimable.pop(page, None)
+
+    def _release_page(self, page: int):
+        n = self.ref.get(page, 1) - 1
+        if n > 0:
+            self.ref[page] = n
+            return
+        self.ref.pop(page, None)
+        if page in self.cached:
+            # most-recently-used end; evictions pop from the other side
+            self.reclaimable[page] = None
+            self.reclaimable.move_to_end(page)
+        else:
+            self.free.append(page)
+
+    def _take_page(self) -> int:
+        """One fresh page: free list first, then evict the LRU reclaimable
+        page (its cache index entries die via ``evict_hook``)."""
+        if self.free:
+            page = self.free.pop()
+        else:
+            page = self.evict_reclaimable()
+            if page is None:
+                raise PageAllocationError("out of KV pages: free list and "
+                                          "reclaimable tier both empty")
+        self.ref[page] = 1
+        self.pages_taken += 1
+        return page
+
+    def evict_reclaimable(self) -> Optional[int]:
+        """Evict the least-recently-used reclaimable page back toward the
+        caller (None when the tier is empty).  The page leaves the cached
+        set and the hook lets the prefix cache unindex it."""
+        if not self.reclaimable:
+            return None
+        page, _ = self.reclaimable.popitem(last=False)
+        self.cached.discard(page)
+        self.reclaim_evictions += 1
+        if self.evict_hook is not None:
+            self.evict_hook(page)
+        return page
+
+    def reclaim_to_free(self) -> Optional[int]:
+        """Evict the LRU reclaimable page straight onto the free list (the
+        prefix cache's capacity enforcement); None when none evictable."""
+        page = self.evict_reclaimable()
+        if page is not None:
+            self.free.append(page)
+        return page
+
+    def mark_cached(self, page: int):
+        """The prefix cache indexed this page: on last release it parks in
+        the reclaimable tier instead of returning to the free list."""
+        self.cached.add(page)
+
+    def unmark_cached(self, page: int):
+        """Drop cache status; if the page is parked reclaimable it returns
+        to the free list immediately."""
+        self.cached.discard(page)
+        if page in self.reclaimable:
+            del self.reclaimable[page]
+            self.free.append(page)
+
+    def _check_injector(self):
         if self.injector is not None:
             try:
                 self.injector.check("page_alloc")
             except Exception as e:
                 raise PageAllocationError(
                     f"injected page_alloc fault: {e}") from e
-        pages = [self.free.pop() for _ in range(need)]
+
+    def allocate(self, seq_id, n_tokens: int, shared=(),
+                 protect=()) -> List[int]:
+        """Pages for ``n_tokens``, reusing ``shared`` cached pages (in
+        order) as the sequence's leading pages — their refcounts bump
+        instead of fresh pages being taken.  ``protect`` pages are pinned
+        for the duration of the call so the reclaim-tier eviction that
+        feeds fresh pages can never surrender them (the serving engine
+        pins a copy-on-write source page this way).  All feasibility
+        checks and the injected-fault site run BEFORE any state mutates,
+        so a ``PageAllocationError`` never leaks a refcount or
+        half-attaches a page."""
+        shared = list(shared)
+        need = -(-n_tokens // self.page_size)
+        if need > self.max_pages_per_seq:
+            raise PageAllocationError(
+                f"{n_tokens} tokens exceed max_pages_per_seq "
+                f"({self.max_pages_per_seq})")
+        if len(shared) > need:
+            raise PageAllocationError(
+                f"{len(shared)} shared pages exceed the {need}-page "
+                f"reservation for {n_tokens} tokens")
+        fresh_needed = need - len(shared)
+        # shared/protected pages parked in the reclaimable tier are about
+        # to be pinned — they can't feed this allocation's fresh pages
+        pinned = set(shared) | set(protect)
+        evictable = sum(1 for p in self.reclaimable if p not in pinned)
+        if fresh_needed > len(self.free) + evictable:
+            raise PageAllocationError(
+                f"out of KV pages: need {fresh_needed}, free "
+                f"{len(self.free)} (+{evictable} reclaimable)")
+        self._check_injector()
+        for p in protect:
+            self._ref_page(p)
+        try:
+            for p in shared:
+                self._ref_page(p)
+            pages = shared + [self._take_page() for _ in range(fresh_needed)]
+        finally:
+            for p in protect:
+                self._release_page(p)
         self.seq_pages[seq_id] = pages
         return pages
 
@@ -192,14 +317,9 @@ class PagedAllocator:
                 raise PageAllocationError(
                     f"out of KV pages: need {need - len(pages)} more, "
                     f"free {len(self.free)}")
-            if self.injector is not None:
-                try:
-                    self.injector.check("page_alloc")
-                except Exception as e:
-                    raise PageAllocationError(
-                        f"injected page_alloc fault: {e}") from e
+            self._check_injector()
             while len(pages) < need:
-                pages.append(self.free.pop())
+                pages.append(self._take_page())
         return pages
 
     def shrink(self, seq_id, total_tokens: int):
@@ -208,10 +328,44 @@ class PagedAllocator:
         pages = self.seq_pages[seq_id]
         need = max(1, -(-total_tokens // self.page_size))
         while len(pages) > need:
-            self.free.append(pages.pop())
+            self._release_page(pages.pop())
 
     def free_sequence(self, seq_id):
-        self.free.extend(self.seq_pages.pop(seq_id, []))
+        for page in self.seq_pages.pop(seq_id, []):
+            self._release_page(page)
+
+    def audit(self) -> dict:
+        """Refcount/accounting invariants; {} when clean.  Every page is
+        exactly one of: free, reclaimable (cached, ref 0), or referenced
+        (ref == number of sequences holding it); totals balance against
+        the pool."""
+        problems = {}
+        held = {}
+        for pages in self.seq_pages.values():
+            for p in pages:
+                held[p] = held.get(p, 0) + 1
+        if held != self.ref:
+            dangling = {p: n for p, n in self.ref.items()
+                        if held.get(p) != n}
+            unrefed = {p: n for p, n in held.items()
+                       if self.ref.get(p) != n}
+            problems["refcounts"] = {"dangling": dangling,
+                                     "unreferenced_held": unrefed}
+        overlap = (set(self.free) & set(self.reclaimable)) | \
+                  (set(self.free) & set(self.ref)) | \
+                  (set(self.reclaimable) & set(self.ref))
+        if overlap:
+            problems["tier_overlap"] = sorted(overlap)
+        pool = self.num_pages - (1 if self.scratch_reserved else 0)
+        total = len(self.free) + len(self.reclaimable) + len(self.ref)
+        if total != pool:
+            problems["page_accounting"] = {
+                "free": len(self.free), "reclaimable": len(self.reclaimable),
+                "referenced": len(self.ref), "pool": pool}
+        if not self.cached >= set(self.reclaimable):
+            problems["uncached_reclaimable"] = sorted(
+                set(self.reclaimable) - self.cached)
+        return problems
 
     def block_table(self, seq_ids) -> np.ndarray:
         """[B, max_pages_per_seq] table (0-padded) for the given batch."""

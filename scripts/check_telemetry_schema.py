@@ -68,12 +68,25 @@ SCHEMA = {
     # ("serve/shed"), deadline cancels ("serve/deadline"), per-slot fault
     # eviction ("serve/evict"), graceful drain ("serve/drain"), normal
     # completion ("serve/finish"), and recovered transient faults
-    # ("serve/fault").  Typed reasons ride in attrs["reason"].
+    # ("serve/fault").  Typed reasons ride in attrs["reason"].  The
+    # ``name`` field is validated against SERVE_EVENTS below.
     "serve": {
         "required": {"ts": _NUM, "kind": str, "name": str},
         "optional": {"attrs": dict, "step": int},
     },
 }
+
+# FROZEN vocabulary of serve-kind event names — must stay byte-identical
+# to ``deepspeed_tpu.inference.robustness.SERVE_EVENTS`` (the tier-1 test
+# diffs the two).  The prefix_* names belong to the prefix-cache subsystem
+# (inference/prefix_cache.py): cached-page attach hits, copy-on-write
+# copies, newly indexed pages, and reclaim-tier evictions.
+SERVE_EVENTS = (
+    "serve/admit", "serve/reject", "serve/shed", "serve/deadline",
+    "serve/evict", "serve/drain", "serve/finish", "serve/fault",
+    "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
+    "serve/prefix_evict",
+)
 
 EVENT_KINDS = tuple(SCHEMA)
 
@@ -106,6 +119,9 @@ def validate_event(event):
             problems.append(
                 f"{kind}: optional field {field!r} has type "
                 f"{type(value).__name__}")
+    if kind == "serve" and isinstance(event.get("name"), str) and \
+            event["name"] not in SERVE_EVENTS:
+        problems.append(f"serve: unknown event name {event['name']!r}")
     return problems
 
 

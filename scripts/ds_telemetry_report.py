@@ -94,9 +94,20 @@ def aggregate(events):
         elif kind == "serve":
             rec = serves.setdefault(ev["name"], {"count": 0, "reasons": {}})
             rec["count"] += 1
-            reason = (ev.get("attrs") or {}).get("reason")
+            attrs = ev.get("attrs") or {}
+            reason = attrs.get("reason")
             if reason:
                 rec["reasons"][reason] = rec["reasons"].get(reason, 0) + 1
+            # prefix-cache events carry their numbers in attrs — sum them
+            # so the report can print the reuse digest without the engine
+            if ev["name"] == "serve/prefix_hit":
+                rec["pages_reused"] = rec.get("pages_reused", 0) + \
+                    int(attrs.get("pages_reused", 0))
+                rec["tokens_reused"] = rec.get("tokens_reused", 0) + \
+                    int(attrs.get("tokens_reused", 0))
+            elif ev["name"] == "serve/prefix_insert":
+                rec["pages"] = rec.get("pages", 0) + \
+                    int(attrs.get("pages", 0))
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "steps": steps, "stalls": stalls,
             "metas": metas, "serves": serves}
@@ -133,8 +144,35 @@ def summarize(agg):
             "heartbeat": heartbeat,
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
+            "prefix_cache": _prefix_cache_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _prefix_cache_summary(agg):
+    """Prefix-cache reuse digest from the ``serve/prefix_*`` events, plus
+    the frozen ``serve/prefix_hit_rate`` gauge when ``health()`` pushed
+    one (the gauge is exact — page-level hit rate over every lookup; the
+    event-derived fields count only admitted requests)."""
+    serves = agg.get("serves", {})
+    hits = serves.get("serve/prefix_hit", {})
+    admits = serves.get("serve/admit", {}).get("count", 0)
+    if not hits and "serve/prefix_hit_rate" not in agg["gauges"]:
+        return None
+    rate = agg["gauges"].get("serve/prefix_hit_rate", {}).get("last")
+    return {
+        "requests_with_hits": hits.get("count", 0),
+        "admitted": admits,
+        "request_hit_fraction": (round(hits.get("count", 0) / admits, 4)
+                                 if admits else None),
+        "pages_reused": hits.get("pages_reused", 0),
+        "tokens_reused": hits.get("tokens_reused", 0),
+        "cow_copies": serves.get("serve/prefix_cow", {}).get("count", 0),
+        "pages_inserted": serves.get("serve/prefix_insert",
+                                     {}).get("pages", 0),
+        "evictions": serves.get("serve/prefix_evict", {}).get("count", 0),
+        "page_hit_rate_gauge": rate,
+    }
 
 
 # a warm prefetch queue pops in microseconds — any input wait past this is
@@ -223,6 +261,22 @@ def print_tables(summary, out=sys.stdout):
             reasons = ", ".join(f"{k}={v}" for k, v in r["reasons"].items())
             w(f"{name:<24}{r['count']:>7}  {reasons}\n")
         w("\n")
+    pc = summary.get("prefix_cache")
+    if pc:
+        w("== prefix cache ==\n")
+        frac = pc["request_hit_fraction"]
+        w(f"requests with hits: {pc['requests_with_hits']}"
+          f"/{pc['admitted']} admitted"
+          + (f" ({frac * 100:.1f}%)" if frac is not None else "") + "\n")
+        w(f"pages reused: {pc['pages_reused']}  "
+          f"tokens reused: {pc['tokens_reused']}  "
+          f"cow copies: {pc['cow_copies']}\n")
+        w(f"pages inserted: {pc['pages_inserted']}  "
+          f"evictions: {pc['evictions']}")
+        if pc["page_hit_rate_gauge"] is not None:
+            w(f"  |  page hit rate (gauge): "
+              f"{pc['page_hit_rate_gauge'] * 100:.1f}%")
+        w("\n\n")
     hb = summary["heartbeat"]
     w(f"== heartbeat ==\nsteps: {hb['steps']}  "
       f"median step: {hb['median_step_ms']} ms\n\n")

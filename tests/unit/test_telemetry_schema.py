@@ -35,6 +35,20 @@ def test_kind_sets_in_lockstep(checker):
     assert set(checker.EVENT_KINDS) == set(EVENT_KINDS)
 
 
+def test_serve_event_names_in_lockstep(checker):
+    """The frozen serve-name vocabulary must stay byte-identical between
+    the engine side (inference/robustness.py) and the checker script."""
+    from deepspeed_tpu.inference.robustness import SERVE_EVENTS
+    assert checker.SERVE_EVENTS == SERVE_EVENTS
+
+
+def test_rejects_unknown_serve_name(checker):
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "serve", "name": "serve/not_a_thing"})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "serve", "name": "serve/prefix_hit"})
+
+
 def test_rejects_unknown_kind_and_fields(checker):
     assert checker.validate_event({"ts": 1.0, "kind": "bogus", "name": "x"})
     assert checker.validate_event(
@@ -79,6 +93,13 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.serve("serve/fault", attrs={"site": "serve_step", "error": "inj"})
     tel.serve("serve/finish", attrs={"req_id": "r1", "n_generated": 8})
     tel.serve("serve/drain", attrs={"finished": 3, "shed": 1, "steps": 12})
+    tel.serve("serve/prefix_hit", attrs={"req_id": "r5", "pages_reused": 3,
+                                         "tokens_reused": 384, "cow": 1})
+    tel.serve("serve/prefix_cow", attrs={"req_id": "r5", "src": 7,
+                                         "dst": 12, "tokens": 90})
+    tel.serve("serve/prefix_insert", attrs={"req_id": "r5", "pages": 4,
+                                            "at": "finish"})
+    tel.serve("serve/prefix_evict", attrs={"page": 7})
     wd = StepStallWatchdog(tel, stall_factor=1.0, min_stall_secs=0.0)
     wd.beat(0)
     wd.beat(1)
