@@ -558,6 +558,158 @@ def _worker_serving_prefix(spec):
     print(json.dumps(_serving_prefix_bench(spec)))
 
 
+def _serving_attn_bench(spec=None):
+    """CPU-runnable serving-attention micro-bench: the jnp gather path vs
+    the fused ragged Pallas kernel (interpret mode) on ONE mixed
+    prefill+decode batch over a shared paged pool.
+
+    The gather path is how the engine served before the ragged kernel:
+    host-side regrouping into per-prefill rectangular calls plus one
+    batched decode call, each materialising a max_pages-padded [Hkv, S, D]
+    view per sequence.  The ragged kernel serves the whole mix in one
+    launch reading pages in place.  Interpret-mode wall time is NOT a TPU
+    number (the interpreter is orders slower) — the transferable outputs
+    are the equivalence check and the analytic bytes-moved-per-decoded-
+    token roofline (docs/mfu_ceiling.md §5), recorded for the next
+    on-chip round.  Also drives a tiny engine + ``serve/attn`` spans
+    through one telemetry stream and reports
+    ``ds_telemetry_report.serving_attention`` — proving attention's share
+    of serve-step time is measurable from the frozen stream."""
+    spec = spec or {}
+    import importlib.util
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.ops.paged_attention import (PagedAllocator,
+                                                   PagedKVCache,
+                                                   paged_decode_attention)
+    from deepspeed_tpu.ops.pallas.ragged_paged_attention import \
+        ragged_paged_attention
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    H, HKV, D, PAGE = 4, 2, 16, 16
+    NPAGES = 64
+    prefill_lens = list(spec.get("prefill_lens", [24, 17]))
+    decode_ctx = list(spec.get("decode_ctx", [40, 33]))
+    iters = int(spec.get("iters", 5))
+
+    rng = np.random.default_rng(0)
+    q_lens = prefill_lens + [1] * len(decode_ctx)
+    ctx_lens = prefill_lens + decode_ctx
+    alloc = PagedAllocator(NPAGES, PAGE, max_pages_per_seq=8,
+                           reserve_scratch=True)
+    for s, c in enumerate(ctx_lens):
+        alloc.allocate(s, c)
+    tables = jnp.asarray(alloc.block_table(list(range(len(ctx_lens)))))
+    kp = jnp.asarray(rng.standard_normal((NPAGES, HKV, PAGE, D)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((NPAGES, HKV, PAGE, D)),
+                     jnp.float32)
+    cache = PagedKVCache(kp, vp)
+    q = jnp.asarray(rng.standard_normal((sum(q_lens), H, D)), jnp.float32)
+    ctx = jnp.asarray(ctx_lens, jnp.int32)
+
+    tmp = tempfile.mkdtemp(prefix="serving_attn_bench_")
+    tel = Telemetry().configure(
+        TelemetryConfig({"enabled": True, "output_path": tmp,
+                         "job_name": "serving_attn_bench"}), rank=0)
+
+    def gather_mixed():
+        """Pre-kernel serving shape: one rectangular jnp call per prefill
+        plus one batched call for the decodes."""
+        outs, off = [], 0
+        for s, ql in enumerate(prefill_lens):
+            outs.append(paged_decode_attention(
+                q[off:off + ql][None], cache, tables[s:s + 1],
+                ctx[s:s + 1], impl="jnp")[0])
+            off += ql
+        nd = len(decode_ctx)
+        dec = paged_decode_attention(
+            q[off:].reshape(nd, 1, H, D), cache,
+            tables[len(prefill_lens):], ctx[len(prefill_lens):],
+            impl="jnp")
+        outs.append(dec.reshape(nd, H, D))
+        return jnp.concatenate(outs, axis=0)
+
+    def fused_mixed():
+        return ragged_paged_attention(q, kp, vp, tables, ctx, q_lens,
+                                      interpret=True)
+
+    def timed(fn, label):
+        fn().block_until_ready()   # warmup/compile outside the timing
+        best = float("inf")
+        for _ in range(iters):
+            with tel.span("serve/attn", attrs={"backend": label}):
+                t0 = time.perf_counter()
+                fn().block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+        return best * 1000.0
+
+    gather_ms = timed(gather_mixed, "jnp")
+    fused_ms = timed(fused_mixed, "pallas-interpret")
+    err = float(jnp.max(jnp.abs(gather_mixed() - fused_mixed())))
+
+    # analytic HBM traffic per decoded token (fp32 here; ratio is
+    # dtype-free): the gather path materialises the max_pages-padded K
+    # and V views and reads them again through the softmax/PV einsums
+    # (~3 passes), the fused kernel streams each sequence's true context
+    # once.  docs/mfu_ceiling.md §5 carries the decomposition.
+    bpe = 4
+    S_pad = int(tables.shape[1]) * PAGE
+    gather_bytes = 3 * 2 * S_pad * HKV * D * bpe
+    mean_ctx = sum(decode_ctx) / len(decode_ctx)
+    fused_bytes = 2 * mean_ctx * HKV * D * bpe
+    # drive a tiny engine through the same stream so serve/backend +
+    # serve/step land next to the serve/attn spans
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    eng = ServingEngine(model, params, max_batch=2, page_size=8,
+                        max_seq=64, dtype=jnp.float32, telemetry=tel,
+                        serving={"attention_backend": "jnp"})
+    eng.generate([[1, 2, 3, 4, 5], [7, 8, 9]], max_new_tokens=3)
+    leaks = eng.leak_report()
+    tel.close()
+
+    # attention's share of serve-step time, read back the way an operator
+    # would: through ds_telemetry_report's serving_attention summary
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rp = os.path.join(repo, "scripts", "ds_telemetry_report.py")
+    sp = importlib.util.spec_from_file_location("ds_telemetry_report", rp)
+    report = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(report)
+    files = report.discover_files(os.path.join(tmp, "serving_attn_bench"))
+    summary = report.summarize(report.aggregate(report.load_events(files)))
+
+    return {
+        "q_lens": q_lens,
+        "ctx_lens": ctx_lens,
+        "gather_jnp_ms": round(gather_ms, 3),
+        "ragged_interpret_ms": round(fused_ms, 3),
+        "max_abs_diff": err,
+        "equivalent": err < 2e-5,
+        "gather_bytes_per_decoded_token": gather_bytes,
+        "fused_bytes_per_decoded_token": int(fused_bytes),
+        "analytic_bytes_ratio": round(gather_bytes / fused_bytes, 1),
+        "serving_attention_report": summary.get("serving_attention"),
+        "leaks": leaks,
+        "note": "interpret-mode wall time is not a TPU number; the "
+                "equivalence + analytic roofline are the transferable "
+                "outputs for the next on-chip round",
+    }
+
+
+def _worker_serving_attn(spec):
+    print(json.dumps(_serving_attn_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -650,6 +802,24 @@ def _attach_serving_prefix(out):
     return out
 
 
+def _attach_serving_attn(out):
+    """Attach the serving-attention micro-bench under the stable key
+    ``cpu_serving_attn`` (CPU-runnable: jnp gather vs interpret-mode
+    ragged kernel on a mixed batch, equivalence + analytic roofline).
+    Budget-gated; a failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "serving_attn", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_serving_attn"] = res
+    else:
+        out.setdefault("notes", {})["serving_attn"] = (err or "")[:200]
+    return out
+
+
 def main():
     errors = {}
 
@@ -676,7 +846,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))
+            print(json.dumps(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -764,7 +934,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))
+        print(json.dumps(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -839,7 +1009,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))
+    print(json.dumps(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))
 
 
 if __name__ == "__main__":
@@ -866,6 +1036,8 @@ if __name__ == "__main__":
             _worker_serving(spec)
         elif which == "serving_prefix":
             _worker_serving_prefix(spec)
+        elif which == "serving_attn":
+            _worker_serving_attn(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

@@ -84,7 +84,16 @@ SERVE_EVENTS = (
     # ("serve/prefix_evict")
     "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
     "serve/prefix_evict",
+    # attention-backend record: emitted once at engine construction with
+    # attrs attention_backend / impl / interpret, so a telemetry stream's
+    # serve/step spans are attributable to the kernel path that ran
+    "serve/backend",
 )
+
+# the serving.attention_backend vocabulary (mirrors
+# ops/paged_attention.py ATTENTION_BACKENDS; validated at config time so
+# a typo fails construction, not the first jitted step)
+ATTENTION_BACKENDS = ("auto", "jnp", "pallas", "pallas-interpret")
 
 
 class RequestRejected(Exception):
@@ -149,6 +158,10 @@ class ServingRobustnessConfig(DeepSpeedConfigModel):
     max_prompt_tokens = 0           # extra prompt cap under max_seq (0=off)
     step_fault_limit = 8            # consecutive serve_step faults -> raise
     fault_injection = {}            # FaultInjector spec (serving sites)
+    # paged-attention implementation: "auto" (Pallas on TPU, jnp
+    # elsewhere) | "jnp" (gather oracle) | "pallas" | "pallas-interpret"
+    # (the kernel through the interpreter — CPU CI bit-identity)
+    attention_backend = "auto"
     # content-hashed KV-page reuse (inference/prefix_cache.py):
     # {"enabled": bool, "max_cached_pages": int, "min_prefix_tokens": int}
     prefix_cache = {}
@@ -161,6 +174,10 @@ class ServingRobustnessConfig(DeepSpeedConfigModel):
         if self.overload_policy not in OVERLOAD_POLICIES:
             raise ValueError(
                 f"serving.overload_policy must be one of {OVERLOAD_POLICIES}")
+        if self.attention_backend not in ATTENTION_BACKENDS:
+            raise ValueError(
+                f"serving.attention_backend must be one of "
+                f"{ATTENTION_BACKENDS}")
         for k in ("max_queue", "queue_high_watermark", "queue_low_watermark",
                   "free_page_low_watermark", "block_max_steps",
                   "max_prompt_tokens", "step_fault_limit"):

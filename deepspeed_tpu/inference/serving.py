@@ -27,6 +27,7 @@ rest of the batch keeps serving; ``drain()`` quiesces the engine and
 request state, so recovered requests stay bit-identical.
 """
 
+import functools
 import math
 import time
 from dataclasses import dataclass, field
@@ -45,7 +46,8 @@ from deepspeed_tpu.inference.robustness import (
 from deepspeed_tpu.inference.prefix_cache import PrefixCache, PrefixMatch
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
-                                               PagedAllocator)
+                                               PagedAllocator,
+                                               resolve_attention_backend)
 from deepspeed_tpu.runtime.resilience import FaultInjector
 from deepspeed_tpu.utils.logging import logger
 
@@ -184,10 +186,19 @@ class ServingEngine:
         # the request's own last real page)
         self.tables = np.zeros((max_batch, self.max_pages_per_seq + 1),
                                np.int32)
+        # attention backend: "auto" (Pallas kernel on TPU, jnp elsewhere),
+        # "jnp" (gather oracle), "pallas", or "pallas-interpret" (the exact
+        # kernel path through the interpreter — CPU CI).  Bound as static
+        # kwargs BEFORE jit so every compiled shape uses one backend.
+        self.attention_backend = self.serving.attention_backend
+        attn_impl, attn_interpret = resolve_attention_backend(
+            self.attention_backend)
+        self._paged_call = functools.partial(
+            self.model.apply_with_paged_cache,
+            attn_backend=attn_impl, attn_interpret=attn_interpret)
         # one jit serves prefill (B=1, bucketed T) and decode (B=max_batch,
         # T=1) alike: jax.jit caches a compilation per input shape
-        self._step_fn = jax.jit(self.model.apply_with_paged_cache,
-                                donate_argnums=(2,))
+        self._step_fn = jax.jit(self._paged_call, donate_argnums=(2,))
         self._rng = {}
         # multi-token decode: one device program advances every slot
         # ``decode_chunk`` tokens (sampling included) per host round-trip.
@@ -207,6 +218,13 @@ class ServingEngine:
                       "deadline": 0, "evicted": 0, "finished": 0,
                       "step_faults": 0, "drains": 0, "prefix_hits": 0,
                       "prefix_cow_copies": 0, "prefix_evictions": 0}
+        # one frozen event per engine records which attention path every
+        # serve/step span of this stream ran (ds_telemetry_report keys
+        # its serving-attention table off it)
+        self._serve_event("serve/backend",
+                          attention_backend=self.attention_backend,
+                          impl=attn_impl or "auto",
+                          interpret=int(attn_interpret))
 
     # -- telemetry -------------------------------------------------------
     @property
@@ -469,11 +487,7 @@ class ServingEngine:
                 self._serve_event("serve/evict", req_id=req.req_id,
                                   reason=EVICT_FAULT, error=str(e))
                 continue
-            if need_tokens > total:
-                self.alloc.shrink(req.req_id, total)
-                pages = self.alloc.seq_pages[req.req_id]
-                self.tables[slot, :] = 0
-                self.tables[slot, :len(pages)] = pages
+            self._trim_reservation(slot, req)
             if self.prefix_cache is not None:
                 added = self.prefix_cache.insert(
                     req.prompt, self.alloc.seq_pages[req.req_id])
@@ -481,12 +495,38 @@ class ServingEngine:
                     self._serve_event("serve/prefix_insert",
                                       req_id=req.req_id, pages=added)
 
-    def _run_step(self, ids, tables, lengths):
-        if self.mesh is not None:
-            with self.mesh:
-                return self._step_fn(self.params, ids, self.caches,
-                                     tables, lengths)
-        return self._step_fn(self.params, ids, self.caches, tables, lengths)
+    def _trim_reservation(self, slot: int, req: _Request):
+        """Trim the slot's reservation to the request's TRUE page need.
+
+        Bucketed prefill over-allocates to the padded suffix length; the
+        surplus used to be returned only when ``need_tokens > total``,
+        leaving the invariant to the caller.  Trimming unconditionally —
+        and asserting the result — is what lets the ragged kernel, the
+        block tables, and the allocator all agree on true lengths
+        (``leak_report`` audits the same invariant engine-wide)."""
+        total = len(req.prompt) + req.max_new_tokens
+        self.alloc.shrink(req.req_id, total)
+        pages = self.alloc.seq_pages[req.req_id]
+        expected = max(1, -(-total // self.page_size))
+        assert len(pages) == expected, (
+            f"request {req.req_id!r}: {len(pages)} pages held after trim, "
+            f"expected {expected} for {total} tokens "
+            f"(page_size {self.page_size})")
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(pages)] = pages
+
+    def _run_step(self, ids, tables, lengths, phase="decode"):
+        with self.telemetry.span("serve/step",
+                                 attrs={"backend": self.attention_backend,
+                                        "phase": phase,
+                                        "batch": int(ids.shape[0]),
+                                        "tokens": int(ids.shape[1])}):
+            if self.mesh is not None:
+                with self.mesh:
+                    return self._step_fn(self.params, ids, self.caches,
+                                         tables, lengths)
+            return self._step_fn(self.params, ids, self.caches, tables,
+                                 lengths)
 
     # -- prefix-cache plumbing ------------------------------------------
     def _on_prefix_evict(self, page: int):
@@ -528,7 +568,7 @@ class ServingEngine:
         logits, self.caches, _ = self._run_step(
             jnp.asarray(ids),
             jnp.asarray(self.tables[slot:slot + 1]),
-            jnp.full((1,), cached, jnp.int32))
+            jnp.full((1,), cached, jnp.int32), phase="prefill")
         self.lengths[slot] = len(req.prompt)
         req.last_token = self._sample(
             req, np.asarray(logits[0, len(suffix) - 1]))
@@ -594,7 +634,7 @@ class ServingEngine:
     # -- the chunked decode step (K tokens per dispatch) ----------------
     def _build_chunk_fn(self, use_filters: bool):
         K = self.decode_chunk
-        model = self.model
+        paged_call = self._paged_call   # backend-bound apply_with_paged_cache
 
         def chunk(params, caches, tables, lengths, last, temps, seeds,
                   gen_counts, top_ks, top_ps):
@@ -628,7 +668,7 @@ class ServingEngine:
 
             def one(carry, t):
                 caches, lengths, last = carry
-                logits, caches, _ = model.apply_with_paged_cache(
+                logits, caches, _ = paged_call(
                     params, last[:, None], caches, tables, lengths)
                 lg = logits[:, 0]
                 greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -678,11 +718,16 @@ class ServingEngine:
                 jnp.asarray(temps), jnp.asarray(seeds),
                 jnp.asarray(gen_counts), jnp.asarray(top_ks),
                 jnp.asarray(top_ps))
-        if self.mesh is not None:
-            with self.mesh:
+        with self.telemetry.span("serve/step",
+                                 attrs={"backend": self.attention_backend,
+                                        "phase": "decode_chunk",
+                                        "batch": int(self.max_batch),
+                                        "tokens": int(K)}):
+            if self.mesh is not None:
+                with self.mesh:
+                    toks, self.caches = chunk_fn(*args)
+            else:
                 toks, self.caches = chunk_fn(*args)
-        else:
-            toks, self.caches = chunk_fn(*args)
         toks = np.asarray(toks)
 
         done_slots, done_now = [], {}
@@ -907,6 +952,20 @@ class ServingEngine:
                  (self.lengths[s] != 0 or self.tables[s].any())]
         if dirty:
             leaks["dirty_inactive_slots"] = dirty
+        # every active slot's reservation must equal its TRUE page need
+        # (prompt + budget) — _trim_reservation's invariant, the lengths
+        # the ragged attention kernel and the allocator both work from
+        over = {}
+        for s, req in enumerate(self.slots):
+            if req is None:
+                continue
+            total = len(req.prompt) + req.max_new_tokens
+            expected = max(1, -(-total // self.page_size))
+            held = len(self.alloc.seq_pages.get(req.req_id, ()))
+            if held != expected:
+                over[str(req.req_id)] = {"held": held, "expected": expected}
+        if over:
+            leaks["over_reserved_slots"] = over
         return leaks
 
     # -- convenience ----------------------------------------------------

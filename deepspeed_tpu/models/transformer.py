@@ -1000,14 +1000,19 @@ class CausalTransformerLM:
                 x[None], (c.n_layers,) + x.shape).copy(), one)
 
     def apply_with_paged_cache(self, params, input_ids, caches, block_tables,
-                               lengths):
+                               lengths, *, attn_backend=None,
+                               attn_interpret=False):
         """Forward over paged KV caches: appends the T new tokens of every
         sequence at ``lengths`` (tables must already map the pages) and
         attends over each sequence's ragged prefix.  Returns
         (logits [B, T, V], new caches, lengths + T).
 
         ``caches``: pytree from ``init_paged_caches``; ``block_tables``:
-        [B, max_pages] int32; ``lengths``: [B] int32.
+        [B, max_pages] int32; ``lengths``: [B] int32.  ``attn_backend`` /
+        ``attn_interpret`` select the paged-attention implementation
+        (``ops/paged_attention.py``: None = auto, "jnp" oracle, "pallas"
+        fused ragged kernel; interpret runs the kernel on CPU) — static
+        kwargs, so the serving engine binds them before jit.
         """
         from deepspeed_tpu.ops.paged_attention import (PagedKVCache,
                                                        paged_decode_attention,
@@ -1040,6 +1045,8 @@ class CausalTransformerLM:
             attn = paged_decode_attention(q, cache, block_tables,
                                           lengths + T,
                                           softmax_scale=c.attn_scale,
+                                          impl=attn_backend,
+                                          interpret=attn_interpret,
                                           logit_softcap=c.attn_logit_softcap)
             attn_delta = self._proj(attn.reshape(B, T, H * dh), layer, "wo")
             if "attn_post_norm" in layer:   # Gemma-2 sandwich

@@ -112,6 +112,25 @@ def main(argv=None):
                           q, kp, vp, t, ln, interpret=interp),
                       qd, kp, kp, tables, lengths))
 
+    # fused ragged paged attention (one kernel, mixed prefill+decode):
+    # gate pure-decode, pure-prefill, and mixed ragged shapes over the
+    # same page pool — q_lens is host metadata, so it closes over the fn
+    from deepspeed_tpu.ops.pallas.ragged_paged_attention import \
+        ragged_paged_attention
+
+    def ragged(name, q_lens, ctx_lens):
+        qr = jax.random.normal(rng, (sum(q_lens), H, D), jnp.bfloat16)
+        ctx = jnp.asarray(ctx_lens, jnp.int32)
+        rows.append(_gate(
+            f"ragged_{name}",
+            lambda q, kp, vp, t, c: ragged_paged_attention(
+                q, kp, vp, t, c, q_lens, interpret=interp),
+            qr, kp, kp, tables, ctx))
+
+    ragged("decode", [1] * B, [S // 2] * B)
+    ragged("prefill", [256] * B, [256] * B)
+    ragged("mixed", [256, 1], [256, S // 2])
+
     # sparse attention (fixed local+global layout)
     block, nb = 128, S // 128
     layout = np.zeros((H, nb, nb), np.int64)

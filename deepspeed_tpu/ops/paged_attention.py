@@ -13,12 +13,14 @@ Layout:
   block_tables:    [B, max_pages_per_seq] int32 — page ids per sequence
   lengths:         [B] int32 — tokens currently stored per sequence
 
-Two compute paths behind one API: the Pallas kernel
-(``ops/pallas/decode_attention.py:paged_attention_pallas`` — the key-block
-index map reads the block table so only each sequence's own pages are
-DMA'd) on TPU, and this module's jnp gather + masked softmax as the
-oracle/fallback.  Page allocation is host-side (``PagedAllocator``)
-because it is control flow, not compute.
+Two compute paths behind one API: the fused ragged Pallas kernel
+(``ops/pallas/ragged_paged_attention.py`` — the K/V index maps read the
+block table so only each sequence's own pages are DMA'd, and one launch
+serves a mixed prefill+decode batch) on TPU, and this module's jnp
+gather + masked softmax as the oracle/fallback.
+``resolve_attention_backend`` maps the ``serving.attention_backend``
+config strings onto the pair.  Page allocation is host-side
+(``PagedAllocator``) because it is control flow, not compute.
 """
 
 import math
@@ -33,6 +35,26 @@ import numpy as np
 class PagedKVCache(NamedTuple):
     k_pages: jnp.ndarray   # [P, Hkv, page, D]
     v_pages: jnp.ndarray
+
+
+# public vocabulary for serving.attention_backend (docs/config-json.md)
+ATTENTION_BACKENDS = ("auto", "jnp", "pallas", "pallas-interpret")
+
+
+def resolve_attention_backend(backend):
+    """Map a ``serving.attention_backend`` string to (impl, interpret).
+
+    ``impl`` is what ``use_pallas`` consumes (None = auto: Pallas on TPU,
+    jnp elsewhere); ``interpret`` forces the Pallas kernel through the
+    interpreter so CPU CI can run the exact kernel path bit-for-bit."""
+    if backend is None or backend == "auto":
+        return None, False
+    if backend == "pallas-interpret":
+        return "pallas", True
+    if backend in ("jnp", "pallas"):
+        return backend, False
+    raise ValueError(f"unknown attention backend {backend!r}; "
+                     f"expected one of {ATTENTION_BACKENDS}")
 
 
 def init_paged_cache(num_pages, page_size, n_kv_heads, head_dim,
@@ -83,20 +105,29 @@ def paged_decode_attention(q, cache: PagedKVCache, block_tables, lengths,
                            softmax_scale: Optional[float] = None,
                            impl: Optional[str] = None,
                            interpret: bool = False,
-                           logit_softcap: Optional[float] = None):
+                           logit_softcap: Optional[float] = None,
+                           backend: Optional[str] = None):
     """q: [B, T, H, D] — the last T tokens of each sequence (T=1 decode).
 
     ``impl``: None (auto: Pallas kernel on TPU, jnp elsewhere), "pallas",
-    or "jnp".  The jnp path gathers each sequence's pages into its logical
-    view and runs masked attention over the valid ragged prefix."""
+    or "jnp"; ``backend`` is the serving-config spelling ("auto" | "jnp" |
+    "pallas" | "pallas-interpret") and overrides ``impl``/``interpret``
+    when given.  The Pallas path is the fused ragged kernel
+    (``ops/pallas/ragged_paged_attention.py``); the jnp path gathers each
+    sequence's pages into its logical view and runs masked attention over
+    the valid ragged prefix — it is the oracle the kernel is tested
+    against.  ``logit_softcap`` is jnp-only and forces the fallback."""
     from deepspeed_tpu.ops.decode_attention import use_pallas
+    if backend is not None:
+        impl, forced = resolve_attention_backend(backend)
+        interpret = interpret or forced
     if use_pallas(impl) and not logit_softcap:
-        from deepspeed_tpu.ops.pallas.decode_attention import \
-            paged_attention_pallas
-        return paged_attention_pallas(q, cache.k_pages, cache.v_pages,
-                                      block_tables, lengths,
-                                      softmax_scale=softmax_scale,
-                                      interpret=interpret)
+        from deepspeed_tpu.ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention_rect
+        return ragged_paged_attention_rect(q, cache.k_pages, cache.v_pages,
+                                           block_tables, lengths,
+                                           softmax_scale=softmax_scale,
+                                           interpret=interpret)
     B, T, H, D = q.shape
     Hkv = cache.k_pages.shape[1]
     page_size = cache.k_pages.shape[2]

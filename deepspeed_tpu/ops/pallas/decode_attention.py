@@ -17,9 +17,10 @@ TPU design (one kernel body, two front-ends):
   persists across the key-block grid dimension), fp32 accumulation, one
   [group·T, D] output tile per (batch, kv head);
 * GQA comes free: the q tile for one kv head is its whole head group;
-* the paged front-end is identical except the key-block index map reads the
-  sequence's **block table** (vLLM-style page pool, PAPERS.md ragged paged
-  attention) instead of a linear offset.
+* the paged front-end (``paged_attention_pallas``) is now a deprecated
+  shim over the fused ragged kernel in
+  ``ops/pallas/ragged_paged_attention.py`` — one paged-attention kernel
+  surface for decode, prefill, and mixed ragged batches.
 
 The jnp paths in ``ops/decode_attention.py`` / ``ops/paged_attention.py``
 remain the test oracles; ``interpret=True`` runs this kernel on CPU CI.
@@ -161,56 +162,20 @@ def decode_attention_pallas(q, k, v, lengths, softmax_scale=None,
 
 def paged_attention_pallas(q, k_pages, v_pages, block_tables, lengths,
                            softmax_scale=None, interpret=False):
-    """Ragged paged decode attention.
+    """DEPRECATED: delegate to the fused ragged kernel.
 
-    q: [B, T, H, D]; k_pages/v_pages: [P, Hkv, page_size, D];
-    block_tables: [B, max_pages] int32 page ids; lengths: [B] int32.
-    The key-block index map reads the block table, so only each
-    sequence's own pages are ever DMA'd.
+    The decode-only paged kernel that used to live here is subsumed by
+    ``ops/pallas/ragged_paged_attention.py`` (one kernel surface for
+    decode, prefill, and mixed ragged batches).  This shim keeps the old
+    signature — q: [B, T, H, D]; k_pages/v_pages: [P, Hkv, page_size, D];
+    block_tables: [B, max_pages] int32; lengths: [B] int32 — and routes
+    through the rectangular front-end, which for T=1 does identical work
+    (one q row per sequence, pages resolved through the block table).
+    New callers should use ``paged_decode_attention`` in
+    ``ops/paged_attention.py`` or the ragged entry points directly.
     """
-    B, T, H, D = q.shape
-    P, Hkv, page_size, _ = k_pages.shape
-    group = H // Hkv
-    max_pages = block_tables.shape[1]
-    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
-    lengths = jnp.asarray(lengths, jnp.int32)
-    block_tables = jnp.asarray(block_tables, jnp.int32)
-
-    qg = q.reshape(B, T, Hkv, group, D)
-
-    def k_map(b, h, i, lens, tables):
-        last = jnp.maximum(pl.cdiv(lens[b], page_size) - 1, 0)
-        page = tables[b, jnp.minimum(i, last)]
-        return (page, h, 0, 0)
-
-    def paged_kernel(lengths_ref, tables_ref, *refs, **kw):
-        _decode_kernel(lengths_ref, *refs, **kw)
-
-    grid = (B, Hkv, max_pages)
-    kernel = functools.partial(
-        paged_kernel, scale=scale, block_k=page_size, n_q_tokens=T,
-        group=group)
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, T, 1, group, D),
-                             lambda b, h, i, lens, tables: (b, 0, h, 0, 0)),
-                pl.BlockSpec((1, 1, page_size, D), k_map),
-                pl.BlockSpec((1, 1, page_size, D), k_map),
-            ],
-            out_specs=pl.BlockSpec((1, T, 1, group, D),
-                                   lambda b, h, i, lens, tables:
-                                   (b, 0, h, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((T * group, D), jnp.float32),
-                pltpu.VMEM((T * group, 1), jnp.float32),
-                pltpu.VMEM((T * group, 1), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, T, Hkv, group, D), q.dtype),
-        interpret=interpret,
-    )(lengths, block_tables, qg, k_pages, v_pages)
-    return out.reshape(B, T, H, D)
+    from deepspeed_tpu.ops.pallas.ragged_paged_attention import \
+        ragged_paged_attention_rect
+    return ragged_paged_attention_rect(q, k_pages, v_pages, block_tables,
+                                       lengths, softmax_scale=softmax_scale,
+                                       interpret=interpret)

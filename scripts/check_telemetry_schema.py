@@ -81,11 +81,15 @@ SCHEMA = {
 # diffs the two).  The prefix_* names belong to the prefix-cache subsystem
 # (inference/prefix_cache.py): cached-page attach hits, copy-on-write
 # copies, newly indexed pages, and reclaim-tier evictions.
+# "serve/backend" records the attention backend an engine was built with
+# (attrs: attention_backend / impl / interpret) so the stream's serve/step
+# spans are attributable to the kernel path that produced them.
 SERVE_EVENTS = (
     "serve/admit", "serve/reject", "serve/shed", "serve/deadline",
     "serve/evict", "serve/drain", "serve/finish", "serve/fault",
     "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
     "serve/prefix_evict",
+    "serve/backend",
 )
 
 EVENT_KINDS = tuple(SCHEMA)

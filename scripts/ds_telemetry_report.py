@@ -108,6 +108,8 @@ def aggregate(events):
             elif ev["name"] == "serve/prefix_insert":
                 rec["pages"] = rec.get("pages", 0) + \
                     int(attrs.get("pages", 0))
+            elif ev["name"] == "serve/backend":
+                rec["backend"] = attrs.get("attention_backend", "?")
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "steps": steps, "stalls": stalls,
             "metas": metas, "serves": serves}
@@ -144,9 +146,34 @@ def summarize(agg):
             "heartbeat": heartbeat,
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
+            "serving_attention": _serving_attention_summary(agg),
             "prefix_cache": _prefix_cache_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _serving_attention_summary(agg):
+    """Attention-backend digest: which kernel path served the stream
+    (``serve/backend`` event) and attention's share of serve-step time —
+    the ``serve/attn`` spans a bench or instrumented engine wraps the
+    attention calls in, sized against the engine's ``serve/step``
+    dispatch spans."""
+    steps = agg["spans"].get("serve/step")
+    attn = agg["spans"].get("serve/attn")
+    backend = agg.get("serves", {}).get("serve/backend", {}).get("backend")
+    if not steps and not attn and backend is None:
+        return None
+    total_step = sum(steps) if steps else None
+    total_attn = sum(attn) if attn else None
+    return {
+        "backend": backend,
+        "steps": len(steps) if steps else 0,
+        "total_step_ms": round(total_step, 3) if total_step else None,
+        "attn_spans": len(attn) if attn else 0,
+        "total_attn_ms": round(total_attn, 3) if total_attn else None,
+        "attn_fraction_of_step": (round(total_attn / total_step, 4)
+                                  if total_attn and total_step else None),
+    }
 
 
 def _prefix_cache_summary(agg):
@@ -261,6 +288,18 @@ def print_tables(summary, out=sys.stdout):
             reasons = ", ".join(f"{k}={v}" for k, v in r["reasons"].items())
             w(f"{name:<24}{r['count']:>7}  {reasons}\n")
         w("\n")
+    sa = summary.get("serving_attention")
+    if sa:
+        w("== serving attention ==\n")
+        w(f"backend: {sa['backend'] or '?'}  "
+          f"steps: {sa['steps']}  "
+          f"total step: {sa['total_step_ms']} ms\n")
+        w(f"attn spans: {sa['attn_spans']}  "
+          f"total attn: {sa['total_attn_ms']} ms")
+        if sa["attn_fraction_of_step"] is not None:
+            w(f"  |  attention share of serve-step: "
+              f"{sa['attn_fraction_of_step'] * 100:.1f}%")
+        w("\n\n")
     pc = summary.get("prefix_cache")
     if pc:
         w("== prefix cache ==\n")

@@ -1,0 +1,234 @@
+"""Fused ragged paged-attention kernel vs the jnp gather oracle.
+
+The Pallas kernel (``ops/pallas/ragged_paged_attention.py``) must be
+bit-class equivalent (per-dtype tolerance) to ``paged_decode_attention``'s
+jnp path on every ragged mix — decode-only, prefill-only, mixed — through
+real ``PagedAllocator`` block tables including prefix-cache shared pages
+and partial last pages.  On top of the kernel-level equivalence, the
+serving engine's token streams must be BIT-IDENTICAL across
+``attention_backend="jnp"`` and ``"pallas-interpret"`` — the backend is a
+performance knob, never a quality knob.  All kernel runs use
+``interpret=True`` (this suite is CPU tier-1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.paged_attention import (PagedAllocator, PagedKVCache,
+                                               paged_decode_attention,
+                                               resolve_attention_backend)
+from deepspeed_tpu.ops.pallas.ragged_paged_attention import (
+    ragged_paged_attention, ragged_paged_attention_rect)
+
+H, HKV, D, PAGE = 4, 2, 8, 4
+NPAGES = 64
+TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+def _build_state(ctx_lens, shared_pages=0, seed=0):
+    """A page pool + allocator-produced block tables for one ragged batch.
+
+    ``shared_pages`` > 0 attaches that many leading pages of a holder
+    sequence to EVERY request (``allocate(shared=...)`` — the prefix-cache
+    admission path), so the kernel must read refcounted shared pages in
+    place."""
+    rng = np.random.default_rng(seed)
+    alloc = PagedAllocator(NPAGES, PAGE, max_pages_per_seq=8,
+                           reserve_scratch=True)
+    shared = []
+    if shared_pages:
+        shared = alloc.allocate("__prefix__",
+                                shared_pages * PAGE)[:shared_pages]
+    for s, c in enumerate(ctx_lens):
+        # a request can share at most its own FULL pages
+        n_shared = min(shared_pages, max(0, (c - 1) // PAGE))
+        alloc.allocate(s, c, shared=shared[:n_shared])
+    tables = jnp.asarray(alloc.block_table(list(range(len(ctx_lens)))))
+    kp = jnp.asarray(rng.standard_normal((NPAGES, HKV, PAGE, D)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((NPAGES, HKV, PAGE, D)),
+                     jnp.float32)
+    return alloc, tables, kp, vp
+
+
+def _ref(q_packed, q_lens, ctx_lens, kp, vp, tables):
+    """Oracle: one rectangular jnp gather call per sequence."""
+    cache = PagedKVCache(kp, vp)
+    outs, off = [], 0
+    for s, (ql, c) in enumerate(zip(q_lens, ctx_lens)):
+        o = paged_decode_attention(
+            q_packed[off:off + ql][None], cache, tables[s:s + 1],
+            jnp.asarray([c], jnp.int32), impl="jnp")
+        outs.append(o[0])
+        off += ql
+    return jnp.concatenate(outs, axis=0)
+
+
+CASES = [
+    ("decode_only", [1, 1, 1], [9, 4, 16]),
+    ("prefill_only", [9, 5], [9, 5]),
+    ("mixed", [6, 1, 3, 1], [6, 13, 7, 16]),
+    ("length_one", [1], [1]),
+    ("page_boundary", [4, 1], [8, 8]),       # ctx exactly fills pages
+    ("partial_last_page", [5, 1], [5, 10]),  # ctx ends mid-page
+]
+
+
+@pytest.mark.parametrize("name,q_lens,ctx_lens",
+                         CASES, ids=[c[0] for c in CASES])
+def test_matches_jnp_oracle(name, q_lens, ctx_lens):
+    _, tables, kp, vp = _build_state(ctx_lens)
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((sum(q_lens), H, D)), jnp.float32)
+    got = ragged_paged_attention(q, kp, vp, tables,
+                                 jnp.asarray(ctx_lens, jnp.int32), q_lens,
+                                 interpret=True)
+    want = _ref(q, q_lens, ctx_lens, kp, vp, tables)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+def test_prefix_cache_shared_pages_read_in_place():
+    """Both requests' tables lead with the SAME physical pages (refcounted
+    prefix attach); the kernel must produce the oracle's answer reading
+    them in place — and the mix has a decode rider over the same pool."""
+    q_lens, ctx_lens = [5, 1, 1], [13, 11, 9]
+    alloc, tables, kp, vp = _build_state(ctx_lens, shared_pages=2)
+    t = np.asarray(tables)
+    assert t[0, 0] == t[1, 0] and t[0, 1] == t[1, 1]   # genuinely shared
+    assert alloc.audit() == {}
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((sum(q_lens), H, D)), jnp.float32)
+    got = ragged_paged_attention(q, kp, vp, tables,
+                                 jnp.asarray(ctx_lens, jnp.int32), q_lens,
+                                 interpret=True)
+    want = _ref(q, q_lens, ctx_lens, kp, vp, tables)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+@pytest.mark.parametrize("T", [1, 5, 8, 12])
+def test_rect_front_end(T):
+    """The rectangular wrapper (the jitted serving path's shape) must
+    match the oracle for decode (T=1), in-tile prefill, exact-tile, and
+    the Tp-padding path (T=12 > q_tile=8)."""
+    B = 3
+    ctx = [T + 3, T, T + 9]
+    _, tables, kp, vp = _build_state(ctx)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    lengths = jnp.asarray(ctx, jnp.int32)
+    got = ragged_paged_attention_rect(q, kp, vp, tables, lengths,
+                                     interpret=True)
+    want = paged_decode_attention(q, PagedKVCache(kp, vp), tables, lengths,
+                                  impl="jnp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+def test_backend_selected_entry_point():
+    """``paged_decode_attention(backend=...)`` routes "pallas-interpret"
+    through the ragged kernel and agrees with the jnp backend."""
+    ctx = [7, 12]
+    _, tables, kp, vp = _build_state(ctx)
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 1, H, D)), jnp.float32)
+    cache = PagedKVCache(kp, vp)
+    lengths = jnp.asarray(ctx, jnp.int32)
+    a = paged_decode_attention(q, cache, tables, lengths, backend="jnp")
+    b = paged_decode_attention(q, cache, tables, lengths,
+                               backend="pallas-interpret")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), **TOL)
+
+
+def test_resolve_attention_backend():
+    assert resolve_attention_backend(None) == (None, False)
+    assert resolve_attention_backend("auto") == (None, False)
+    assert resolve_attention_backend("jnp") == ("jnp", False)
+    assert resolve_attention_backend("pallas") == ("pallas", False)
+    assert resolve_attention_backend("pallas-interpret") == ("pallas", True)
+    with pytest.raises(ValueError):
+        resolve_attention_backend("cuda")
+
+
+def test_deprecated_shim_still_serves():
+    """``paged_attention_pallas`` (old decode-only surface) delegates to
+    the ragged kernel with unchanged semantics."""
+    from deepspeed_tpu.ops.pallas.decode_attention import \
+        paged_attention_pallas
+    ctx = [9, 14]
+    _, tables, kp, vp = _build_state(ctx)
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((2, 1, H, D)), jnp.float32)
+    lengths = jnp.asarray(ctx, jnp.int32)
+    got = paged_attention_pallas(q, kp, vp, tables, lengths, interpret=True)
+    want = paged_decode_attention(q, PagedKVCache(kp, vp), tables, lengths,
+                                  impl="jnp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+# -- serving end-to-end ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny():
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    return cfg, model, params
+
+
+def test_serving_bit_identical_across_backends(tiny):
+    """The whole engine — bucketed prefill, batched decode, sampling —
+    must emit bit-identical token streams under the jnp gather path and
+    the interpret-mode ragged kernel, with a clean leak report."""
+    from deepspeed_tpu.inference.serving import ServingEngine
+    cfg, model, params = tiny
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 9, 3)]
+
+    def run(backend):
+        eng = ServingEngine(model, params, max_batch=4, page_size=8,
+                            max_seq=64, dtype=jnp.float32,
+                            serving={"attention_backend": backend})
+        assert eng.attention_backend == backend
+        out = eng.generate(prompts, max_new_tokens=4)
+        assert eng.leak_report() == {}
+        return out
+
+    assert run("jnp") == run("pallas-interpret")
+
+
+def test_bad_backend_rejected_at_construction(tiny):
+    from deepspeed_tpu.inference.serving import ServingEngine
+    cfg, model, params = tiny
+    with pytest.raises(ValueError, match="attention_backend"):
+        ServingEngine(model, params, max_batch=1, page_size=8, max_seq=64,
+                      dtype=jnp.float32,
+                      serving={"attention_backend": "cuda"})
+
+
+def test_reservation_trimmed_and_audited(tiny):
+    """Admission must trim the bucketed-prefill over-allocation to the
+    request's true page need (``_trim_reservation``), and
+    ``leak_report()`` must flag any active slot whose reservation drifts
+    from it."""
+    from deepspeed_tpu.inference.serving import ServingEngine
+    cfg, model, params = tiny
+    eng = ServingEngine(model, params, max_batch=1, page_size=4,
+                        max_seq=32, dtype=jnp.float32)
+    # prompt 9 + budget 2 = 11 tokens -> 3 pages; the prefill bucket pads
+    # to 16 tokens -> 4 pages reserved, so admission MUST return one
+    prompt = list(range(1, 10))
+    eng.add_request("r0", prompt, max_new_tokens=2)
+    eng.step()
+    assert eng.slots[0] is not None and eng.slots[0].req_id == "r0"
+    assert len(eng.alloc.seq_pages["r0"]) == 3
+    assert eng.leak_report() == {}
+    # force a drifted reservation: the audit must name the slot
+    eng.alloc.extend("r0", 16)
+    leaks = eng.leak_report()
+    assert "over_reserved_slots" in leaks
+    assert leaks["over_reserved_slots"]["r0"]["held"] == 4
+    eng.alloc.shrink("r0", 11)
+    assert eng.leak_report() == {}

@@ -100,6 +100,16 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.serve("serve/prefix_insert", attrs={"req_id": "r5", "pages": 4,
                                             "at": "finish"})
     tel.serve("serve/prefix_evict", attrs={"page": 7})
+    tel.serve("serve/backend", attrs={"attention_backend": "pallas",
+                                      "impl": "pallas", "interpret": 0})
+    # the per-step attention spans the serving engine wraps its dispatches
+    # in (phase: prefill / decode / decode_chunk)
+    with tel.span("serve/step", attrs={"backend": "pallas",
+                                       "phase": "decode", "batch": 4,
+                                       "tokens": 1}):
+        pass
+    with tel.span("serve/attn", attrs={"backend": "jnp"}):
+        pass
     wd = StepStallWatchdog(tel, stall_factor=1.0, min_stall_secs=0.0)
     wd.beat(0)
     wd.beat(1)
