@@ -710,6 +710,123 @@ def _worker_serving_attn(spec):
     print(json.dumps(_serving_attn_bench(spec)))
 
 
+def _serving_slo_bench(spec=None):
+    """CPU-runnable serving-SLO micro-bench: a mixed short/long-prompt
+    workload (interactive vs batch shapes) with per-request deadlines,
+    reporting the observability plane's own numbers — TTFT / TPOT / e2e /
+    queue-wait p50/p99 from the registry histograms, SLO attainment and
+    goodput from the deadline verdicts — plus a live scrape of the
+    pull-based exporter (ephemeral port), validated against the
+    Prometheus-exposition checker.  Wall-clock numbers are CPU numbers;
+    the transferable outputs are the trace-completeness audit and the
+    scrape-path proof."""
+    spec = spec or {}
+    import importlib.util
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.robustness import RequestRejected
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    n_requests = int(spec.get("requests", 24))
+    arrivals = int(spec.get("arrivals_per_step", 2))
+    max_new = int(spec.get("max_new_tokens", 6))
+    deadline_s = float(spec.get("deadline_s", 60.0))
+
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    tmp = tempfile.mkdtemp(prefix="serving_slo_bench_")
+    tel = Telemetry().configure(
+        TelemetryConfig({"enabled": True, "output_path": tmp,
+                         "job_name": "serving_slo_bench",
+                         "export": {"enabled": True, "port": 0}}), rank=0)
+    eng = ServingEngine(
+        model, params, max_batch=4, page_size=8, max_seq=64,
+        dtype=jnp.float32, telemetry=tel,
+        serving={"max_queue": int(spec.get("max_queue", 12)),
+                 "overload_policy": "shed-oldest"})
+    rng = np.random.default_rng(0)
+    # interactive (short) vs batch (long) prompt mix; both classes carry
+    # a deadline so every terminal yields an SLO verdict
+    prompts = []
+    for i in range(n_requests):
+        n = int(rng.integers(3, 7)) if i % 2 == 0 else \
+            int(rng.integers(24, 33))
+        prompts.append(rng.integers(0, cfg.vocab_size, (n,)).tolist())
+    rejected = 0
+    next_req = 0
+    while next_req < n_requests or eng.queue or eng.n_active:
+        for _ in range(arrivals):
+            if next_req >= n_requests:
+                break
+            try:
+                eng.add_request(next_req, prompts[next_req],
+                                max_new_tokens=max_new,
+                                deadline_s=deadline_s)
+            except RequestRejected:
+                rejected += 1
+            next_req += 1
+        eng.step()
+    health = eng.health()    # populates the latency section
+    leaks = eng.leak_report()
+
+    # live scrape through the exporter, validated with the checker
+    host, port = tel.exporter.address
+    prom = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=5).read().decode()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cp = os.path.join(repo, "scripts", "check_telemetry_schema.py")
+    sp = importlib.util.spec_from_file_location("check_telemetry_schema",
+                                                cp)
+    checker = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(checker)
+    prom_problems = checker.validate_prom_exposition(prom)
+    tel.close()
+
+    def pcts(name):
+        s = health["latency"][name]
+        return {"count": s["count"],
+                "p50_ms": round(s["p50"], 3) if s["p50"] is not None
+                else None,
+                "p99_ms": round(s["p99"], 3) if s["p99"] is not None
+                else None}
+
+    slo = health["slo"]
+    verdicts = slo["attained"] + slo["missed"]
+    return {
+        "offered_requests": n_requests,
+        "served": eng.stats["finished"],
+        "shed": eng.stats["shed"],
+        "rejected": rejected,
+        "ttft": pcts("serve/ttft_ms"),
+        "tpot": pcts("serve/tpot_ms"),
+        "e2e": pcts("serve/e2e_ms"),
+        "queue_wait": pcts("serve/queue_wait_ms"),
+        "slo_attained": slo["attained"],
+        "slo_missed": slo["missed"],
+        "slo_attainment": (round(slo["attained"] / verdicts, 3)
+                           if verdicts else None),
+        "goodput_tokens": slo["goodput_tokens"],
+        "traces": health["traces"],
+        "exporter_scrape_ok": not prom_problems and
+        "ds_serve_ttft_ms" in prom,
+        "leaks": leaks,
+    }
+
+
+def _worker_serving_slo(spec):
+    print(json.dumps(_serving_slo_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -820,6 +937,24 @@ def _attach_serving_attn(out):
     return out
 
 
+def _attach_serving_slo(out):
+    """Attach the serving-SLO micro-bench under the stable key
+    ``cpu_serving_slo`` (CPU-runnable: TTFT/TPOT/e2e percentiles, SLO
+    attainment, exporter scrape proof).  Budget-gated; a failure is
+    recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "serving_slo", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_serving_slo"] = res
+    else:
+        out.setdefault("notes", {})["serving_slo"] = (err or "")[:200]
+    return out
+
+
 def main():
     errors = {}
 
@@ -846,7 +981,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))
+            print(json.dumps(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -934,7 +1069,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))
+        print(json.dumps(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -1009,7 +1144,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))
+    print(json.dumps(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))
 
 
 if __name__ == "__main__":
@@ -1038,6 +1173,8 @@ if __name__ == "__main__":
             _worker_serving_prefix(spec)
         elif which == "serving_attn":
             _worker_serving_attn(spec)
+        elif which == "serving_slo":
+            _worker_serving_slo(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

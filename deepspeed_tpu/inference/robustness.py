@@ -37,8 +37,10 @@ All telemetry from this layer rides the frozen ``serve`` event kind
 ``serve/finish``, ``serve/fault``.
 """
 
+import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, List
+from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
@@ -88,7 +90,24 @@ SERVE_EVENTS = (
     # attrs attention_backend / impl / interpret, so a telemetry stream's
     # serve/step spans are attributable to the kernel path that ran
     "serve/backend",
+    # per-request lifecycle trace (RequestTracer): one event per state
+    # transition, each carrying req_id plus the derived latencies so a
+    # request's full history is reconstructible from the JSONL stream
+    # alone.  The "queued" state is implicit between admitted and
+    # prefill_start (queue_wait_ms attr); the "decode" phase is implicit
+    # between first_token and the terminal (tpot_ms attr).  Every admitted
+    # request reaches EXACTLY ONE of the four terminals — the
+    # trace-completeness invariant leak_report() audits.
+    "serve/request/admitted", "serve/request/prefill_start",
+    "serve/request/first_token",
+    "serve/request/finish", "serve/request/shed",
+    "serve/request/deadline", "serve/request/evict",
 )
+
+# the closed set of trace terminals (the tail of the serve/request/*
+# vocabulary above); RequestResult statuses map onto it via
+# ``ServingEngine._TERMINAL_BY_STATUS`` ("drained" folds into "shed")
+TRACE_TERMINALS = ("finish", "shed", "deadline", "evict")
 
 # the serving.attention_backend vocabulary (mirrors
 # ops/paged_attention.py ATTENTION_BACKENDS; validated at config time so
@@ -218,3 +237,152 @@ class AdmissionController:
             if queue_ok and pages_ok:
                 self.overloaded = False
         return self.overloaded
+
+
+# ----------------------------------------------------------------------
+# per-request lifecycle tracing
+# ----------------------------------------------------------------------
+@dataclass
+class RequestTrace:
+    """One request's lifecycle timestamps (engine-clock seconds) and the
+    latencies derived from them.  ``-1.0`` marks a state never reached —
+    the derived accessors return ``None`` for those, so a request evicted
+    before its first token reports no TTFT rather than a garbage one."""
+    req_id: Any
+    t_admit: float
+    deadline: float = 0.0       # absolute engine-clock deadline (0 = none)
+    slot: int = -1              # batch slot once scheduled
+    t_prefill_start: float = -1.0
+    t_first_token: float = -1.0
+    terminal: str = ""          # one of TRACE_TERMINALS once closed
+    t_terminal: float = -1.0
+    n_generated: int = 0
+    reason: str = ""            # typed reason for abnormal terminals
+
+    def queue_wait_ms(self) -> Optional[float]:
+        if self.t_prefill_start < 0:
+            return None
+        return (self.t_prefill_start - self.t_admit) * 1000.0
+
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first_token < 0:
+            return None
+        return (self.t_first_token - self.t_admit) * 1000.0
+
+    def tpot_ms(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (the decode-rate
+        half of the TTFT/TPOT split)."""
+        if self.t_first_token < 0 or self.t_terminal < 0 or \
+                self.n_generated < 2:
+            return None
+        return (self.t_terminal - self.t_first_token) * 1000.0 / \
+            (self.n_generated - 1)
+
+    def e2e_ms(self) -> Optional[float]:
+        if self.t_terminal < 0:
+            return None
+        return (self.t_terminal - self.t_admit) * 1000.0
+
+    def slo(self) -> Optional[str]:
+        """SLO attainment for deadline-bearing requests: ``"ok"`` when the
+        request finished on time, ``"miss"`` for every other terminal (a
+        shed or evicted deadline request did not meet its SLO either).
+        ``None`` when no deadline was set or the trace is still open."""
+        if not self.deadline or not self.terminal:
+            return None
+        ok = self.terminal == "finish" and self.t_terminal <= self.deadline
+        return "ok" if ok else "miss"
+
+
+class RequestTracer:
+    """Always-on host-side request lifecycle bookkeeping for the serving
+    engine.  Transitions are dict updates against an injectable clock —
+    cheap enough to leave on with telemetry disabled; the engine pairs
+    each transition with a frozen ``serve/request/*`` event when the
+    stream is live.
+
+    The contract this class exists to enforce: every admitted request
+    reaches EXACTLY ONE terminal (:data:`TRACE_TERMINALS`).  Violations —
+    a double admit, a terminal on an unknown/closed request, an open trace
+    with no live owner — are recorded and surfaced by :meth:`audit`, which
+    ``ServingEngine.leak_report()`` folds in, so trace leaks fail the same
+    invariant sweep page leaks do."""
+
+    def __init__(self, clock=None, max_completed=4096):
+        self._clock = clock if clock is not None else time.monotonic
+        self.open: Dict[Any, RequestTrace] = {}
+        # bounded retention: a long-running server must not accumulate a
+        # trace per request forever — the counters below stay exact
+        self.completed = deque(maxlen=max_completed)
+        self.admitted = 0
+        self.closed = 0
+        self.terminals = {t: 0 for t in TRACE_TERMINALS}
+        self.errors: List[str] = []
+
+    def admit(self, req_id, deadline: float = 0.0,
+              now: Optional[float] = None) -> RequestTrace:
+        now = self._clock() if now is None else now
+        if req_id in self.open:
+            self.errors.append(f"double admit for {req_id!r}")
+            return self.open[req_id]
+        tr = RequestTrace(req_id, t_admit=now, deadline=float(deadline))
+        self.open[req_id] = tr
+        self.admitted += 1
+        return tr
+
+    def prefill_start(self, req_id, slot: int) -> Optional[RequestTrace]:
+        tr = self.open.get(req_id)
+        if tr is None:
+            self.errors.append(f"prefill_start for untracked {req_id!r}")
+            return None
+        tr.slot = int(slot)
+        tr.t_prefill_start = self._clock()
+        return tr
+
+    def first_token(self, req_id) -> Optional[RequestTrace]:
+        tr = self.open.get(req_id)
+        if tr is None:
+            self.errors.append(f"first_token for untracked {req_id!r}")
+            return None
+        tr.t_first_token = self._clock()
+        return tr
+
+    def terminal(self, req_id, terminal: str, n_generated: int = 0,
+                 reason: str = "") -> Optional[RequestTrace]:
+        if terminal not in TRACE_TERMINALS:
+            self.errors.append(
+                f"unknown terminal {terminal!r} for {req_id!r}")
+            return None
+        tr = self.open.pop(req_id, None)
+        if tr is None:
+            self.errors.append(
+                f"terminal {terminal!r} for closed/unknown {req_id!r}")
+            return None
+        tr.terminal = terminal
+        tr.t_terminal = self._clock()
+        tr.n_generated = int(n_generated)
+        tr.reason = reason
+        self.terminals[terminal] += 1
+        self.closed += 1
+        self.completed.append(tr)
+        return tr
+
+    def audit(self, live_req_ids) -> Dict[str, Any]:
+        """Trace-completeness invariant sweep.  ``live_req_ids`` is every
+        request currently queued or active in the engine; returns {} when
+        clean, else typed leak entries (the ``leak_report()`` shape)."""
+        live = set(live_req_ids)
+        leaks: Dict[str, Any] = {}
+        orphans = sorted(set(self.open) - live, key=str)
+        if orphans:
+            leaks["trace_open_orphans"] = orphans
+        untraced = sorted(live - set(self.open), key=str)
+        if untraced:
+            leaks["untraced_requests"] = untraced
+        if self.errors:
+            leaks["trace_errors"] = list(self.errors)
+        if self.admitted != self.closed + len(self.open):
+            leaks["trace_count_mismatch"] = {
+                "admitted": self.admitted, "closed": self.closed,
+                "open": len(self.open)}
+        return leaks

@@ -42,7 +42,7 @@ from deepspeed_tpu.inference.robustness import (
     REJECT_DUPLICATE, REJECT_INFEASIBLE, REJECT_OVERLOADED,
     REJECT_OVERSIZED, REJECT_QUEUE_FULL, SHED_DEADLINE, SHED_DRAIN,
     SHED_OLDEST, AdmissionController, RequestRejected, RequestResult,
-    ServingRobustnessConfig, ServingStalled)
+    RequestTracer, ServingRobustnessConfig, ServingStalled)
 from deepspeed_tpu.inference.prefix_cache import PrefixCache, PrefixMatch
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
@@ -50,6 +50,17 @@ from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
                                                resolve_attention_backend)
 from deepspeed_tpu.runtime.resilience import FaultInjector
 from deepspeed_tpu.utils.logging import logger
+
+
+# RequestResult statuses -> lifecycle-trace terminal names (the tail of
+# the frozen serve/request/* vocabulary).  "drained" folds into "shed":
+# from the request's point of view a drain IS a shed, just engine-initiated.
+_TERMINAL_BY_STATUS = {"shed": "shed", "drained": "shed",
+                       "deadline": "deadline", "evicted": "evict"}
+
+
+def _round_ms(v):
+    return None if v is None else round(v, 3)
 
 
 @dataclass
@@ -212,12 +223,19 @@ class ServingEngine:
         self._clock = clock if clock is not None else time.monotonic
         self._telemetry = telemetry
         self._admission = AdmissionController(self.serving)
+        # per-request lifecycle traces on the SAME injectable clock as the
+        # deadline machinery — always on (host dict ops), so the
+        # trace-completeness invariant in leak_report() holds even with
+        # telemetry disabled
+        self.tracer = RequestTracer(clock=self._clock)
         self._consec_step_faults = 0
         self.draining = False
         self.stats = {"admitted": 0, "rejected": 0, "shed": 0,
                       "deadline": 0, "evicted": 0, "finished": 0,
                       "step_faults": 0, "drains": 0, "prefix_hits": 0,
-                      "prefix_cow_copies": 0, "prefix_evictions": 0}
+                      "prefix_cow_copies": 0, "prefix_evictions": 0,
+                      "slo_attained": 0, "slo_missed": 0,
+                      "goodput_tokens": 0}
         # one frozen event per engine records which attention path every
         # serve/step span of this stream ran (ds_telemetry_report keys
         # its serving-attention table off it)
@@ -239,6 +257,52 @@ class ServingEngine:
         clean = {k: (v if isinstance(v, (int, float, str)) else str(v))
                  for k, v in attrs.items() if v is not None and v != ""}
         tel.serve(name, attrs=clean or None)
+
+    def _observe_ms(self, name, ms):
+        """Record one latency sample into registry histogram ``name``
+        (telemetry-gated; None samples — state never reached — drop)."""
+        if ms is None:
+            return
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.registry.histogram(name).observe(ms)
+
+    def _close_trace(self, req: _Request, terminal: str, reason: str = ""):
+        """Close a request's lifecycle trace with its terminal: bump SLO /
+        goodput counters from the deadline verdict, land the latency
+        histogram samples, and emit the frozen ``serve/request/<terminal>``
+        trace event carrying every derived latency."""
+        tr = self.tracer.terminal(req.req_id, terminal,
+                                  n_generated=len(req.out), reason=reason)
+        if tr is None:   # leak_report() will surface the tracer error
+            return
+        slo = tr.slo()
+        if slo == "ok":
+            self.stats["slo_attained"] += 1
+        elif slo == "miss":
+            self.stats["slo_missed"] += 1
+        if terminal == "finish":
+            self.stats["goodput_tokens"] += len(req.out)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            if slo == "ok":
+                tel.count("serve/slo_attained")
+            elif slo == "miss":
+                tel.count("serve/slo_missed")
+            if terminal == "finish":
+                # decode-rate and end-to-end distributions track SUCCESSFUL
+                # requests; abnormal terminals would skew them downward
+                tel.count("serve/goodput_tokens", len(req.out))
+                self._observe_ms("serve/tpot_ms", tr.tpot_ms())
+                self._observe_ms("serve/e2e_ms", tr.e2e_ms())
+        self._serve_event(
+            f"serve/request/{terminal}", req_id=req.req_id,
+            slot=(tr.slot if tr.slot >= 0 else None),
+            reason=reason, n_generated=len(req.out),
+            queue_wait_ms=_round_ms(tr.queue_wait_ms()),
+            ttft_ms=_round_ms(tr.ttft_ms()),
+            tpot_ms=_round_ms(tr.tpot_ms()),
+            e2e_ms=_round_ms(tr.e2e_ms()), slo=slo)
 
     # -- host control flow ---------------------------------------------
     def _reject(self, req_id, reason, detail=""):
@@ -294,14 +358,22 @@ class ServingEngine:
         now = self._clock()
         ttl = deadline_s if deadline_s is not None \
             else (float(cfg.default_deadline_s) or None)
+        deadline = (now + ttl) if ttl else 0.0
         self.queue.append(_Request(req_id, prompt, max_new_tokens,
                                    temperature, seed, top_k, top_p,
-                                   submit_time=now,
-                                   deadline=(now + ttl) if ttl else 0.0))
+                                   submit_time=now, deadline=deadline))
         self.stats["admitted"] += 1
+        # lifecycle trace opens HERE: admission is the promise leak_report
+        # audits — exactly one serve/request/* terminal closes it
+        self.tracer.admit(req_id, deadline=deadline, now=now)
         self._serve_event("serve/admit", req_id=req_id,
                           queue_depth=len(self.queue),
                           free_pages=self.alloc.free_page_count)
+        self._serve_event("serve/request/admitted", req_id=req_id,
+                          queue_depth=len(self.queue),
+                          prompt_tokens=len(prompt),
+                          max_new_tokens=int(max_new_tokens),
+                          deadline=int(bool(deadline)))
         self._admit()
 
     def _admission_pressure(self):
@@ -368,6 +440,7 @@ class ServingEngine:
             req_id=req.req_id, status=status, reason=reason,
             tokens=list(req.prompt) + list(req.out),
             n_generated=len(req.out), detail=detail)
+        self._close_trace(req, _TERMINAL_BY_STATUS[status], reason=reason)
 
     def _evict_slot(self, slot: int, status: str, reason: str,
                     detail: str = ""):
@@ -464,6 +537,14 @@ class ServingEngine:
             self.tables[slot, :len(pages)] = pages
             self.lengths[slot] = 0
             self.slots[slot] = req
+            tr = self.tracer.prefill_start(req.req_id, slot)
+            if tr is not None:
+                self._observe_ms("serve/queue_wait_ms", tr.queue_wait_ms())
+                self._serve_event("serve/request/prefill_start",
+                                  req_id=req.req_id, slot=slot,
+                                  pages=len(pages), cached_tokens=cached,
+                                  queue_wait_ms=_round_ms(
+                                      tr.queue_wait_ms()))
             try:
                 if match.cow_src is not None:
                     # the request's first owned page inherits the partial
@@ -572,6 +653,15 @@ class ServingEngine:
         self.lengths[slot] = len(req.prompt)
         req.last_token = self._sample(
             req, np.asarray(logits[0, len(suffix) - 1]))
+        # the first output token exists as of the sample above — a sampler
+        # fault raises before this line, so an evicted-at-prefill request
+        # correctly reports no TTFT
+        tr = self.tracer.first_token(req.req_id)
+        if tr is not None:
+            self._observe_ms("serve/ttft_ms", tr.ttft_ms())
+            self._serve_event("serve/request/first_token",
+                              req_id=req.req_id, slot=slot,
+                              ttft_ms=_round_ms(tr.ttft_ms()))
 
     def _sample(self, req: _Request, logits: np.ndarray) -> int:
         if self.injector is not None:
@@ -625,6 +715,7 @@ class ServingEngine:
         self.stats["finished"] += 1
         self._serve_event("serve/finish", req_id=req.req_id,
                           n_generated=len(req.out))
+        self._close_trace(req, "finish")
         self._admit()
 
     @property
@@ -907,11 +998,24 @@ class ServingEngine:
             "overloaded": self._admission.overloaded,
             "undelivered_terminated": len(self.terminated),
             "counters": dict(self.stats),
+            "slo": {"attained": self.stats["slo_attained"],
+                    "missed": self.stats["slo_missed"],
+                    "goodput_tokens": self.stats["goodput_tokens"]},
+            "traces": {"open": len(self.tracer.open),
+                       "admitted": self.tracer.admitted,
+                       "closed": self.tracer.closed,
+                       "terminals": dict(self.tracer.terminals)},
         }
         if self.prefix_cache is not None:
             snap["prefix_cache"] = self.prefix_cache.snapshot()
         tel = self.telemetry
         if tel is not None and tel.enabled:
+            # windowed latency distributions (ms) with p50/p90/p99 — the
+            # same histograms the exporter serves as summary quantiles
+            snap["latency"] = {
+                name: tel.registry.histogram(name).summary()
+                for name in ("serve/queue_wait_ms", "serve/ttft_ms",
+                             "serve/tpot_ms", "serve/e2e_ms")}
             for key in ("free_pages", "available_pages", "queue_depth",
                         "active_slots", "oldest_request_age_s"):
                 tel.registry.gauge(f"serving/{key}").set(snap[key])
@@ -966,6 +1070,10 @@ class ServingEngine:
                 over[str(req.req_id)] = {"held": held, "expected": expected}
         if over:
             leaks["over_reserved_slots"] = over
+        # trace completeness: every admitted request is either still live
+        # (queued/active) or reached exactly one serve/request/* terminal
+        live = {r.req_id for r in self.queue} | active
+        leaks.update(self.tracer.audit(live))
         return leaks
 
     # -- convenience ----------------------------------------------------

@@ -1,3 +1,4 @@
+from .export import MetricsExporter, prom_name, prom_text
 from .monitor import JsonlMonitor, Monitor, MonitorMaster
 from .telemetry import (JsonlEventSink, MetricsRegistry, StepStallWatchdog,
                         Telemetry, get_telemetry)

@@ -1,0 +1,152 @@
+"""Pull-based metrics exporter: the scrape surface of the telemetry spine.
+
+A background daemon thread serves the live :class:`MetricsRegistry`
+snapshot over plain HTTP so an external agent — Prometheus, the future
+prefix-aware router/autoscaler (ROADMAP item 5), or plain ``curl`` —
+can observe a running job without touching its JSONL files:
+
+* ``GET /metrics``       — Prometheus text exposition format 0.0.4.
+  Counters map to ``counter`` families, gauges to ``gauge`` (with a
+  companion ``<name>_peak`` gauge), histograms to ``summary`` families
+  with p50/p90/p99 quantile samples plus ``_sum``/``_count``.
+* ``GET /metrics.json``  — the raw registry snapshot as JSON (same shape
+  as :meth:`Telemetry.snapshot`); ``/snapshot`` is an alias.
+* ``GET /healthz``       — liveness probe, ``{"ok": true}``.
+
+Everything is read-only and stdlib-only (``http.server``), off by default,
+and enabled through the ``telemetry.export`` config block
+(:class:`deepspeed_tpu.runtime.config.TelemetryExportConfig`) —
+``Telemetry.configure`` starts one exporter on rank 0 alongside the JSONL
+sink.  Port 0 binds an ephemeral port (tests, multi-job hosts); the bound
+address is re-read from :attr:`MetricsExporter.address`.
+"""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepspeed_tpu.utils.logging import logger
+
+# Prometheus metric-name grammar.  Registry names use "/" and may use "-";
+# prom_name() folds every illegal character to "_" and prefixes "ds_" so
+# e.g. "serve/ttft_ms" exports as "ds_serve_ttft_ms".
+PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+_QUANTILES = (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99"))
+
+
+def prom_name(name):
+    """Registry metric name -> legal Prometheus family name."""
+    return "ds_" + re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+
+
+def _fmt(v):
+    """Prometheus sample value: floats as repr, ints stay ints."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def prom_text(snapshot):
+    """Render a registry snapshot (``Telemetry.snapshot()`` shape) as
+    Prometheus text exposition format 0.0.4."""
+    lines = []
+    for name in sorted(snapshot.get("counters", {})):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(snapshot['counters'][name])}")
+    for name in sorted(snapshot.get("gauges", {})):
+        g = snapshot["gauges"][name]
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(g['value'])}")
+        # peak is -inf until the first set(); skip the unset sentinel
+        if g["peak"] != float("-inf"):
+            lines.append(f"# TYPE {pn}_peak gauge")
+            lines.append(f"{pn}_peak {_fmt(g['peak'])}")
+    for name in sorted(snapshot.get("histograms", {})):
+        s = snapshot["histograms"][name]
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        count = int(s.get("count", 0))
+        for q, key in _QUANTILES:
+            if s.get(key) is not None:
+                lines.append(f'{pn}{{quantile="{q}"}} {_fmt(s[key])}')
+        mean = s.get("mean")
+        total = (mean * count) if (mean is not None and count) else 0.0
+        lines.append(f"{pn}_sum {_fmt(total)}")
+        lines.append(f"{pn}_count {count}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Read-only scrape endpoints; per-exporter subclasses bind
+    ``exporter``."""
+
+    exporter = None  # set on the per-instance subclass
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = prom_text(self.exporter.telemetry.snapshot())
+            self._reply(200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path in ("/metrics.json", "/snapshot"):
+            body = json.dumps(self.exporter.telemetry.snapshot(),
+                              default=str)
+            self._reply(200, body, "application/json")
+        elif path == "/healthz":
+            self._reply(200, '{"ok": true}', "application/json")
+        else:
+            self._reply(404, '{"error": "not found"}', "application/json")
+
+    def _reply(self, code, body, content_type):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):  # scrapes are not stdout news
+        logger.debug("metrics exporter: " + fmt % args)
+
+
+class MetricsExporter:
+    """Background HTTP server exporting a :class:`Telemetry`'s registry.
+
+    The server thread is a daemon: it never blocks interpreter exit, and
+    every request handler only READS the registry snapshot (one lock-held
+    dict copy), so scrapes cannot stall the step loop.
+    """
+
+    def __init__(self, telemetry, host="127.0.0.1", port=9866):
+        self.telemetry = telemetry
+        handler = type("_BoundHandler", (_Handler,), {"exporter": self})
+        self._server = ThreadingHTTPServer((host, int(port)), handler)
+        self._server.daemon_threads = True
+        self._thread = None
+
+    @property
+    def address(self):
+        """(host, port) actually bound — port 0 requests resolve here."""
+        return self._server.server_address[:2]
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="ds-metrics-exporter")
+            self._thread.start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -97,8 +97,9 @@ class Histogram:
         self._samples = deque(maxlen=max_samples)
 
     def observe(self, value, now=None):
-        self._samples.append((now if now is not None else time.monotonic(),
-                              float(value)))
+        now = now if now is not None else time.monotonic()
+        self._prune(now)
+        self._samples.append((now, float(value)))
 
     def _prune(self, now=None):
         now = now if now is not None else time.monotonic()
@@ -111,6 +112,8 @@ class Histogram:
         return [v for _, v in self._samples]
 
     def percentile(self, q, now=None):
+        """q-th percentile over the live window (stale samples are pruned
+        here too, not just on observe).  None on an empty window."""
         vals = sorted(self.values(now))
         if not vals:
             return None
@@ -118,9 +121,13 @@ class Histogram:
         return vals[idx]
 
     def summary(self, now=None):
+        """Windowed stats.  An empty window returns the full typed shape
+        (count 0, every stat None) so consumers — the exporter, health(),
+        the report script — never KeyError on a quiet histogram."""
         vals = sorted(self.values(now))
         if not vals:
-            return {"count": 0}
+            return {"count": 0, "min": None, "max": None, "mean": None,
+                    "p50": None, "p90": None, "p99": None}
         n = len(vals)
 
         def pct(q):
@@ -238,14 +245,20 @@ class Telemetry:
         self.registry = MetricsRegistry()
         self.sink = None
         self.config = None
+        self.exporter = None
 
     def configure(self, config=None, rank=None):
         """(Re)configure from a ``TelemetryConfig``-shaped object.  The sink
         is rank-0-gated; non-zero ranks keep the registry and spans (xprof
-        annotations are per-host) but write no events."""
+        annotations are per-host) but write no events.  When the config
+        carries an enabled ``export`` block, a rank-0 background HTTP
+        exporter (monitor/export.py) is started on the same gate."""
         if self.sink is not None:
             self.sink.close()
             self.sink = None
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
         self.config = config
         self.enabled = bool(config is not None and config.enabled)
         if not self.enabled:
@@ -263,7 +276,46 @@ class Telemetry:
                 out_dir,
                 max_bytes=int(float(config.max_file_mb) * 1024 * 1024),
                 max_files=config.max_files)
+            self._start_exporter(getattr(config, "export", None))
         return self
+
+    def _start_exporter(self, export_cfg):
+        """Start the pull-based metrics exporter when the config asks for
+        one.  Accepts a ``TelemetryExportConfig`` or a plain dict (callers
+        that hand-build configs); failure to bind is logged, never fatal —
+        observability must not take down the run."""
+        if export_cfg is None:
+            return
+        if isinstance(export_cfg, dict):
+            enabled = bool(export_cfg.get("enabled", False))
+            host = str(export_cfg.get("host", "127.0.0.1"))
+            port = int(export_cfg.get("port", 9866))
+        else:
+            enabled = bool(export_cfg.enabled)
+            host = str(export_cfg.host)
+            port = int(export_cfg.port)
+        if not enabled:
+            return
+        try:
+            from deepspeed_tpu.monitor.export import MetricsExporter
+            self.exporter = MetricsExporter(self, host=host, port=port)
+            self.exporter.start()
+        except Exception as e:
+            logger.warning(f"metrics exporter failed to start: {e}")
+            self.exporter = None
+            return
+        addr = self.exporter.address
+        self.emit("meta", "telemetry/export",
+                  attrs={"host": addr[0], "port": addr[1]})
+
+    def snapshot(self):
+        """One JSON-safe snapshot of the whole registry — counters, gauges
+        (value + peak), and histogram summaries with p50/p90/p99 — stamped
+        with the capture time.  This is the object the exporter serves and
+        the registry snapshot API callers poll."""
+        snap = self.registry.snapshot()
+        snap["ts"] = round(time.time(), 6)
+        return snap
 
     # -- events --------------------------------------------------------
     def emit(self, kind, name, **fields):
@@ -335,6 +387,9 @@ class Telemetry:
         self.emit("comm", op_name, bytes=int(size_bytes), axis=str(axis))
 
     def close(self):
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
         if self.sink is not None:
             self.sink.close()
             self.sink = None

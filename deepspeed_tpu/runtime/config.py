@@ -70,9 +70,26 @@ class CSVConfig(MonitorConfig):
     pass
 
 
+class TelemetryExportConfig(DeepSpeedConfigModel):
+    """``"telemetry.export"`` block: the pull-based metrics exporter
+    (``monitor/export.py``) — a rank-0 background HTTP thread serving the
+    live registry as Prometheus text (``/metrics``) and a JSON snapshot
+    (``/metrics.json``).  Off by default; port 0 binds an ephemeral port
+    (the bound address is logged via the ``telemetry/export`` meta
+    event)."""
+    enabled = False
+    host = "127.0.0.1"              # bind address (loopback by default)
+    port = 9866                     # 0 -> ephemeral
+
+    def _validate(self):
+        if not (0 <= int(self.port) <= 65535):
+            raise ValueError("telemetry.export.port must be in [0, 65535]")
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``"telemetry"`` block: the unified JSONL event stream
-    (``monitor/telemetry.py``) plus the step-stall watchdog."""
+    (``monitor/telemetry.py``) plus the step-stall watchdog and the
+    optional pull-based metrics exporter."""
     enabled = False
     output_path = ""                # dir for events.jsonl ("" -> ./telemetry)
     job_name = "DeepSpeedJobName"
@@ -83,6 +100,11 @@ class TelemetryConfig(DeepSpeedConfigModel):
     stall_factor = 10.0             # stall when gap > factor * median step
     stall_min_secs = 1.0            # floor on the stall threshold
     stall_poll_secs = 1.0           # watchdog poll interval
+    export = {}                     # TelemetryExportConfig sub-block
+
+    def _validate(self):
+        if not isinstance(self.export, TelemetryExportConfig):
+            self.export = TelemetryExportConfig(self.export or {})
 
 
 class AsyncPipelineConfig(DeepSpeedConfigModel):

@@ -9,12 +9,18 @@ against the telemetry module so the two cannot drift silently.
 
 Usage:
     python scripts/check_telemetry_schema.py <events.jsonl> [more.jsonl ...]
+    python scripts/check_telemetry_schema.py --prom <metrics.txt> [...]
+
+The ``--prom`` mode validates a Prometheus text exposition page (the
+``monitor/export.py`` /metrics surface) instead: metric-name grammar,
+known TYPE declarations, numeric sample values.
 
 Exit code 0 when every event on every file validates; 1 otherwise (each
 offending line is reported with its file:lineno).
 """
 
 import json
+import re
 import sys
 
 # required: field -> allowed types.  optional: same, may be absent.
@@ -90,6 +96,18 @@ SERVE_EVENTS = (
     "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
     "serve/prefix_evict",
     "serve/backend",
+    # per-request lifecycle trace (RequestTracer): one event per state
+    # transition, each carrying req_id plus the derived latencies so a
+    # request's full history is reconstructible from the JSONL stream
+    # alone.  The "queued" state is implicit between admitted and
+    # prefill_start (queue_wait_ms attr); the "decode" phase is implicit
+    # between first_token and the terminal (tpot_ms attr).  Every admitted
+    # request reaches EXACTLY ONE of the four terminals — the
+    # trace-completeness invariant leak_report() audits.
+    "serve/request/admitted", "serve/request/prefill_start",
+    "serve/request/first_token",
+    "serve/request/finish", "serve/request/shed",
+    "serve/request/deadline", "serve/request/evict",
 )
 
 EVENT_KINDS = tuple(SCHEMA)
@@ -150,11 +168,86 @@ def validate_file(path):
         return list(validate_stream(f))
 
 
+# ----------------------------------------------------------------------
+# exporter metric-name validation (monitor/export.py)
+# ----------------------------------------------------------------------
+# Prometheus text exposition format 0.0.4, the exporter's /metrics
+# surface.  Every exported family name must match the metric-name
+# grammar, carry a known TYPE, and every sample must belong to a typed
+# family (summaries also own their _sum/_count companions).
+PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+PROM_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)$")
+PROM_TYPES = ("counter", "gauge", "summary", "histogram", "untyped")
+
+
+def validate_prom_exposition(text):
+    """Validate a Prometheus text exposition page (the exporter's
+    ``/metrics`` body).  Returns a list of problem strings (empty =
+    valid)."""
+    problems = []
+    typed = set()
+    for i, line in enumerate(text.splitlines(), start=1):
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                problems.append(f"line {i}: malformed TYPE line")
+                continue
+            _, _, name, ptype = parts
+            if not PROM_NAME_RE.match(name):
+                problems.append(f"line {i}: illegal metric name {name!r}")
+            if ptype not in PROM_TYPES:
+                problems.append(f"line {i}: unknown type {ptype!r}")
+            typed.add(name)
+            continue
+        if line.startswith("#"):
+            continue    # HELP / comments
+        m = PROM_SAMPLE_RE.match(line)
+        if not m:
+            problems.append(f"line {i}: malformed sample line {line!r}")
+            continue
+        name, _, value = m.group(1), m.group(2), m.group(3)
+        try:
+            float(value)
+        except ValueError:
+            if value not in ("+Inf", "-Inf", "NaN"):
+                problems.append(
+                    f"line {i}: non-numeric sample value {value!r}")
+        family = name
+        for suffix in ("_sum", "_count", "_bucket"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                family = name[:-len(suffix)]
+                break
+        if family not in typed:
+            problems.append(
+                f"line {i}: sample {name!r} has no TYPE declaration")
+    return problems
+
+
+def validate_prom_file(path):
+    with open(path) as f:
+        return validate_prom_exposition(f.read())
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
         print(__doc__)
         return 2
+    if argv[0] == "--prom":
+        bad = 0
+        for path in argv[1:]:
+            for p in validate_prom_file(path):
+                print(f"{path}: {p}")
+                bad += 1
+        if bad:
+            print(f"FAIL: {bad} problem(s)")
+            return 1
+        print("OK: exposition validated")
+        return 0
     bad = 0
     total = 0
     for path in argv:

@@ -67,6 +67,8 @@ def aggregate(events):
     stalls = []
     metas = []
     serves = {}      # event name -> {count, reasons: {reason: n}}
+    requests = []    # reconstructed serve/request/* lifecycle traces
+    open_reqs = {}   # req_id -> index into requests (trace not yet closed)
     for ev in events:
         kind = ev.get("kind")
         if kind == "span":
@@ -110,9 +112,40 @@ def aggregate(events):
                     int(attrs.get("pages", 0))
             elif ev["name"] == "serve/backend":
                 rec["backend"] = attrs.get("attention_backend", "?")
+            elif ev["name"].startswith("serve/request/"):
+                # rebuild per-request lifecycle traces from the stream;
+                # req_ids may recur across runs in one file, so a fresh
+                # "admitted" after a terminal opens a NEW trace
+                stage = ev["name"].rsplit("/", 1)[1]
+                rid = attrs.get("req_id")
+                if stage == "admitted":
+                    open_reqs[rid] = len(requests)
+                    requests.append({"req_id": rid, "t_admit": ev["ts"],
+                                     "prompt_tokens":
+                                         attrs.get("prompt_tokens"),
+                                     "deadline": attrs.get("deadline", 0),
+                                     "terminal": None})
+                    continue
+                idx = open_reqs.get(rid)
+                if idx is None:
+                    continue    # trace head rotated away
+                trace = requests[idx]
+                if stage == "prefill_start":
+                    trace["slot"] = attrs.get("slot")
+                    trace["queue_wait_ms"] = attrs.get("queue_wait_ms")
+                elif stage == "first_token":
+                    trace["ttft_ms"] = attrs.get("ttft_ms")
+                else:           # finish | shed | deadline | evict
+                    trace["terminal"] = stage
+                    for k in ("reason", "n_generated", "slot", "slo",
+                              "queue_wait_ms", "ttft_ms", "tpot_ms",
+                              "e2e_ms"):
+                        if attrs.get(k) is not None:
+                            trace[k] = attrs[k]
+                    del open_reqs[rid]
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "steps": steps, "stalls": stalls,
-            "metas": metas, "serves": serves}
+            "metas": metas, "serves": serves, "requests": requests}
 
 
 def summarize(agg):
@@ -148,8 +181,62 @@ def summarize(agg):
             "serving": serve_rows,
             "serving_attention": _serving_attention_summary(agg),
             "prefix_cache": _prefix_cache_summary(agg),
+            "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+# how many individual request rows the latency table prints (slowest by
+# e2e first); the percentile block always covers EVERY reconstructed trace
+MAX_REQUEST_ROWS = 20
+
+
+def _request_latency_summary(agg):
+    """Per-request latency digest from the reconstructed
+    ``serve/request/*`` traces: terminal counts + trace-completeness
+    (orphans = admitted with no terminal — a live engine mid-run, or a
+    trace leak), SLO attainment, p50/p90/p99 for every derived latency,
+    and the slowest individual requests."""
+    traces = agg.get("requests") or []
+    if not traces:
+        return None
+    terminals = {}
+    slo = {"ok": 0, "miss": 0}
+    dists = {"queue_wait_ms": [], "ttft_ms": [], "tpot_ms": [],
+             "e2e_ms": []}
+    for t in traces:
+        term = t.get("terminal")
+        terminals[term or "open"] = terminals.get(term or "open", 0) + 1
+        if t.get("slo") in slo:
+            slo[t["slo"]] += 1
+        for k, vals in dists.items():
+            if t.get(k) is not None:
+                vals.append(float(t[k]))
+    pct_rows = {}
+    for k, vals in dists.items():
+        if not vals:
+            continue
+        vals = sorted(vals)
+        pct_rows[k] = {"count": len(vals),
+                       "p50": round(_pct(vals, 50), 3),
+                       "p90": round(_pct(vals, 90), 3),
+                       "p99": round(_pct(vals, 99), 3),
+                       "max": round(vals[-1], 3)}
+    closed = [t for t in traces if t.get("terminal")]
+    slowest = sorted(closed, key=lambda t: t.get("e2e_ms") or -1.0,
+                     reverse=True)[:MAX_REQUEST_ROWS]
+    return {
+        "traces": len(traces),
+        "terminals": dict(sorted(terminals.items())),
+        "orphans": terminals.get("open", 0),
+        "slo": slo,
+        "latency": pct_rows,
+        "slowest": [{k: t.get(k) for k in
+                     ("req_id", "terminal", "reason", "slot",
+                      "n_generated", "queue_wait_ms", "ttft_ms",
+                      "tpot_ms", "e2e_ms", "slo") if t.get(k) is not None}
+                    for t in slowest],
+    }
 
 
 def _serving_attention_summary(agg):
@@ -316,6 +403,35 @@ def print_tables(summary, out=sys.stdout):
             w(f"  |  page hit rate (gauge): "
               f"{pc['page_hit_rate_gauge'] * 100:.1f}%")
         w("\n\n")
+    rl = summary.get("request_latency")
+    if rl:
+        w("== request latency (serve/request/* traces) ==\n")
+        terms = ", ".join(f"{k}={v}" for k, v in rl["terminals"].items())
+        w(f"traces: {rl['traces']}  terminals: {terms}\n")
+        if rl["orphans"]:
+            w(f"OPEN TRACES (no terminal yet): {rl['orphans']}\n")
+        if rl["slo"]["ok"] or rl["slo"]["miss"]:
+            total = rl["slo"]["ok"] + rl["slo"]["miss"]
+            w(f"slo: {rl['slo']['ok']}/{total} attained "
+              f"({rl['slo']['ok'] / total * 100:.1f}%)\n")
+        if rl["latency"]:
+            w(f"{'latency (ms)':<20}{'count':>7}{'p50':>10}{'p90':>10}"
+              f"{'p99':>10}{'max':>10}\n")
+            for name, r in rl["latency"].items():
+                w(f"{name:<20}{r['count']:>7}{r['p50']:>10}{r['p90']:>10}"
+                  f"{r['p99']:>10}{r['max']:>10}\n")
+        if rl["slowest"]:
+            w(f"slowest requests (by e2e, top {len(rl['slowest'])}):\n")
+            w(f"{'req_id':<12}{'terminal':<10}{'slot':>5}{'gen':>5}"
+              f"{'queue':>9}{'ttft':>9}{'tpot':>9}{'e2e':>10}  slo\n")
+            for t in rl["slowest"]:
+                w(f"{str(t.get('req_id', '?')):<12}"
+                  f"{t.get('terminal', '?'):<10}"
+                  f"{t.get('slot', '-'):>5}{t.get('n_generated', 0):>5}"
+                  f"{t.get('queue_wait_ms', '-'):>9}"
+                  f"{t.get('ttft_ms', '-'):>9}{t.get('tpot_ms', '-'):>9}"
+                  f"{t.get('e2e_ms', '-'):>10}  {t.get('slo', '-')}\n")
+        w("\n")
     hb = summary["heartbeat"]
     w(f"== heartbeat ==\nsteps: {hb['steps']}  "
       f"median step: {hb['median_step_ms']} ms\n\n")

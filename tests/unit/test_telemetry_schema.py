@@ -102,6 +102,29 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.serve("serve/prefix_evict", attrs={"page": 7})
     tel.serve("serve/backend", attrs={"attention_backend": "pallas",
                                       "impl": "pallas", "interpret": 0})
+    # the per-request lifecycle trace (RequestTracer): admitted ->
+    # prefill_start -> first_token -> exactly one terminal
+    tel.serve("serve/request/admitted",
+              attrs={"req_id": "r6", "queue_depth": 1, "prompt_tokens": 5,
+                     "max_new_tokens": 8, "deadline": 1})
+    tel.serve("serve/request/prefill_start",
+              attrs={"req_id": "r6", "slot": 0, "pages": 2,
+                     "cached_tokens": 0, "queue_wait_ms": 1.25})
+    tel.serve("serve/request/first_token",
+              attrs={"req_id": "r6", "slot": 0, "ttft_ms": 4.5})
+    tel.serve("serve/request/finish",
+              attrs={"req_id": "r6", "slot": 0, "n_generated": 8,
+                     "queue_wait_ms": 1.25, "ttft_ms": 4.5,
+                     "tpot_ms": 2.0, "e2e_ms": 18.5, "slo": "ok"})
+    tel.serve("serve/request/shed",
+              attrs={"req_id": "r7", "reason": "shed_oldest",
+                     "n_generated": 0, "e2e_ms": 3.0, "slo": "miss"})
+    tel.serve("serve/request/deadline",
+              attrs={"req_id": "r8", "slot": 1, "reason": "deadline",
+                     "n_generated": 2, "e2e_ms": 55.0, "slo": "miss"})
+    tel.serve("serve/request/evict",
+              attrs={"req_id": "r9", "slot": 2, "reason": "fault",
+                     "n_generated": 1, "e2e_ms": 9.0})
     # the per-step attention spans the serving engine wraps its dispatches
     # in (phase: prefill / decode / decode_chunk)
     with tel.span("serve/step", attrs={"backend": "pallas",
@@ -120,6 +143,63 @@ def test_accepts_every_emitter(checker, tmp_path):
     problems = checker.validate_file(
         os.path.join(str(tmp_path), "schema", "events.jsonl"))
     assert problems == []
+
+
+def test_trace_terminals_are_tail_of_serve_vocabulary(checker):
+    """The four TRACE_TERMINALS map onto serve/request/<terminal> names in
+    the frozen vocabulary — a rename on either side fails here."""
+    from deepspeed_tpu.inference.robustness import TRACE_TERMINALS
+    for t in TRACE_TERMINALS:
+        assert f"serve/request/{t}" in checker.SERVE_EVENTS
+
+
+def test_prom_exposition_validation(checker):
+    good = ("# TYPE ds_serve_ttft_ms summary\n"
+            'ds_serve_ttft_ms{quantile="0.5"} 2.0\n'
+            "ds_serve_ttft_ms_sum 6.0\n"
+            "ds_serve_ttft_ms_count 3\n"
+            "# TYPE ds_engine_loss gauge\n"
+            "ds_engine_loss 0.5\n")
+    assert checker.validate_prom_exposition(good) == []
+    assert checker.validate_prom_exposition("ds_orphan 1\n")  # no TYPE
+    assert checker.validate_prom_exposition(
+        "# TYPE 9bad gauge\n9bad 1\n")          # illegal name
+    assert checker.validate_prom_exposition(
+        "# TYPE ds_x frobnicator\nds_x 1\n")    # unknown type
+    assert checker.validate_prom_exposition(
+        "# TYPE ds_x gauge\nds_x banana\n")     # non-numeric value
+
+
+def test_prom_lockstep_with_exporter(checker):
+    """The exporter's live output must satisfy the checker's --prom
+    grammar — the two halves of the scrape contract."""
+    from deepspeed_tpu.monitor.export import prom_name, prom_text
+    assert checker.PROM_NAME_RE.match(prom_name("serve/ttft_ms"))
+    snap = {"counters": {"serve/slo_attained": 2},
+            "gauges": {"engine/loss": {"value": 0.5, "peak": 0.9},
+                       "fresh": {"value": 0.0, "peak": float("-inf")}},
+            "histograms": {"serve/ttft_ms":
+                           {"count": 3, "min": 1.0, "max": 3.0,
+                            "mean": 2.0, "p50": 2.0, "p90": 3.0,
+                            "p99": 3.0},
+                           "empty": {"count": 0, "min": None, "max": None,
+                                     "mean": None, "p50": None,
+                                     "p90": None, "p99": None}}}
+    text = prom_text(snap)
+    assert checker.validate_prom_exposition(text) == []
+    assert 'ds_serve_ttft_ms{quantile="0.5"} 2.0' in text
+    assert "ds_fresh_peak" not in text      # -inf sentinel skipped
+    assert "ds_empty_count 0" in text       # typed empty summary exports
+
+
+def test_prom_cli_exit_codes(checker, tmp_path, capsys):
+    good = tmp_path / "good.prom"
+    good.write_text("# TYPE ds_x gauge\nds_x 1.0\n")
+    bad = tmp_path / "bad.prom"
+    bad.write_text("ds_untyped 1.0\n")
+    assert checker.main(["--prom", str(good)]) == 0
+    assert checker.main(["--prom", str(good), str(bad)]) == 1
+    assert "no TYPE declaration" in capsys.readouterr().out
 
 
 def test_cli_exit_codes(checker, tmp_path, capsys):
