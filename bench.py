@@ -827,6 +827,119 @@ def _worker_serving_slo(spec):
     print(json.dumps(_serving_slo_bench(spec)))
 
 
+def _comm_census_bench(spec=None):
+    """CPU-runnable distributed-telemetry micro-bench: a simulated 4-rank
+    run (N threads, each owning its own Telemetry configured with a
+    distinct rank — the same shard layout N real processes produce) with
+    synthetic timed collectives and one deliberately delayed rank.
+    Reports the observability plane's own numbers: the aggregator's
+    per-collective achieved-bandwidth accounting checked against the
+    hand-computed bytes/duration, the cross-rank skew table, the
+    straggler verdict, plus schema-checker validation of every shard and
+    a live scrape of the rank-0 exporter's rank-labelled /metrics and
+    /cluster endpoints.  Durations are synthetic by design — the
+    accounting chain, not the wire, is what this bench measures."""
+    spec = spec or {}
+    import importlib.util
+    import tempfile
+    import threading
+    import urllib.request
+
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    n_ranks = int(spec.get("ranks", 4))
+    steps = int(spec.get("steps", 12))
+    step_ms = float(spec.get("step_ms", 20.0))
+    straggler_ms = 4.0 * step_ms              # 4x median, threshold 2x
+    comm_bytes = int(spec.get("comm_bytes", 4 << 20))
+    comm_dur_ms = float(spec.get("comm_dur_ms", 2.0))
+    tmp = tempfile.mkdtemp(prefix="comm_census_bench_")
+
+    def _cfg():
+        return TelemetryConfig(
+            {"enabled": True, "output_path": tmp,
+             "job_name": "comm_census",
+             "export": {"enabled": True, "port": 0},
+             "distributed": {"enabled": True, "skew_threshold": 2.0,
+                             "straggler_window": steps}})
+
+    tels = [None] * n_ranks
+
+    def _run_rank(rank):
+        tel = Telemetry().configure(_cfg(), rank=rank)
+        tels[rank] = tel
+        for step in range(1, steps + 1):
+            ms = straggler_ms if rank == n_ranks - 1 else step_ms
+            tel.emit("heartbeat", "engine/heartbeat", step=step,
+                     step_ms=ms)
+            tel.collective("all_reduce", comm_bytes, "fsdp",
+                           dtype="float32", dur_ms=comm_dur_ms,
+                           world=n_ranks)
+            tel.collective("all_gather", comm_bytes // 4, "fsdp",
+                           dtype="bfloat16", dur_ms=comm_dur_ms / 2,
+                           world=n_ranks)
+
+    threads = [threading.Thread(target=_run_rank, args=(r,))
+               for r in range(n_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    shard_dir = os.path.join(tmp, "comm_census")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sp = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(repo, "scripts", "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(checker)
+    shard_problems, n_shards = checker.validate_shard_dir(shard_dir)
+
+    # rank 0 owns the aggregator and the exporter; scrape both surfaces
+    tels[0].cluster.refresh(force=True)
+    host, port = tels[0].exporter.address
+    prom = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=5).read().decode()
+    snap = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/cluster", timeout=5).read())
+    prom_problems = checker.validate_prom_exposition(prom)
+    cluster_problems = checker.validate_cluster_payload(snap)
+    for tel in tels:
+        tel.close()
+
+    # bandwidth accounting: the aggregated achieved GB/s must reproduce
+    # the hand-computed sum(bytes)/sum(duration) of the injected events
+    expect = comm_bytes / (comm_dur_ms / 1e3) / 1e9
+    row = snap["collectives"]["all_reduce"]
+    achieved = row["achieved_gbps"] or 0.0
+    skew = snap["step_skew"]
+    return {
+        "ranks": n_ranks,
+        "steps_aligned": snap["steps"]["aligned"],
+        "shards_validated": n_shards,
+        "shard_problems": len(shard_problems),
+        "cluster_payload_ok": not cluster_problems,
+        "exporter_scrape_ok": not prom_problems and 'rank="0"' in prom,
+        "all_reduce_calls": row["calls"],
+        "achieved_gbps": achieved,
+        "expected_gbps": round(expect, 4),
+        "bandwidth_rel_err": round(abs(achieved - expect) / expect, 6),
+        "busbw_gbps": row["busbw_gbps"],
+        "step_skew_ms": {"p50": skew["p50_spread_ms"],
+                         "max": skew["max_spread_ms"]},
+        "straggler_rank": snap["straggler"]["rank"],
+        "straggler_metric": snap["straggler"]["metric"],
+        "straggler_detected": snap["straggler"]["rank"] == n_ranks - 1,
+        "note": "synthetic durations: this bench proves the shard -> "
+                "aggregate -> scrape accounting chain, not wire speed",
+    }
+
+
+def _worker_comm_census(spec):
+    print(json.dumps(_comm_census_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -955,6 +1068,25 @@ def _attach_serving_slo(out):
     return out
 
 
+def _attach_comm_census(out):
+    """Attach the distributed-telemetry micro-bench under the stable key
+    ``cpu_comm_census`` (CPU-runnable: simulated 4-rank shard run,
+    bandwidth accounting vs hand-computed, straggler verdict, checker
+    validation).  Budget-gated; a failure is recorded in notes, never
+    fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "comm_census", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_comm_census"] = res
+    else:
+        out.setdefault("notes", {})["comm_census"] = (err or "")[:200]
+    return out
+
+
 def main():
     errors = {}
 
@@ -981,7 +1113,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))
+            print(json.dumps(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -1144,7 +1276,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))
+    print(json.dumps(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))
 
 
 if __name__ == "__main__":
@@ -1175,6 +1307,8 @@ if __name__ == "__main__":
             _worker_serving_attn(spec)
         elif which == "serving_slo":
             _worker_serving_slo(spec)
+        elif which == "comm_census":
+            _worker_comm_census(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

@@ -22,6 +22,8 @@ Semantics differ from NCCL fundamentally and deliberately:
 """
 
 import os
+import time
+from contextlib import contextmanager
 from typing import Optional
 
 from deepspeed_tpu.comm.backend import ReduceOp, XlaBackend
@@ -29,6 +31,15 @@ from deepspeed_tpu.parallel.topology import FSDP_AXIS
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 _backend: Optional[XlaBackend] = None
+
+# FROZEN vocabulary of comm-event op names — every ``comm``-kind telemetry
+# event carries one of these.  Mirrored byte-identical in
+# scripts/check_telemetry_schema.py (a tier-1 test diffs the two); adding
+# a collective verb means extending both in the same change.
+COMM_OPS = (
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "broadcast", "scatter", "ppermute", "barrier",
+)
 
 
 # ----------------------------------------------------------------------
@@ -47,12 +58,14 @@ class CommsLogger:
         self.verbose = verbose
         self.prof_ops = prof_ops or []
 
-    def append(self, op_name, size_bytes, axis):
+    def append(self, op_name, size_bytes, axis, dtype=None, dur_ms=None,
+               world=None):
         # unified telemetry census rides every traced op, independent of the
         # comms_logger's own enabled/prof_ops filters (no-op when telemetry
-        # is off — one flag check inside comm())
+        # is off — one flag check inside collective())
         from deepspeed_tpu.monitor.telemetry import get_telemetry
-        get_telemetry().comm(op_name, size_bytes, axis)
+        get_telemetry().collective(op_name, size_bytes, axis, dtype=dtype,
+                                   dur_ms=dur_ms, world=world)
         if not self.enabled:
             return
         if self.prof_ops and op_name not in self.prof_ops:
@@ -92,16 +105,57 @@ def log_summary():
     comms_logger.log_all()
 
 
-def _nbytes(x):
+def _payload(x):
+    """(bytes, dtype-name) of a tensor/tracer — dtype-TRUE: byte size is
+    ``size * dtype.itemsize`` of the actual payload dtype, never an
+    element count.  Python scalars fall back through numpy; unknowns
+    record zero bytes rather than failing a traced program."""
     try:
-        import numpy as np
-        return x.size * x.dtype.itemsize
+        return int(x.size) * x.dtype.itemsize, str(x.dtype)
     except Exception:
-        return 0
+        try:
+            import numpy as np
+            a = np.asarray(x)
+            return int(a.nbytes), str(a.dtype)
+        except Exception:
+            return 0, None
+
+
+def _axis_world(axis):
+    """Device count along a mesh axis (or axis tuple); None outside a mesh
+    context."""
+    try:
+        from deepspeed_tpu.parallel import groups
+        n = groups._axis_size(axis)
+        return int(n) if n else None
+    except Exception:
+        return None
+
+
+@contextmanager
+def _traced(op_name, tensor, axis):
+    """Timed collective span around a verb body: records payload bytes
+    (dtype-true), dtype, axis/group, world size, and the host-observed
+    duration of the verb call.  Inside ``jit``/``shard_map`` the duration
+    is TRACE time (the census convention — a shape traces once, executes
+    many); host-level ops (``barrier``) and callers timing executed
+    programs get true wall time.  Telemetry lands the span in histogram
+    ``comm/{op}_ms``, counters ``comm/{op}/calls|bytes``, and one frozen
+    ``comm`` JSONL event with achieved bus bandwidth vs the analytic link
+    peak (comm/topology_model.py).  A verb that raises records nothing."""
+    t0 = time.perf_counter()
+    yield
+    dur_ms = (time.perf_counter() - t0) * 1e3
+    nbytes, dtype = _payload(tensor)
+    comms_logger.append(op_name, nbytes, axis, dtype=dtype, dur_ms=dur_ms,
+                        world=_axis_world(axis))
 
 
 def _record(op_name, tensor, axis):
-    comms_logger.append(op_name, _nbytes(tensor), axis)
+    """Untimed census append (back-compat shim for external callers)."""
+    nbytes, dtype = _payload(tensor)
+    comms_logger.append(op_name, nbytes, axis, dtype=dtype,
+                        world=_axis_world(axis))
 
 
 # ----------------------------------------------------------------------
@@ -178,20 +232,20 @@ def has_all_to_all_single():
 # ----------------------------------------------------------------------
 def all_reduce(tensor, op=ReduceOp.SUM, group=FSDP_AXIS, async_op=False):
     from jax import lax
-    _record("all_reduce", tensor, group)
-    if op == ReduceOp.SUM:
-        return lax.psum(tensor, group)
-    if op == ReduceOp.AVG:
-        return lax.pmean(tensor, group)
-    if op == ReduceOp.MAX:
-        return lax.pmax(tensor, group)
-    if op == ReduceOp.MIN:
-        return lax.pmin(tensor, group)
-    if op == ReduceOp.PRODUCT:
-        import jax.numpy as jnp
-        # no lax.pprod; exp∘psum∘log is unstable — gather and reduce instead
-        return jnp.prod(lax.all_gather(tensor, group), axis=0)
-    raise ValueError(f"unsupported reduce op {op}")
+    with _traced("all_reduce", tensor, group):
+        if op == ReduceOp.SUM:
+            return lax.psum(tensor, group)
+        if op == ReduceOp.AVG:
+            return lax.pmean(tensor, group)
+        if op == ReduceOp.MAX:
+            return lax.pmax(tensor, group)
+        if op == ReduceOp.MIN:
+            return lax.pmin(tensor, group)
+        if op == ReduceOp.PRODUCT:
+            import jax.numpy as jnp
+            # no lax.pprod; exp∘psum∘log is unstable — gather and reduce
+            return jnp.prod(lax.all_gather(tensor, group), axis=0)
+        raise ValueError(f"unsupported reduce op {op}")
 
 
 def inference_all_reduce(tensor, op=ReduceOp.SUM, group="tp", async_op=False):
@@ -202,8 +256,8 @@ def all_gather(tensor, group=FSDP_AXIS, axis=0, tiled=False, async_op=False):
     """Gather along a new (or tiled) leading dim.  ``tiled=True`` is the
     ``all_gather_base`` flat-buffer form."""
     from jax import lax
-    _record("all_gather", tensor, group)
-    return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+    with _traced("all_gather", tensor, group):
+        return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
 
 
 def all_gather_base(tensor, group=FSDP_AXIS, async_op=False):
@@ -217,12 +271,13 @@ def allgather_fn(tensor, group=FSDP_AXIS):
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=FSDP_AXIS, scatter_dim=0,
                    tiled=True, async_op=False):
     from jax import lax
-    _record("reduce_scatter", tensor, group)
-    out = lax.psum_scatter(tensor, group, scatter_dimension=scatter_dim, tiled=tiled)
-    if op == ReduceOp.AVG:
-        from deepspeed_tpu.parallel import groups
-        out = out / groups._axis_size(group)
-    return out
+    with _traced("reduce_scatter", tensor, group):
+        out = lax.psum_scatter(tensor, group, scatter_dimension=scatter_dim,
+                               tiled=tiled)
+        if op == ReduceOp.AVG:
+            from deepspeed_tpu.parallel import groups
+            out = out / groups._axis_size(group)
+        return out
 
 
 def reduce_scatter_base(tensor, group=FSDP_AXIS, async_op=False):
@@ -236,19 +291,19 @@ def reduce_scatter_fn(tensor, group=FSDP_AXIS):
 def all_to_all_single(tensor, group="sp", split_axis=0, concat_axis=0,
                       tiled=True, async_op=False):
     from jax import lax
-    _record("all_to_all", tensor, group)
-    return lax.all_to_all(tensor, group, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=tiled)
+    with _traced("all_to_all", tensor, group):
+        return lax.all_to_all(tensor, group, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=tiled)
 
 
 def broadcast(tensor, src=0, group=FSDP_AXIS, async_op=False):
     """Value of device ``src`` (index along ``group``) on every device."""
     import jax.numpy as jnp
     from jax import lax
-    _record("broadcast", tensor, group)
-    idx = lax.axis_index(group)
-    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
-    return lax.psum(masked, group)
+    with _traced("broadcast", tensor, group):
+        idx = lax.axis_index(group)
+        masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+        return lax.psum(masked, group)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=FSDP_AXIS, async_op=False):
@@ -262,12 +317,12 @@ def scatter(tensor, src=0, group=FSDP_AXIS):
     import jax.numpy as jnp
     from jax import lax
     from deepspeed_tpu.parallel import groups
-    _record("scatter", tensor, group)
-    full = broadcast(tensor, src=src, group=group)
-    n = groups._axis_size(group)
-    idx = lax.axis_index(group)
-    shard = full.shape[0] // n
-    return lax.dynamic_slice_in_dim(full, idx * shard, shard, axis=0)
+    with _traced("scatter", tensor, group):
+        full = broadcast(tensor, src=src, group=group)
+        n = groups._axis_size(group)
+        idx = lax.axis_index(group)
+        shard = full.shape[0] // n
+        return lax.dynamic_slice_in_dim(full, idx * shard, shard, axis=0)
 
 
 def send(tensor, dst, group="pp"):
@@ -290,20 +345,22 @@ def ppermute_shift(tensor, shift=1, group="pp", wrap=True):
     The pipeline/ring-attention workhorse."""
     from jax import lax
     from deepspeed_tpu.parallel import groups
-    _record("ppermute", tensor, group)
-    n = groups._axis_size(group)
-    if wrap:
-        perm = [(i, (i + shift) % n) for i in range(n)]
-    else:
-        perm = [(i, i + shift) for i in range(n) if 0 <= i + shift < n]
-    return lax.ppermute(tensor, group, perm)
+    with _traced("ppermute", tensor, group):
+        n = groups._axis_size(group)
+        if wrap:
+            perm = [(i, (i + shift) % n) for i in range(n)]
+        else:
+            perm = [(i, i + shift) for i in range(n) if 0 <= i + shift < n]
+        return lax.ppermute(tensor, group, perm)
 
 
 def barrier(group=None, async_op=False):
     """Host-level sync point.  Inside jit, ordering is XLA's job; at host
     level we block on outstanding work (the reference's dist.barrier most
-    often guards host-side checkpoint I/O)."""
+    often guards host-side checkpoint I/O).  The comm span here carries
+    TRUE wall time (the barrier blocks the host), zero payload bytes."""
     import jax
+    t0 = time.perf_counter()
     jax.effects_barrier()
     if jax.process_count() > 1:
         try:
@@ -311,6 +368,9 @@ def barrier(group=None, async_op=False):
             multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
         except Exception:
             pass
+    comms_logger.append("barrier", 0, group if group is not None else "world",
+                        dur_ms=(time.perf_counter() - t0) * 1e3,
+                        world=jax.process_count())
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):

@@ -1030,6 +1030,10 @@ class ServingEngine:
                                    ("serve/prefix_cached_pages",
                                     "cached_pages")):
                     tel.registry.gauge(gauge).set(pc[key])
+        if tel is not None and getattr(tel, "cluster", None) is not None:
+            # distributed telemetry: cross-rank skew/straggler view rides
+            # along on the same health surface operators already poll
+            snap["cluster"] = tel.cluster.snapshot()
         return snap
 
     def leak_report(self) -> Dict[str, Any]:

@@ -1,3 +1,5 @@
+from .aggregate import (CLUSTER_GAUGES, ClusterAggregator, aggregate_cluster,
+                        aggregate_shards, discover_shards)
 from .export import MetricsExporter, prom_name, prom_text
 from .monitor import JsonlMonitor, Monitor, MonitorMaster
 from .telemetry import (JsonlEventSink, MetricsRegistry, StepStallWatchdog,

@@ -1,0 +1,346 @@
+"""Cross-rank shard aggregation: merge per-rank telemetry streams into one
+cluster view — step-time skew, per-collective arrival spread, comm
+bandwidth accounting, and a straggler verdict.
+
+Distributed telemetry (``telemetry.distributed``) makes every process
+write its own shard ``events.rank{N}.jsonl`` (each record stamped with its
+rank).  This module is the read side: :func:`discover_shards` finds the
+shards (rotated generations included, a torn last line from a live writer
+is tolerated and counted), :func:`aggregate_cluster` aligns records by
+step across ranks, and :class:`ClusterAggregator` wraps both behind a
+rate-limited cache that backs the exporter's ``/cluster`` endpoint, the
+stall watchdog's cross-rank sweep, and ``health()``'s cluster section.
+
+Skew semantics (docs/telemetry.md):
+
+* **step-time skew** — over the aligned steps (step numbers every rank
+  reported a heartbeat for), the per-step spread ``max - min`` of the
+  measured step wall times.  A healthy SPMD job has near-zero spread; a
+  rank whose step times diverge is falling behind the collective schedule.
+* **collective arrival spread** — the k-th traced collective of each op is
+  matched across ranks and the spread of its host timestamps taken; a
+  rank consistently arriving late at collectives is blocked on something
+  local (input feed, host work) even if barriers equalize its step time.
+* **straggler verdict** — a rank is flagged when its median step time over
+  the last ``straggler_window`` aligned steps exceeds ``skew_threshold``
+  times the median of the per-rank medians, or when its mean
+  collective-entry delay exceeds the same multiple of the cluster median
+  step time.  With zero injected skew nothing is flagged (the threshold
+  is a multiple > 1 of the median, which every rank sits at).
+
+The single-rank degenerate case reduces to the PR 1 stream: one shard
+(``events.rank0.jsonl`` or a legacy ``events.jsonl``), zero spreads, no
+verdict — counts and medians match ``ds_telemetry_report.py``.
+"""
+
+import glob
+import json
+import os
+import re
+import threading
+import time
+
+from deepspeed_tpu.comm.topology_model import busbw_factor, link_peak_gbps
+
+# FROZEN vocabulary of cluster/* gauge names the aggregator maintains in
+# the registry (scraped via the exporter's /metrics).  Mirrored in
+# scripts/check_telemetry_schema.py; a tier-1 test diffs the two.
+CLUSTER_GAUGES = (
+    "cluster/ranks",
+    "cluster/missing_ranks",
+    "cluster/step_skew_ms",
+    "cluster/step_skew_rel",
+    "cluster/collective_spread_ms",
+    "cluster/straggler_rank",
+)
+
+_SHARD_RE = re.compile(r"events\.rank(\d+)\.jsonl$")
+
+
+def discover_shards(shard_dir):
+    """Map ``rank -> [files oldest..newest]`` for every shard under
+    ``shard_dir``.  Rotated generations (``events.rank0.jsonl.N``) come
+    first, oldest first; a legacy single-rank ``events.jsonl`` (PR 1
+    layout, no distributed block) maps to rank 0."""
+    shards = {}
+
+    def add(rank, live):
+        rotated = sorted(
+            (p for p in glob.glob(live + ".*")
+             if p.rsplit(".", 1)[1].isdigit()),
+            key=lambda p: int(p.rsplit(".", 1)[1]), reverse=True)
+        files = rotated + ([live] if os.path.exists(live) else [])
+        if files:
+            shards[rank] = files
+
+    for path in glob.glob(os.path.join(shard_dir, "events.rank*.jsonl")):
+        m = _SHARD_RE.search(path)
+        if m:
+            add(int(m.group(1)), path)
+    if not shards:
+        add(0, os.path.join(shard_dir, "events.jsonl"))
+    return shards
+
+
+def load_shard(files):
+    """(events, torn_lines) for one rank's files.  A line that fails to
+    parse — the torn tail of a live writer, a partial flush — is skipped
+    and counted, never fatal."""
+    events, torn = [], 0
+    for path in files:
+        try:
+            f = open(path)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    torn += 1
+    return events, torn
+
+
+def _median(vals):
+    """Sample median, LOWER middle on even counts — with two ranks the
+    upper middle IS the straggler's own value, which would make the
+    step-time verdict (worst > threshold x median) unsatisfiable."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[(len(s) - 1) // 2]
+
+
+def _rank_series(events):
+    """Per-rank digest of one shard: ``steps[step] = (ts, step_ms)`` from
+    heartbeats (last write wins — replays/out-of-order streams collapse to
+    one record per step) and the ordered comm-event series per op."""
+    steps = {}
+    comms = {}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "heartbeat":
+            step = ev.get("step")
+            if step is not None:
+                steps[int(step)] = (float(ev.get("ts", 0.0)),
+                                    ev.get("step_ms"))
+        elif kind == "comm":
+            comms.setdefault(ev.get("name"), []).append(ev)
+    return steps, comms
+
+
+def _collective_rows(comms_by_rank):
+    """Per-op bandwidth + cross-rank arrival alignment.
+
+    Bandwidth is hand-computable from the stream: ``achieved_gbps`` is the
+    summed payload of TIMED events divided by their summed duration (events
+    without ``dur_ms`` count toward calls/bytes but not bandwidth);
+    ``busbw_gbps`` applies the nccl-tests bus factor for the op's typical
+    world size.  Arrival spread matches the k-th occurrence of each op
+    across every rank that traced at least k+1 of them."""
+    ops = sorted({op for c in comms_by_rank.values() for op in c})
+    rows = {}
+    entry_delays = {r: [] for r in comms_by_rank}
+    for op in ops:
+        calls = bytes_total = timed_calls = timed_bytes = 0
+        dur_total = 0.0
+        world = None
+        for evs in comms_by_rank.values():
+            for ev in evs.get(op, []):
+                calls += 1
+                bytes_total += int(ev.get("bytes", 0))
+                if ev.get("world") is not None:
+                    world = max(world or 0, int(ev["world"]))
+                if ev.get("dur_ms"):
+                    timed_calls += 1
+                    timed_bytes += int(ev.get("bytes", 0))
+                    dur_total += float(ev["dur_ms"])
+        achieved = busbw = None
+        if dur_total > 0 and timed_bytes:
+            achieved = timed_bytes / (dur_total / 1e3) / 1e9
+            busbw = achieved * busbw_factor(op, world or 2)
+        spreads = []
+        series = {r: evs.get(op, []) for r, evs in comms_by_rank.items()
+                  if evs.get(op)}
+        if len(series) >= 2:
+            depth = min(len(s) for s in series.values())
+            for k in range(depth):
+                arrivals = {r: float(s[k].get("ts", 0.0))
+                            for r, s in series.items()}
+                lo = min(arrivals.values())
+                spreads.append((max(arrivals.values()) - lo) * 1e3)
+                for r, ts in arrivals.items():
+                    entry_delays[r].append((ts - lo) * 1e3)
+        rows[op] = {
+            "calls": calls, "bytes": bytes_total,
+            "timed_calls": timed_calls, "timed_bytes": timed_bytes,
+            "dur_ms": round(dur_total, 4),
+            "achieved_gbps": (round(achieved, 4)
+                              if achieved is not None else None),
+            "busbw_gbps": round(busbw, 4) if busbw is not None else None,
+            "peak_gbps": link_peak_gbps(),
+            "world": world,
+            "arrival_spread_ms": (
+                {"p50": round(_median(spreads), 4),
+                 "max": round(max(spreads), 4)} if spreads else None),
+        }
+    mean_delays = {r: (sum(d) / len(d) if d else 0.0)
+                   for r, d in entry_delays.items()}
+    return rows, mean_delays
+
+
+def aggregate_cluster(events_by_rank, skew_threshold=2.0,
+                      straggler_window=32, torn_lines=0, shard_dir=""):
+    """Merge per-rank event lists into the cluster snapshot dict (the
+    ``/cluster`` payload; schema held by check_telemetry_schema.py)."""
+    skew_threshold = float(skew_threshold)
+    straggler_window = max(1, int(straggler_window))
+    series = {r: _rank_series(evs) for r, evs in events_by_rank.items()}
+    steps_by_rank = {r: s for r, (s, _) in series.items()}
+    comms_by_rank = {r: c for r, (_, c) in series.items()}
+    ranks = sorted(series)
+    missing = ([r for r in range(max(ranks) + 1) if r not in series]
+               if ranks else [])
+
+    all_steps = set()
+    for s in steps_by_rank.values():
+        all_steps |= set(s)
+    aligned = sorted(set.intersection(*map(set, steps_by_rank.values()))
+                     if steps_by_rank else set())
+    window = aligned[-straggler_window:]
+
+    # cross-rank step-time skew over the aligned window
+    spreads, rels = [], []
+    per_rank_ms = {r: [] for r in ranks}
+    for step in window:
+        ms = {r: steps_by_rank[r][step][1] for r in ranks
+              if steps_by_rank[r][step][1] is not None}
+        for r, v in ms.items():
+            per_rank_ms[r].append(float(v))
+        if len(ms) >= 2:
+            spread = max(ms.values()) - min(ms.values())
+            spreads.append(spread)
+            med = _median(list(ms.values()))
+            if med:
+                rels.append(spread / med)
+    medians = {r: _median(v) for r, v in per_rank_ms.items()}
+    global_med = _median([m for m in medians.values() if m is not None])
+
+    collectives, mean_delays = _collective_rows(comms_by_rank)
+
+    # straggler verdict: step-time first, collective-entry second
+    verdict_rank, metric = None, None
+    if len(ranks) >= 2 and global_med:
+        worst = max((m, r) for r, m in medians.items() if m is not None)
+        if worst[0] > skew_threshold * global_med:
+            verdict_rank, metric = worst[1], "step_time"
+        else:
+            late = max(((d, r) for r, d in mean_delays.items()),
+                       default=(0.0, None))
+            if late[1] is not None and late[0] > skew_threshold * global_med:
+                verdict_rank, metric = late[1], "collective_entry"
+
+    return {
+        "ts": round(time.time(), 6),
+        "shard_dir": str(shard_dir),
+        "ranks": ranks,
+        "missing_ranks": missing,
+        "torn_lines": int(torn_lines),
+        "steps": {
+            "count": len(all_steps),
+            "aligned": len(aligned),
+            "median_step_ms": (round(global_med, 4)
+                               if global_med is not None else None),
+        },
+        "step_skew": {
+            "aligned": len(window),
+            "max_spread_ms": (round(max(spreads), 4) if spreads else None),
+            "p50_spread_ms": (round(_median(spreads), 4)
+                              if spreads else None),
+            "max_rel": round(max(rels), 4) if rels else None,
+        },
+        "collectives": collectives,
+        "straggler": {
+            "rank": verdict_rank,
+            "metric": metric,
+            "threshold": skew_threshold,
+            "window": straggler_window,
+            "per_rank": {
+                str(r): {
+                    "steps": len(per_rank_ms[r]),
+                    "median_step_ms": (round(medians[r], 4)
+                                       if medians[r] is not None else None),
+                    "mean_entry_delay_ms": round(mean_delays.get(r, 0.0), 4),
+                } for r in ranks},
+        },
+    }
+
+
+def aggregate_shards(shard_dir, skew_threshold=2.0, straggler_window=32):
+    """Discover + load + aggregate in one call (report script, tests)."""
+    shards = discover_shards(shard_dir)
+    events, torn = {}, 0
+    for rank, files in shards.items():
+        evs, t = load_shard(files)
+        events[rank] = evs
+        torn += t
+    return aggregate_cluster(events, skew_threshold=skew_threshold,
+                             straggler_window=straggler_window,
+                             torn_lines=torn, shard_dir=shard_dir)
+
+
+class ClusterAggregator:
+    """Live wrapper: re-aggregates the shard directory on demand, at most
+    once per ``min_refresh_secs`` (scrapes and watchdog polls share one
+    pass over the files), and mirrors the headline numbers onto the
+    frozen ``cluster/*`` registry gauges so /metrics carries them without
+    a second aggregation."""
+
+    def __init__(self, shard_dir, skew_threshold=2.0, straggler_window=32,
+                 registry=None, min_refresh_secs=1.0):
+        self.shard_dir = str(shard_dir)
+        self.skew_threshold = float(skew_threshold)
+        self.straggler_window = int(straggler_window)
+        self.registry = registry
+        self.min_refresh_secs = float(min_refresh_secs)
+        self._lock = threading.Lock()
+        self._cache = None
+        self._cached_at = None
+
+    def refresh(self, force=False):
+        with self._lock:
+            now = time.monotonic()
+            if not force and self._cache is not None and \
+                    now - self._cached_at < self.min_refresh_secs:
+                return self._cache
+            snap = aggregate_shards(
+                self.shard_dir, skew_threshold=self.skew_threshold,
+                straggler_window=self.straggler_window)
+            self._cache, self._cached_at = snap, now
+        self._push_gauges(snap)
+        return snap
+
+    def snapshot(self):
+        """The /cluster payload (cached within ``min_refresh_secs``)."""
+        return self.refresh()
+
+    def _push_gauges(self, snap):
+        if self.registry is None:
+            return
+        skew = snap["step_skew"]
+        spread_max = max((r["arrival_spread_ms"]["max"]
+                          for r in snap["collectives"].values()
+                          if r.get("arrival_spread_ms")), default=0.0)
+        straggler = snap["straggler"]["rank"]
+        for name, value in (
+                ("cluster/ranks", len(snap["ranks"])),
+                ("cluster/missing_ranks", len(snap["missing_ranks"])),
+                ("cluster/step_skew_ms", skew["max_spread_ms"] or 0.0),
+                ("cluster/step_skew_rel", skew["max_rel"] or 0.0),
+                ("cluster/collective_spread_ms", spread_max),
+                ("cluster/straggler_rank",
+                 straggler if straggler is not None else -1)):
+            self.registry.gauge(name).set(value)

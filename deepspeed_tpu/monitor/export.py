@@ -11,7 +11,15 @@ can observe a running job without touching its JSONL files:
   with p50/p90/p99 quantile samples plus ``_sum``/``_count``.
 * ``GET /metrics.json``  — the raw registry snapshot as JSON (same shape
   as :meth:`Telemetry.snapshot`); ``/snapshot`` is an alias.
+* ``GET /cluster``       — cross-rank aggregation snapshot (distributed
+  telemetry: per-rank shards merged by ``monitor/aggregate.py`` into
+  skew, comm-bandwidth, and straggler tables); 404 when the exporter has
+  no aggregator (single-rank / distributed block off).
 * ``GET /healthz``       — liveness probe, ``{"ok": true}``.
+
+In distributed mode every sample on ``/metrics`` carries a ``rank``
+label (``ds_engine_loss{rank="0"}``) so multi-rank scrapes stay
+distinguishable at the collector.
 
 Everything is read-only and stdlib-only (``http.server``), off by default,
 and enabled through the ``telemetry.export`` config block
@@ -50,23 +58,37 @@ def _fmt(v):
     return repr(float(v))
 
 
-def prom_text(snapshot):
+def _label_str(labels, extra=None):
+    """``{k="v",...}`` sample-label block; empty string when unlabelled."""
+    pairs = dict(labels or {})
+    if extra:
+        pairs.update(extra)
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in sorted(pairs.items()))
+    return "{" + body + "}"
+
+
+def prom_text(snapshot, labels=None):
     """Render a registry snapshot (``Telemetry.snapshot()`` shape) as
-    Prometheus text exposition format 0.0.4."""
+    Prometheus text exposition format 0.0.4.  ``labels`` (e.g.
+    ``{"rank": "0"}`` in distributed mode) are attached to every sample;
+    quantile samples merge them with their ``quantile`` label."""
+    base = _label_str(labels)
     lines = []
     for name in sorted(snapshot.get("counters", {})):
         pn = prom_name(name)
         lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {_fmt(snapshot['counters'][name])}")
+        lines.append(f"{pn}{base} {_fmt(snapshot['counters'][name])}")
     for name in sorted(snapshot.get("gauges", {})):
         g = snapshot["gauges"][name]
         pn = prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {_fmt(g['value'])}")
+        lines.append(f"{pn}{base} {_fmt(g['value'])}")
         # peak is -inf until the first set(); skip the unset sentinel
         if g["peak"] != float("-inf"):
             lines.append(f"# TYPE {pn}_peak gauge")
-            lines.append(f"{pn}_peak {_fmt(g['peak'])}")
+            lines.append(f"{pn}_peak{base} {_fmt(g['peak'])}")
     for name in sorted(snapshot.get("histograms", {})):
         s = snapshot["histograms"][name]
         pn = prom_name(name)
@@ -74,11 +96,12 @@ def prom_text(snapshot):
         count = int(s.get("count", 0))
         for q, key in _QUANTILES:
             if s.get(key) is not None:
-                lines.append(f'{pn}{{quantile="{q}"}} {_fmt(s[key])}')
+                ql = _label_str(labels, {"quantile": q})
+                lines.append(f"{pn}{ql} {_fmt(s[key])}")
         mean = s.get("mean")
         total = (mean * count) if (mean is not None and count) else 0.0
-        lines.append(f"{pn}_sum {_fmt(total)}")
-        lines.append(f"{pn}_count {count}")
+        lines.append(f"{pn}_sum{base} {_fmt(total)}")
+        lines.append(f"{pn}_count{base} {count}")
     return "\n".join(lines) + "\n"
 
 
@@ -92,13 +115,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
-            body = prom_text(self.exporter.telemetry.snapshot())
+            body = prom_text(self.exporter.telemetry.snapshot(),
+                             labels=self.exporter.labels)
             self._reply(200, body,
                         "text/plain; version=0.0.4; charset=utf-8")
         elif path in ("/metrics.json", "/snapshot"):
             body = json.dumps(self.exporter.telemetry.snapshot(),
                               default=str)
             self._reply(200, body, "application/json")
+        elif path == "/cluster":
+            if self.exporter.cluster_fn is None:
+                self._reply(404, '{"error": "no cluster aggregator"}',
+                            "application/json")
+            else:
+                try:
+                    body = json.dumps(self.exporter.cluster_fn(),
+                                      default=str)
+                    self._reply(200, body, "application/json")
+                except Exception as e:   # aggregation must not 500 a scrape
+                    self._reply(503, json.dumps({"error": str(e)}),
+                                "application/json")
         elif path == "/healthz":
             self._reply(200, '{"ok": true}', "application/json")
         else:
@@ -124,8 +160,13 @@ class MetricsExporter:
     dict copy), so scrapes cannot stall the step loop.
     """
 
-    def __init__(self, telemetry, host="127.0.0.1", port=9866):
+    def __init__(self, telemetry, host="127.0.0.1", port=9866, labels=None,
+                 cluster_fn=None):
         self.telemetry = telemetry
+        # distributed mode: per-sample labels ({"rank": "0"}) and the
+        # shard aggregator behind GET /cluster
+        self.labels = dict(labels) if labels else None
+        self.cluster_fn = cluster_fn
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True

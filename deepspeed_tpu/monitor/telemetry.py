@@ -229,6 +229,24 @@ class JsonlEventSink:
                 self._file = None
 
 
+def _coerce_distributed(dcfg):
+    """``telemetry.distributed`` block as a plain dict — accepts the
+    TelemetryDistributedConfig object, a raw dict (hand-built configs),
+    or None (block absent: distributed mode off)."""
+    if dcfg is None:
+        return {"enabled": False, "shard_dir": "", "skew_threshold": 2.0,
+                "straggler_window": 32}
+    if isinstance(dcfg, dict):
+        return {"enabled": bool(dcfg.get("enabled", False)),
+                "shard_dir": str(dcfg.get("shard_dir", "") or ""),
+                "skew_threshold": float(dcfg.get("skew_threshold", 2.0)),
+                "straggler_window": int(dcfg.get("straggler_window", 32))}
+    return {"enabled": bool(dcfg.enabled),
+            "shard_dir": str(dcfg.shard_dir or ""),
+            "skew_threshold": float(dcfg.skew_threshold),
+            "straggler_window": int(dcfg.straggler_window)}
+
+
 # ----------------------------------------------------------------------
 # the telemetry object
 # ----------------------------------------------------------------------
@@ -246,19 +264,32 @@ class Telemetry:
         self.sink = None
         self.config = None
         self.exporter = None
+        self.rank = 0
+        self.cluster = None
+        self._stamp_rank = False
 
     def configure(self, config=None, rank=None):
-        """(Re)configure from a ``TelemetryConfig``-shaped object.  The sink
-        is rank-0-gated; non-zero ranks keep the registry and spans (xprof
-        annotations are per-host) but write no events.  When the config
-        carries an enabled ``export`` block, a rank-0 background HTTP
-        exporter (monitor/export.py) is started on the same gate."""
+        """(Re)configure from a ``TelemetryConfig``-shaped object.
+
+        Default mode keeps the PR 1 contract: the sink is rank-0-gated
+        (``events.jsonl``); non-zero ranks keep the registry and spans
+        (xprof annotations are per-host) but write no events.  With the
+        ``telemetry.distributed`` block enabled, EVERY process writes its
+        own shard ``events.rank{N}.jsonl`` (rank stamped into each
+        record) and rank 0 additionally owns a :class:`ClusterAggregator`
+        over the shard directory — the data plane behind the exporter's
+        ``/cluster`` endpoint, the watchdog's cross-rank check, and
+        ``health()``'s cluster section.  When the config carries an
+        enabled ``export`` block, a rank-0 background HTTP exporter
+        (monitor/export.py) is started on the same gate."""
         if self.sink is not None:
             self.sink.close()
             self.sink = None
         if self.exporter is not None:
             self.exporter.close()
             self.exporter = None
+        self.cluster = None
+        self._stamp_rank = False
         self.config = config
         self.enabled = bool(config is not None and config.enabled)
         if not self.enabled:
@@ -269,9 +300,26 @@ class Telemetry:
                 rank = jax.process_index()
             except Exception:
                 rank = 0
-        if rank == 0:
-            out_dir = os.path.join(config.output_path or "./telemetry",
-                                   config.job_name)
+        self.rank = int(rank)
+        dcfg = _coerce_distributed(getattr(config, "distributed", None))
+        out_dir = os.path.join(config.output_path or "./telemetry",
+                               config.job_name)
+        if dcfg["enabled"]:
+            shard_dir = dcfg["shard_dir"] or out_dir
+            self.sink = JsonlEventSink(
+                shard_dir, filename=f"events.rank{self.rank}.jsonl",
+                max_bytes=int(float(config.max_file_mb) * 1024 * 1024),
+                max_files=config.max_files)
+            self._stamp_rank = True
+            if self.rank == 0:
+                from deepspeed_tpu.monitor.aggregate import ClusterAggregator
+                self.cluster = ClusterAggregator(
+                    shard_dir,
+                    skew_threshold=dcfg["skew_threshold"],
+                    straggler_window=dcfg["straggler_window"],
+                    registry=self.registry)
+                self._start_exporter(getattr(config, "export", None))
+        elif self.rank == 0:
             self.sink = JsonlEventSink(
                 out_dir,
                 max_bytes=int(float(config.max_file_mb) * 1024 * 1024),
@@ -298,7 +346,12 @@ class Telemetry:
             return
         try:
             from deepspeed_tpu.monitor.export import MetricsExporter
-            self.exporter = MetricsExporter(self, host=host, port=port)
+            labels = {"rank": str(self.rank)} if self._stamp_rank else None
+            cluster_fn = (self.cluster.snapshot
+                          if self.cluster is not None else None)
+            self.exporter = MetricsExporter(self, host=host, port=port,
+                                            labels=labels,
+                                            cluster_fn=cluster_fn)
             self.exporter.start()
         except Exception as e:
             logger.warning(f"metrics exporter failed to start: {e}")
@@ -322,6 +375,10 @@ class Telemetry:
         if not self.enabled or self.sink is None:
             return
         event = {"ts": round(time.time(), 6), "kind": kind, "name": name}
+        if self._stamp_rank:
+            # distributed (sharded) mode: every record carries its origin
+            # rank so a merged stream keeps per-rank attribution
+            event["rank"] = self.rank
         event.update({k: v for k, v in fields.items() if v is not None})
         self.sink.emit(event)
 
@@ -379,12 +436,40 @@ class Telemetry:
 
     def comm(self, op_name, size_bytes, axis):
         """Per-op comm census (trace-time: a shape traces once, executes
-        many times — counts are per-trace like ``CommsLogger``)."""
+        many times — counts are per-trace like ``CommsLogger``).  Bare
+        bytes-only form; timed spans go through :meth:`collective`."""
+        self.collective(op_name, size_bytes, axis)
+
+    def collective(self, op_name, size_bytes, axis, dtype=None, dur_ms=None,
+                   world=None):
+        """One traced/timed collective: counters ``comm/{op}/calls|bytes``,
+        duration histogram ``comm/{op}_ms``, and a ``comm`` event carrying
+        payload dtype, axis/group, world size, and achieved bus bandwidth
+        against the analytic per-link peak (comm/topology_model.py).
+
+        Durations are host-observed around the verb — trace time inside
+        ``jit`` (the census convention), true wall time for host-level ops
+        (``barrier``) and for callers that time executed programs (the
+        comm benchmarks, the cpu_comm_census micro-bench)."""
         if not self.enabled:
             return
         self.registry.counter(f"comm/{op_name}/calls").inc()
         self.registry.counter(f"comm/{op_name}/bytes").inc(int(size_bytes))
-        self.emit("comm", op_name, bytes=int(size_bytes), axis=str(axis))
+        busbw = peak = None
+        if dur_ms is not None:
+            dur_ms = float(dur_ms)
+            self.registry.histogram(f"comm/{op_name}_ms").observe(dur_ms)
+            from deepspeed_tpu.comm.topology_model import bus_bandwidth
+            busbw, peak = bus_bandwidth(op_name, size_bytes, dur_ms, world)
+            if busbw is not None:
+                self.registry.gauge(f"comm/{op_name}/busbw_gbps").set(busbw)
+        self.emit("comm", op_name, bytes=int(size_bytes), axis=str(axis),
+                  dtype=str(dtype) if dtype is not None else None,
+                  dur_ms=round(dur_ms, 4) if dur_ms is not None else None,
+                  world=int(world) if world is not None else None,
+                  busbw_gbps=(round(busbw, 4) if busbw is not None
+                              else None),
+                  peak_gbps=peak)
 
     def close(self):
         if self.exporter is not None:
@@ -393,6 +478,8 @@ class Telemetry:
         if self.sink is not None:
             self.sink.close()
             self.sink = None
+        self.cluster = None
+        self._stamp_rank = False
         self.enabled = False
 
 
@@ -418,11 +505,18 @@ class StepStallWatchdog:
     """
 
     def __init__(self, telemetry: Telemetry, stall_factor=10.0,
-                 poll_interval_secs=1.0, min_stall_secs=1.0, window=64):
+                 poll_interval_secs=1.0, min_stall_secs=1.0, window=64,
+                 cluster=None, cluster_poll_secs=30.0):
         self.telemetry = telemetry
         self.stall_factor = float(stall_factor)
         self.poll_interval_secs = float(poll_interval_secs)
         self.min_stall_secs = float(min_stall_secs)
+        # distributed mode: a ClusterAggregator over the rank shards —
+        # the watchdog doubles as the cross-rank straggler sentinel
+        self.cluster = cluster
+        self.cluster_poll_secs = float(cluster_poll_secs)
+        self._last_cluster_poll = None
+        self._cluster_reported = None
         self._lock = threading.Lock()
         self._durations = deque(maxlen=window)
         self._last_beat = None
@@ -494,10 +588,40 @@ class StepStallWatchdog:
             median_step_s=round(median, 6), threshold_s=round(threshold, 3))
         return True
 
+    def check_cluster(self, now=None):
+        """Cross-rank straggler sweep (distributed mode only): refresh the
+        shard aggregator on its own slower cadence and emit ONE meta event
+        per newly flagged straggler rank.  Returns the flagged rank (int)
+        or None.  File I/O bounded: the aggregator tails shards and this
+        runs every ``cluster_poll_secs``, not every watchdog poll."""
+        if self.cluster is None:
+            return None
+        now = now if now is not None else time.monotonic()
+        if self._last_cluster_poll is not None and \
+                now - self._last_cluster_poll < self.cluster_poll_secs:
+            return self._cluster_reported
+        self._last_cluster_poll = now
+        snap = self.cluster.snapshot()
+        verdict = snap.get("straggler") or {}
+        rank = verdict.get("rank")
+        if rank is not None and rank != self._cluster_reported:
+            logger.warning(
+                f"cluster straggler: rank {rank} "
+                f"({verdict.get('metric')}) beyond "
+                f"{verdict.get('threshold')}x median")
+            self.telemetry.emit(
+                "meta", "cluster/straggler",
+                attrs={"rank": int(rank),
+                       "metric": str(verdict.get("metric")),
+                       "threshold": verdict.get("threshold")})
+        self._cluster_reported = rank
+        return rank
+
     def _run(self):
         while not self._stop.wait(self.poll_interval_secs):
             try:
                 self.check()
+                self.check_cluster()
             except Exception as e:  # never kill the host process
                 logger.warning(f"stall watchdog check failed: {e}")
 

@@ -86,6 +86,30 @@ class TelemetryExportConfig(DeepSpeedConfigModel):
             raise ValueError("telemetry.export.port must be in [0, 65535]")
 
 
+class TelemetryDistributedConfig(DeepSpeedConfigModel):
+    """``"telemetry.distributed"`` block: per-rank telemetry shards and
+    cross-rank aggregation (``monitor/aggregate.py``).  Enabled, EVERY
+    process writes its own ``events.rank{N}.jsonl`` shard (rank stamped
+    into each record) and rank 0 aggregates the shards into step-time
+    skew, per-collective arrival spread, comm bandwidth, and a straggler
+    verdict — served on the exporter's ``/cluster`` endpoint and folded
+    into the stall watchdog and ``health()``."""
+    enabled = False
+    shard_dir = ""                  # "" -> <output_path>/<job_name>
+    skew_threshold = 2.0            # straggler = beyond this multiple of
+    #                                 the cross-rank median step time
+    straggler_window = 32           # aligned steps in the verdict window
+
+    def _validate(self):
+        if float(self.skew_threshold) <= 1.0:
+            raise ValueError(
+                "telemetry.distributed.skew_threshold must be > 1.0 "
+                "(a multiple of the median; <= 1 flags healthy ranks)")
+        if int(self.straggler_window) < 1:
+            raise ValueError(
+                "telemetry.distributed.straggler_window must be >= 1")
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``"telemetry"`` block: the unified JSONL event stream
     (``monitor/telemetry.py``) plus the step-stall watchdog and the
@@ -101,10 +125,14 @@ class TelemetryConfig(DeepSpeedConfigModel):
     stall_min_secs = 1.0            # floor on the stall threshold
     stall_poll_secs = 1.0           # watchdog poll interval
     export = {}                     # TelemetryExportConfig sub-block
+    distributed = {}                # TelemetryDistributedConfig sub-block
 
     def _validate(self):
         if not isinstance(self.export, TelemetryExportConfig):
             self.export = TelemetryExportConfig(self.export or {})
+        if not isinstance(self.distributed, TelemetryDistributedConfig):
+            self.distributed = TelemetryDistributedConfig(
+                self.distributed or {})
 
 
 class AsyncPipelineConfig(DeepSpeedConfigModel):
@@ -172,6 +200,10 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     top_modules = 1
     detailed = True
     output_file = None
+    # per-device peak TFLOP/s for the live train/mfu gauge; 0 -> look up
+    # the chip table (comm/topology_model.py) from the device kind.  The
+    # gauge emits only when a peak is known (set this on CPU/test runs).
+    peak_tflops = 0.0
 
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):

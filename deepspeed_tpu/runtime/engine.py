@@ -306,11 +306,18 @@ class DeepSpeedEngine:
                 use_thread=self._async_enabled and ap.drain_thread)
         self._watchdog = None
         if self._tel_enabled and tc.stall_watchdog:
+            # distributed telemetry: the watchdog also runs the cross-rank
+            # straggler sweep over the shard aggregator (rank 0 owns one)
             self._watchdog = StepStallWatchdog(
                 self.telemetry, stall_factor=tc.stall_factor,
                 poll_interval_secs=tc.stall_poll_secs,
-                min_stall_secs=tc.stall_min_secs).start()
+                min_stall_secs=tc.stall_min_secs,
+                cluster=self.telemetry.cluster).start()
         self._last_batch_tokens = None
+        # live MFU: analytic per-step model flops (set once the flops
+        # profiler has run) / measured step time / device-peak ceiling
+        self._analytic_step_flops = None
+        self._mfu_peak_flops = None
         # fault-tolerance layer (config "resilience", runtime/resilience.py):
         # durable checkpoint transactions + retry policy are always wired
         # (rc.enabled gates the durable protocol); preemption handler and
@@ -738,11 +745,37 @@ class DeepSpeedEngine:
             rng=state.rng)
         return new_state, grad_norm
 
+    def _census_grad_reduce(self, grads):
+        """Trace-time comm census for the ZeRO gradient reduction.
+
+        The engine never calls a ``dist.*`` verb for grad sync — the
+        grad-spec constraint makes the XLA partitioner insert the
+        cross-device reduction — so without this record the single
+        largest communicator in training is invisible to the comm plane
+        (ROADMAP item 3's bytes-saved gauges hook in exactly here).
+        Payload bytes are dtype-TRUE: ``size * itemsize`` at the grad
+        tree's actual dtypes (works on tracers — aval shape/dtype), never
+        an element count.  Stage >= 2 shards the reduction
+        (reduce-scatter semantics); stages 0/1 land replicated grads
+        (all-reduce).  Runs at trace time like every comm census."""
+        if not self._tel_enabled:
+            return
+        world = groups.get_data_parallel_world_size()
+        if world <= 1:
+            return
+        leaves = jax.tree_util.tree_leaves(grads)
+        nbytes = sum(int(g.size) * np.dtype(g.dtype).itemsize for g in leaves)
+        op = "reduce_scatter" if self.zero_stage >= 2 else "all_reduce"
+        dist.comms_logger.append(op, nbytes, "fsdp",
+                                 dtype=str(leaves[0].dtype) if leaves else None,
+                                 world=world)
+
     def _finish_step(self, state: TrainState, loss, grads, rng):
         """Shared train-step tail: grad placement constraint, overflow
         check, optimizer update, metrics.  Used by both the dense and the
         pipeline engines so their semantics cannot diverge."""
         grads = constrain(grads, self.plan.grad_specs(state.params), self.mesh)
+        self._census_grad_reduce(grads)
         fp16 = self._config.fp16_enabled
         overflow = has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
         new_state, grad_norm = self._apply_update(
@@ -820,6 +853,7 @@ class DeepSpeedEngine:
                     qstep=moq_anneal_step(state))
                 grads = constrain(grads, self.plan.grad_specs(state.params),
                                   self.mesh)
+                self._census_grad_reduce(grads)
                 overflow = (has_inf_or_nan(grads) if fp16
                             else jnp.asarray(False))
                 grad_norm = _global_norm_f32(grads)
@@ -907,6 +941,7 @@ class DeepSpeedEngine:
                     qstep=moq_anneal_step(state))
                 grads = constrain(grads, self.plan.grad_specs(state.params),
                                   self.mesh)
+                self._census_grad_reduce(grads)
                 overflow = (has_inf_or_nan(grads)
                             if self._config.fp16_enabled else jnp.asarray(False))
                 return loss, grads, overflow, rng
@@ -1348,6 +1383,14 @@ class DeepSpeedEngine:
             if self._last_batch_tokens:
                 tel.gauge("engine/tokens_per_sec",
                           self._last_batch_tokens / step_secs, step=step)
+            if self._analytic_step_flops:
+                flops_per_sec = self._analytic_step_flops / step_secs
+                tel.gauge("train/model_flops_per_sec", flops_per_sec,
+                          step=step)
+                if self._mfu_peak_flops:
+                    tel.gauge("train/mfu",
+                              flops_per_sec / self._mfu_peak_flops,
+                              step=step)
         if self._config.telemetry_config.hbm_gauges:
             self._emit_hbm_gauges(step)
 
@@ -1430,6 +1473,21 @@ class DeepSpeedEngine:
                                      output_file=fpc.output_file)
         prof.end_profile()
         self.flops_profiler = prof
+        # wire the analytic count into live telemetry: a train step is
+        # fwd+bwd (~3x forward flops) over `gas` microbatches; every step
+        # from here on emits train/model_flops_per_sec, and train/mfu when
+        # a per-device peak is known (config peak_tflops, else chip table)
+        if prof.total_flops:
+            self._analytic_step_flops = 3.0 * float(prof.total_flops) * gas
+            peak = (float(fpc.peak_tflops) * 1e12
+                    if float(getattr(fpc, "peak_tflops", 0.0) or 0.0) > 0
+                    else None)
+            if peak is None:
+                from deepspeed_tpu.comm.topology_model import \
+                    device_peak_flops
+                peak = device_peak_flops()
+            self._mfu_peak_flops = (peak * jax.device_count()
+                                    if peak else None)
 
     def global_samples(self):
         return self.global_steps * self._config.train_batch_size

@@ -562,6 +562,13 @@ class ParamStreamRunner:
             lambda g: (g.astype(jnp.float32) / scale).astype(gdt), tree)
         if jax.process_count() == 1:
             return tree
+        # comm census for the implicit reduction: XLA inserts it at the
+        # constraint below, so no dist.* verb ever sees these bytes.
+        # Dtype-true payload at gdt (the tree was just cast to it).
+        from deepspeed_tpu.comm.comm import comms_logger
+        comms_logger.append("all_reduce", _tree_bytes(tree), "ici",
+                            dtype=str(jnp.dtype(gdt)),
+                            world=jax.process_count())
         repl = NamedSharding(self.mesh, P())
         return jax.tree_util.tree_map(
             lambda g: jax.lax.with_sharding_constraint(g, repl), tree)

@@ -10,16 +10,26 @@ against the telemetry module so the two cannot drift silently.
 Usage:
     python scripts/check_telemetry_schema.py <events.jsonl> [more.jsonl ...]
     python scripts/check_telemetry_schema.py --prom <metrics.txt> [...]
+    python scripts/check_telemetry_schema.py --shards <shard_dir> [...]
+    python scripts/check_telemetry_schema.py --cluster <payload.json> [...]
 
 The ``--prom`` mode validates a Prometheus text exposition page (the
 ``monitor/export.py`` /metrics surface) instead: metric-name grammar,
 known TYPE declarations, numeric sample values.
 
+The ``--shards`` mode validates a distributed-telemetry shard directory
+(``events.rank{N}.jsonl`` per process, rotated generations included):
+every event on every shard must validate AND carry a ``rank`` stamp
+matching its filename.  The ``--cluster`` mode validates a saved
+``/cluster`` endpoint payload (``monitor/aggregate.py`` snapshot shape).
+
 Exit code 0 when every event on every file validates; 1 otherwise (each
 offending line is reported with its file:lineno).
 """
 
+import glob
 import json
+import os
 import re
 import sys
 
@@ -41,10 +51,16 @@ SCHEMA = {
         "required": {"ts": _NUM, "kind": str, "name": str, "value": _NUM},
         "optional": {"step": int},
     },
+    # collective-tracing events (comm/comm.py _traced spans + analytic
+    # censuses): payload bytes are dtype-TRUE; timed records add the
+    # host-observed duration, participant count, and achieved bus
+    # bandwidth against the analytic per-link peak
+    # (comm/topology_model.py).  ``name`` is validated against COMM_OPS.
     "comm": {
         "required": {"ts": _NUM, "kind": str, "name": str, "bytes": int,
                      "axis": str},
-        "optional": {},
+        "optional": {"dtype": str, "dur_ms": _NUM, "world": int,
+                     "busbw_gbps": _NUM, "peak_gbps": _NUM},
     },
     "heartbeat": {
         "required": {"ts": _NUM, "kind": str, "name": str, "step": int},
@@ -110,6 +126,32 @@ SERVE_EVENTS = (
     "serve/request/deadline", "serve/request/evict",
 )
 
+# Distributed (sharded) mode stamps every record with its origin rank so
+# merged streams keep attribution; single-rank streams omit it.
+for _spec in SCHEMA.values():
+    _spec["optional"]["rank"] = int
+
+# FROZEN vocabulary of comm-kind event names — must stay byte-identical
+# to ``deepspeed_tpu.comm.comm.COMM_OPS`` (the tier-1 test diffs the
+# two).  Covers every traced dist.* verb plus the analytic censuses for
+# XLA-inserted reductions (engine grad reduce, param-stream replication).
+COMM_OPS = (
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "broadcast", "scatter", "ppermute", "barrier",
+)
+
+# FROZEN vocabulary of the cluster aggregation gauges — must stay
+# byte-identical to ``deepspeed_tpu.monitor.aggregate.CLUSTER_GAUGES``
+# (the tier-1 test diffs the two).
+CLUSTER_GAUGES = (
+    "cluster/ranks",
+    "cluster/missing_ranks",
+    "cluster/step_skew_ms",
+    "cluster/step_skew_rel",
+    "cluster/collective_spread_ms",
+    "cluster/straggler_rank",
+)
+
 EVENT_KINDS = tuple(SCHEMA)
 
 
@@ -144,6 +186,13 @@ def validate_event(event):
     if kind == "serve" and isinstance(event.get("name"), str) and \
             event["name"] not in SERVE_EVENTS:
         problems.append(f"serve: unknown event name {event['name']!r}")
+    if kind == "comm" and isinstance(event.get("name"), str) and \
+            event["name"] not in COMM_OPS:
+        problems.append(f"comm: unknown collective {event['name']!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str) and \
+            event["name"].startswith("cluster/") and \
+            event["name"] not in CLUSTER_GAUGES:
+        problems.append(f"gauge: unknown cluster gauge {event['name']!r}")
     return problems
 
 
@@ -166,6 +215,157 @@ def validate_stream(lines):
 def validate_file(path):
     with open(path) as f:
         return list(validate_stream(f))
+
+
+# ----------------------------------------------------------------------
+# distributed-telemetry shard directories (monitor/aggregate.py)
+# ----------------------------------------------------------------------
+_SHARD_RE = re.compile(r"events\.rank(\d+)\.jsonl(\.\d+)?$")
+
+
+def validate_shard_dir(shard_dir):
+    """Validate every per-rank shard under ``shard_dir``.  Beyond the
+    per-event schema, each record's ``rank`` stamp must match the rank in
+    its shard's filename — a mis-stamped shard would silently corrupt the
+    cross-rank alignment.  Returns ``(problems, shards_seen)``."""
+    problems = []
+    paths = sorted(glob.glob(os.path.join(shard_dir, "events.rank*.jsonl")) +
+                   glob.glob(os.path.join(shard_dir, "events.rank*.jsonl.*")))
+    shards = 0
+    for path in paths:
+        m = _SHARD_RE.search(path)
+        if not m:
+            continue
+        shards += 1
+        want_rank = int(m.group(1))
+        with open(path) as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines, start=1):
+            stripped = line.strip()
+            if not stripped:
+                continue
+            try:
+                event = json.loads(stripped)
+            except ValueError:
+                # torn tail of a live writer: tolerated on the final
+                # line (aggregation skips and counts it), fatal
+                # anywhere else
+                if i != len(lines):
+                    problems.append(
+                        f"{path}:{i}: unparseable non-final line")
+                continue
+            for p in validate_event(event):
+                problems.append(f"{path}:{i}: {p}")
+            got = event.get("rank") if isinstance(event, dict) else None
+            if got != want_rank:
+                problems.append(
+                    f"{path}:{i}: rank stamp {got!r} != shard "
+                    f"rank {want_rank}")
+    if not shards:
+        problems.append(f"{shard_dir}: no events.rank*.jsonl shards found")
+    return problems, shards
+
+
+# ----------------------------------------------------------------------
+# /cluster endpoint payload (monitor/aggregate.py aggregate_cluster)
+# ----------------------------------------------------------------------
+def _check(problems, cond, msg):
+    if not cond:
+        problems.append(msg)
+
+
+def validate_cluster_payload(obj):
+    """Validate a decoded ``/cluster`` snapshot (the aggregate_cluster
+    dict).  Returns a list of problem strings (empty = valid)."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"payload is {type(obj).__name__}, not an object"]
+    for field, types in (("ts", _NUM), ("shard_dir", str), ("ranks", list),
+                         ("missing_ranks", list), ("torn_lines", int),
+                         ("steps", dict), ("step_skew", dict),
+                         ("collectives", dict), ("straggler", dict)):
+        if field not in obj:
+            problems.append(f"missing required field {field!r}")
+        elif not isinstance(obj[field], types):
+            problems.append(f"field {field!r} has type "
+                            f"{type(obj[field]).__name__}")
+    if problems:
+        return problems
+    _check(problems, all(isinstance(r, int) for r in obj["ranks"]),
+           "ranks: non-int rank")
+    _check(problems, all(isinstance(r, int) for r in obj["missing_ranks"]),
+           "missing_ranks: non-int rank")
+    steps = obj["steps"]
+    for f in ("count", "aligned"):
+        _check(problems, isinstance(steps.get(f), int),
+               f"steps.{f}: not an int")
+    _check(problems,
+           steps.get("median_step_ms") is None or
+           isinstance(steps["median_step_ms"], _NUM),
+           "steps.median_step_ms: not numeric or null")
+    skew = obj["step_skew"]
+    _check(problems, isinstance(skew.get("aligned"), int),
+           "step_skew.aligned: not an int")
+    for f in ("max_spread_ms", "p50_spread_ms", "max_rel"):
+        _check(problems,
+               skew.get(f) is None or isinstance(skew[f], _NUM),
+               f"step_skew.{f}: not numeric or null")
+    for op, row in obj["collectives"].items():
+        if op not in COMM_OPS:
+            problems.append(f"collectives: unknown collective {op!r}")
+            continue
+        if not isinstance(row, dict):
+            problems.append(f"collectives.{op}: not an object")
+            continue
+        for f in ("calls", "bytes", "timed_calls", "timed_bytes"):
+            _check(problems, isinstance(row.get(f), int),
+                   f"collectives.{op}.{f}: not an int")
+        _check(problems, isinstance(row.get("dur_ms"), _NUM),
+               f"collectives.{op}.dur_ms: not numeric")
+        for f in ("achieved_gbps", "busbw_gbps", "peak_gbps"):
+            _check(problems,
+                   row.get(f) is None or isinstance(row[f], _NUM),
+                   f"collectives.{op}.{f}: not numeric or null")
+        spread = row.get("arrival_spread_ms")
+        _check(problems,
+               spread is None or (
+                   isinstance(spread, dict) and
+                   isinstance(spread.get("p50"), _NUM) and
+                   isinstance(spread.get("max"), _NUM)),
+               f"collectives.{op}.arrival_spread_ms: malformed")
+    strag = obj["straggler"]
+    _check(problems,
+           strag.get("rank") is None or isinstance(strag["rank"], int),
+           "straggler.rank: not an int or null")
+    _check(problems,
+           strag.get("metric") in (None, "step_time", "collective_entry"),
+           f"straggler.metric: unknown metric {strag.get('metric')!r}")
+    _check(problems, isinstance(strag.get("threshold"), _NUM),
+           "straggler.threshold: not numeric")
+    _check(problems, isinstance(strag.get("window"), int),
+           "straggler.window: not an int")
+    per_rank = strag.get("per_rank")
+    if not isinstance(per_rank, dict):
+        problems.append("straggler.per_rank: not an object")
+    else:
+        for r, row in per_rank.items():
+            ok = (isinstance(row, dict) and
+                  isinstance(row.get("steps"), int) and
+                  (row.get("median_step_ms") is None or
+                   isinstance(row["median_step_ms"], _NUM)) and
+                  isinstance(row.get("mean_entry_delay_ms"), _NUM))
+            _check(problems, ok,
+                   f"straggler.per_rank[{r!r}]: malformed row")
+    return problems
+
+
+def validate_cluster_file(path):
+    with open(path) as f:
+        try:
+            obj = json.load(f)
+        except ValueError as e:
+            return [f"not valid JSON: {e}"]
+    return validate_cluster_payload(obj)
 
 
 # ----------------------------------------------------------------------
@@ -247,6 +447,30 @@ def main(argv=None):
             print(f"FAIL: {bad} problem(s)")
             return 1
         print("OK: exposition validated")
+        return 0
+    if argv[0] == "--shards":
+        bad = shards = 0
+        for shard_dir in argv[1:]:
+            problems, n = validate_shard_dir(shard_dir)
+            shards += n
+            for p in problems:
+                print(p)
+                bad += 1
+        if bad:
+            print(f"FAIL: {bad} problem(s) across {shards} shard(s)")
+            return 1
+        print(f"OK: {shards} shard(s) validated")
+        return 0
+    if argv[0] == "--cluster":
+        bad = 0
+        for path in argv[1:]:
+            for p in validate_cluster_file(path):
+                print(f"{path}: {p}")
+                bad += 1
+        if bad:
+            print(f"FAIL: {bad} problem(s)")
+            return 1
+        print("OK: cluster payload validated")
         return 0
     bad = 0
     total = 0

@@ -2,12 +2,17 @@
 """Aggregate a unified-telemetry JSONL stream into human-readable tables.
 
 Reads the ``events.jsonl`` (plus rotated ``events.jsonl.N`` generations,
-oldest first) written by ``deepspeed_tpu/monitor/telemetry.py`` and prints:
+oldest first) written by ``deepspeed_tpu/monitor/telemetry.py`` — or, for
+a distributed run, every per-rank shard ``events.rank{N}.jsonl`` in the
+directory — and prints:
 
 * per-span latency percentiles (count / mean / p50 / p90 / p99 / max),
-* comm volume per op (traced calls, total bytes, axes),
-* gauge last/peak table (HBM bytes-in-use, tokens/s, loss, ...),
-* heartbeat summary (steps seen, median step time) and any stall events.
+* comm census per op: traced calls, total bytes, summed duration, and
+  achieved bandwidth (timed bytes / timed duration) for timed records,
+* gauge last/peak table (HBM bytes-in-use, tokens/s, MFU, loss, ...),
+* heartbeat summary (steps seen, median step time) and any stall events,
+* with >= 2 rank shards: a per-rank cluster table (steps, median step
+  time) and the cross-rank step-time skew.
 
 Usage:
     python scripts/ds_telemetry_report.py <telemetry_dir_or_events.jsonl>
@@ -21,14 +26,8 @@ import os
 import sys
 
 
-def discover_files(target):
-    """events.jsonl + rotated generations for a path that may be a dir, the
-    live file, or a glob; ordered oldest -> newest so replay is in time
-    order."""
-    if os.path.isdir(target):
-        live = os.path.join(target, "events.jsonl")
-    else:
-        live = target
+def _with_rotations(live):
+    """[oldest rotated .N .. live] for one stream file."""
     rotated = sorted(
         glob.glob(live + ".*"),
         key=lambda p: int(p.rsplit(".", 1)[1])
@@ -38,6 +37,27 @@ def discover_files(target):
     if os.path.exists(live):
         files.append(live)
     return files
+
+
+def discover_files(target):
+    """Stream files for a path that may be a dir, the live file, or a
+    glob; ordered oldest -> newest per stream so replay is in time order.
+    A directory holding per-rank shards (``events.rank{N}.jsonl``,
+    distributed telemetry) yields every shard; records carry their rank
+    stamp so the merged replay keeps attribution."""
+    if os.path.isdir(target):
+        shards = sorted(
+            p for p in glob.glob(os.path.join(target, "events.rank*.jsonl"))
+            if p.rsplit("rank", 1)[1].split(".")[0].isdigit())
+        if shards:
+            files = []
+            for live in shards:
+                files.extend(_with_rotations(live))
+            return files
+        live = os.path.join(target, "events.jsonl")
+    else:
+        live = target
+    return _with_rotations(live)
 
 
 def load_events(files):
@@ -63,6 +83,7 @@ def aggregate(events):
     comms = {}       # op -> {calls, bytes, axes}
     gauges = {}      # name -> {last, peak, n}
     heartbeats = []  # step_ms values
+    rank_steps = {}  # rank -> {step: step_ms} (distributed shards)
     steps = set()
     stalls = []
     metas = []
@@ -75,10 +96,18 @@ def aggregate(events):
             spans.setdefault(ev["name"], []).append(float(ev["dur_ms"]))
         elif kind == "comm":
             rec = comms.setdefault(ev["name"],
-                                   {"calls": 0, "bytes": 0, "axes": set()})
+                                   {"calls": 0, "bytes": 0, "axes": set(),
+                                    "dur_ms": 0.0, "timed_calls": 0,
+                                    "timed_bytes": 0})
             rec["calls"] += 1
             rec["bytes"] += int(ev["bytes"])
             rec["axes"].add(ev.get("axis", "?"))
+            # timed records (comm tracing): achieved bandwidth is the
+            # summed timed payload over the summed duration
+            if ev.get("dur_ms"):
+                rec["dur_ms"] += float(ev["dur_ms"])
+                rec["timed_calls"] += 1
+                rec["timed_bytes"] += int(ev["bytes"])
         elif kind == "gauge":
             g = gauges.setdefault(ev["name"],
                                   {"last": None, "peak": None, "n": 0})
@@ -89,6 +118,12 @@ def aggregate(events):
             steps.add(ev.get("step"))
             if ev.get("step_ms") is not None:
                 heartbeats.append(float(ev["step_ms"]))
+            # distributed shards stamp each record; single-rank -> rank 0
+            rs = rank_steps.setdefault(int(ev.get("rank", 0)), {})
+            if ev.get("step") is not None:
+                rs[int(ev["step"])] = (ev.get("step_ms")
+                                       if ev.get("step_ms") is not None
+                                       else rs.get(int(ev["step"])))
         elif kind == "stall":
             stalls.append(ev)
         elif kind == "meta":
@@ -144,7 +179,8 @@ def aggregate(events):
                             trace[k] = attrs[k]
                     del open_reqs[rid]
     return {"spans": spans, "comms": comms, "gauges": gauges,
-            "heartbeats": heartbeats, "steps": steps, "stalls": stalls,
+            "heartbeats": heartbeats, "rank_steps": rank_steps,
+            "steps": steps, "stalls": stalls,
             "metas": metas, "serves": serves, "requests": requests}
 
 
@@ -161,10 +197,16 @@ def summarize(agg):
             "p99_ms": round(_pct(vals, 99), 3),
             "max_ms": round(vals[-1], 3),
         }
-    comm_rows = {
-        op: {"calls": rec["calls"], "bytes": rec["bytes"],
-             "axes": sorted(rec["axes"])}
-        for op, rec in sorted(agg["comms"].items())}
+    comm_rows = {}
+    for op, rec in sorted(agg["comms"].items()):
+        row = {"calls": rec["calls"], "bytes": rec["bytes"],
+               "axes": sorted(rec["axes"]),
+               "dur_ms": round(rec.get("dur_ms", 0.0), 3),
+               "timed_calls": rec.get("timed_calls", 0)}
+        dur, tb = rec.get("dur_ms", 0.0), rec.get("timed_bytes", 0)
+        row["achieved_gbps"] = (round(tb / (dur / 1e3) / 1e9, 4)
+                                if dur > 0 and tb else None)
+        comm_rows[op] = row
     gauge_rows = {
         name: {"last": g["last"], "peak": g["peak"], "samples": g["n"]}
         for name, g in sorted(agg["gauges"].items())}
@@ -177,6 +219,7 @@ def summarize(agg):
         for name, rec in sorted(agg.get("serves", {}).items())}
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
+            "cluster": _cluster_summary(agg),
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
             "serving_attention": _serving_attention_summary(agg),
@@ -184,6 +227,50 @@ def summarize(agg):
             "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _cluster_summary(agg):
+    """Cross-rank digest from the rank stamps on heartbeat records: one
+    row per rank (steps seen, median step time) plus step-time skew over
+    the aligned steps (step numbers every rank reported).  None for
+    single-rank streams — the table only means something when >= 2 shards
+    were merged."""
+    rank_steps = agg.get("rank_steps") or {}
+    if len(rank_steps) < 2:
+        return None
+    ranks = sorted(rank_steps)
+    per_rank = {}
+    for r in ranks:
+        ms = sorted(float(v) for v in rank_steps[r].values()
+                    if v is not None)
+        per_rank[str(r)] = {
+            "steps": len(rank_steps[r]),
+            "median_step_ms": round(_pct(ms, 50), 3) if ms else None,
+        }
+    aligned = sorted(set.intersection(
+        *(set(s) for s in rank_steps.values())))
+    spreads = []
+    for step in aligned:
+        ms = [float(rank_steps[r][step]) for r in ranks
+              if rank_steps[r].get(step) is not None]
+        if len(ms) >= 2:
+            spreads.append(max(ms) - min(ms))
+    spreads.sort()
+    medians = sorted(v["median_step_ms"] for v in per_rank.values()
+                     if v["median_step_ms"] is not None)
+    return {
+        "ranks": len(ranks),
+        "aligned_steps": len(aligned),
+        "per_rank": per_rank,
+        "step_skew_ms": {
+            "p50": round(_pct(spreads, 50), 3) if spreads else None,
+            "max": round(spreads[-1], 3) if spreads else None,
+        },
+        # the slowest rank relative to the median-of-medians: the same
+        # ratio the live aggregator's straggler verdict thresholds on
+        "worst_rel": (round(medians[-1] / _pct(medians, 50), 4)
+                      if medians and _pct(medians, 50) else None),
+    }
 
 
 # how many individual request rows the latency table prints (slowest by
@@ -338,9 +425,13 @@ def print_tables(summary, out=sys.stdout):
         w("\n")
     if summary["comms"]:
         w("== comm census (traced calls) ==\n")
-        w(f"{'op':<24}{'calls':>7}{'bytes':>14}  axes\n")
+        w(f"{'op':<24}{'calls':>7}{'bytes':>14}{'dur_ms':>12}"
+          f"{'GB/s':>9}  axes\n")
         for op, r in summary["comms"].items():
-            w(f"{op:<24}{r['calls']:>7}{_fmt_bytes(r['bytes']):>14}  "
+            bw = r.get("achieved_gbps")
+            w(f"{op:<24}{r['calls']:>7}{_fmt_bytes(r['bytes']):>14}"
+              f"{r.get('dur_ms', 0.0):>12}"
+              f"{bw if bw is not None else '-':>9}  "
               f"{','.join(r['axes'])}\n")
         w("\n")
     if summary["gauges"]:
@@ -432,6 +523,21 @@ def print_tables(summary, out=sys.stdout):
                   f"{t.get('ttft_ms', '-'):>9}{t.get('tpot_ms', '-'):>9}"
                   f"{t.get('e2e_ms', '-'):>10}  {t.get('slo', '-')}\n")
         w("\n")
+    cl = summary.get("cluster")
+    if cl:
+        w(f"== cluster ({cl['ranks']} ranks, "
+          f"{cl['aligned_steps']} aligned steps) ==\n")
+        w(f"{'rank':<6}{'steps':>7}{'median step ms':>16}\n")
+        for r, row in sorted(cl["per_rank"].items(), key=lambda kv:
+                             int(kv[0])):
+            med = row["median_step_ms"]
+            w(f"{r:<6}{row['steps']:>7}"
+              f"{med if med is not None else '-':>16}\n")
+        skew = cl["step_skew_ms"]
+        w(f"step skew: p50 {skew['p50']} ms  max {skew['max']} ms")
+        if cl["worst_rel"] is not None:
+            w(f"  |  slowest rank vs median: {cl['worst_rel']:.2f}x")
+        w("\n\n")
     hb = summary["heartbeat"]
     w(f"== heartbeat ==\nsteps: {hb['steps']}  "
       f"median step: {hb['median_step_ms']} ms\n\n")

@@ -42,6 +42,36 @@ def test_serve_event_names_in_lockstep(checker):
     assert checker.SERVE_EVENTS == SERVE_EVENTS
 
 
+def test_comm_ops_in_lockstep(checker):
+    """The frozen collective-name vocabulary must stay byte-identical
+    between the engine side (comm/comm.py) and the checker script."""
+    from deepspeed_tpu.comm.comm import COMM_OPS
+    assert checker.COMM_OPS == COMM_OPS
+
+
+def test_cluster_gauges_in_lockstep(checker):
+    """The frozen cluster/* gauge vocabulary must stay byte-identical
+    between the aggregator (monitor/aggregate.py) and the checker."""
+    from deepspeed_tpu.monitor.aggregate import CLUSTER_GAUGES
+    assert checker.CLUSTER_GAUGES == CLUSTER_GAUGES
+
+
+def test_rejects_unknown_comm_and_cluster_names(checker):
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "comm", "name": "gossip", "bytes": 4,
+         "axis": "dp"})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "comm", "name": "all_gather", "bytes": 4,
+         "axis": "dp", "dtype": "float32", "dur_ms": 1.5, "world": 4,
+         "busbw_gbps": 0.75, "peak_gbps": 100.0, "rank": 2})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "cluster/bogus", "value": 1.0,
+         "peak": 1.0})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "cluster/step_skew_ms",
+         "value": 1.0, "peak": 1.0, "rank": 0})
+
+
 def test_rejects_unknown_serve_name(checker):
     assert checker.validate_event(
         {"ts": 1.0, "kind": "serve", "name": "serve/not_a_thing"})
@@ -75,6 +105,9 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.gauge("hbm/bytes_in_use", 123456.0, step=1)
     tel.gauge("engine/loss", 0.5)
     tel.comm("all_reduce", 1 << 20, "dp")
+    # the fully-annotated collective-tracing record (comm tracing)
+    tel.collective("reduce_scatter", 1 << 20, "fsdp", dtype="bfloat16",
+                   dur_ms=2.5, world=4)
     tel.emit("meta", "engine/init", attrs={"mesh": {"dp": 8}})
     tel.fault("fault/retry", attrs={"op": "ckpt_save[t1]", "attempt": 1,
                                     "max_retries": 3, "error": "OSError()",
@@ -211,3 +244,58 @@ def test_cli_exit_codes(checker, tmp_path, capsys):
     assert checker.main([str(good), str(bad)]) == 1
     out = capsys.readouterr().out
     assert "unknown kind" in out and "not valid JSON" in out
+
+
+def _shard_line(rank, **extra):
+    import json
+    ev = {"ts": 1.0, "kind": "meta", "name": "engine/init", "rank": rank}
+    ev.update(extra)
+    return json.dumps(ev) + "\n"
+
+
+def test_shards_cli(checker, tmp_path, capsys):
+    good = tmp_path / "good"
+    good.mkdir()
+    (good / "events.rank0.jsonl").write_text(_shard_line(0))
+    (good / "events.rank1.jsonl").write_text(_shard_line(1))
+    assert checker.main(["--shards", str(good)]) == 0
+    assert "2 shard(s)" in capsys.readouterr().out
+    # a torn FINAL line is tolerated (live writer), anywhere else fatal
+    (good / "events.rank1.jsonl").write_text(_shard_line(1) + '{"torn')
+    assert checker.main(["--shards", str(good)]) == 0
+    (good / "events.rank1.jsonl").write_text('{"torn\n' + _shard_line(1))
+    assert checker.main(["--shards", str(good)]) == 1
+    capsys.readouterr()
+    # a rank stamp disagreeing with the shard filename is corruption
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "events.rank0.jsonl").write_text(_shard_line(3))
+    assert checker.main(["--shards", str(bad)]) == 1
+    assert "rank stamp" in capsys.readouterr().out
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert checker.main(["--shards", str(empty)]) == 1
+
+
+def test_cluster_cli_and_payload(checker, tmp_path, capsys):
+    import json
+    from deepspeed_tpu.monitor.aggregate import aggregate_cluster
+    events = {r: [{"ts": 1.0 + s, "kind": "heartbeat", "name": "hb",
+                   "step": s, "step_ms": 10.0, "rank": r}
+                  for s in range(4)] for r in range(2)}
+    snap = aggregate_cluster(events)
+    assert checker.validate_cluster_payload(snap) == []
+    p = tmp_path / "cluster.json"
+    p.write_text(json.dumps(snap))
+    assert checker.main(["--cluster", str(p)]) == 0
+    # mutations the validator must catch
+    assert checker.validate_cluster_payload({"ts": 1.0})
+    broken = dict(snap)
+    broken["straggler"] = dict(snap["straggler"], metric="vibes")
+    assert checker.validate_cluster_payload(broken)
+    broken = dict(snap)
+    broken["collectives"] = {"gossip": {}}
+    assert checker.validate_cluster_payload(broken)
+    p.write_text("not json")
+    assert checker.main(["--cluster", str(p)]) == 1
+    capsys.readouterr()
