@@ -940,6 +940,129 @@ def _worker_comm_census(spec):
     print(json.dumps(_comm_census_bench(spec)))
 
 
+def _compile_churn_bench(spec=None):
+    """CPU-runnable profiling-plane micro-bench: a jitted kernel driven
+    through a deliberately shape-churned workload so every new shape is a
+    jit-cache miss.  Reports the observability plane's own numbers: the
+    CompileWatcher's miss census against the known churn count, the
+    recompile-storm verdict, schema-checker validation of the emitted
+    ``compile/*`` events, the mem/roofline gauge path (allocator stats
+    injected — CPU has none), and a live scrape of /metrics + /healthz.
+    The churn is synthetic by design — the trace -> verdict -> scrape
+    chain, not XLA compile speed, is what this bench measures."""
+    spec = spec or {}
+    import importlib.util
+    import tempfile
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    n_shapes = int(spec.get("shapes", 6))
+    repeat = int(spec.get("repeat", 3))
+    shapes = [(1, 8 * (i + 1)) for i in range(n_shapes)]
+    tmp = tempfile.mkdtemp(prefix="compile_churn_bench_")
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": tmp, "job_name": "compile_churn",
+         "export": {"enabled": True, "port": 0},
+         "profiling": {"enabled": True, "storm_threshold": 3,
+                       "storm_window_s": 60.0}}))
+    plane = tel.profiling
+
+    @jax.jit
+    def kernel(x):
+        return (x * 2.0 + 1.0).sum()
+
+    wrapped = plane.wrap(kernel, "bench/churn")
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        for shape in shapes:
+            wrapped(jnp.ones(shape, jnp.float32))
+    churn_wall_s = time.perf_counter() - t0
+    # hot-path tax: every fingerprint is now cached, so this pass prices
+    # the wrapper's per-call dict lookup
+    t0 = time.perf_counter()
+    for shape in shapes:
+        wrapped(jnp.ones(shape, jnp.float32))
+    hot_us = (time.perf_counter() - t0) / n_shapes * 1e6
+    snap = plane.compile_snapshot()
+
+    # mem attribution + roofline ride the same stream: CPU has no
+    # allocator stats, so inject a growing fake and pin the peaks
+    state = {"n": 0}
+
+    def fake_stats():
+        state["n"] += 1
+        return {"bytes_in_use": (1 << 20) + state["n"] * 4096,
+                "peak_bytes_in_use": (1 << 20) + state["n"] * 8192}
+
+    plane.hbm.stats_fn = fake_stats
+    with plane.track("serve_step"):
+        wrapped(jnp.ones(shapes[0], jnp.float32))
+    plane.peak_hbm_gbps = 819.0
+    plane.roofline("train_batch", 0.01, flops=1e9, bytes_moved=1e8,
+                   peak_flops=1e12, step=1)
+
+    host, port = tel.exporter.address
+    prom = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics", timeout=5).read().decode()
+    health = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/healthz", timeout=5).read())
+    tel.close()
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sp = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(repo, "scripts", "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(checker)
+    events_path = os.path.join(tmp, "compile_churn", "events.jsonl")
+    problems = checker.validate_file(events_path)
+    prom_problems = checker.validate_prom_exposition(prom)
+    misses = storms = mem_gauges = roofline_gauges = 0
+    with open(events_path) as f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("kind") == "compile":
+                if ev.get("name") == "compile/storm":
+                    storms += 1
+                else:
+                    misses += 1
+            elif ev.get("kind") == "gauge":
+                if ev.get("name", "").startswith("mem/"):
+                    mem_gauges += 1
+                elif ev.get("name", "").startswith("roofline/"):
+                    roofline_gauges += 1
+    return {
+        "recompiles": snap["total_misses"],
+        "expected_recompiles": n_shapes,
+        "storm_flagged": bool(snap["storm_active"]),
+        "storm_events": storms,
+        "miss_events": misses,
+        "mem_gauge_events": mem_gauges,
+        "roofline_gauge_events": roofline_gauges,
+        "events_ok": not problems,
+        "schema_problems": len(problems),
+        "exporter_scrape_ok": (not prom_problems and
+                               "ds_compile_misses" in prom),
+        "healthz_storm": bool(health.get("recompile_storm")),
+        "churn_wall_s": round(churn_wall_s, 4),
+        "hot_call_overhead_us": round(hot_us, 2),
+        "note": "synthetic shape churn: this bench proves the miss -> "
+                "event -> storm -> scrape chain, not XLA compile speed",
+    }
+
+
+def _worker_compile_churn(spec):
+    print(json.dumps(_compile_churn_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -1087,6 +1210,68 @@ def _attach_comm_census(out):
     return out
 
 
+def _attach_compile_churn(out):
+    """Attach the profiling-plane micro-bench under the stable key
+    ``cpu_compile_churn`` (CPU-runnable: shape-churned jit workload,
+    compile/* event validation, storm verdict, /metrics + /healthz
+    scrape).  Budget-gated; a failure is recorded in notes, never
+    fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "compile_churn", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_compile_churn"] = res
+    else:
+        out.setdefault("notes", {})["compile_churn"] = (err or "")[:200]
+    return out
+
+
+def _append_ledger(out):
+    """Append this run's numeric bench metrics to the perf-regression
+    ledger (``BENCH_LEDGER`` env override; default BENCH_LEDGER.jsonl
+    next to this file).  One row per (bench, metric) scalar — the frozen
+    row schema lives in scripts/check_telemetry_schema.py (--ledger) and
+    scripts/ds_perf_diff.py gates later runs against the medians.  Best
+    effort: a read-only checkout must not fail the bench."""
+    path = os.environ.get(
+        "BENCH_LEDGER",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_LEDGER.jsonl"))
+    ts = time.time()
+    run = f"run-{int(ts)}"
+    rows = []
+
+    def _rows_from(bench, rec):
+        for metric, value in rec.items():
+            if isinstance(value, bool) or not isinstance(value,
+                                                         (int, float)):
+                continue
+            rows.append({"ts": ts, "run": run, "bench": bench,
+                         "metric": metric, "value": value})
+
+    if isinstance(out.get("value"), (int, float)) and out.get("metric"):
+        rows.append({"ts": ts, "run": run, "bench": "train",
+                     "metric": str(out["metric"]),
+                     "value": float(out["value"]),
+                     "unit": str(out.get("unit", ""))})
+    for key, rec in out.items():
+        if key.startswith("cpu_") and isinstance(rec, dict):
+            _rows_from(key, rec)
+    if not rows:
+        return out
+    try:
+        with open(path, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        out["ledger"] = {"path": path, "run": run, "rows": len(rows)}
+    except OSError as e:
+        out.setdefault("notes", {})["ledger"] = str(e)[:200]
+    return out
+
+
 def main():
     errors = {}
 
@@ -1113,7 +1298,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))
+            print(json.dumps(_append_ledger(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -1201,7 +1386,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))
+        print(json.dumps(_append_ledger(_attach_compile_churn(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -1276,7 +1461,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))
+    print(json.dumps(_append_ledger(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))
 
 
 if __name__ == "__main__":
@@ -1309,6 +1494,8 @@ if __name__ == "__main__":
             _worker_serving_slo(spec)
         elif which == "comm_census":
             _worker_comm_census(spec)
+        elif which == "compile_churn":
+            _worker_compile_churn(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

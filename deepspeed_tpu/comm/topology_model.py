@@ -44,6 +44,16 @@ PEAK_TFLOPS = (
     ("v4", 275.0), ("v3", 61.5), ("v2", 22.5),
 )
 
+# per-chip HBM peak bandwidth (GB/s) — the denominator of the live
+# bandwidth roofline (monitor/profiling.py roofline/*/bandwidth_frac):
+# achieved bytes/s over a span divided by what the memory system could
+# have streamed
+HBM_PEAK_GBPS = (
+    ("v6e", 1640.0), ("v6 lite", 1640.0), ("v6", 1640.0),
+    ("v5p", 2765.0), ("v5e", 819.0), ("v5 lite", 819.0), ("v5", 2765.0),
+    ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
+)
+
 
 def _lookup(table, kind):
     k = (kind or "").lower()
@@ -85,6 +95,12 @@ def device_peak_flops(device_kind=None):
     """Per-chip bf16 dense peak in FLOP/s (not TFLOP/s); None off-TPU."""
     tf = _lookup(PEAK_TFLOPS, device_kind or _device_kind())
     return tf * 1e12 if tf is not None else None
+
+
+def hbm_peak_gbps(device_kind=None):
+    """Per-chip HBM peak bandwidth in GB/s; None off-TPU (the live
+    bandwidth roofline simply doesn't emit without a known peak)."""
+    return _lookup(HBM_PEAK_GBPS, device_kind or _device_kind())
 
 
 def bus_bandwidth(op_name, size_bytes, dur_ms, world, device_kind=None,

@@ -86,6 +86,11 @@ SERVE_EVENTS = (
     # ("serve/prefix_evict")
     "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
     "serve/prefix_evict",
+    # profiling plane (monitor/profiling.py): rising-edge record that the
+    # CompileWatcher flagged a recompile storm on the serving jit entry
+    # points (attrs: misses) — shape-bucket churn burning latency on
+    # compiles; health()["recompile_storm"] mirrors it live
+    "serve/compile_storm",
     # attention-backend record: emitted once at engine construction with
     # attrs attention_backend / impl / interpret, so a telemetry stream's
     # serve/step spans are attributable to the kernel path that ran

@@ -27,6 +27,7 @@ rest of the batch keeps serving; ``drain()`` quiesces the engine and
 request state, so recovered requests stay bit-identical.
 """
 
+import contextlib
 import functools
 import math
 import time
@@ -222,6 +223,12 @@ class ServingEngine:
 
         self._clock = clock if clock is not None else time.monotonic
         self._telemetry = telemetry
+        # profiling plane (monitor/profiling.py): route the serving jit
+        # entry points through the CompileWatcher — shape-bucket churn
+        # shows up as compile/* events, and a recompile storm flips
+        # health()["recompile_storm"].  Telemetry must be bound first.
+        self._storm_flagged = False
+        self._step_fn = self._wrap_compiled(self._step_fn, "serve/step_fn")
         self._admission = AdmissionController(self.serving)
         # per-request lifecycle traces on the SAME injectable clock as the
         # deadline machinery — always on (host dict ops), so the
@@ -249,6 +256,22 @@ class ServingEngine:
     def telemetry(self):
         return self._telemetry if self._telemetry is not None \
             else get_telemetry()
+
+    @property
+    def _profiling(self):
+        tel = self.telemetry
+        return getattr(tel, "profiling", None) if tel is not None else None
+
+    def _wrap_compiled(self, fn, site):
+        """Compile-tracing wrapper (no-op without the profiling plane)."""
+        prof = self._profiling
+        return prof.wrap(fn, site) if prof is not None else fn
+
+    def _prof_track(self, span):
+        """HBM attribution context for serve_step/prefill spans."""
+        prof = self._profiling
+        return prof.track(span) if prof is not None \
+            else contextlib.nullcontext()
 
     def _serve_event(self, name, **attrs):
         tel = self.telemetry
@@ -601,7 +624,9 @@ class ServingEngine:
                                  attrs={"backend": self.attention_backend,
                                         "phase": phase,
                                         "batch": int(ids.shape[0]),
-                                        "tokens": int(ids.shape[1])}):
+                                        "tokens": int(ids.shape[1])}), \
+                self._prof_track("prefill" if phase == "prefill"
+                                 else "serve_step"):
             if self.mesh is not None:
                 with self.mesh:
                     return self._step_fn(self.params, ids, self.caches,
@@ -625,7 +650,8 @@ class ServingEngine:
             def copy(caches, src, dst):
                 return jax.tree_util.tree_map(
                     lambda leaf: leaf.at[:, dst].set(leaf[:, src]), caches)
-            self._copy_page_fn = jax.jit(copy, donate_argnums=(0,))
+            self._copy_page_fn = self._wrap_compiled(
+                jax.jit(copy, donate_argnums=(0,)), "serve/copy_page")
         if self.mesh is not None:
             with self.mesh:
                 self.caches = self._copy_page_fn(
@@ -788,7 +814,9 @@ class ServingEngine:
         use_filters = any(r is not None and (r.top_k or r.top_p < 1.0)
                           for r in self.slots)
         if self._chunk_fns.get(use_filters) is None:
-            self._chunk_fns[use_filters] = self._build_chunk_fn(use_filters)
+            self._chunk_fns[use_filters] = self._wrap_compiled(
+                self._build_chunk_fn(use_filters),
+                f"serve/decode_chunk:{int(use_filters)}")
         chunk_fn = self._chunk_fns[use_filters]
         last = np.zeros(self.max_batch, np.int32)
         temps = np.zeros(self.max_batch, np.float32)
@@ -813,7 +841,8 @@ class ServingEngine:
                                  attrs={"backend": self.attention_backend,
                                         "phase": "decode_chunk",
                                         "batch": int(self.max_batch),
-                                        "tokens": int(K)}):
+                                        "tokens": int(K)}), \
+                self._prof_track("serve_step"):
             if self.mesh is not None:
                 with self.mesh:
                     toks, self.caches = chunk_fn(*args)
@@ -847,6 +876,21 @@ class ServingEngine:
             done_now[rid] = self.finished.pop(rid)
         return done_now
 
+    def _check_compile_storm(self):
+        """Rising-edge serve event when the CompileWatcher flags a
+        recompile storm: serving shape-bucket churn is an operator error
+        (bucketing misconfigured), so it lands in the frozen serve/*
+        stream next to shed/fault events, not just the compile/* stream."""
+        prof = self._profiling
+        if prof is None:
+            return
+        active = bool(prof.storm_active)
+        if active and not self._storm_flagged:
+            snap = prof.compile_snapshot()
+            self._serve_event("serve/compile_storm",
+                              misses=int(snap.get("total_misses", 0)))
+        self._storm_flagged = active
+
     # -- the batched decode step ---------------------------------------
     def step(self) -> Dict[Any, List[int]]:
         """Advance every active request by one token (``decode_chunk``
@@ -871,6 +915,7 @@ class ServingEngine:
                 return {}
             self._consec_step_faults = 0
         self._admit()
+        self._check_compile_storm()
         if self.n_active == 0:
             return {}
         if self.decode_chunk > 1:
@@ -1008,6 +1053,12 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             snap["prefix_cache"] = self.prefix_cache.snapshot()
+        prof = self._profiling
+        if prof is not None:
+            # compile health: a recompile storm means serving latency is
+            # going to compile, not tokens — operators page on this flag
+            snap["compile"] = prof.compile_snapshot()
+            snap["recompile_storm"] = bool(prof.storm_active)
         tel = self.telemetry
         if tel is not None and tel.enabled:
             # windowed latency distributions (ms) with p50/p90/p99 — the
@@ -1078,6 +1129,11 @@ class ServingEngine:
         # (queued/active) or reached exactly one serve/request/* terminal
         live = {r.req_id for r in self.queue} | active
         leaks.update(self.tracer.audit(live))
+        # HBM leak detector (profiling plane): monotonic live-byte growth
+        # across snapshots — device memory the page allocator can't see
+        prof = self._profiling
+        if prof is not None:
+            leaks.update(prof.leak_report())
         return leaks
 
     # -- convenience ----------------------------------------------------

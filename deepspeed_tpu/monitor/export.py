@@ -15,7 +15,9 @@ can observe a running job without touching its JSONL files:
   telemetry: per-rank shards merged by ``monitor/aggregate.py`` into
   skew, comm-bandwidth, and straggler tables); 404 when the exporter has
   no aggregator (single-rank / distributed block off).
-* ``GET /healthz``       — liveness probe, ``{"ok": true}``.
+* ``GET /healthz``       — liveness probe, ``{"ok": true}``; when the
+  profiling plane is on it also carries ``recompile_storm`` (the
+  CompileWatcher's live storm verdict).
 
 In distributed mode every sample on ``/metrics`` carries a ``rank``
 label (``ds_engine_loss{rank="0"}``) so multi-rank scrapes stay
@@ -136,7 +138,13 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(503, json.dumps({"error": str(e)}),
                                 "application/json")
         elif path == "/healthz":
-            self._reply(200, '{"ok": true}', "application/json")
+            health = {"ok": True}
+            # profiling plane: liveness scrapers get the recompile-storm
+            # verdict without parsing the full metric surface
+            prof = getattr(self.exporter.telemetry, "profiling", None)
+            if prof is not None:
+                health["recompile_storm"] = bool(prof.storm_active)
+            self._reply(200, json.dumps(health), "application/json")
         else:
             self._reply(404, '{"error": "not found"}', "application/json")
 

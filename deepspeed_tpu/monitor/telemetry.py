@@ -41,7 +41,7 @@ from deepspeed_tpu.utils.logging import logger
 # The closed set of event kinds.  Adding a kind means updating the frozen
 # schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
 EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
-               "meta", "fault", "serve")
+               "meta", "fault", "serve", "compile")
 
 
 def _profiler_annotation(name):
@@ -247,6 +247,25 @@ def _coerce_distributed(dcfg):
             "straggler_window": int(dcfg.straggler_window)}
 
 
+def _coerce_profiling(pcfg):
+    """``telemetry.profiling`` block as a plain dict — accepts the
+    TelemetryProfilingConfig object, a raw dict (hand-built configs), or
+    None (block absent: profiling plane off)."""
+    defaults = {"enabled": False, "snapshot_interval": 8,
+                "storm_threshold": 3, "storm_window_s": 60.0,
+                "leak_window": 8, "peak_hbm_gbps": 0.0}
+    if pcfg is None:
+        return defaults
+    get = (pcfg.get if isinstance(pcfg, dict)
+           else lambda k, d: getattr(pcfg, k, d))
+    return {"enabled": bool(get("enabled", False)),
+            "snapshot_interval": int(get("snapshot_interval", 8)),
+            "storm_threshold": int(get("storm_threshold", 3)),
+            "storm_window_s": float(get("storm_window_s", 60.0)),
+            "leak_window": int(get("leak_window", 8)),
+            "peak_hbm_gbps": float(get("peak_hbm_gbps", 0.0))}
+
+
 # ----------------------------------------------------------------------
 # the telemetry object
 # ----------------------------------------------------------------------
@@ -266,6 +285,7 @@ class Telemetry:
         self.exporter = None
         self.rank = 0
         self.cluster = None
+        self.profiling = None
         self._stamp_rank = False
 
     def configure(self, config=None, rank=None):
@@ -289,11 +309,19 @@ class Telemetry:
             self.exporter.close()
             self.exporter = None
         self.cluster = None
+        self.profiling = None
         self._stamp_rank = False
         self.config = config
         self.enabled = bool(config is not None and config.enabled)
         if not self.enabled:
             return self
+        pcfg = _coerce_profiling(getattr(config, "profiling", None))
+        if pcfg.pop("enabled"):
+            # fourth observability plane (monitor/profiling.py): compile
+            # tracing, per-span HBM attribution, live roofline — built on
+            # EVERY rank (registry + events; the sink gates writes)
+            from deepspeed_tpu.monitor.profiling import ProfilingPlane
+            self.profiling = ProfilingPlane(self, **pcfg)
         if rank is None:
             try:
                 import jax
@@ -479,6 +507,7 @@ class Telemetry:
             self.sink.close()
             self.sink = None
         self.cluster = None
+        self.profiling = None
         self._stamp_rank = False
         self.enabled = False
 
@@ -502,11 +531,19 @@ class StepStallWatchdog:
     ``max(stall_factor * rolling_median_step, min_stall_secs)``, logs a
     warning and emits a structured ``stall`` event — once per stalled step,
     so a long hang produces one event, not a flood.
+
+    With a :class:`~deepspeed_tpu.monitor.profiling.CompileWatcher`
+    attached (``compile_watcher``), observed compile time since the last
+    beat is EXEMPT from the gap: a cold-start or shape-churn step that
+    legitimately spends tens of seconds in XLA no longer risks a false
+    stall verdict — only the non-compile remainder is judged against the
+    threshold.
     """
 
     def __init__(self, telemetry: Telemetry, stall_factor=10.0,
                  poll_interval_secs=1.0, min_stall_secs=1.0, window=64,
-                 cluster=None, cluster_poll_secs=30.0):
+                 cluster=None, cluster_poll_secs=30.0,
+                 compile_watcher=None):
         self.telemetry = telemetry
         self.stall_factor = float(stall_factor)
         self.poll_interval_secs = float(poll_interval_secs)
@@ -515,6 +552,9 @@ class StepStallWatchdog:
         # the watchdog doubles as the cross-rank straggler sentinel
         self.cluster = cluster
         self.cluster_poll_secs = float(cluster_poll_secs)
+        # profiling plane: compile time since the last beat is exempted
+        # from the stall gap (None -> no exemption)
+        self.compile_watcher = compile_watcher
         self._last_cluster_poll = None
         self._cluster_reported = None
         self._lock = threading.Lock()
@@ -538,10 +578,11 @@ class StepStallWatchdog:
             self._thread.join(timeout=5.0)
             self._thread = None
 
-    def beat(self, step):
+    def beat(self, step, now=None):
         """Record a completed step; emits a ``heartbeat`` event carrying the
-        measured step wall time."""
-        now = time.monotonic()
+        measured step wall time.  ``now`` is injectable for deterministic
+        tests (FakeClock), defaulting to the monotonic clock."""
+        now = now if now is not None else time.monotonic()
         with self._lock:
             step_s = (now - self._last_beat
                       if self._last_beat is not None else None)
@@ -576,6 +617,14 @@ class StepStallWatchdog:
             median = vals[len(vals) // 2]
         threshold = max(self.stall_factor * median, self.min_stall_secs)
         gap = now - last_beat
+        if self.compile_watcher is not None:
+            # exempt observed compile time since the last beat: a step
+            # that recompiled may legitimately exceed the median-derived
+            # threshold by exactly its compile cost
+            try:
+                gap -= self.compile_watcher.compile_secs_since(last_beat)
+            except Exception:
+                pass
         if gap <= threshold:
             return False
         with self._lock:

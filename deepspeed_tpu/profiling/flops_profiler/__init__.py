@@ -1,4 +1,4 @@
 from deepspeed_tpu.profiling.flops_profiler.profiler import (  # noqa: F401
-    FlopsProfiler, get_model_profile, jaxpr_flops, xla_cost_analysis,
-    flops_to_string, macs_to_string, params_to_string, duration_to_string,
-    number_to_string, params_count)
+    FlopsProfiler, get_model_profile, jaxpr_flops, jaxpr_hbm_bytes,
+    xla_cost_analysis, flops_to_string, macs_to_string, params_to_string,
+    duration_to_string, number_to_string, params_count)

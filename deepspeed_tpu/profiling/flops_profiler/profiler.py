@@ -153,6 +153,71 @@ def jaxpr_flops(fn: Callable, *args, **kwargs) -> Tuple[int, Dict[str, int]]:
     return sum(tree.values()), tree
 
 
+def _aval_bytes(av):
+    shape = getattr(av, "shape", None)
+    dtype = getattr(av, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return _prod(shape) * np.dtype(dtype).itemsize
+
+
+def _walk_jaxpr_bytes(jaxpr, mult: int = 1) -> int:
+    """Analytic memory-traffic estimate: operand + result bytes of every
+    dot/conv (the HBM-bound tensor ops), result bytes only for
+    elementwise/reduce chains — approximating XLA's fusion, which keeps
+    those intermediates in registers/VMEM.  An estimate of bytes MOVED,
+    not bytes resident; it upper-bounds post-fusion ``bytes accessed``
+    without a compile, which is exactly what the live bandwidth roofline
+    needs (the denominator is a peak, the fraction is a ceiling-relative
+    signal, not an audit)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_mult = mult
+        subs = []
+        if name == "scan":
+            subs = [eqn.params["jaxpr"].jaxpr]
+            sub_mult = mult * int(eqn.params.get("length", 1))
+        elif name in ("pjit", "closed_call", "custom_jvp_call",
+                      "custom_vjp_call", "custom_vjp_call_jaxpr",
+                      "remat", "checkpoint", "custom_lin"):
+            p = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            if p is not None:
+                subs = [p.jaxpr if hasattr(p, "jaxpr") else p]
+        elif name == "cond":
+            branches = eqn.params.get("branches", ())
+            if branches:
+                total += max(_walk_jaxpr_bytes(br.jaxpr, 1)
+                             for br in branches) * mult
+                continue
+        elif name == "while":
+            subs = [eqn.params["body_jaxpr"].jaxpr]
+        if subs:
+            for s in subs:
+                if s is not None:
+                    total += _walk_jaxpr_bytes(s, sub_mult)
+            continue
+        if name in ("dot_general", "conv_general_dilated"):
+            moved = sum(_aval_bytes(v.aval) for v in eqn.invars) + \
+                sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        elif name in _ELEMENTWISE_1 or name in _ELEMENTWISE_TRANSCENDENTAL \
+                or name in _REDUCE:
+            moved = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        else:
+            moved = 0
+        total += moved * mult
+    return total
+
+
+def jaxpr_hbm_bytes(fn: Callable, *args, **kwargs) -> int:
+    """Total analytic memory traffic (bytes) of ``fn(*args, **kwargs)``
+    — the numerator of the live bandwidth roofline
+    (``monitor/profiling.py``).  Analytic jaxpr walk only: no compile,
+    no execution, safe at trace time on any backend."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return _walk_jaxpr_bytes(closed.jaxpr)
+
+
 def xla_cost_analysis(fn: Callable, *args, **kwargs) -> Optional[Dict[str, float]]:
     """Post-fusion cost analysis from the compiled executable, if the
     backend exposes it (flops, bytes accessed)."""

@@ -110,6 +110,37 @@ class TelemetryDistributedConfig(DeepSpeedConfigModel):
                 "telemetry.distributed.straggler_window must be >= 1")
 
 
+class TelemetryProfilingConfig(DeepSpeedConfigModel):
+    """``"telemetry.profiling"`` block: the performance observability
+    plane (``monitor/profiling.py``) — compile tracing with a
+    recompile-storm verdict, per-span HBM attribution with a
+    monotonic-growth leak detector, and the live roofline gauges.  Off
+    by default; enabled it costs the hot path host-side fingerprinting
+    and periodic allocator-stat reads, never a device sync."""
+    enabled = False
+    snapshot_interval = 8           # steps between HBM live-buffer samples
+    storm_threshold = 3             # jit misses within the window -> storm
+    storm_window_s = 60.0           # sliding storm window (seconds)
+    leak_window = 8                 # consecutive growing samples -> leak
+    peak_hbm_gbps = 0.0             # bandwidth-roofline peak override;
+    #                                 0 -> chip table (comm/topology_model)
+
+    def _validate(self):
+        if int(self.snapshot_interval) < 1:
+            raise ValueError(
+                "telemetry.profiling.snapshot_interval must be >= 1")
+        if int(self.storm_threshold) < 1:
+            raise ValueError(
+                "telemetry.profiling.storm_threshold must be >= 1")
+        if float(self.storm_window_s) <= 0:
+            raise ValueError(
+                "telemetry.profiling.storm_window_s must be > 0")
+        if int(self.leak_window) < 2:
+            raise ValueError(
+                "telemetry.profiling.leak_window must be >= 2 "
+                "(growth needs at least two samples)")
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``"telemetry"`` block: the unified JSONL event stream
     (``monitor/telemetry.py``) plus the step-stall watchdog and the
@@ -126,6 +157,7 @@ class TelemetryConfig(DeepSpeedConfigModel):
     stall_poll_secs = 1.0           # watchdog poll interval
     export = {}                     # TelemetryExportConfig sub-block
     distributed = {}                # TelemetryDistributedConfig sub-block
+    profiling = {}                  # TelemetryProfilingConfig sub-block
 
     def _validate(self):
         if not isinstance(self.export, TelemetryExportConfig):
@@ -133,6 +165,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
         if not isinstance(self.distributed, TelemetryDistributedConfig):
             self.distributed = TelemetryDistributedConfig(
                 self.distributed or {})
+        if not isinstance(self.profiling, TelemetryProfilingConfig):
+            self.profiling = TelemetryProfilingConfig(self.profiling or {})
 
 
 class AsyncPipelineConfig(DeepSpeedConfigModel):

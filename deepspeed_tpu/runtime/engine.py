@@ -29,6 +29,7 @@ The model contract is functional: ``model`` is a callable
 ``.loss`` method of the same signature, e.g. our model zoo classes).
 """
 
+import contextlib
 import os
 import time
 from typing import Any, Callable, Dict, Optional
@@ -304,6 +305,9 @@ class DeepSpeedEngine:
                 self._drain_emit,
                 sync_interval=ap.sync_interval if self._async_enabled else 1,
                 use_thread=self._async_enabled and ap.drain_thread)
+        # profiling plane (monitor/profiling.py): compile tracing + HBM
+        # attribution + live roofline; None unless telemetry.profiling.enabled
+        self._profiling = self.telemetry.profiling
         self._watchdog = None
         if self._tel_enabled and tc.stall_watchdog:
             # distributed telemetry: the watchdog also runs the cross-rank
@@ -312,11 +316,15 @@ class DeepSpeedEngine:
                 self.telemetry, stall_factor=tc.stall_factor,
                 poll_interval_secs=tc.stall_poll_secs,
                 min_stall_secs=tc.stall_min_secs,
-                cluster=self.telemetry.cluster).start()
+                cluster=self.telemetry.cluster,
+                compile_watcher=(self._profiling.compiles
+                                 if self._profiling is not None else None),
+            ).start()
         self._last_batch_tokens = None
         # live MFU: analytic per-step model flops (set once the flops
         # profiler has run) / measured step time / device-peak ceiling
         self._analytic_step_flops = None
+        self._analytic_step_bytes = None
         self._mfu_peak_flops = None
         # fault-tolerance layer (config "resilience", runtime/resilience.py):
         # durable checkpoint transactions + retry policy are always wired
@@ -831,10 +839,26 @@ class DeepSpeedEngine:
 
         return train_step
 
+    def _wrap_compiled(self, fn, site):
+        """Route a jitted entry point through the CompileWatcher so cache
+        misses (recompiles) are timed and emitted as ``compile/*`` events."""
+        if self._profiling is None:
+            return fn
+        return self._profiling.wrap(fn, site,
+                                    step_fn=lambda: self.global_steps)
+
+    def _prof_track(self, span):
+        """HBM attribution context for a top-level span; no-op without the
+        profiling plane (or off-TPU, where memory_stats() is unavailable)."""
+        if self._profiling is None:
+            return contextlib.nullcontext()
+        return self._profiling.track(span)
+
     def _get_compiled_train_step(self, gas: int):
         if gas not in self._compiled_train_step:
             step = self._build_train_step(gas)
-            self._compiled_train_step[gas] = jax.jit(step, donate_argnums=(0,))
+            self._compiled_train_step[gas] = self._wrap_compiled(
+                jax.jit(step, donate_argnums=(0,)), f"engine/train_step:{gas}")
         return self._compiled_train_step[gas]
 
     # ------------------------------------------------------------------
@@ -858,7 +882,8 @@ class DeepSpeedEngine:
                             else jnp.asarray(False))
                 grad_norm = _global_norm_f32(grads)
                 return loss, grads, overflow, grad_norm, rng
-            self._compiled_offload_grad[gas] = jax.jit(grad_step)
+            self._compiled_offload_grad[gas] = self._wrap_compiled(
+                jax.jit(grad_step), f"engine/offload_grad:{gas}")
         return self._compiled_offload_grad[gas]
 
     def _offload_host_apply(self, grads, overflow, grad_norm):
@@ -920,7 +945,8 @@ class DeepSpeedEngine:
         ``backward``).  Returns the unscaled loss."""
         if not self._tel_enabled:
             return self._forward_inner(batch, rng)
-        with self.telemetry.span("engine/forward", step=self.global_steps):
+        with self.telemetry.span("engine/forward", step=self.global_steps), \
+                self._prof_track("fwd"):
             return self._forward_inner(batch, rng)
 
     def _forward_inner(self, batch, rng=None):
@@ -945,7 +971,8 @@ class DeepSpeedEngine:
                 overflow = (has_inf_or_nan(grads)
                             if self._config.fp16_enabled else jnp.asarray(False))
                 return loss, grads, overflow, rng
-            self._compiled_fwd_bwd = jax.jit(fwd_bwd)
+            self._compiled_fwd_bwd = self._wrap_compiled(
+                jax.jit(fwd_bwd), "engine/fwd_bwd")
         batch = self._shard_batch(batch)
         with self.mesh:
             loss, grads, overflow, rng = self._compiled_fwd_bwd(self.state, batch)
@@ -961,7 +988,8 @@ class DeepSpeedEngine:
         Parity: reference ``backward:1931`` (scaling by 1/GAS happens here)."""
         if not self._tel_enabled:
             return self._backward_inner(loss)
-        with self.telemetry.span("engine/backward", step=self.global_steps):
+        with self.telemetry.span("engine/backward", step=self.global_steps), \
+                self._prof_track("bwd"):
             return self._backward_inner(loss)
 
     def _backward_inner(self, loss=None):
@@ -991,7 +1019,8 @@ class DeepSpeedEngine:
         Parity: reference ``step:2142`` → ``_take_model_step:2074``."""
         if not self._tel_enabled:
             return self._step_inner()
-        with self.telemetry.span("engine/step", step=self.global_steps):
+        with self.telemetry.span("engine/step", step=self.global_steps), \
+                self._prof_track("step"):
             self._step_inner()
         if self._step_applied:
             self._emit_step_telemetry()
@@ -1007,8 +1036,9 @@ class DeepSpeedEngine:
                                      self._accum_overflow, grad_norm)
         else:
             if self._compiled_apply is None:
-                self._compiled_apply = jax.jit(self._apply_update,
-                                               donate_argnums=(0, 1))
+                self._compiled_apply = self._wrap_compiled(
+                    jax.jit(self._apply_update, donate_argnums=(0, 1)),
+                    "engine/apply")
             with self.mesh:
                 self.state, grad_norm = self._compiled_apply(
                     self.state, self._accum_grads, self._accum_overflow)
@@ -1042,7 +1072,8 @@ class DeepSpeedEngine:
         else:
             t0 = time.perf_counter()
             with self.telemetry.span("engine/train_batch",
-                                     step=self.global_steps):
+                                     step=self.global_steps), \
+                    self._prof_track("train_batch"):
                 loss = self._train_batch_inner(data_iter, batch)
             self._emit_step_telemetry(step_secs=time.perf_counter() - t0,
                                       metrics=self._last_metrics)
@@ -1237,7 +1268,8 @@ class DeepSpeedEngine:
                         p_c, moq_anneal_step(state),
                         schedule_offset=self.quantizer.schedule_offset)
                 return self.loss_fn(p_c, batch, state.rng)
-            self._compiled_eval = jax.jit(ev)
+            self._compiled_eval = self._wrap_compiled(
+                jax.jit(ev), "engine/eval")
         batch = self._prep_eval_batch(batch)
         batch = self._shard_batch(batch,
                                   leading_gas_dim=self._eval_leading_gas_dim)
@@ -1391,6 +1423,17 @@ class DeepSpeedEngine:
                     tel.gauge("train/mfu",
                               flops_per_sec / self._mfu_peak_flops,
                               step=step)
+        if self._profiling is not None:
+            self._profiling.on_step(step)
+            if step_secs is not None and step_secs > 0:
+                # live roofline: achieved fraction of peak compute and HBM
+                # bandwidth for the whole train_batch span (analytic
+                # numerators from the flops profiler, table denominators)
+                self._profiling.roofline(
+                    "train_batch", step_secs,
+                    flops=self._analytic_step_flops,
+                    bytes_moved=self._analytic_step_bytes,
+                    peak_flops=self._mfu_peak_flops, step=step)
         if self._config.telemetry_config.hbm_gauges:
             self._emit_hbm_gauges(step)
 
@@ -1479,6 +1522,17 @@ class DeepSpeedEngine:
         # a per-device peak is known (config peak_tflops, else chip table)
         if prof.total_flops:
             self._analytic_step_flops = 3.0 * float(prof.total_flops) * gas
+            # analytic HBM traffic for the bandwidth roofline: same 3x
+            # fwd+bwd approximation over the jaxpr's operand/result bytes
+            try:
+                from deepspeed_tpu.profiling.flops_profiler import \
+                    jaxpr_hbm_bytes
+                with self.mesh:
+                    fwd_bytes = jaxpr_hbm_bytes(fwd, self.state.params, micro)
+                self._analytic_step_bytes = (3.0 * float(fwd_bytes) * gas
+                                             if fwd_bytes else None)
+            except Exception:
+                self._analytic_step_bytes = None
             peak = (float(fpc.peak_tflops) * 1e12
                     if float(getattr(fpc, "peak_tflops", 0.0) or 0.0) > 0
                     else None)

@@ -134,7 +134,8 @@ class PipelineEngine(DeepSpeedEngine):
                             else jnp.asarray(False))
                 grad_norm = _global_norm_f32(grads)
                 return loss, grads, overflow, grad_norm, rng
-            self._compiled_offload_grad[gas] = jax.jit(grad_step)
+            self._compiled_offload_grad[gas] = self._wrap_compiled(
+                jax.jit(grad_step), f"pipe/offload_grad:{gas}")
         return self._compiled_offload_grad[gas]
 
     def _model_scaled_loss(self, p_c, batch, rng, loss_scale):

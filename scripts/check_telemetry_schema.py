@@ -12,6 +12,13 @@ Usage:
     python scripts/check_telemetry_schema.py --prom <metrics.txt> [...]
     python scripts/check_telemetry_schema.py --shards <shard_dir> [...]
     python scripts/check_telemetry_schema.py --cluster <payload.json> [...]
+    python scripts/check_telemetry_schema.py --ledger <BENCH_LEDGER.jsonl>
+
+The ``--ledger`` mode validates a perf-regression ledger
+(``bench.py`` appends one row per micro-bench metric; ``scripts/
+ds_perf_diff.py`` compares runs against it): every row must carry
+``ts``/``run``/``bench``/``metric``/``value`` with an optional
+``unit``.
 
 The ``--prom`` mode validates a Prometheus text exposition page (the
 ``monitor/export.py`` /metrics surface) instead: metric-name grammar,
@@ -96,6 +103,20 @@ SCHEMA = {
         "required": {"ts": _NUM, "kind": str, "name": str},
         "optional": {"attrs": dict, "step": int},
     },
+    # profiling-plane compile tracing (monitor/profiling.py
+    # CompileWatcher): one "compile/miss" record per jit-cache miss with
+    # the wrapped site, the observed wall time (compile + first
+    # execution), the site's cumulative miss count, and the cause diff vs
+    # the previous call signature; one "compile/storm" record per storm
+    # onset (site "*", count = misses inside the sliding window).  The
+    # ``name`` field is validated against COMPILE_EVENTS, ``cause``
+    # against COMPILE_CAUSES.
+    "compile": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "site": str,
+                     "count": int},
+        "optional": {"dur_ms": _NUM, "cause": str, "window_s": _NUM,
+                     "attrs": dict, "step": int},
+    },
 }
 
 # FROZEN vocabulary of serve-kind event names — must stay byte-identical
@@ -111,6 +132,10 @@ SERVE_EVENTS = (
     "serve/evict", "serve/drain", "serve/finish", "serve/fault",
     "serve/prefix_hit", "serve/prefix_cow", "serve/prefix_insert",
     "serve/prefix_evict",
+    # "serve/compile_storm" fires once per recompile-storm onset seen by
+    # the serving engine's CompileWatcher (monitor/profiling.py): shapes
+    # are churning faster than the jit cache amortises (attrs: misses).
+    "serve/compile_storm",
     "serve/backend",
     # per-request lifecycle trace (RequestTracer): one event per state
     # transition, each carrying req_id plus the derived latencies so a
@@ -151,6 +176,21 @@ CLUSTER_GAUGES = (
     "cluster/collective_spread_ms",
     "cluster/straggler_rank",
 )
+
+# FROZEN vocabularies of the profiling plane — each must stay
+# byte-identical to its twin in ``deepspeed_tpu.monitor.profiling``
+# (the tier-1 test diffs every pair).  compile-kind event names; the
+# cause labels a compile/miss may carry; the logical top-level spans
+# HBM/roofline attribution keys on; and the per-span metric leaves of
+# the ``mem/<span>/<metric>`` and ``roofline/<span>/<metric>`` gauge
+# families (validated below for every gauge event under those prefixes).
+COMPILE_EVENTS = ("compile/miss", "compile/storm")
+COMPILE_CAUSES = ("cold", "new_shape", "new_dtype", "new_callable",
+                  "new_static")
+PROFILE_SPANS = ("fwd", "bwd", "step", "train_batch", "serve_step",
+                 "prefill")
+MEM_METRICS = ("live_bytes", "peak_bytes", "frag_bytes")
+ROOFLINE_METRICS = ("compute_frac", "bandwidth_frac")
 
 EVENT_KINDS = tuple(SCHEMA)
 
@@ -193,6 +233,23 @@ def validate_event(event):
             event["name"].startswith("cluster/") and \
             event["name"] not in CLUSTER_GAUGES:
         problems.append(f"gauge: unknown cluster gauge {event['name']!r}")
+    if kind == "compile" and isinstance(event.get("name"), str):
+        if event["name"] not in COMPILE_EVENTS:
+            problems.append(
+                f"compile: unknown event name {event['name']!r}")
+        cause = event.get("cause")
+        if cause is not None and cause not in COMPILE_CAUSES:
+            problems.append(f"compile: unknown cause {cause!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str):
+        for prefix, metrics in (("mem/", MEM_METRICS),
+                                ("roofline/", ROOFLINE_METRICS)):
+            if not event["name"].startswith(prefix):
+                continue
+            parts = event["name"].split("/")
+            if len(parts) != 3 or parts[1] not in PROFILE_SPANS or \
+                    parts[2] not in metrics:
+                problems.append(
+                    f"gauge: unknown {prefix}* gauge {event['name']!r}")
     return problems
 
 
@@ -369,6 +426,59 @@ def validate_cluster_file(path):
 
 
 # ----------------------------------------------------------------------
+# perf-regression ledger (bench.py appends; scripts/ds_perf_diff.py reads)
+# ----------------------------------------------------------------------
+# One row per (run, bench, metric): ``run`` groups every metric a single
+# bench.py invocation recorded, so ds_perf_diff.py can baseline on prior
+# runs and diff the latest against them.
+LEDGER_REQUIRED = {"ts": _NUM, "run": str, "bench": str, "metric": str,
+                   "value": _NUM}
+LEDGER_OPTIONAL = {"unit": str}
+
+
+def validate_ledger_row(row):
+    """Validate one decoded ledger row.  Returns a list of problem
+    strings (empty = valid)."""
+    problems = []
+    if not isinstance(row, dict):
+        return [f"row is {type(row).__name__}, not an object"]
+    for field, types in LEDGER_REQUIRED.items():
+        if field not in row:
+            problems.append(f"ledger: missing required field {field!r}")
+        elif not isinstance(row[field], types) or \
+                isinstance(row[field], bool):
+            problems.append(f"ledger: field {field!r} has type "
+                            f"{type(row[field]).__name__}")
+    allowed = set(LEDGER_REQUIRED) | set(LEDGER_OPTIONAL)
+    for field, value in row.items():
+        if field not in allowed:
+            problems.append(f"ledger: unknown field {field!r}")
+        elif field in LEDGER_OPTIONAL and (
+                not isinstance(value, LEDGER_OPTIONAL[field])
+                or isinstance(value, bool)):
+            problems.append(f"ledger: optional field {field!r} has type "
+                            f"{type(value).__name__}")
+    return problems
+
+
+def validate_ledger_file(path):
+    problems = []
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                problems.append(f"{path}:{i}: not valid JSON: {e}")
+                continue
+            for p in validate_ledger_row(row):
+                problems.append(f"{path}:{i}: {p}")
+    return problems
+
+
+# ----------------------------------------------------------------------
 # exporter metric-name validation (monitor/export.py)
 # ----------------------------------------------------------------------
 # Prometheus text exposition format 0.0.4, the exporter's /metrics
@@ -460,6 +570,17 @@ def main(argv=None):
             print(f"FAIL: {bad} problem(s) across {shards} shard(s)")
             return 1
         print(f"OK: {shards} shard(s) validated")
+        return 0
+    if argv[0] == "--ledger":
+        bad = 0
+        for path in argv[1:]:
+            for p in validate_ledger_file(path):
+                print(p)
+                bad += 1
+        if bad:
+            print(f"FAIL: {bad} problem(s)")
+            return 1
+        print("OK: ledger validated")
         return 0
     if argv[0] == "--cluster":
         bad = 0

@@ -90,6 +90,7 @@ def aggregate(events):
     serves = {}      # event name -> {count, reasons: {reason: n}}
     requests = []    # reconstructed serve/request/* lifecycle traces
     open_reqs = {}   # req_id -> index into requests (trace not yet closed)
+    compiles = {"sites": {}, "storms": 0, "total_misses": 0}
     for ev in events:
         kind = ev.get("kind")
         if kind == "span":
@@ -124,6 +125,21 @@ def aggregate(events):
                 rs[int(ev["step"])] = (ev.get("step_ms")
                                        if ev.get("step_ms") is not None
                                        else rs.get(int(ev["step"])))
+        elif kind == "compile":
+            # profiling plane (monitor/profiling.py): per-site recompile
+            # census + storm count for the compile-tracing table
+            if ev.get("name") == "compile/storm":
+                compiles["storms"] += 1
+            else:
+                rec = compiles["sites"].setdefault(
+                    ev.get("site", "?"),
+                    {"misses": 0, "dur_ms": 0.0, "causes": {}})
+                rec["misses"] += 1
+                rec["dur_ms"] += float(ev.get("dur_ms") or 0.0)
+                cause = ev.get("cause")
+                if cause:
+                    rec["causes"][cause] = rec["causes"].get(cause, 0) + 1
+                compiles["total_misses"] += 1
         elif kind == "stall":
             stalls.append(ev)
         elif kind == "meta":
@@ -181,7 +197,8 @@ def aggregate(events):
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "rank_steps": rank_steps,
             "steps": steps, "stalls": stalls,
-            "metas": metas, "serves": serves, "requests": requests}
+            "metas": metas, "serves": serves, "requests": requests,
+            "compiles": compiles}
 
 
 def summarize(agg):
@@ -219,6 +236,7 @@ def summarize(agg):
         for name, rec in sorted(agg.get("serves", {}).items())}
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
+            "profiling": _profiling_summary(agg),
             "cluster": _cluster_summary(agg),
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
@@ -227,6 +245,34 @@ def summarize(agg):
             "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _profiling_summary(agg):
+    """Profiling-plane digest (monitor/profiling.py): the per-site
+    recompile census, per-span memory attribution from the
+    ``mem/<span>/<metric>`` gauges, and the live roofline fractions from
+    ``roofline/<span>/<metric>``.  None when the stream carries no
+    profiling records at all (plane off)."""
+    comp = agg.get("compiles") or {"sites": {}, "storms": 0,
+                                   "total_misses": 0}
+    mem, roofline = {}, {}
+    for name, g in agg["gauges"].items():
+        parts = name.split("/")
+        if len(parts) != 3:
+            continue
+        family = {"mem": mem, "roofline": roofline}.get(parts[0])
+        if family is not None:
+            family.setdefault(parts[1], {})[parts[2]] = {
+                "last": g["last"], "peak": g["peak"]}
+    if not (comp["total_misses"] or comp["storms"] or mem or roofline):
+        return None
+    sites = {site: {"misses": rec["misses"],
+                    "dur_ms": round(rec["dur_ms"], 3),
+                    "causes": dict(sorted(rec["causes"].items()))}
+             for site, rec in sorted(comp["sites"].items())}
+    return {"compile": {"total_misses": comp["total_misses"],
+                        "storms": comp["storms"], "sites": sites},
+            "mem": mem, "roofline": roofline}
 
 
 def _cluster_summary(agg):
@@ -446,6 +492,43 @@ def print_tables(summary, out=sys.stdout):
                 peak = round(peak, 4) if isinstance(peak, float) else peak
             w(f"{name:<36}{last:>16}{peak:>16}{r['samples']:>9}\n")
         w("\n")
+    prof = summary.get("profiling")
+    if prof:
+        comp = prof["compile"]
+        w("== profiling: compile tracing ==\n")
+        w(f"jit cache misses: {comp['total_misses']}  "
+          f"storms: {comp['storms']}\n")
+        if comp["sites"]:
+            w(f"{'site':<32}{'misses':>7}{'dur_ms':>12}  causes\n")
+            for site, r in comp["sites"].items():
+                causes = ", ".join(f"{k}={v}"
+                                   for k, v in r["causes"].items())
+                w(f"{site:<32}{r['misses']:>7}{r['dur_ms']:>12}  "
+                  f"{causes}\n")
+        w("\n")
+        if prof["mem"]:
+            w("== profiling: HBM attribution (peak per span) ==\n")
+            w(f"{'span':<16}{'live':>12}{'peak':>12}{'frag':>12}\n")
+            for span, metrics in sorted(prof["mem"].items()):
+                cells = []
+                for m in ("live_bytes", "peak_bytes", "frag_bytes"):
+                    rec = metrics.get(m)
+                    cells.append(_fmt_bytes(rec["peak"]) if rec else "-")
+                w(f"{span:<16}{cells[0]:>12}{cells[1]:>12}"
+                  f"{cells[2]:>12}\n")
+            w("\n")
+        if prof["roofline"]:
+            w("== profiling: live roofline (fraction of peak) ==\n")
+            w(f"{'span':<16}{'compute':>10}{'bandwidth':>11}\n")
+            for span, metrics in sorted(prof["roofline"].items()):
+                cells = []
+                for m in ("compute_frac", "bandwidth_frac"):
+                    rec = metrics.get(m)
+                    cells.append(f"{rec['last'] * 100:.1f}%"
+                                 if rec and isinstance(
+                                     rec["last"], (int, float)) else "-")
+                w(f"{span:<16}{cells[0]:>10}{cells[1]:>11}\n")
+            w("\n")
     feed = summary.get("input_feed")
     if feed:
         w("== input feed (engine/input_wait) ==\n")

@@ -299,3 +299,93 @@ def test_cluster_cli_and_payload(checker, tmp_path, capsys):
     p.write_text("not json")
     assert checker.main(["--cluster", str(p)]) == 1
     capsys.readouterr()
+
+
+# ----------------------------------------------------------------------
+# profiling plane: compile kind + mem/roofline gauge vocabularies
+# ----------------------------------------------------------------------
+def test_profiling_vocabularies_in_lockstep(checker):
+    """The frozen compile/mem/roofline vocabularies must stay
+    byte-identical between monitor/profiling.py and the checker."""
+    from deepspeed_tpu.monitor import profiling
+    assert checker.COMPILE_EVENTS == profiling.COMPILE_EVENTS
+    assert checker.COMPILE_CAUSES == profiling.COMPILE_CAUSES
+    assert checker.PROFILE_SPANS == profiling.PROFILE_SPANS
+    assert checker.MEM_METRICS == profiling.MEM_METRICS
+    assert checker.ROOFLINE_METRICS == profiling.ROOFLINE_METRICS
+
+
+def test_compile_event_validation(checker):
+    miss = {"ts": 1.0, "kind": "compile", "name": "compile/miss",
+            "site": "engine/train_step:1", "dur_ms": 812.5, "count": 1,
+            "cause": "cold", "step": 0, "rank": 0}
+    assert not checker.validate_event(miss)
+    storm = {"ts": 2.0, "kind": "compile", "name": "compile/storm",
+             "site": "*", "count": 4, "window_s": 60.0}
+    assert not checker.validate_event(storm)
+    # unknown event name / cause outside the frozen vocabulary
+    assert checker.validate_event(dict(miss, name="compile/hiccup"))
+    assert checker.validate_event(dict(miss, cause="gremlins"))
+    # missing required site/count
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "compile", "name": "compile/miss", "count": 1})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "compile", "name": "compile/miss",
+         "site": "engine/apply"})
+
+
+def test_mem_and_roofline_gauge_validation(checker):
+    for span in checker.PROFILE_SPANS:
+        for metric in checker.MEM_METRICS:
+            assert not checker.validate_event(
+                {"ts": 1.0, "kind": "gauge",
+                 "name": f"mem/{span}/{metric}", "value": 1024.0,
+                 "peak": 2048.0})
+        for metric in checker.ROOFLINE_METRICS:
+            assert not checker.validate_event(
+                {"ts": 1.0, "kind": "gauge",
+                 "name": f"roofline/{span}/{metric}", "value": 0.41,
+                 "peak": 0.5, "step": 7, "rank": 1})
+    # unknown span / metric / malformed structure are all rejected
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "mem/warmup/live_bytes",
+         "value": 1.0, "peak": 1.0})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "mem/fwd/rss_bytes",
+         "value": 1.0, "peak": 1.0})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "roofline/fwd/mfu",
+         "value": 1.0, "peak": 1.0})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "roofline/compute_frac",
+         "value": 1.0, "peak": 1.0})
+
+
+def test_ledger_row_validation(checker):
+    good = {"ts": 1.0, "run": "run-1", "bench": "cpu_dispatch",
+            "metric": "steps_per_sec", "value": 12.5, "unit": "steps/s"}
+    assert checker.validate_ledger_row(good) == []
+    assert checker.validate_ledger_row({"ts": 1.0, "run": "r",
+                                        "bench": "b", "metric": "m",
+                                        "value": 1})== []
+    # missing field / wrong types / unknown field / bool value
+    assert checker.validate_ledger_row({k: v for k, v in good.items()
+                                        if k != "metric"})
+    assert checker.validate_ledger_row(dict(good, value="fast"))
+    assert checker.validate_ledger_row(dict(good, value=True))
+    assert checker.validate_ledger_row(dict(good, vibe="good"))
+    assert checker.validate_ledger_row([1, 2])
+
+
+def test_ledger_cli_exit_codes(checker, tmp_path, capsys):
+    import json
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(
+        {"ts": 1.0, "run": "r1", "bench": "b", "metric": "m",
+         "value": 1.0}) + "\n\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ts": 1.0, "run": "r1"}\nnot json\n')
+    assert checker.main(["--ledger", str(good)]) == 0
+    assert checker.main(["--ledger", str(good), str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "not valid JSON" in out
