@@ -558,6 +558,112 @@ def _worker_serving_prefix(spec):
     print(json.dumps(_serving_prefix_bench(spec)))
 
 
+def _fleet_bench(spec=None):
+    """CPU-runnable fleet micro-bench: a shared-prefix workload (several
+    prompt families, distinct suffixes) served by one replica and by a
+    fleet, then again with a mid-flight injected ``replica_kill``.
+    Reports aggregate decode throughput at each replica count (the
+    scaling claim), per-replica prefix-cache hit rates (the affinity
+    claim — fleet routing must keep them at single-engine levels), and
+    the kill run's recovery cost (extra wall/steps over the no-fault
+    fleet run) with zero lost requests."""
+    spec = spec or {}
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.runtime.resilience import FaultInjector
+
+    n_replicas = int(spec.get("replicas", 3))
+    n_requests = int(spec.get("requests", 18))
+    max_new = int(spec.get("max_new_tokens", 6))
+    prefix_len = int(spec.get("shared_prefix_tokens", 24))
+
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    families = [rng.integers(0, cfg.vocab_size, (prefix_len,)).tolist()
+                for _ in range(2 * n_replicas)]
+    prompts = {
+        f"q{i}": families[i % len(families)] +
+        rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        for i in range(n_requests)}
+
+    def factory(rid, epoch):
+        return ServingEngine(
+            model, params, max_batch=4, page_size=8, max_seq=128,
+            dtype=jnp.float32, replica_epoch=epoch,
+            serving={"prefix_cache": {"enabled": True}})
+
+    def run(replicas, injector=None, health_interval=2):
+        fleet = FleetRouter(
+            factory,
+            fleet={"replicas": replicas, "max_replicas": replicas + 1,
+                   "health_interval": health_interval},
+            injector=injector)
+        # warm each engine's jit caches off the clock so the timed phase
+        # measures serving, not per-replica compilation
+        for rep in fleet.replicas.values():
+            rep.engine.generate([prompts["q0"]], max_new_tokens=2)
+        t0 = time.perf_counter()
+        for rid, p in prompts.items():
+            fleet.submit(rid, p, max_new_tokens=max_new)
+        done = fleet.join(max_steps=2000)
+        wall = time.perf_counter() - t0
+        generated = sum(len(toks) - len(prompts[rid])
+                        for rid, toks in done.items())
+        hit_rates = [
+            r["prefix_hit_rate"]
+            for r in fleet.health()["replicas"].values()
+            if r["prefix_hit_rate"] is not None and r["state"] == "healthy"]
+        return {"fleet": fleet, "done": done, "wall_s": wall,
+                "generated": generated,
+                # replicas are parallel fault domains on real hardware but
+                # step serially in this single process, so the scaling
+                # claim is tokens per FLEET step (one round across all
+                # replicas), not wall-clock
+                "tokens_per_step": generated / max(fleet.steps, 1),
+                "hit_rates": hit_rates, "steps": fleet.steps,
+                "leaks": fleet.leak_report()}
+
+    r1 = run(1)
+    rn = run(n_replicas)
+    kill = run(n_replicas, injector=FaultInjector(
+        {"replica_kill": {"fail_at": [1], "msg": "bench chaos"}}))
+    st = kill["fleet"].stats
+    lost = st["submitted"] - st["finished"] - st["terminated"]
+    return {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "agg_tokens_per_step_single": round(r1["tokens_per_step"], 3),
+        "agg_tokens_per_step_fleet": round(rn["tokens_per_step"], 3),
+        "throughput_scale_frac": round(
+            rn["tokens_per_step"] / max(r1["tokens_per_step"], 1e-9), 3),
+        "prefix_hit_rate_single": r1["hit_rates"][0] if r1["hit_rates"]
+        else 0.0,
+        "prefix_hit_rate_fleet_min": min(rn["hit_rates"], default=0.0),
+        "bit_identical": rn["done"] == r1["done"],
+        "kill_bit_identical": kill["done"] == r1["done"],
+        "kill_extra_wall_s": round(kill["wall_s"] - rn["wall_s"], 3),
+        "kill_recovery_steps": kill["steps"] - rn["steps"],
+        "kills": kill["fleet"].stats["kills"],
+        "redispatches": kill["fleet"].stats["redispatches"],
+        "respawns": kill["fleet"].stats["respawns"],
+        "lost_requests": lost,
+        "leaks_fleet": rn["leaks"],
+        "leaks_kill": kill["leaks"],
+    }
+
+
+def _worker_fleet(spec):
+    print(json.dumps(_fleet_bench(spec)))
+
+
 def _serving_attn_bench(spec=None):
     """CPU-runnable serving-attention micro-bench: the jnp gather path vs
     the fused ragged Pallas kernel (interpret mode) on ONE mixed
@@ -1229,6 +1335,24 @@ def _attach_compile_churn(out):
     return out
 
 
+def _attach_fleet(out):
+    """Attach the fleet-failover micro-bench under the stable key
+    ``cpu_fleet`` (CPU-runnable: aggregate throughput vs replica count,
+    per-replica prefix hit rates, and kill-recovery cost).  Budget-gated;
+    a failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "fleet", {},
+        timeout=max(60, min(300, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_fleet"] = res
+    else:
+        out.setdefault("notes", {})["fleet"] = (err or "")[:200]
+    return out
+
+
 def _append_ledger(out):
     """Append this run's numeric bench metrics to the perf-regression
     ledger (``BENCH_LEDGER`` env override; default BENCH_LEDGER.jsonl
@@ -1298,7 +1422,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))
+            print(json.dumps(_append_ledger(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -1386,7 +1510,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_compile_churn(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))
+        print(json.dumps(_append_ledger(_attach_fleet(_attach_compile_churn(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -1461,7 +1585,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))
+    print(json.dumps(_append_ledger(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))
 
 
 if __name__ == "__main__":
@@ -1488,6 +1612,8 @@ if __name__ == "__main__":
             _worker_serving(spec)
         elif which == "serving_prefix":
             _worker_serving_prefix(spec)
+        elif which == "fleet":
+            _worker_fleet(spec)
         elif which == "serving_attn":
             _worker_serving_attn(spec)
         elif which == "serving_slo":

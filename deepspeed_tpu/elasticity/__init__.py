@@ -2,13 +2,15 @@
 
 from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
                                                     HeartbeatMonitor,
+                                                    ReplicaAutoscaler,
                                                     ScaleEvent)
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityConfig, ElasticityConfigError, ElasticityError,
     ElasticityIncompatibleWorldSize, compute_elastic_config,
     ensure_immutable_elastic_config, get_valid_gpus)
 
-__all__ = ["DSElasticAgent", "HeartbeatMonitor", "ScaleEvent",
+__all__ = ["DSElasticAgent", "HeartbeatMonitor", "ReplicaAutoscaler",
+           "ScaleEvent",
            "ElasticityConfig",
            "ElasticityError", "ElasticityConfigError",
            "ElasticityIncompatibleWorldSize", "compute_elastic_config",

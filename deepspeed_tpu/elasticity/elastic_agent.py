@@ -91,6 +91,68 @@ class ScaleEvent(Exception):
         super().__init__(f"scale to {new_world_size}")
 
 
+class ReplicaAutoscaler:
+    """Serving-fleet scale decisions from aggregated ``serve/*`` gauges.
+
+    The training-side agent above supervises *worker processes*; this is
+    the serving analogue the fleet router (``inference/fleet.py``) calls
+    once per supervision sweep with fleet-aggregate load: total queue
+    depth, shed events since the last sweep, and the worst per-replica
+    free-KV-page fraction.  Decisions are hysteretic and rate-limited —
+    one replica per decision, with a cooldown of sweeps between decisions
+    — so a transient burst doesn't flap the fleet size.
+
+    Scale up (toward ``max_replicas``) when queue depth per replica
+    reaches ``scale_up_queue_per_replica``, OR any requests were shed
+    since the last sweep, OR the tightest replica's free-page fraction is
+    at/below ``free_page_low_frac``.  Scale down (toward
+    ``min_replicas``) only when the queue per replica is at/below
+    ``scale_down_queue_per_replica`` AND nothing was shed AND pages are
+    comfortable."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 8,
+                 scale_up_queue_per_replica: int = 8,
+                 scale_down_queue_per_replica: int = 1,
+                 free_page_low_frac: float = 0.1,
+                 cooldown_sweeps: int = 8):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_queue_per_replica = int(scale_up_queue_per_replica)
+        self.scale_down_queue_per_replica = int(scale_down_queue_per_replica)
+        self.free_page_low_frac = float(free_page_low_frac)
+        self.cooldown_sweeps = int(cooldown_sweeps)
+        self._cooldown = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    def decide(self, n_replicas: int, queue_depth: int = 0,
+               shed_delta: int = 0, free_page_frac: float = 1.0) -> int:
+        """Desired replica count for the next sweep (moves by at most 1)."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return n_replicas
+        per_replica = queue_depth / max(1, n_replicas)
+        pressed = (per_replica >= self.scale_up_queue_per_replica
+                   or shed_delta > 0
+                   or free_page_frac <= self.free_page_low_frac)
+        if pressed and n_replicas < self.max_replicas:
+            self._cooldown = self.cooldown_sweeps
+            self.scale_ups += 1
+            return n_replicas + 1
+        idle = (per_replica <= self.scale_down_queue_per_replica
+                and shed_delta == 0
+                and free_page_frac > self.free_page_low_frac)
+        if idle and n_replicas > self.min_replicas:
+            self._cooldown = self.cooldown_sweeps
+            self.scale_downs += 1
+            return n_replicas - 1
+        return n_replicas
+
+
 class DSElasticAgent:
 
     def __init__(self, ds_config: Dict, start_world_size: int,

@@ -2,6 +2,8 @@
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.fleet import (FleetConfig, FleetRouter,
+                                           FLEET_EVENTS)
 from deepspeed_tpu.inference.prefix_cache import (PrefixCache,
                                                   PrefixCacheConfig,
                                                   PrefixMatch)
@@ -15,4 +17,5 @@ from deepspeed_tpu.inference.serving import ServingEngine
 __all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "ServingEngine",
            "RequestRejected", "RequestResult", "ServingRobustnessConfig",
            "ServingStalled", "AdmissionController", "PrefixCache",
-           "PrefixCacheConfig", "PrefixMatch"]
+           "PrefixCacheConfig", "PrefixMatch", "FleetConfig",
+           "FleetRouter", "FLEET_EVENTS"]

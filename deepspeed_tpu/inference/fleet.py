@@ -1,0 +1,717 @@
+"""Fleet front-end: prefix-affinity routing over N serving replicas.
+
+Every PR so far hardens ONE :class:`ServingEngine`; the ROADMAP north
+star needs N of them.  :class:`FleetRouter` owns multiple engines as
+in-process fault domains and gives them a single engine-shaped surface
+(``submit`` → ``step`` → ``finished`` / ``pop_terminated`` / ``drain``
+/ ``health`` / ``leak_report``), built on three ideas:
+
+* **Prefix-affinity routing.**  The routing key is the same rolling
+  blake2b chain the prefix cache uses for content-hashed KV pages
+  (``inference/prefix_cache.py``), computed over the first
+  ``route_prefix_tokens`` prompt tokens — so requests that share a
+  prefix land on the replica that already holds those pages, and
+  per-replica hit rates stay at single-engine levels under fleet
+  traffic.  Replica choice is rendezvous (highest-random-weight)
+  hashing: each replica scores ``blake2b(key ‖ replica_id)`` and the
+  highest healthy score wins, so a dead replica remaps ONLY its own
+  keys and a respawn (same replica id, new epoch) re-takes its ring
+  slot.
+* **Supervision.**  A sweep every ``health_interval`` steps consults
+  the fault injector (``replica_kill``), each replica's
+  ``leak_report()`` (page/trace leaks ⇒ fence) and ``health()``
+  (``recompile_storm`` ⇒ fence).  A *fenced* replica is drained
+  through the graceful ``drain()`` path — finished work is delivered,
+  shed work is redispatched; a *killed* replica is dropped abruptly
+  and every request it owned is redispatched from scratch.  Either
+  way the replica respawns with a fresh epoch (the
+  :class:`RequestTracer` namespace, so a redispatched id re-admitted
+  on the new engine cannot read as a double admit).
+* **Zero lost requests.**  The fleet keeps its own request table and a
+  fleet-level :class:`RequestTracer`: every submitted id ends in
+  exactly one of the frozen trace terminals — delivered via
+  ``finished``, or typed into ``pop_terminated()`` (deadline, shed,
+  redispatch budget exhausted).  ``leak_report()`` audits that
+  bookkeeping the same way the engine audits pages.
+
+Dispatch atomicity follows the ``page_alloc`` idiom: the
+``route_dispatch`` injector site is consulted BEFORE the routing table
+or any engine mutates, so a faulted dispatch leaves the request exactly
+where it was (pending) and it retries on the next step.
+
+Scaling rides ``elasticity.ReplicaAutoscaler``: aggregated queue depth,
+shed deltas, and the tightest free-page fraction feed hysteretic
+one-replica-at-a-time decisions between ``min_replicas`` and
+``max_replicas``.
+"""
+
+import hashlib
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.elasticity.elastic_agent import ReplicaAutoscaler
+from deepspeed_tpu.inference.robustness import (
+    REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING, REJECT_DRAINING,
+    REJECT_DUPLICATE, REJECT_INFEASIBLE, REJECT_OVERSIZED, SHED_DEADLINE,
+    SHED_DRAIN, RequestRejected, RequestResult, RequestTracer)
+from deepspeed_tpu.monitor.telemetry import get_telemetry
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.runtime.resilience import FaultInjector
+from deepspeed_tpu.utils.logging import logger
+
+# The frozen fleet/* event vocabulary.  scripts/check_telemetry_schema.py
+# duplicates this tuple on purpose (the checker must not import the
+# package); tests/unit/test_telemetry_schema.py diffs the two.
+FLEET_EVENTS = (
+    "fleet/spawn", "fleet/respawn", "fleet/route", "fleet/spill",
+    "fleet/dispatch_fault", "fleet/redispatch", "fleet/kill",
+    "fleet/fence", "fleet/drain", "fleet/shed",
+    "fleet/scale_up", "fleet/scale_down",
+)
+
+# the closed set of replica supervision states (docs/serving.md)
+REPLICA_STATES = ("healthy", "fenced", "dead")
+
+# typed shed reason: the per-request redispatch budget ran out — the
+# request bounced off too many dying/overloaded replicas
+SHED_REDISPATCH_BUDGET = "redispatch_budget"
+
+# engine rejection reasons that indict the REQUEST, not the replica —
+# spilling these to another replica would just collect the same verdict,
+# so the fleet terminates the request instead of retrying forever
+_FATAL_REJECTS = (REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING,
+                  REJECT_OVERSIZED, REJECT_INFEASIBLE)
+
+
+class FleetConfig(DeepSpeedConfigModel):
+    """The ``serving.fleet`` config block (docs/config-json.md)."""
+
+    replicas = 2                    # initial replica count
+    min_replicas = 1                # supervision floor (respawn target)
+    max_replicas = 8                # autoscale ceiling
+    health_interval = 8             # fleet steps between supervision sweeps
+    redispatch_max = 3              # per-request redispatch budget
+    route_prefix_tokens = 0         # routing-key prefix len (0 = page_size)
+    autoscale = False               # ReplicaAutoscaler on aggregate gauges
+    scale_up_queue_per_replica = 8
+    scale_down_queue_per_replica = 1
+    free_page_low_frac = 0.1
+    cooldown_sweeps = 8
+    fault_injection = {}            # FaultInjector spec (fleet sites)
+
+    def _validate(self):
+        for k in ("replicas", "min_replicas", "health_interval"):
+            if int(getattr(self, k)) < 1:
+                raise ValueError(f"serving.fleet.{k} must be >= 1")
+        for k in ("redispatch_max", "route_prefix_tokens",
+                  "scale_up_queue_per_replica",
+                  "scale_down_queue_per_replica", "cooldown_sweeps"):
+            if int(getattr(self, k)) < 0:
+                raise ValueError(f"serving.fleet.{k} must be >= 0")
+        if int(self.max_replicas) < int(self.min_replicas):
+            raise ValueError(
+                "serving.fleet.max_replicas must be >= min_replicas")
+        if not (int(self.min_replicas) <= int(self.replicas)
+                <= int(self.max_replicas)):
+            raise ValueError("serving.fleet.replicas must lie in "
+                             "[min_replicas, max_replicas]")
+        if not (0.0 <= float(self.free_page_low_frac) < 1.0):
+            raise ValueError(
+                "serving.fleet.free_page_low_frac must be in [0, 1)")
+
+
+@dataclass
+class _FleetRequest:
+    """Fleet-side bookkeeping for one submitted request.  ``state`` walks
+    pending → dispatched → (pending …) → finished | terminated; the
+    dispatch counter enforces the redispatch budget."""
+    req_id: Any
+    prompt: List[int]
+    kwargs: Dict[str, Any]
+    route_key: bytes
+    deadline: float = 0.0           # absolute fleet-clock time; 0 = none
+    state: str = "pending"
+    replica_id: Optional[str] = None
+    dispatches: int = 0
+
+
+@dataclass
+class _Replica:
+    replica_id: str
+    epoch: str
+    engine: Any
+    state: str = "healthy"
+
+
+class FleetRouter:
+    """N in-process :class:`ServingEngine` fault domains behind one
+    engine-shaped front-end.
+
+    ``engine_factory(replica_id, epoch)`` builds one replica; the factory
+    MUST pass ``replica_epoch=epoch`` through to the engine so respawned
+    replicas book traces under a fresh namespace.  Every engine should be
+    built from the same (model, params, config) for bit-identical
+    redispatch — a request's output depends only on (prompt, sampling
+    params, seed), never on which replica or batch served it.
+    """
+
+    def __init__(self, engine_factory, fleet=None, injector=None,
+                 telemetry=None, clock=None):
+        cfg = fleet if isinstance(fleet, FleetConfig) \
+            else FleetConfig(fleet or {})
+        self.fleet = cfg
+        self._factory = engine_factory
+        self._clock = clock if clock is not None else time.monotonic
+        self._telemetry = telemetry
+        self.injector = injector if injector is not None \
+            else FaultInjector.from_config(cfg.fault_injection)
+        self.replicas: Dict[str, _Replica] = {}
+        self.requests: Dict[Any, _FleetRequest] = {}
+        self.pending = deque()          # req_ids awaiting (re)dispatch
+        self.finished: Dict[Any, List[int]] = {}
+        self.terminated: Dict[Any, RequestResult] = {}
+        self.tracer = RequestTracer(clock=self._clock)
+        self.draining = False
+        self.steps = 0
+        self.stats = {"submitted": 0, "finished": 0, "terminated": 0,
+                      "shed": 0, "deadline": 0, "redispatches": 0,
+                      "spills": 0, "dispatch_faults": 0, "kills": 0,
+                      "fences": 0, "respawns": 0, "scale_ups": 0,
+                      "scale_downs": 0}
+        self._gens: Dict[str, int] = {}     # replica_id -> spawn generation
+        self._next_rid = 0
+        self._target = int(cfg.replicas)
+        self._last_shed_total = 0
+        self._autoscaler = ReplicaAutoscaler(
+            min_replicas=int(cfg.min_replicas),
+            max_replicas=int(cfg.max_replicas),
+            scale_up_queue_per_replica=int(cfg.scale_up_queue_per_replica),
+            scale_down_queue_per_replica=int(
+                cfg.scale_down_queue_per_replica),
+            free_page_low_frac=float(cfg.free_page_low_frac),
+            cooldown_sweeps=int(cfg.cooldown_sweeps)) \
+            if cfg.autoscale else None
+        # the routing key hashes the first N prompt tokens; N defaults to
+        # one KV page so the key matches exactly the prefix-cache chain
+        # key of the request's first page
+        self._route_tokens = int(cfg.route_prefix_tokens)
+        self._route_root = hashlib.blake2b(
+            b"ds:fleet-route", digest_size=16).digest()
+        for _ in range(int(cfg.replicas)):
+            self._spawn()
+        self.attach_exporter()
+
+    # -- plumbing --------------------------------------------------------
+    def _tel(self):
+        tel = self._telemetry if self._telemetry is not None \
+            else get_telemetry()
+        return tel if (tel is not None and tel.enabled) else None
+
+    def _fleet_event(self, name, **attrs):
+        tel = self._tel()
+        if tel is not None:
+            tel.fleet(name, step=self.steps,
+                      attrs={k: v for k, v in attrs.items()
+                             if v is not None} or None)
+
+    def attach_exporter(self):
+        """Bind this router's :meth:`health` behind the telemetry
+        exporter's ``GET /fleet`` endpoint (no-op without an exporter)."""
+        tel = self._telemetry if self._telemetry is not None \
+            else get_telemetry()
+        exporter = getattr(tel, "exporter", None)
+        if exporter is not None:
+            exporter.fleet_fn = self.health
+
+    # -- replica lifecycle ----------------------------------------------
+    def _spawn(self, replica_id=None, respawn=False):
+        rid = replica_id
+        if rid is None:
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+        gen = self._gens.get(rid, -1) + 1
+        self._gens[rid] = gen
+        epoch = f"{rid}g{gen}"
+        engine = self._factory(rid, epoch)
+        rep = _Replica(rid, epoch, engine)
+        self.replicas[rid] = rep
+        if self._route_tokens == 0:
+            self._route_tokens = int(engine.page_size)
+        if respawn:
+            self.stats["respawns"] += 1
+        self._fleet_event("fleet/respawn" if respawn else "fleet/spawn",
+                          replica=rid, epoch=epoch)
+        return rep
+
+    def _healthy(self) -> List[_Replica]:
+        return [r for r in self.replicas.values() if r.state == "healthy"]
+
+    def _retire(self, rep: _Replica):
+        """Drop a replica from the routing ring (engine already drained
+        or abandoned); its fleet requests must have been re-homed."""
+        self.replicas.pop(rep.replica_id, None)
+
+    def _requeue_owned(self, rep: _Replica) -> List[Any]:
+        """Every fleet request dispatched to ``rep`` goes back to pending
+        (redispatch-from-scratch) — or to a typed terminal when its
+        redispatch budget is spent."""
+        moved = []
+        for fr in self.requests.values():
+            if fr.state == "dispatched" and \
+                    fr.replica_id == rep.replica_id:
+                self._requeue(fr)
+                moved.append(fr.req_id)
+        return moved
+
+    def _requeue(self, fr: _FleetRequest):
+        if fr.dispatches > int(self.fleet.redispatch_max):
+            self._shed_terminal(
+                fr, SHED_REDISPATCH_BUDGET,
+                detail=f"{fr.dispatches} dispatches exhausted the "
+                       f"redispatch budget {self.fleet.redispatch_max}")
+            return
+        fr.state = "pending"
+        fr.replica_id = None
+        self.pending.append(fr.req_id)
+        if fr.dispatches:
+            self.stats["redispatches"] += 1
+            self._fleet_event("fleet/redispatch", req_id=fr.req_id,
+                              dispatches=fr.dispatches)
+
+    def kill_replica(self, replica_id, detail="killed"):
+        """Abrupt replica death (the ``replica_kill`` injector path, also
+        callable directly from tests/chaos drills): NO drain — the engine
+        is dropped mid-flight and every request it owned is redispatched
+        from scratch to the surviving ring."""
+        rep = self.replicas.get(replica_id)
+        if rep is None or rep.state == "dead":
+            return
+        rep.state = "dead"
+        self.stats["kills"] += 1
+        moved = self._requeue_owned(rep)
+        logger.warning(
+            f"fleet: replica {replica_id} ({rep.epoch}) killed: {detail}; "
+            f"redispatching {len(moved)} requests")
+        self._fleet_event("fleet/kill", replica=replica_id,
+                          epoch=rep.epoch, redispatched=len(moved),
+                          detail=detail)
+        self._retire(rep)
+
+    def _fence(self, rep: _Replica, why: str):
+        """Graceful failover: stop routing to the replica, drain it (its
+        finished work is delivered, its shed work redispatched), then
+        retire it.  The respawn happens on the next ``step``."""
+        rep.state = "fenced"
+        self.stats["fences"] += 1
+        self._fleet_event("fleet/fence", replica=rep.replica_id,
+                          epoch=rep.epoch, reason=why)
+        try:
+            res = rep.engine.drain()
+        except Exception as e:   # a broken drain degrades to a kill
+            rep.state = "healthy"   # let kill_replica see it live
+            self.kill_replica(rep.replica_id,
+                              detail=f"drain failed while fencing: {e}")
+            return
+        self._collect_finished(rep, res["finished"])
+        self._collect_terminated(rep)
+        self._fleet_event("fleet/drain", replica=rep.replica_id,
+                          finished=len(res["finished"]),
+                          shed=len(res["shed"]), steps=res["steps"])
+        self._requeue_owned(rep)
+        self._retire(rep)
+
+    # -- routing ---------------------------------------------------------
+    def _route_key(self, prompt: List[int]) -> bytes:
+        """Rolling blake2b chain over the first ``route_prefix_tokens``
+        prompt tokens — the prefix-cache chain-key idiom, so shared
+        prefixes share a routing key."""
+        h = hashlib.blake2b(self._route_root, digest_size=16)
+        n = self._route_tokens or len(prompt)
+        h.update(np.asarray(prompt[:n], np.int64).tobytes())
+        return h.digest()
+
+    def _pick(self, key: bytes) -> Optional[_Replica]:
+        """Rendezvous hashing: highest ``blake2b(key ‖ replica_id)``
+        among healthy replicas.  Membership changes only remap keys whose
+        winner died; a respawn under the same replica_id re-takes its
+        slot."""
+        best, best_score = None, None
+        for rep in self._healthy():
+            h = hashlib.blake2b(key, digest_size=8)
+            h.update(rep.replica_id.encode())
+            score = (int.from_bytes(h.digest(), "big"), rep.replica_id)
+            if best_score is None or score > best_score:
+                best, best_score = rep, score
+        return best
+
+    def _dispatch(self, fr: _FleetRequest) -> bool:
+        """One dispatch attempt.  The injector is consulted BEFORE the
+        routing table or any engine mutates (the page_alloc atomicity
+        idiom): a fault here leaves the request exactly as it was and it
+        retries on the next step.  Returns True when the request left the
+        pending state (dispatched OR typed into a terminal)."""
+        if self.injector is not None:
+            self.injector.check("route_dispatch")
+        now = self._clock()
+        if fr.deadline and now >= fr.deadline:
+            self._deadline_terminal(fr)
+            return True
+        target = self._pick(fr.route_key)
+        if target is None:
+            return False                 # no healthy replicas right now
+        # affinity target first; spill order by least load
+        order = [target] + sorted(
+            (r for r in self._healthy() if r is not target),
+            key=lambda r: (len(r.engine.queue) + r.engine.n_active,
+                           r.replica_id))
+        rejects = []
+        for i, rep in enumerate(order):
+            kwargs = dict(fr.kwargs)
+            if fr.deadline:
+                kwargs["deadline_s"] = fr.deadline - now
+            try:
+                rep.engine.add_request(fr.req_id, fr.prompt, **kwargs)
+            except RequestRejected as e:
+                rejects.append(e)
+                continue
+            fr.state = "dispatched"
+            fr.replica_id = rep.replica_id
+            fr.dispatches += 1
+            if i > 0:
+                self.stats["spills"] += 1
+                self._fleet_event("fleet/spill", req_id=fr.req_id,
+                                  replica=rep.replica_id,
+                                  affinity=target.replica_id)
+            self._fleet_event("fleet/route", req_id=fr.req_id,
+                              replica=rep.replica_id,
+                              dispatches=fr.dispatches)
+            return True
+        # every healthy replica said no — a request-indicting reason
+        # terminates (another replica would say the same); overload keeps
+        # it pending for the next step
+        fatal = next((e for e in rejects if e.reason in _FATAL_REJECTS),
+                     None)
+        if fatal is not None:
+            self._shed_terminal(fr, fatal.reason, detail=fatal.detail)
+            return True
+        return False
+
+    def _pump_pending(self):
+        """Try to place every pending request; whatever cannot be placed
+        (injected dispatch fault, fleet-wide overload, no healthy
+        replicas) stays pending for the next step."""
+        for _ in range(len(self.pending)):
+            rid = self.pending.popleft()
+            fr = self.requests[rid]
+            if fr.state != "pending":
+                continue
+            try:
+                placed = self._dispatch(fr)
+            except Exception as e:      # injected route_dispatch fault
+                self.stats["dispatch_faults"] += 1
+                self._fleet_event("fleet/dispatch_fault", req_id=rid,
+                                  error=str(e))
+                self.pending.append(rid)
+                continue
+            if not placed:
+                self.pending.append(rid)
+
+    # -- terminals -------------------------------------------------------
+    def _finish_fleet(self, fr: _FleetRequest, tokens: List[int]):
+        fr.state = "finished"
+        self.finished[fr.req_id] = tokens
+        self.stats["finished"] += 1
+        self.tracer.terminal(
+            fr.req_id, "finish",
+            n_generated=max(0, len(tokens) - len(fr.prompt)))
+
+    def _shed_terminal(self, fr: _FleetRequest, reason: str,
+                       detail: str = ""):
+        fr.state = "terminated"
+        self.terminated[fr.req_id] = RequestResult(
+            fr.req_id, "shed", reason, detail=detail)
+        self.stats["terminated"] += 1
+        self.stats["shed"] += 1
+        self.tracer.terminal(fr.req_id, "shed", reason=reason)
+        self._fleet_event("fleet/shed", req_id=fr.req_id, reason=reason)
+
+    def _deadline_terminal(self, fr: _FleetRequest,
+                           result: Optional[RequestResult] = None):
+        fr.state = "terminated"
+        self.terminated[fr.req_id] = result if result is not None else \
+            RequestResult(fr.req_id, "deadline", SHED_DEADLINE,
+                          detail="expired before dispatch")
+        self.stats["terminated"] += 1
+        self.stats["deadline"] += 1
+        self.tracer.terminal(
+            fr.req_id, "deadline",
+            n_generated=result.n_generated if result else 0,
+            reason=SHED_DEADLINE)
+
+    def _collect_finished(self, rep: _Replica, done: Dict[Any, List[int]]):
+        for rid, tokens in done.items():
+            fr = self.requests.get(rid)
+            if fr is not None and fr.state == "dispatched" and \
+                    fr.replica_id == rep.replica_id:
+                self._finish_fleet(fr, tokens)
+
+    def _collect_terminated(self, rep: _Replica):
+        """Fold one replica's typed terminals into fleet state: deadlines
+        are final (the TTL is absolute), everything else — shed, evicted,
+        drained — is the REPLICA's fault, so the request redispatches
+        while its budget lasts."""
+        for rid, result in rep.engine.pop_terminated().items():
+            fr = self.requests.get(rid)
+            if fr is None or fr.state != "dispatched" or \
+                    fr.replica_id != rep.replica_id:
+                continue
+            if result.status == "deadline":
+                self._deadline_terminal(fr, result)
+            else:
+                self._requeue(fr)
+
+    # -- public surface --------------------------------------------------
+    def submit(self, req_id, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0, seed: int = 0, top_k: int = 0,
+               top_p: float = 1.0, deadline_s: Optional[float] = None):
+        """Register one request with the fleet and try to place it.
+        Raises typed :class:`RequestRejected` only for conditions the
+        fleet can see without an engine (duplicate id, draining); every
+        other failure mode resolves asynchronously into a typed terminal
+        in :meth:`pop_terminated` — nothing is ever silently dropped."""
+        if self.draining:
+            raise RequestRejected(req_id, REJECT_DRAINING,
+                                  "fleet is draining; admission stopped")
+        if req_id in self.requests:
+            raise RequestRejected(req_id, REJECT_DUPLICATE,
+                                  "req_id already submitted to the fleet")
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        now = self._clock()
+        fr = _FleetRequest(
+            req_id, prompt,
+            kwargs=dict(max_new_tokens=int(max_new_tokens),
+                        temperature=float(temperature), seed=int(seed),
+                        top_k=int(top_k), top_p=float(top_p)),
+            route_key=self._route_key(prompt),
+            deadline=(now + deadline_s) if deadline_s else 0.0)
+        self.requests[req_id] = fr
+        self.pending.append(req_id)
+        self.stats["submitted"] += 1
+        self.tracer.admit(req_id, deadline=fr.deadline, now=now)
+        self._pump_pending()
+
+    def step(self) -> Dict[Any, List[int]]:
+        """Advance the whole fleet: retry pending dispatches, step every
+        replica (an engine that raises is killed and its requests
+        redispatched), fold terminals, run the supervision sweep on its
+        interval, and respawn up to the target replica count.  Returns
+        the requests that finished THIS step (req_id → full tokens), like
+        ``ServingEngine.step``."""
+        self.steps += 1
+        self._pump_pending()
+        done_now: Dict[Any, List[int]] = {}
+        for rep in list(self.replicas.values()):
+            if rep.state != "healthy":
+                continue
+            try:
+                done = rep.engine.step()
+            except Exception as e:
+                self.kill_replica(rep.replica_id,
+                                  detail=f"step raised: {e}")
+                continue
+            before = set(self.finished)
+            self._collect_finished(rep, done)
+            self._collect_terminated(rep)
+            for rid in set(self.finished) - before:
+                done_now[rid] = self.finished[rid]
+        if self.steps % int(self.fleet.health_interval) == 0:
+            self._supervise()
+        self._ensure_target()
+        return done_now
+
+    def pop_terminated(self) -> Dict[Any, RequestResult]:
+        """Hand back (and clear) every fleet-level typed terminal since
+        the last call (deadline expiries, redispatch-budget sheds,
+        drain sheds)."""
+        out = self.terminated
+        self.terminated = {}
+        return out
+
+    def join(self, max_steps: int = 10_000) -> Dict[Any, List[int]]:
+        """Step until every submitted request reaches a terminal (or the
+        step budget runs out); returns everything finished meanwhile."""
+        done: Dict[Any, List[int]] = {}
+        for _ in range(max_steps):
+            if not self._unresolved():
+                break
+            done.update(self.step())
+        return done
+
+    def _unresolved(self) -> int:
+        return sum(1 for fr in self.requests.values()
+                   if fr.state in ("pending", "dispatched"))
+
+    # -- supervision -----------------------------------------------------
+    def _supervise(self):
+        for rep in list(self.replicas.values()):
+            if rep.state != "healthy":
+                continue
+            if self.injector is not None:
+                try:
+                    self.injector.check("replica_kill")
+                except Exception as e:
+                    self.kill_replica(rep.replica_id, detail=str(e))
+                    continue
+            try:
+                leaks = rep.engine.leak_report()
+                storm = bool(rep.engine.health().get("recompile_storm"))
+            except Exception as e:
+                self.kill_replica(rep.replica_id,
+                                  detail=f"health check raised: {e}")
+                continue
+            if leaks:
+                self._fence(rep, f"leak_report: {sorted(leaks)}")
+            elif storm:
+                self._fence(rep, "recompile_storm")
+        self._autoscale()
+
+    def _autoscale(self):
+        if self._autoscaler is None:
+            return
+        healthy = self._healthy()
+        queue_depth = len(self.pending) + sum(
+            len(r.engine.queue) for r in healthy)
+        shed_total = self.stats["shed"] + sum(
+            r.engine.stats["shed"] for r in healthy)
+        shed_delta = max(0, shed_total - self._last_shed_total)
+        self._last_shed_total = shed_total
+        fracs = [r.engine.alloc.free_page_count /
+                 max(1, r.engine.alloc.num_pages - 1) for r in healthy]
+        desired = self._autoscaler.decide(
+            max(1, len(healthy)), queue_depth=queue_depth,
+            shed_delta=shed_delta,
+            free_page_frac=min(fracs) if fracs else 1.0)
+        if desired > self._target:
+            self.stats["scale_ups"] += 1
+            self._fleet_event("fleet/scale_up", replicas=desired,
+                              queue_depth=queue_depth)
+        elif desired < self._target:
+            self.stats["scale_downs"] += 1
+            self._fleet_event("fleet/scale_down", replicas=desired,
+                              queue_depth=queue_depth)
+            # retire the least-loaded healthy replica gracefully
+            victim = min(
+                self._healthy(),
+                key=lambda r: (len(r.engine.queue) + r.engine.n_active,
+                               r.replica_id),
+                default=None)
+            if victim is not None:
+                self._fence(victim, "scale_down")
+        self._target = desired
+
+    def _ensure_target(self):
+        """Respawn (dead ring slots first, so rendezvous affinity is
+        restored) until the fleet is back at the target size."""
+        floor = max(int(self.fleet.min_replicas), self._target)
+        while len(self.replicas) < floor:
+            dead = sorted(set(self._gens) - set(self.replicas))
+            self._spawn(replica_id=dead[0] if dead else None,
+                        respawn=bool(dead))
+
+    # -- lifecycle / introspection ---------------------------------------
+    def drain(self) -> Dict[str, Any]:
+        """Quiesce the whole fleet: stop admission, drain every replica
+        (delivering what finishes), then shed whatever is still pending
+        — every submitted request ends in ``finished`` or a typed
+        terminal."""
+        self.draining = True
+        finished: Dict[Any, List[int]] = {}
+        for rep in list(self.replicas.values()):
+            if rep.state != "healthy":
+                continue
+            before = set(self.finished)
+            self._fence(rep, "fleet drain")
+            for rid in set(self.finished) - before:
+                finished[rid] = self.finished[rid]
+        shed_ids = []
+        for rid in list(self.pending):
+            fr = self.requests[rid]
+            if fr.state == "pending":
+                self._shed_terminal(fr, SHED_DRAIN,
+                                    detail="shed by fleet drain()")
+                shed_ids.append(rid)
+        self.pending.clear()
+        return {"finished": finished, "shed": shed_ids,
+                "health": self.health()}
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet snapshot: per-replica supervision state + condensed
+        engine health, aggregate load, counters, and the fleet-level
+        trace ledger.  Aggregate gauges are mirrored onto the telemetry
+        registry (``fleet/*``) and the whole dict is served by the
+        exporter's ``GET /fleet``."""
+        per_replica = {}
+        queue_depth = len(self.pending)
+        for rep in self.replicas.values():
+            eng = rep.engine
+            per_replica[rep.replica_id] = {
+                "state": rep.state,
+                "epoch": rep.epoch,
+                "queue_depth": len(eng.queue),
+                "active_slots": eng.n_active,
+                "free_pages": eng.alloc.free_page_count,
+                "prefix_hit_rate": (
+                    eng.prefix_cache.snapshot()["hit_rate"]
+                    if eng.prefix_cache is not None else None),
+            }
+            queue_depth += len(eng.queue)
+        snap = {
+            "replicas": per_replica,
+            "n_replicas": len(self.replicas),
+            "n_healthy": len(self._healthy()),
+            "target_replicas": self._target,
+            "pending": len(self.pending),
+            "in_flight": self._unresolved(),
+            "queue_depth": queue_depth,
+            "draining": self.draining,
+            "counters": dict(self.stats),
+            "traces": {"open": len(self.tracer.open),
+                       "admitted": self.tracer.admitted,
+                       "closed": self.tracer.closed,
+                       "terminals": dict(self.tracer.terminals)},
+        }
+        tel = self._tel()
+        if tel is not None:
+            for gauge, key in (("fleet/replicas", "n_replicas"),
+                               ("fleet/healthy", "n_healthy"),
+                               ("fleet/pending", "pending"),
+                               ("fleet/queue_depth", "queue_depth")):
+                tel.registry.gauge(gauge).set(snap[key])
+            tel.registry.gauge("fleet/redispatches").set(
+                self.stats["redispatches"])
+        return snap
+
+    def leak_report(self) -> Dict[str, Any]:
+        """Fleet invariant audit, {} when clean: every live replica's own
+        ``leak_report()`` (keys prefixed ``<replica_id>:``), the
+        fleet-level trace-completeness audit, and the bookkeeping
+        identity submitted == finished + terminated + unresolved."""
+        leaks: Dict[str, Any] = {}
+        for rep in self.replicas.values():
+            for k, v in rep.engine.leak_report().items():
+                leaks[f"{rep.replica_id}:{k}"] = v
+        live = [fr.req_id for fr in self.requests.values()
+                if fr.state in ("pending", "dispatched")]
+        leaks.update(self.tracer.audit(live))
+        resolved = self.stats["finished"] + self.stats["terminated"]
+        if self.stats["submitted"] != resolved + self._unresolved():
+            leaks["fleet_count_mismatch"] = {
+                "submitted": self.stats["submitted"],
+                "finished": self.stats["finished"],
+                "terminated": self.stats["terminated"],
+                "unresolved": self._unresolved()}
+        return leaks

@@ -189,12 +189,19 @@ class ServingRobustnessConfig(DeepSpeedConfigModel):
     # content-hashed KV-page reuse (inference/prefix_cache.py):
     # {"enabled": bool, "max_cached_pages": int, "min_prefix_tokens": int}
     prefix_cache = {}
+    # multi-replica fleet front-end (inference/fleet.py): replicas /
+    # min_replicas / max_replicas, health_interval, redispatch_max,
+    # autoscale thresholds.  Ignored by a bare ServingEngine.
+    fleet = {}
 
     def _validate(self):
         if isinstance(self.prefix_cache, dict):
             from deepspeed_tpu.inference.prefix_cache import \
                 PrefixCacheConfig
             self.prefix_cache = PrefixCacheConfig(self.prefix_cache)
+        if isinstance(self.fleet, dict):
+            from deepspeed_tpu.inference.fleet import FleetConfig
+            self.fleet = FleetConfig(self.fleet)
         if self.overload_policy not in OVERLOAD_POLICIES:
             raise ValueError(
                 f"serving.overload_policy must be one of {OVERLOAD_POLICIES}")
@@ -311,10 +318,17 @@ class RequestTracer:
     a double admit, a terminal on an unknown/closed request, an open trace
     with no live owner — are recorded and surfaced by :meth:`audit`, which
     ``ServingEngine.leak_report()`` folds in, so trace leaks fail the same
-    invariant sweep page leaks do."""
+    invariant sweep page leaks do.
 
-    def __init__(self, clock=None, max_completed=4096):
+    ``epoch`` namespaces every request id: under a fleet front-end the
+    same id legitimately reappears on a respawned replica (redispatch
+    after a kill), and without the namespace a merged audit would read
+    that as a double admit.  Ids in reports keep the ``epoch:id`` form so
+    the replica generation stays visible."""
+
+    def __init__(self, clock=None, max_completed=4096, epoch=None):
         self._clock = clock if clock is not None else time.monotonic
+        self.epoch = epoch
         self.open: Dict[Any, RequestTrace] = {}
         # bounded retention: a long-running server must not accumulate a
         # trace per request forever — the counters below stay exact
@@ -324,44 +338,53 @@ class RequestTracer:
         self.terminals = {t: 0 for t in TRACE_TERMINALS}
         self.errors: List[str] = []
 
+    def _key(self, req_id):
+        """The id this tracer books under — ``"epoch:id"`` when the owner
+        is an epoch-stamped fleet replica, the raw id otherwise."""
+        return req_id if self.epoch is None else f"{self.epoch}:{req_id}"
+
     def admit(self, req_id, deadline: float = 0.0,
               now: Optional[float] = None) -> RequestTrace:
         now = self._clock() if now is None else now
-        if req_id in self.open:
-            self.errors.append(f"double admit for {req_id!r}")
-            return self.open[req_id]
-        tr = RequestTrace(req_id, t_admit=now, deadline=float(deadline))
-        self.open[req_id] = tr
+        key = self._key(req_id)
+        if key in self.open:
+            self.errors.append(f"double admit for {key!r}")
+            return self.open[key]
+        tr = RequestTrace(key, t_admit=now, deadline=float(deadline))
+        self.open[key] = tr
         self.admitted += 1
         return tr
 
     def prefill_start(self, req_id, slot: int) -> Optional[RequestTrace]:
-        tr = self.open.get(req_id)
+        key = self._key(req_id)
+        tr = self.open.get(key)
         if tr is None:
-            self.errors.append(f"prefill_start for untracked {req_id!r}")
+            self.errors.append(f"prefill_start for untracked {key!r}")
             return None
         tr.slot = int(slot)
         tr.t_prefill_start = self._clock()
         return tr
 
     def first_token(self, req_id) -> Optional[RequestTrace]:
-        tr = self.open.get(req_id)
+        key = self._key(req_id)
+        tr = self.open.get(key)
         if tr is None:
-            self.errors.append(f"first_token for untracked {req_id!r}")
+            self.errors.append(f"first_token for untracked {key!r}")
             return None
         tr.t_first_token = self._clock()
         return tr
 
     def terminal(self, req_id, terminal: str, n_generated: int = 0,
                  reason: str = "") -> Optional[RequestTrace]:
+        key = self._key(req_id)
         if terminal not in TRACE_TERMINALS:
             self.errors.append(
-                f"unknown terminal {terminal!r} for {req_id!r}")
+                f"unknown terminal {terminal!r} for {key!r}")
             return None
-        tr = self.open.pop(req_id, None)
+        tr = self.open.pop(key, None)
         if tr is None:
             self.errors.append(
-                f"terminal {terminal!r} for closed/unknown {req_id!r}")
+                f"terminal {terminal!r} for closed/unknown {key!r}")
             return None
         tr.terminal = terminal
         tr.t_terminal = self._clock()
@@ -376,7 +399,7 @@ class RequestTracer:
         """Trace-completeness invariant sweep.  ``live_req_ids`` is every
         request currently queued or active in the engine; returns {} when
         clean, else typed leak entries (the ``leak_report()`` shape)."""
-        live = set(live_req_ids)
+        live = {self._key(r) for r in live_req_ids}
         leaks: Dict[str, Any] = {}
         orphans = sorted(set(self.open) - live, key=str)
         if orphans:

@@ -94,14 +94,18 @@ class ServingEngine:
                  max_seq: int = 2048, dtype=jnp.bfloat16,
                  eos_token_id: Optional[int] = None, tp_size: int = 1,
                  ep_size: int = 1, decode_chunk: int = 1,
-                 serving=None, telemetry=None, injector=None, clock=None):
+                 serving=None, telemetry=None, injector=None, clock=None,
+                 replica_epoch=None):
         """``serving``: a :class:`ServingRobustnessConfig` or its dict —
         defaults keep pre-hardening behaviour (unbounded queue, no
         deadlines).  ``injector``: a ``FaultInjector`` for the serving
         sites (built from ``serving.fault_injection`` when omitted).
         ``clock``: monotonic-seconds callable, injectable so deadline
         tests don't sleep.  ``telemetry``: explicit Telemetry instance;
-        None uses the process singleton at event time."""
+        None uses the process singleton at event time.  ``replica_epoch``:
+        set by the fleet front-end — namespaces request ids in the tracer
+        so a respawned replica re-serving a redispatched id cannot read as
+        a double admit in a merged audit."""
         self.model = model
         self.config = model.config
         self.max_batch = max_batch
@@ -234,7 +238,8 @@ class ServingEngine:
         # deadline machinery — always on (host dict ops), so the
         # trace-completeness invariant in leak_report() holds even with
         # telemetry disabled
-        self.tracer = RequestTracer(clock=self._clock)
+        self.replica_epoch = replica_epoch
+        self.tracer = RequestTracer(clock=self._clock, epoch=replica_epoch)
         self._consec_step_faults = 0
         self.draining = False
         self.stats = {"admitted": 0, "rejected": 0, "shed": 0,

@@ -15,6 +15,9 @@ can observe a running job without touching its JSONL files:
   telemetry: per-rank shards merged by ``monitor/aggregate.py`` into
   skew, comm-bandwidth, and straggler tables); 404 when the exporter has
   no aggregator (single-rank / distributed block off).
+* ``GET /fleet``         — serving-fleet health snapshot (per-replica
+  supervision states + aggregate load) once a ``FleetRouter`` has called
+  ``attach_exporter``; 404 until then.
 * ``GET /healthz``       — liveness probe, ``{"ok": true}``; when the
   profiling plane is on it also carries ``recompile_storm`` (the
   CompileWatcher's live storm verdict).
@@ -137,6 +140,18 @@ class _Handler(BaseHTTPRequestHandler):
                 except Exception as e:   # aggregation must not 500 a scrape
                     self._reply(503, json.dumps({"error": str(e)}),
                                 "application/json")
+        elif path == "/fleet":
+            if self.exporter.fleet_fn is None:
+                self._reply(404, '{"error": "no fleet router"}',
+                            "application/json")
+            else:
+                try:
+                    body = json.dumps(self.exporter.fleet_fn(),
+                                      default=str)
+                    self._reply(200, body, "application/json")
+                except Exception as e:   # a snapshot must not 500 a scrape
+                    self._reply(503, json.dumps({"error": str(e)}),
+                                "application/json")
         elif path == "/healthz":
             health = {"ok": True}
             # profiling plane: liveness scrapers get the recompile-storm
@@ -169,12 +184,15 @@ class MetricsExporter:
     """
 
     def __init__(self, telemetry, host="127.0.0.1", port=9866, labels=None,
-                 cluster_fn=None):
+                 cluster_fn=None, fleet_fn=None):
         self.telemetry = telemetry
         # distributed mode: per-sample labels ({"rank": "0"}) and the
         # shard aggregator behind GET /cluster
         self.labels = dict(labels) if labels else None
         self.cluster_fn = cluster_fn
+        # serving fleet: FleetRouter.attach_exporter() binds its health
+        # snapshot behind GET /fleet; 404 until a router registers
+        self.fleet_fn = fleet_fn
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True

@@ -41,7 +41,7 @@ from deepspeed_tpu.utils.logging import logger
 # The closed set of event kinds.  Adding a kind means updating the frozen
 # schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
 EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
-               "meta", "fault", "serve", "compile")
+               "meta", "fault", "serve", "compile", "fleet")
 
 
 def _profiler_annotation(name):
@@ -461,6 +461,16 @@ class Telemetry:
             return
         self.registry.counter(f"{name}/count").inc()
         self.emit("serve", name, step=step, attrs=attrs or None)
+
+    def fleet(self, name, step=None, attrs=None):
+        """Structured fleet-routing event (inference/fleet.py): replica
+        spawns/kills/fences, routed dispatches, spills, redispatches,
+        drains, respawns, and autoscale decisions.  Like :meth:`serve`,
+        each also bumps counter ``<name>/count``."""
+        if not self.enabled:
+            return
+        self.registry.counter(f"{name}/count").inc()
+        self.emit("fleet", name, step=step, attrs=attrs or None)
 
     def comm(self, op_name, size_bytes, axis):
         """Per-op comm census (trace-time: a shape traces once, executes

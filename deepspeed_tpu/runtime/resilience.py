@@ -173,9 +173,14 @@ _EXC_TABLE = {
 # configs stay forward-compatible with new sites.  serve_step / serve_sample
 # / page_alloc are the serving-side sites (inference/robustness.py): the
 # whole-batch decode dispatch, the per-request host sampler, and the KV
-# page allocator.
+# page allocator.  replica_kill / route_dispatch are the fleet-level sites
+# (inference/fleet.py): abrupt replica death during a supervision sweep,
+# and the routing-table dispatch — consulted BEFORE any routing state
+# mutates, so a faulted dispatch never half-registers a request (the
+# page_alloc atomicity idiom).
 FAULT_SITES = ("ckpt_save", "ckpt_load", "fs", "dataloader_next",
-               "serve_step", "serve_sample", "page_alloc")
+               "serve_step", "serve_sample", "page_alloc",
+               "replica_kill", "route_dispatch")
 
 
 class FaultInjector:

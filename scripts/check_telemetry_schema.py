@@ -117,6 +117,16 @@ SCHEMA = {
         "optional": {"dur_ms": _NUM, "cause": str, "window_s": _NUM,
                      "attrs": dict, "step": int},
     },
+    # fleet-routing events (inference/fleet.py FleetRouter): replica
+    # spawns/respawns, routed dispatches ("fleet/route"), affinity-miss
+    # spills, injected dispatch faults, redispatches after a replica
+    # failure, abrupt kills, fencing, graceful drains, fleet-level sheds
+    # (redispatch budget, fleet drain), and autoscale decisions.  The
+    # ``name`` field is validated against FLEET_EVENTS below.
+    "fleet": {
+        "required": {"ts": _NUM, "kind": str, "name": str},
+        "optional": {"attrs": dict, "step": int},
+    },
 }
 
 # FROZEN vocabulary of serve-kind event names — must stay byte-identical
@@ -149,6 +159,16 @@ SERVE_EVENTS = (
     "serve/request/first_token",
     "serve/request/finish", "serve/request/shed",
     "serve/request/deadline", "serve/request/evict",
+)
+
+# FROZEN vocabulary of fleet-kind event names — must stay byte-identical
+# to ``deepspeed_tpu.inference.fleet.FLEET_EVENTS`` (the tier-1 test
+# diffs the two).  Typed reasons / replica ids / epochs ride in attrs.
+FLEET_EVENTS = (
+    "fleet/spawn", "fleet/respawn", "fleet/route", "fleet/spill",
+    "fleet/dispatch_fault", "fleet/redispatch", "fleet/kill",
+    "fleet/fence", "fleet/drain", "fleet/shed",
+    "fleet/scale_up", "fleet/scale_down",
 )
 
 # Distributed (sharded) mode stamps every record with its origin rank so
@@ -226,6 +246,9 @@ def validate_event(event):
     if kind == "serve" and isinstance(event.get("name"), str) and \
             event["name"] not in SERVE_EVENTS:
         problems.append(f"serve: unknown event name {event['name']!r}")
+    if kind == "fleet" and isinstance(event.get("name"), str) and \
+            event["name"] not in FLEET_EVENTS:
+        problems.append(f"fleet: unknown event name {event['name']!r}")
     if kind == "comm" and isinstance(event.get("name"), str) and \
             event["name"] not in COMM_OPS:
         problems.append(f"comm: unknown collective {event['name']!r}")

@@ -88,6 +88,7 @@ def aggregate(events):
     stalls = []
     metas = []
     serves = {}      # event name -> {count, reasons: {reason: n}}
+    fleets = {}      # fleet event name -> {count, reasons, replicas}
     requests = []    # reconstructed serve/request/* lifecycle traces
     open_reqs = {}   # req_id -> index into requests (trace not yet closed)
     compiles = {"sites": {}, "storms": 0, "total_misses": 0}
@@ -144,6 +145,17 @@ def aggregate(events):
             stalls.append(ev)
         elif kind == "meta":
             metas.append(ev)
+        elif kind == "fleet":
+            rec = fleets.setdefault(ev["name"], {"count": 0, "reasons": {},
+                                                 "replicas": set()})
+            rec["count"] += 1
+            attrs = ev.get("attrs") or {}
+            reason = attrs.get("reason")
+            if reason:
+                rec["reasons"][reason] = rec["reasons"].get(reason, 0) + 1
+            replica = attrs.get("replica")
+            if replica:
+                rec["replicas"].add(str(replica))
         elif kind == "serve":
             rec = serves.setdefault(ev["name"], {"count": 0, "reasons": {}})
             rec["count"] += 1
@@ -197,8 +209,8 @@ def aggregate(events):
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "rank_steps": rank_steps,
             "steps": steps, "stalls": stalls,
-            "metas": metas, "serves": serves, "requests": requests,
-            "compiles": compiles}
+            "metas": metas, "serves": serves, "fleets": fleets,
+            "requests": requests, "compiles": compiles}
 
 
 def summarize(agg):
@@ -234,12 +246,18 @@ def summarize(agg):
         name: {"count": rec["count"],
                "reasons": dict(sorted(rec["reasons"].items()))}
         for name, rec in sorted(agg.get("serves", {}).items())}
+    fleet_rows = {
+        name: {"count": rec["count"],
+               "reasons": dict(sorted(rec["reasons"].items())),
+               "replicas": sorted(rec["replicas"])}
+        for name, rec in sorted(agg.get("fleets", {}).items())}
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
             "profiling": _profiling_summary(agg),
             "cluster": _cluster_summary(agg),
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
+            "fleet": fleet_rows,
             "serving_attention": _serving_attention_summary(agg),
             "prefix_cache": _prefix_cache_summary(agg),
             "request_latency": _request_latency_summary(agg),
@@ -548,6 +566,19 @@ def print_tables(summary, out=sys.stdout):
         for name, r in serving.items():
             reasons = ", ".join(f"{k}={v}" for k, v in r["reasons"].items())
             w(f"{name:<24}{r['count']:>7}  {reasons}\n")
+        w("\n")
+    fleet = summary.get("fleet")
+    if fleet:
+        w("== fleet events ==\n")
+        w(f"{'event':<24}{'count':>7}  replicas | reasons\n")
+        for name, r in fleet.items():
+            parts = []
+            if r["replicas"]:
+                parts.append(",".join(r["replicas"]))
+            if r["reasons"]:
+                parts.append(", ".join(f"{k}={v}"
+                                       for k, v in r["reasons"].items()))
+            w(f"{name:<24}{r['count']:>7}  {' | '.join(parts)}\n")
         w("\n")
     sa = summary.get("serving_attention")
     if sa:

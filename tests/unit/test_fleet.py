@@ -1,0 +1,454 @@
+"""Fleet serving resilience tests (inference/fleet.py): prefix-affinity
+routing, replica supervision, zero-loss failover under injected replica
+kills, dispatch atomicity, redispatch budgets, autoscaling, drain
+accounting, and the ``GET /fleet`` export surface.
+
+Oracle discipline (inherited from the serving hardening tests): a
+request's output depends only on (prompt, sampling params, seed) — never
+on which replica, batch, or dispatch attempt served it — so failover may
+RE-SERVE a request, never perturb one.  The acceptance scenario runs the
+same shared-prefix workload with and without an injected mid-flight
+``replica_kill`` and demands bit-identical finished outputs."""
+
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import ReplicaAutoscaler
+from deepspeed_tpu.inference.fleet import (FLEET_EVENTS, FleetConfig,
+                                           FleetRouter,
+                                           SHED_REDISPATCH_BUDGET)
+from deepspeed_tpu.inference.robustness import (REJECT_DRAINING,
+                                                REJECT_DUPLICATE,
+                                                RequestRejected,
+                                                RequestTracer,
+                                                ServingRobustnessConfig)
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+from deepspeed_tpu.runtime.resilience import FAULT_SITES, FaultInjector
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    return cfg, model, params
+
+
+def _factory(model, params, **overrides):
+    """An ``engine_factory`` for FleetRouter: identical engines (required
+    for bit-identical redispatch), prefix cache on, epoch plumbed."""
+    def build(replica_id, epoch):
+        kw = dict(max_batch=4, page_size=8, max_seq=128,
+                  dtype=jnp.float32, replica_epoch=epoch,
+                  serving={"prefix_cache": {"enabled": True}})
+        kw.update(overrides)
+        return ServingEngine(model, params, **kw)
+    return build
+
+
+def _family_prompts(cfg, n_families=6, per_family=2, prefix_len=24,
+                    suffix_len=4, seed=0):
+    """``n_families`` shared 24-token prefixes (3 KV pages at page_size=8)
+    with distinct short suffixes — the prefix-cache-friendly workload."""
+    rng = np.random.default_rng(seed)
+    fams = [rng.integers(0, cfg.vocab_size, (prefix_len,)).tolist()
+            for _ in range(n_families)]
+    prompts = {}
+    for fi, fam in enumerate(fams):
+        for j in range(per_family):
+            suffix = rng.integers(0, cfg.vocab_size, (suffix_len,)).tolist()
+            prompts[f"f{fi}q{j}"] = fam + suffix
+    return prompts
+
+
+def _assert_zero_loss(fleet, n_submitted):
+    """Every submitted id reaches exactly one terminal and nothing leaks."""
+    st = fleet.stats
+    assert st["submitted"] == n_submitted
+    assert st["finished"] + st["terminated"] == n_submitted
+    done = set(fleet.finished)
+    term = {rid for rid, fr in fleet.requests.items()
+            if fr.state == "terminated"}
+    assert done | term == set(fleet.requests)
+    assert not (done & term)
+    assert fleet.leak_report() == {}
+
+
+# ----------------------------------------------------------------------
+# config + frozen vocabularies
+# ----------------------------------------------------------------------
+def test_fleet_config_validation():
+    for bad in ({"replicas": 0}, {"health_interval": 0},
+                {"min_replicas": 2, "max_replicas": 1},
+                {"replicas": 5, "max_replicas": 4},
+                {"redispatch_max": -1}, {"free_page_low_frac": 1.5}):
+        with pytest.raises(ValueError):
+            FleetConfig(bad)
+    with pytest.raises(ValueError):
+        FleetConfig({"bogus_knob": 1}, strict=True)
+    # the serving config nests and promotes the fleet block
+    cfg = ServingRobustnessConfig({"fleet": {"replicas": 3,
+                                             "redispatch_max": 5}})
+    assert isinstance(cfg.fleet, FleetConfig)
+    assert cfg.fleet.replicas == 3 and cfg.fleet.redispatch_max == 5
+
+
+def test_fleet_fault_sites_frozen():
+    assert "replica_kill" in FAULT_SITES
+    assert "route_dispatch" in FAULT_SITES
+    assert len(FLEET_EVENTS) == len(set(FLEET_EVENTS))
+    assert all(name.startswith("fleet/") for name in FLEET_EVENTS)
+
+
+# ----------------------------------------------------------------------
+# tracer epoch namespacing (the respawn double-admit fix)
+# ----------------------------------------------------------------------
+def test_request_tracer_epoch_namespacing():
+    t0 = RequestTracer(clock=lambda: 0.0, epoch="r1g0")
+    t1 = RequestTracer(clock=lambda: 0.0, epoch="r1g1")
+    # the same redispatched id admits cleanly under each generation
+    t0.admit("q", now=0.0)
+    t1.admit("q", now=0.0)
+    assert t0.errors == [] and t1.errors == []
+    # audit maps live ids through the namespace before comparing
+    assert t0.audit(["q"]) == {}
+    t1.terminal("q", "shed", reason="fault")
+    assert t1.audit([]) == {}
+    # a genuine double admit WITHIN one epoch still trips, and the error
+    # keeps the epoch-qualified id so the generation stays visible
+    t0.admit("q", now=0.0)
+    assert any("r1g0:q" in e for e in t0.errors)
+
+
+# ----------------------------------------------------------------------
+# prefix-affinity routing
+# ----------------------------------------------------------------------
+def test_prefix_affinity_routing(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=6)
+
+    def owners():
+        fleet = FleetRouter(_factory(model, params),
+                            fleet={"replicas": 3, "max_replicas": 3})
+        for rid, p in sorted(prompts.items()):
+            fleet.submit(rid, p, max_new_tokens=2)
+        return {rid: fleet.requests[rid].replica_id for rid in prompts}
+
+    a, b = owners(), owners()
+    # routing is a pure function of (prompt prefix, healthy ring)
+    assert a == b
+    # same family -> same routing key -> same replica
+    for fi in range(6):
+        assert a[f"f{fi}q0"] == a[f"f{fi}q1"]
+    # rendezvous hashing actually spreads families across the ring
+    assert len(set(a.values())) >= 2
+
+
+def test_fleet_basic_serve_matches_single_engine(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=3)
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2, "max_replicas": 2})
+    for rid, p in sorted(prompts.items()):
+        fleet.submit(rid, p, max_new_tokens=4)
+    done = fleet.join()
+    _assert_zero_loss(fleet, len(prompts))
+
+    single = ServingEngine(model, params, max_batch=4, page_size=8,
+                           max_seq=128, dtype=jnp.float32)
+    for rid, p in sorted(prompts.items()):
+        single.add_request(rid, p, max_new_tokens=4)
+    alone = {}
+    while single.queue or single.n_active:
+        alone.update(single.step())
+    for rid in prompts:
+        assert done[rid] == alone[rid], rid
+
+    with pytest.raises(RequestRejected) as ei:
+        fleet.submit("f0q0", prompts["f0q0"], max_new_tokens=2)
+    assert ei.value.reason == REJECT_DUPLICATE
+
+
+# ----------------------------------------------------------------------
+# the acceptance scenario: injected replica kill mid-flight
+# ----------------------------------------------------------------------
+def test_acceptance_replica_kill_zero_loss_bit_identical(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=6)
+    seeds = sorted(r for r in prompts if r.endswith("q0"))
+    rest = sorted(r for r in prompts if not r.endswith("q0"))
+
+    def run(inject_kill):
+        fleet = FleetRouter(_factory(model, params),
+                            fleet={"replicas": 3, "max_replicas": 4,
+                                   "health_interval": 2,
+                                   "redispatch_max": 3})
+        # phase 1: seed each family's prefix into its affinity replica
+        for rid in seeds:
+            fleet.submit(rid, prompts[rid], max_new_tokens=4)
+        fleet.join(max_steps=400)
+        # phase 2: the shared-prefix followers, killed mid-flight
+        for rid in rest:
+            fleet.submit(rid, prompts[rid], max_new_tokens=4)
+        if inject_kill:
+            # aim the injector at whichever replica owns the most
+            # in-flight work: the supervision sweep consults the
+            # replica_kill site once per healthy replica in ring order,
+            # so fail_at=[index of the victim] kills it mid-flight
+            owned = {}
+            for fr in fleet.requests.values():
+                if fr.state == "dispatched":
+                    owned[fr.replica_id] = owned.get(fr.replica_id, 0) + 1
+            victim = max(sorted(owned), key=lambda r: owned[r])
+            order = list(fleet.replicas)
+            fleet.injector = FaultInjector(
+                {"replica_kill": {"fail_at": [order.index(victim)],
+                                  "msg": "injected chaos kill"}})
+            assert owned[victim] >= 1
+        fleet.join(max_steps=800)
+        return fleet
+
+    clean = run(False)
+    chaos = run(True)
+
+    # the kill fired mid-flight, work was re-homed, the slot respawned
+    assert chaos.stats["kills"] == 1
+    assert chaos.stats["redispatches"] >= 1
+    assert chaos.stats["respawns"] == 1
+    assert chaos.injector.calls("replica_kill") >= 1
+
+    # zero lost requests under chaos: every id reaches exactly one typed
+    # terminal, and generous budgets mean they all actually finish
+    for fleet in (clean, chaos):
+        _assert_zero_loss(fleet, len(prompts))
+    assert chaos.stats["terminated"] == 0
+
+    # bit-identity: surviving AND redispatched outputs match the
+    # no-fault run token for token
+    assert chaos.finished == clean.finished
+
+    # per-replica prefix hit rates stay at single-engine levels: replay
+    # the same seed-then-followers workload on one engine as the oracle
+    single = ServingEngine(model, params, max_batch=4, page_size=8,
+                           max_seq=128, dtype=jnp.float32,
+                           serving={"prefix_cache": {"enabled": True}})
+    for batch in (seeds, rest):
+        for rid in batch:
+            single.add_request(rid, prompts[rid], max_new_tokens=4)
+        while single.queue or single.n_active:
+            single.step()
+    single_rate = single.prefix_cache.snapshot()["hit_rate"]
+    assert single_rate > 0.3
+    rates = [r["prefix_hit_rate"]
+             for r in clean.health()["replicas"].values()]
+    assert rates and min(rates) >= single_rate - 0.05
+
+
+# ----------------------------------------------------------------------
+# dispatch atomicity (the page_alloc idiom at the route_dispatch site)
+# ----------------------------------------------------------------------
+def test_route_dispatch_fault_is_atomic(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=1, per_family=1)
+    (rid, prompt), = prompts.items()
+    fleet = FleetRouter(
+        _factory(model, params),
+        fleet={"replicas": 2, "max_replicas": 2},
+        injector=FaultInjector({"route_dispatch": {"fail_times": 2,
+                                                   "msg": "route chaos"}}))
+    fleet.submit(rid, prompt, max_new_tokens=4)
+    # the injected fault fired BEFORE any routing-table or engine
+    # mutation: nothing half-registered, the request is simply pending
+    assert fleet.stats["dispatch_faults"] == 1
+    fr = fleet.requests[rid]
+    assert fr.state == "pending" and fr.replica_id is None
+    assert fr.dispatches == 0
+    for rep in fleet.replicas.values():
+        assert len(rep.engine.queue) == 0 and rep.engine.n_active == 0
+    # retries burn the remaining fault then place and finish the request
+    done = fleet.join(max_steps=200)
+    assert set(done) == {rid}
+    assert fleet.stats["dispatch_faults"] == 2
+    assert fleet.injector.calls("route_dispatch") >= 3
+    _assert_zero_loss(fleet, 1)
+
+
+# ----------------------------------------------------------------------
+# redispatch budget: a bouncing request terminates typed, never silently
+# ----------------------------------------------------------------------
+def test_redispatch_budget_exhaustion_is_typed(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=1, per_family=1)
+    (rid, prompt), = prompts.items()
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 1, "max_replicas": 1,
+                               "redispatch_max": 0,
+                               "health_interval": 1})
+    fleet.submit(rid, prompt, max_new_tokens=8)
+    assert fleet.requests[rid].state == "dispatched"
+    fleet.kill_replica(next(iter(fleet.replicas)), detail="chaos drill")
+    # budget 0: the kill's requeue immediately types the request out
+    term = fleet.pop_terminated()
+    assert set(term) == {rid}
+    assert term[rid].status == "shed"
+    assert term[rid].reason == SHED_REDISPATCH_BUDGET
+    fleet.step()                      # supervision respawns the ring slot
+    assert len(fleet.replicas) == 1
+    assert next(iter(fleet.replicas.values())).epoch == "r0g1"
+    _assert_zero_loss(fleet, 1)
+    assert fleet.stats["terminated"] == 1
+
+
+# ----------------------------------------------------------------------
+# autoscaler: pure decisions, then wired through the fleet
+# ----------------------------------------------------------------------
+def test_replica_autoscaler_decisions():
+    a = ReplicaAutoscaler(min_replicas=1, max_replicas=3,
+                          scale_up_queue_per_replica=4,
+                          scale_down_queue_per_replica=1,
+                          cooldown_sweeps=2)
+    assert a.decide(1, queue_depth=8) == 2        # queue pressure
+    assert a.decide(2, queue_depth=9) == 2        # cooldown holds
+    assert a.decide(2, queue_depth=9) == 2        # still cooling
+    assert a.decide(2, queue_depth=9) == 3        # cooldown over
+    b = ReplicaAutoscaler(min_replicas=1, max_replicas=2,
+                          cooldown_sweeps=0)
+    assert b.decide(1, shed_delta=1) == 2         # shed pressure
+    c = ReplicaAutoscaler(min_replicas=1, max_replicas=2,
+                          cooldown_sweeps=0, free_page_low_frac=0.2)
+    assert c.decide(1, free_page_frac=0.1) == 2   # page pressure
+    d = ReplicaAutoscaler(min_replicas=1, max_replicas=3,
+                          cooldown_sweeps=0, scale_down_queue_per_replica=1)
+    assert d.decide(3, queue_depth=0) == 2        # idle drains one at a time
+    assert d.decide(1, queue_depth=0) == 1        # never below the floor
+    assert d.scale_downs >= 1
+    with pytest.raises(ValueError):
+        ReplicaAutoscaler(min_replicas=0)
+    with pytest.raises(ValueError):
+        ReplicaAutoscaler(min_replicas=4, max_replicas=2)
+
+
+def test_fleet_autoscales_up_under_pressure(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=5, per_family=2)
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 1, "min_replicas": 1,
+                               "max_replicas": 3, "health_interval": 1,
+                               "autoscale": True,
+                               "scale_up_queue_per_replica": 2,
+                               "cooldown_sweeps": 0})
+    for rid, p in sorted(prompts.items()):
+        fleet.submit(rid, p, max_new_tokens=4)
+    for _ in range(4):
+        fleet.step()
+    assert fleet.stats["scale_ups"] >= 1
+    assert len(fleet.replicas) >= 2
+    fleet.join()
+    _assert_zero_loss(fleet, len(prompts))
+
+
+# ----------------------------------------------------------------------
+# fleet drain: quiesce with everything accounted
+# ----------------------------------------------------------------------
+def test_fleet_drain_accounts_everything(tiny):
+    cfg, model, params = tiny
+    prompts = _family_prompts(cfg, n_families=4, per_family=2)
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2, "max_replicas": 2})
+    for rid, p in sorted(prompts.items()):
+        fleet.submit(rid, p, max_new_tokens=6)
+    fleet.step()
+    out = fleet.drain()
+    # every submitted id is in finished or a typed terminal — none lost
+    term = fleet.pop_terminated()
+    assert set(fleet.finished) | set(term) == set(prompts)
+    assert not (set(fleet.finished) & set(term))
+    assert set(out["shed"]) == set(term)
+    assert fleet.stats["finished"] + fleet.stats["terminated"] \
+        == len(prompts)
+    assert fleet.leak_report() == {}
+    assert out["health"]["draining"] is True
+    with pytest.raises(RequestRejected) as ei:
+        fleet.submit("late", prompts["f0q0"], max_new_tokens=2)
+    assert ei.value.reason == REJECT_DRAINING
+
+
+# ----------------------------------------------------------------------
+# observability: schema-valid fleet events + the /fleet endpoint
+# ----------------------------------------------------------------------
+def _load_checker():
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", "check_telemetry_schema.py")
+    spec = importlib.util.spec_from_file_location("check_telemetry_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_event_stream_is_schema_valid(tiny, tmp_path):
+    cfg, model, params = tiny
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "fleet"}), rank=0)
+    try:
+        prompts = _family_prompts(cfg, n_families=3)
+        fleet = FleetRouter(_factory(model, params),
+                            fleet={"replicas": 2, "max_replicas": 3,
+                                   "health_interval": 1},
+                            telemetry=tel)
+        for rid, p in sorted(prompts.items()):
+            fleet.submit(rid, p, max_new_tokens=4)
+        fleet.step()
+        fleet.kill_replica(next(iter(fleet.replicas)), detail="drill")
+        fleet.join()
+        fleet.health()
+        fleet.drain()
+    finally:
+        tel.close()
+    path = os.path.join(str(tmp_path), "fleet", "events.jsonl")
+    checker = _load_checker()
+    assert checker.validate_file(path) == []
+    with open(path) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    names = {e["name"] for e in events if e["kind"] == "fleet"}
+    assert {"fleet/spawn", "fleet/route", "fleet/kill",
+            "fleet/redispatch", "fleet/respawn"} <= names
+    assert names <= set(FLEET_EVENTS)
+
+
+def test_exporter_fleet_endpoint(tiny, tmp_path):
+    cfg, model, params = tiny
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "exp",
+         "export": {"enabled": True, "port": 0}}), rank=0)
+    try:
+        host, port = tel.exporter.address
+        base = f"http://{host}:{port}"
+        # no router attached yet -> typed 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/fleet")
+        assert ei.value.code == 404
+        fleet = FleetRouter(_factory(model, params),
+                            fleet={"replicas": 2, "max_replicas": 2},
+                            telemetry=tel)
+        with urllib.request.urlopen(base + "/fleet") as r:
+            snap = json.loads(r.read())
+        assert snap["n_replicas"] == 2 and snap["n_healthy"] == 2
+        assert set(snap["replicas"]) == set(fleet.replicas)
+    finally:
+        tel.close()

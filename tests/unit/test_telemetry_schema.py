@@ -42,6 +42,21 @@ def test_serve_event_names_in_lockstep(checker):
     assert checker.SERVE_EVENTS == SERVE_EVENTS
 
 
+def test_fleet_event_names_in_lockstep(checker):
+    """The frozen fleet-name vocabulary must stay byte-identical between
+    the router side (inference/fleet.py) and the checker script."""
+    from deepspeed_tpu.inference.fleet import FLEET_EVENTS
+    assert checker.FLEET_EVENTS == FLEET_EVENTS
+
+
+def test_rejects_unknown_fleet_name(checker):
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "fleet", "name": "fleet/not_a_thing"})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "fleet", "name": "fleet/kill",
+         "attrs": {"replica": "r1", "epoch": "r1g0"}, "step": 3})
+
+
 def test_comm_ops_in_lockstep(checker):
     """The frozen collective-name vocabulary must stay byte-identical
     between the engine side (comm/comm.py) and the checker script."""
@@ -158,6 +173,28 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.serve("serve/request/evict",
               attrs={"req_id": "r9", "slot": 2, "reason": "fault",
                      "n_generated": 1, "e2e_ms": 9.0})
+    # the fleet router's full vocabulary — every name the checker
+    # freezes must pass through the live emitter
+    tel.fleet("fleet/spawn", attrs={"replica": "r0", "epoch": "r0g0"})
+    tel.fleet("fleet/respawn", step=9,
+              attrs={"replica": "r1", "epoch": "r1g1"})
+    tel.fleet("fleet/route", attrs={"req_id": "f1", "replica": "r0",
+                                    "dispatches": 1})
+    tel.fleet("fleet/spill", attrs={"req_id": "f2", "replica": "r1",
+                                    "affinity": "r0"})
+    tel.fleet("fleet/dispatch_fault", attrs={"req_id": "f3",
+                                             "error": "inj"})
+    tel.fleet("fleet/redispatch", attrs={"req_id": "f1", "dispatches": 2})
+    tel.fleet("fleet/kill", attrs={"replica": "r1", "epoch": "r1g1",
+                                   "redispatched": 2, "detail": "chaos"})
+    tel.fleet("fleet/fence", attrs={"replica": "r0", "epoch": "r0g0",
+                                    "reason": "recompile_storm"})
+    tel.fleet("fleet/drain", attrs={"replica": "r0", "finished": 3,
+                                    "shed": 1, "steps": 12})
+    tel.fleet("fleet/shed", attrs={"req_id": "f3",
+                                   "reason": "redispatch_budget"})
+    tel.fleet("fleet/scale_up", attrs={"replicas": 3, "queue_depth": 40})
+    tel.fleet("fleet/scale_down", attrs={"replicas": 2, "queue_depth": 1})
     # the per-step attention spans the serving engine wraps its dispatches
     # in (phase: prefill / decode / decode_chunk)
     with tel.span("serve/step", attrs={"backend": "pallas",
