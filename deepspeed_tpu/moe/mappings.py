@@ -19,11 +19,12 @@ from functools import partial
 import jax
 
 from deepspeed_tpu.comm import comm
+from deepspeed_tpu.ops._shard_map import axis_size
 
 
 def _tp_bound() -> bool:
     try:
-        jax.lax.axis_size("tp")
+        axis_size("tp")
         return True
     except NameError:
         return False
@@ -35,7 +36,7 @@ def _gather(x, dim):
 
 def _drop(x, dim):
     rank = jax.lax.axis_index("tp")
-    size = jax.lax.axis_size("tp")
+    size = axis_size("tp")
     assert x.shape[dim] % size == 0, (
         f"drop_tokens: dimension {dim} ({x.shape[dim]}) is not divisible "
         f"by tensor parallel world size ({size})")

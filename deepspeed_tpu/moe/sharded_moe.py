@@ -363,6 +363,11 @@ def moe_layer_forward(gate: TopKGate, gate_params, expert_params, expert_fn,
         eo = jnp.concatenate(
             [expert_out.reshape(E * C, D),
              jnp.zeros((1, D), expert_out.dtype)])         # dropped read 0
+        # replicate before the combine gather (this IS all-to-all #2's
+        # traffic): XLA's partitioned gather over the unevenly sharded
+        # [E*C+1, D] buffer reads wrong rows under ep sharding, silently
+        # corrupting combined outputs vs the unsharded oracle
+        eo = maybe_constrain(eo, P(None, None))
         gathered = eo[out.slots]                           # [T, k, D]
         combined = jnp.sum(
             gathered * out.gate_vals[..., None].astype(x.dtype),

@@ -1,0 +1,33 @@
+"""shard_map / axis introspection across jax versions.
+
+``jax.shard_map`` only became public API after 0.4.x (older releases ship it
+as ``jax.experimental.shard_map``), the replication-check kwarg was renamed
+``check_rep`` → ``check_vma`` along the way, and ``jax.lax.axis_size``
+appeared later still.  Resolve all three at import time so callers use one
+spelling.
+"""
+
+import inspect
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    from jax.lax import axis_size
+except ImportError:  # pragma: no cover - older jax
+    def axis_size(axis_name):
+        # psum of a static python int folds to the axis extent at trace
+        # time, so the result stays usable in shape/range computations
+        return jax.lax.psum(1, axis_name)
+
+_CHECK_KW = ("check_vma" if "check_vma"
+             in inspect.signature(_shard_map).parameters else "check_rep")
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check})

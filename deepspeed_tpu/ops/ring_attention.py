@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.ops._shard_map import axis_size, shard_map
 from deepspeed_tpu.parallel.topology import BATCH_AXES, SP_AXIS
 from deepspeed_tpu.runtime.zero.stage_plan import active_mesh
 
@@ -67,8 +68,11 @@ def _ring_fwd_local(q, k, v, axis_name, causal, scale):
     Hkv = k.shape[2]
     G = H // Hkv
     q5 = q.reshape(B, S, Hkv, G, D)
-    n = jax.lax.axis_size(axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+    # only the causal mask/skip needs this device's ring position; the
+    # non-causal path must not touch axis_index (it lowers to PartitionId,
+    # which the SPMD partitioner rejects even when the value is dead)
+    my_idx = jax.lax.axis_index(axis_name) if causal else 0
 
     o0 = jnp.zeros((B, S, Hkv, G, D), jnp.float32)
     m0 = jnp.full((B, Hkv, G, S), _NEG, jnp.float32)
@@ -126,8 +130,8 @@ def _ring_bwd_local(q, k, v, out, lse, g, axis_name, causal, scale):
     o5 = out.reshape(B, S, Hkv, G, D).astype(jnp.float32)
     delta = jnp.sum(g5 * o5, axis=-1)                  # [B,S,Hkv,G]
     delta = jnp.moveaxis(delta, 1, 3)                  # [B,Hkv,G,S]
-    n = jax.lax.axis_size(axis_name)
-    my_idx = jax.lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name) if causal else 0  # see fwd note
 
     dq0 = jnp.zeros_like(q5)
     dk0 = jnp.zeros((B, S, Hkv, D), jnp.float32)
@@ -228,7 +232,7 @@ def _zz_fwd_local(q, k, v, axis_name, scale):
     Hkv = k.shape[2]
     G = H // Hkv
     c = S // 2
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     q5 = q.reshape(B, S, Hkv, G, D)
     ar = jnp.arange(c)
@@ -296,7 +300,7 @@ def _zz_bwd_local(q, k, v, out, lse, g, axis_name, scale):
     Hkv = k.shape[2]
     G = H // Hkv
     c = S // 2
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     ar = jnp.arange(c)
     q5 = q.reshape(B, S, Hkv, G, D).astype(jnp.float32)
@@ -409,16 +413,14 @@ def ring_attention(q, k, v, causal=True, softmax_scale=None, mesh=None,
         n = mesh.shape[SP_AXIS]
         perm, inv = zigzag_perm(q.shape[1], n)
         qz, kz, vz = (x[:, perm] for x in (q, k, v))
-        body = jax.shard_map(
+        body = shard_map(
             lambda q, k, v: zigzag_ring_attention_local(
                 q, k, v, SP_AXIS, softmax_scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return body(qz, kz, vz)[:, inv]
-    body = jax.shard_map(
+    body = shard_map(
         # positional call: custom_vjp nondiff_argnums are positional
         lambda q, k, v: ring_attention_local(q, k, v, SP_AXIS, causal,
                                              softmax_scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return body(q, k, v)

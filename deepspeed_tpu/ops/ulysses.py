@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.ops._shard_map import axis_size, shard_map
 from deepspeed_tpu.parallel.topology import BATCH_AXES, SP_AXIS
 from deepspeed_tpu.runtime.zero.stage_plan import active_mesh
 
@@ -39,7 +40,7 @@ def ulysses_attention_local(q, k, v, attn_fn, axis_name=SP_AXIS):
     """Per-device body (call inside shard_map): q/k/v sequence-sharded
     [B, S/sp, H, D]; ``attn_fn(q,k,v)`` computes full attention on the
     head-sharded views."""
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     H = q.shape[2]
     Hkv = k.shape[2]
     assert H % sp == 0, f"n_heads {H} must divide sp degree {sp}"
@@ -59,8 +60,7 @@ def ulysses_attention(q, k, v, attn_fn, mesh=None):
     if mesh is None or mesh.shape.get(SP_AXIS, 1) == 1:
         return attn_fn(q, k, v)
     spec = P(tuple(BATCH_AXES), SP_AXIS, None, None)
-    body = jax.shard_map(
+    body = shard_map(
         lambda q, k, v: ulysses_attention_local(q, k, v, attn_fn),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return body(q, k, v)

@@ -55,17 +55,64 @@ def test_exhausted_budget_promotes_cached_onchip():
         pytest.skip("no committed on-chip artifact")
     with open(os.path.join(REPO, "BENCH_onchip_latest.json")) as f:
         cached = json.load(f)
-    out = subprocess.run([sys.executable, BENCH], capture_output=True,
-                         text=True, timeout=120,
-                         env=dict(os.environ, BENCH_BUDGET_S="1"))
-    assert out.returncode == 0
-    line = json.loads(out.stdout.strip().splitlines()[-1])
-    assert line["fallback"] == "cached_onchip"
-    assert line["vs_baseline"] == cached["vs_baseline"]
-    assert line["value"] == cached["value"]
-    assert "cache_age_hours" in line
-    # the degraded run's own outcome is preserved, not hidden
-    assert line["this_run"]["vs_baseline"] == 0.0
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        # redirect the ledger: a test run must never append rows to the
+        # committed BENCH_LEDGER.jsonl
+        ledger = os.path.join(td, "ledger.jsonl")
+        out = subprocess.run([sys.executable, BENCH], capture_output=True,
+                             text=True, timeout=120,
+                             env=dict(os.environ, BENCH_BUDGET_S="1",
+                                      BENCH_LEDGER=ledger))
+        assert out.returncode == 0
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["fallback"] == "cached_onchip"
+        assert line["vs_baseline"] == cached["vs_baseline"]
+        assert line["value"] == cached["value"]
+        assert "cache_age_hours" in line
+        # the degraded run's own outcome is preserved, not hidden
+        assert line["this_run"]["vs_baseline"] == 0.0
+        # the promoted cached value must NOT reach the ledger as a fresh
+        # train row (it would pin the ds_perf_diff baseline to a stale
+        # constant); only rows the run actually measured may land
+        if os.path.exists(ledger):
+            with open(ledger) as f:
+                for row in map(json.loads, f):
+                    assert not (row["bench"] == "train"
+                                and row["value"] == cached["value"])
+
+
+def test_append_ledger_skips_promoted_cached_train_row(tmp_path,
+                                                       monkeypatch):
+    """A cached_onchip-promoted result must not re-append the stale
+    cached value as this run's train metric — every tunnel-down run
+    would replay the same constant and make the perf gate vacuous.  The
+    degraded run's own metric (distinct cpu-fallback name) is ledgered
+    instead."""
+    bench = _load_bench()
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("BENCH_LEDGER", str(ledger))
+    promoted = {"fallback": "cached_onchip", "cache_age_hours": 5.0,
+                "metric": "train_tokens_per_sec_per_chip", "value": 15765.6,
+                "unit": "tokens/s/chip",
+                "this_run": {"metric": "gpt2_125m_cpu_fallback",
+                             "value": 42.0, "unit": "tokens/s/chip"}}
+    out = bench._append_ledger(promoted)
+    rows = [json.loads(l) for l in ledger.read_text().splitlines()]
+    assert len(rows) == 1 and out["ledger"]["rows"] == 1
+    assert rows[0]["metric"] == "gpt2_125m_cpu_fallback"
+    assert rows[0]["value"] == 42.0
+
+
+def test_append_ledger_promoted_without_own_metric_writes_nothing(
+        tmp_path, monkeypatch):
+    bench = _load_bench()
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("BENCH_LEDGER", str(ledger))
+    promoted = {"fallback": "cached_onchip", "metric": "m", "value": 1.0,
+                "this_run": {"vs_baseline": 0.0}}
+    bench._append_ledger(promoted)
+    assert not ledger.exists()
 
 
 def test_promote_cached_without_artifact_returns_this_run(tmp_path,

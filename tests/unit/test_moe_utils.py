@@ -10,7 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from deepspeed_tpu.ops._shard_map import shard_map
 
 from deepspeed_tpu.moe.experts import Experts
 from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
