@@ -1169,6 +1169,103 @@ def _worker_compile_churn(spec):
     print(json.dumps(_compile_churn_bench(spec)))
 
 
+def _incident_bench(spec=None):
+    """CPU-runnable incident-plane micro-bench: prices the always-on
+    flight recorder (ring-buffer record ns/event — the tax every emit
+    pays once incidents are enabled), then drives a deadline-missing
+    serving workload under an injected recompile storm and proves the
+    verdict -> bundle chain: the storm onset and the SLO burn-rate
+    alerter each write exactly one incident bundle, both validate
+    against the frozen bundle schema, and the /incidents endpoint
+    serves them.  The workload is synthetic by design — the trigger ->
+    bundle -> scrape chain, not model speed, is what this measures."""
+    spec = spec or {}
+    import importlib.util
+    import tempfile
+    import urllib.request
+
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    n_events = int(spec.get("events", 20000))
+    tmp = tempfile.mkdtemp(prefix="incident_bench_")
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": tmp, "job_name": "incident",
+         "export": {"enabled": True, "port": 0},
+         "profiling": {"enabled": True, "storm_threshold": 3,
+                       "storm_window_s": 60.0},
+         "incidents": {"enabled": True, "ring_capacity": 4096,
+                       "burn_windows": [[60.0, 0.3]],
+                       "burn_min_requests": 4, "cooldown_s": 0.0}}))
+    incidents = tel.incidents
+
+    # flight-recorder tax: ring.record() is on every emit path, so its
+    # per-event cost is the plane's standing overhead
+    ev = {"ts": time.time(), "kind": "counter", "name": "bench/tick",
+          "value": 1}
+    t0 = time.perf_counter()
+    for _ in range(n_events):
+        incidents.record(ev)
+    ring_record_ns = (time.perf_counter() - t0) / n_events * 1e9
+
+    # deadline workload: admitted requests that miss their SLO, with the
+    # lifecycle traces + counters the correlation pass joins on
+    base = time.time()
+    for i in range(6):
+        tel.emit("serve", "serve/request/admitted",
+                 attrs={"req_id": f"req-{i}", "deadline": 1})
+        tel.emit("serve", "serve/request/deadline",
+                 attrs={"req_id": f"req-{i}", "slo": "miss"}, step=i)
+        tel.count("serve/slo_missed")
+    # injected recompile storm: 4 distinct non-cold-diffable fingerprints
+    # (the first miss is "cold" and excluded from the storm window)
+    for i in range(4):
+        tel.profiling.compiles.note_miss(
+            "bench/incident", ("f", ((f"s{i}", "f32"),)), 0.01, step=i)
+    # SLO burn: rate over the injected misses trips the single window
+    t0 = time.perf_counter()
+    burn = incidents.observe_slo(now=base + 1.0)
+    trigger_wall_ms = (time.perf_counter() - t0) * 1e3
+
+    host, port = tel.exporter.address
+    scraped = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/incidents", timeout=5).read())
+    bundle_dir = incidents.bundle_dir
+    snap = incidents.snapshot()
+    tel.close()
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sp = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(repo, "scripts", "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(checker)
+    problems, bundles = checker.validate_incidents_path(bundle_dir)
+    stream_problems = checker.validate_file(
+        os.path.join(tmp, "incident", "events.jsonl"))
+    return {
+        "ring_record_ns": round(ring_record_ns, 1),
+        "ring_events_recorded": n_events,
+        "bundles_written": bundles,
+        "expected_bundles": 2,          # storm onset + slo_burn
+        "slo_burn_fired": bool(burn),
+        "slo_burn_trigger_ms": round(trigger_wall_ms, 3),
+        "bundles_ok": not problems,
+        "bundle_problems": len(problems),
+        "events_ok": not stream_problems,
+        "incidents_scrape_ok": (
+            len(scraped.get("incidents", [])) == bundles),
+        "ring_occupancy": int(snap["ring"]["events"]),
+        "note": "synthetic deadline workload + injected storm: this "
+                "bench proves the trigger -> bundle -> scrape chain and "
+                "prices the always-on ring buffer",
+    }
+
+
+def _worker_incident(spec):
+    print(json.dumps(_incident_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -1353,6 +1450,24 @@ def _attach_fleet(out):
     return out
 
 
+def _attach_incident(out):
+    """Attach the incident-plane micro-bench under the stable key
+    ``cpu_incident`` (CPU-runnable: ring-buffer record overhead, injected
+    storm + deadline workload -> bundle chain, /incidents scrape).
+    Budget-gated; a failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "incident", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_incident"] = res
+    else:
+        out.setdefault("notes", {})["incident"] = (err or "")[:200]
+    return out
+
+
 def _append_ledger(out):
     """Append this run's numeric bench metrics to the perf-regression
     ledger (``BENCH_LEDGER`` env override; default BENCH_LEDGER.jsonl
@@ -1431,7 +1546,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))
+            print(json.dumps(_append_ledger(_attach_incident(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -1519,7 +1634,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_fleet(_attach_compile_churn(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))
+        print(json.dumps(_append_ledger(_attach_incident(_attach_fleet(_attach_compile_churn(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -1594,7 +1709,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))
+    print(json.dumps(_append_ledger(_attach_incident(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))
 
 
 if __name__ == "__main__":
@@ -1631,6 +1746,8 @@ if __name__ == "__main__":
             _worker_comm_census(spec)
         elif which == "compile_churn":
             _worker_compile_churn(spec)
+        elif which == "incident":
+            _worker_incident(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

@@ -218,14 +218,28 @@ class FleetRouter:
                       attrs={k: v for k, v in attrs.items()
                              if v is not None} or None)
 
+    def _incident(self, kind, source="", detail=""):
+        """Open an incident bundle (monitor/incidents.py) for a fleet
+        verdict — replica kills and fences; no-op without the plane."""
+        tel = self._tel()
+        incidents = getattr(tel, "incidents", None) if tel else None
+        if incidents is not None:
+            incidents.trigger(kind, source=source, detail=detail,
+                              step=self.steps)
+
     def attach_exporter(self):
         """Bind this router's :meth:`health` behind the telemetry
-        exporter's ``GET /fleet`` endpoint (no-op without an exporter)."""
+        exporter's ``GET /fleet`` endpoint (no-op without an exporter),
+        and register it as incident-bundle context when the incident
+        plane is on."""
         tel = self._telemetry if self._telemetry is not None \
             else get_telemetry()
         exporter = getattr(tel, "exporter", None)
         if exporter is not None:
             exporter.fleet_fn = self.health
+        incidents = getattr(tel, "incidents", None)
+        if incidents is not None:
+            incidents.add_context("fleet_health", self.health)
 
     # -- replica lifecycle ----------------------------------------------
     def _spawn(self, replica_id=None, respawn=False):
@@ -299,6 +313,8 @@ class FleetRouter:
         self._fleet_event("fleet/kill", replica=replica_id,
                           epoch=rep.epoch, redispatched=len(moved),
                           detail=detail)
+        self._incident("replica_kill", source=str(replica_id),
+                       detail=f"{detail}; redispatched {len(moved)}")
         self._retire(rep)
 
     def _fence(self, rep: _Replica, why: str):
@@ -309,6 +325,8 @@ class FleetRouter:
         self.stats["fences"] += 1
         self._fleet_event("fleet/fence", replica=rep.replica_id,
                           epoch=rep.epoch, reason=why)
+        self._incident("replica_fence", source=str(rep.replica_id),
+                       detail=why)
         try:
             res = rep.engine.drain()
         except Exception as e:   # a broken drain degrades to a kill

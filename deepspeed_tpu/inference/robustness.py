@@ -395,6 +395,26 @@ class RequestTracer:
         self.completed.append(tr)
         return tr
 
+    def snapshot_open(self) -> List[Dict[str, Any]]:
+        """JSON-safe dump of every still-open lifecycle trace — the
+        in-flight requests an incident bundle freezes at trigger time
+        (``monitor/incidents.py`` registers this as a bundle context
+        provider)."""
+        now = self._clock()
+        out = []
+        for tr in list(self.open.values()):
+            out.append({
+                "req_id": str(tr.req_id),
+                "slot": tr.slot,
+                "age_ms": round((now - tr.t_admit) * 1000.0, 3),
+                "deadline": tr.deadline or None,
+                "queue_wait_ms": tr.queue_wait_ms(),
+                "ttft_ms": tr.ttft_ms(),
+                "prefilled": tr.t_prefill_start >= 0,
+                "first_token": tr.t_first_token >= 0,
+            })
+        return out
+
     def audit(self, live_req_ids) -> Dict[str, Any]:
         """Trace-completeness invariant sweep.  ``live_req_ids`` is every
         request currently queued or active in the engine; returns {} when

@@ -255,6 +255,13 @@ class ServingEngine:
                           attention_backend=self.attention_backend,
                           impl=attn_impl or "auto",
                           interpret=int(attn_interpret))
+        # incident plane: bundles snapshot this engine's health() and its
+        # in-flight request traces alongside the flight-recorder dump
+        incidents = getattr(self.telemetry, "incidents", None)
+        if incidents is not None:
+            incidents.add_context("serving_health", self.health)
+            incidents.add_context("inflight_traces",
+                                  self.tracer.snapshot_open)
 
     # -- telemetry -------------------------------------------------------
     @property
@@ -921,6 +928,11 @@ class ServingEngine:
             self._consec_step_faults = 0
         self._admit()
         self._check_compile_storm()
+        incidents = getattr(self.telemetry, "incidents", None)
+        if incidents is not None:
+            # SLO burn-rate sweep on the engine's (injectable) clock — a
+            # sustained multi-window miss fraction opens one incident
+            incidents.observe_slo(now=self._clock())
         if self.n_active == 0:
             return {}
         if self.decode_chunk > 1:
@@ -1139,6 +1151,14 @@ class ServingEngine:
         prof = self._profiling
         if prof is not None:
             leaks.update(prof.leak_report())
+        if leaks:
+            incidents = getattr(self.telemetry, "incidents", None)
+            if incidents is not None:
+                # a broken invariant is an incident: one bundle per
+                # episode (the manager's per-kind cooldown dedups the
+                # supervisor's repeated polls)
+                incidents.trigger("leak", source="serving/leak_report",
+                                  detail=",".join(sorted(leaks)))
         return leaks
 
     # -- convenience ----------------------------------------------------

@@ -360,15 +360,20 @@ def moe_layer_forward(gate: TopKGate, gate_params, expert_params, expert_fn,
                               out.combine_weights.astype(x.dtype),
                               expert_out)
     else:
-        eo = jnp.concatenate(
-            [expert_out.reshape(E * C, D),
-             jnp.zeros((1, D), expert_out.dtype)])         # dropped read 0
         # replicate before the combine gather (this IS all-to-all #2's
         # traffic): XLA's partitioned gather over the unevenly sharded
         # [E*C+1, D] buffer reads wrong rows under ep sharding, silently
-        # corrupting combined outputs vs the unsharded oracle
-        eo = maybe_constrain(eo, P(None, None))
-        gathered = eo[out.slots]                           # [T, k, D]
+        # corrupting combined outputs vs the unsharded oracle.  The
+        # gather also stays on the EVEN [E*C, D] buffer with dropped
+        # assignments (slot == E*C) clipped and masked to zero — a
+        # gather from a concat-padded [E*C+1, D] buffer miscompiles
+        # under vmap (pipeline stages batch this layer): the partitioner
+        # re-shards the uneven concat behind the replication constraint
+        # and the batched gather again reads wrong rows
+        eo = maybe_constrain(expert_out.reshape(E * C, D), P(None, None))
+        safe = jnp.clip(out.slots, 0, E * C - 1)
+        gathered = eo[safe] * \
+            (out.slots < E * C)[..., None]                 # dropped read 0
         combined = jnp.sum(
             gathered * out.gate_vals[..., None].astype(x.dtype),
             axis=1)                                        # all-to-all #2

@@ -300,12 +300,16 @@ class ClusterAggregator:
     a second aggregation."""
 
     def __init__(self, shard_dir, skew_threshold=2.0, straggler_window=32,
-                 registry=None, min_refresh_secs=1.0):
+                 registry=None, min_refresh_secs=1.0, incidents=None):
         self.shard_dir = str(shard_dir)
         self.skew_threshold = float(skew_threshold)
         self.straggler_window = int(straggler_window)
         self.registry = registry
         self.min_refresh_secs = float(min_refresh_secs)
+        # incident plane (monitor/incidents.py): a straggler verdict
+        # rising edge opens one incident bundle
+        self.incidents = incidents
+        self._straggler_fired = None
         self._lock = threading.Lock()
         self._cache = None
         self._cached_at = None
@@ -321,7 +325,24 @@ class ClusterAggregator:
                 straggler_window=self.straggler_window)
             self._cache, self._cached_at = snap, now
         self._push_gauges(snap)
+        self._check_straggler(snap)
         return snap
+
+    def _check_straggler(self, snap):
+        """Fire a ``straggler`` incident once per newly flagged rank (the
+        verdict clearing re-arms the edge)."""
+        verdict = snap.get("straggler") or {}
+        rank = verdict.get("rank")
+        # mark fired BEFORE triggering: the bundle write snapshots the
+        # cluster, which may re-enter this check — the edge must already
+        # be consumed or a zero-cooldown config recurses forever
+        fired, self._straggler_fired = self._straggler_fired, rank
+        if rank is not None and rank != fired and \
+                self.incidents is not None:
+            self.incidents.trigger(
+                "straggler", source=f"rank{rank}",
+                detail=f"{verdict.get('metric')} beyond "
+                       f"{verdict.get('threshold')}x median")
 
     def snapshot(self):
         """The /cluster payload (cached within ``min_refresh_secs``)."""

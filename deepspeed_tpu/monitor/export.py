@@ -18,6 +18,9 @@ can observe a running job without touching its JSONL files:
 * ``GET /fleet``         — serving-fleet health snapshot (per-replica
   supervision states + aggregate load) once a ``FleetRouter`` has called
   ``attach_exporter``; 404 until then.
+* ``GET /incidents``     — incident-plane summary (flight-recorder ring
+  occupancy, SLO burn-rate state, bundles written with their paths) when
+  the ``telemetry.incidents`` block is on; 404 otherwise.
 * ``GET /healthz``       — liveness probe, ``{"ok": true}``; when the
   profiling plane is on it also carries ``recompile_storm`` (the
   CompileWatcher's live storm verdict).
@@ -152,6 +155,18 @@ class _Handler(BaseHTTPRequestHandler):
                 except Exception as e:   # a snapshot must not 500 a scrape
                     self._reply(503, json.dumps({"error": str(e)}),
                                 "application/json")
+        elif path == "/incidents":
+            if self.exporter.incidents_fn is None:
+                self._reply(404, '{"error": "no incident manager"}',
+                            "application/json")
+            else:
+                try:
+                    body = json.dumps(self.exporter.incidents_fn(),
+                                      default=str)
+                    self._reply(200, body, "application/json")
+                except Exception as e:   # a snapshot must not 500 a scrape
+                    self._reply(503, json.dumps({"error": str(e)}),
+                                "application/json")
         elif path == "/healthz":
             health = {"ok": True}
             # profiling plane: liveness scrapers get the recompile-storm
@@ -184,7 +199,7 @@ class MetricsExporter:
     """
 
     def __init__(self, telemetry, host="127.0.0.1", port=9866, labels=None,
-                 cluster_fn=None, fleet_fn=None):
+                 cluster_fn=None, fleet_fn=None, incidents_fn=None):
         self.telemetry = telemetry
         # distributed mode: per-sample labels ({"rank": "0"}) and the
         # shard aggregator behind GET /cluster
@@ -193,6 +208,8 @@ class MetricsExporter:
         # serving fleet: FleetRouter.attach_exporter() binds its health
         # snapshot behind GET /fleet; 404 until a router registers
         self.fleet_fn = fleet_fn
+        # incident plane: IncidentManager.snapshot behind GET /incidents
+        self.incidents_fn = incidents_fn
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True

@@ -1,0 +1,410 @@
+"""Incident plane: black-box flight recorder + cross-plane correlation.
+
+PRs 7-9 built three observability planes (serving lifecycle/SLO,
+distributed skew/straggler, compile/HBM/roofline) and PR 10 added fleet
+supervision — but each plane fires its verdict (stall, recompile storm,
+straggler, leak, replica kill, SLO miss) in isolation, and by the time a
+human looks, the evidence that explains it has scrolled out of the JSONL
+stream.  This module gives every verdict one landing place:
+
+* :class:`EventRingBuffer` — an always-on, size- and time-bounded ring of
+  the most recent telemetry events.  ``Telemetry.emit`` feeds it on EVERY
+  rank (the JSONL sink may be rank-0-gated; the ring is not), O(1) per
+  event, so the last N seconds of cross-plane history are always in
+  memory — the black-box flight recorder.
+* :class:`IncidentManager` — every existing verdict source calls
+  :meth:`IncidentManager.trigger`:
+
+  - ``StepStallWatchdog`` stall verdicts                    -> ``stall``
+  - ``CompileWatcher`` recompile-storm rising edges          -> ``storm``
+  - ``ClusterAggregator`` straggler verdicts                 -> ``straggler``
+  - non-empty ``leak_report()`` (engine or fleet)            -> ``leak``
+  - ``FleetRouter`` replica kills / fences                   -> ``replica_kill`` / ``replica_fence``
+  - :class:`SloBurnAlerter` multi-window burn-rate verdicts  -> ``slo_burn``
+
+  On trigger it writes a typed incident bundle under
+  ``<bundle_dir>/<id>/``: ``incident.json`` (trigger, full registry
+  snapshot, cluster gauges, attached ``health()`` / in-flight request
+  traces, and the correlation section) plus ``ring.jsonl`` (the ring
+  dump, one frozen-schema event per line).  Per-trigger-kind cooldown
+  keeps a persistent fault at ONE bundle per episode, and the bundle
+  directory is pruned to ``max_bundles``.
+* :class:`SloBurnAlerter` — Google-SRE-style multi-window burn-rate
+  alerting over the PR 7 ``serve/slo_attained`` / ``serve/slo_missed``
+  counters: the alert fires only when the miss fraction exceeds the
+  threshold in EVERY configured window (short window = burning now, long
+  window = not just a blip), on the rising edge.
+* :func:`correlate` — the cross-plane join: buckets the ring into
+  engine-step windows (per-window serve/request terminals, compile
+  misses, ``mem/<span>/peak_bytes`` excursions, collective timings) and
+  links each SLO-missed request to the cause candidates within
+  ``window_s`` of it — so a TTFT p99 spike points at the recompile or
+  HBM peak that caused it.
+
+Incident events ride a new frozen ``incident`` kind
+(:data:`INCIDENT_EVENTS`, trigger vocabulary
+:data:`INCIDENT_TRIGGERS`) — ``scripts/check_telemetry_schema.py``
+duplicates both on purpose and its ``--incidents`` mode validates bundle
+layout; a tier-1 test diffs the vocabularies.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+from collections import deque
+
+from deepspeed_tpu.utils.logging import logger
+
+# The frozen incident event vocabulary (kind "incident").  Adding a name
+# means updating scripts/check_telemetry_schema.py in the same change —
+# a tier-1 test diffs the two tuples.
+INCIDENT_EVENTS = ("incident/open", "incident/written")
+
+# The closed set of trigger kinds — one per verdict source wired through
+# the planes (see module docstring).  Frozen for the same reason.
+INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
+                     "replica_kill", "replica_fence", "slo_burn")
+
+# Default multi-window burn-rate policy: burning when >= 50% of
+# deadline-bearing requests missed over the last minute AND >= 10% over
+# the last five — the short window says "burning now", the long window
+# says "not just a blip".
+DEFAULT_BURN_WINDOWS = ((60.0, 0.5), (300.0, 0.1))
+
+# Files every bundle directory must contain (checker --incidents
+# validates the same layout).
+BUNDLE_FILES = ("incident.json", "ring.jsonl")
+
+
+class EventRingBuffer:
+    """Bounded ring of recent telemetry events: at most ``capacity``
+    events, none older than ``max_age_s`` at dump time.  ``record`` is
+    O(1) (deque append + amortized head expiry) and takes one
+    uncontended lock, cheap enough to leave on every ``emit``."""
+
+    __slots__ = ("capacity", "max_age_s", "_events", "_lock", "recorded")
+
+    def __init__(self, capacity=2048, max_age_s=600.0):
+        self.capacity = max(1, int(capacity))
+        self.max_age_s = float(max_age_s)
+        self._events = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.recorded = 0
+
+    def record(self, event):
+        with self._lock:
+            self._events.append(event)
+            self.recorded += 1
+
+    def dump(self, now=None):
+        """Events still inside the age window, oldest first."""
+        now = time.time() if now is None else now
+        cutoff = now - self.max_age_s
+        with self._lock:
+            return [e for e in self._events
+                    if float(e.get("ts", now)) >= cutoff]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+
+def _coerce_burn_windows(windows):
+    """Normalise ``burn_windows`` config — ``[[60, 0.5], ...]`` pairs or
+    ``[{"window_s": 60, "threshold": 0.5}, ...]`` dicts — into sorted
+    (window_s, threshold) tuples; empty/None selects the default."""
+    if not windows:
+        return tuple(DEFAULT_BURN_WINDOWS)
+    out = []
+    for w in windows:
+        if isinstance(w, dict):
+            out.append((float(w["window_s"]), float(w["threshold"])))
+        else:
+            out.append((float(w[0]), float(w[1])))
+    return tuple(sorted(out))
+
+
+class SloBurnAlerter:
+    """Multi-window SLO burn-rate alerting over cumulative attained /
+    missed counters.  Feed it counter readings via :meth:`observe`; it
+    keeps (t, attained, missed) samples and reports the rising edge of
+    "the miss fraction exceeds the threshold in EVERY window with at
+    least ``min_requests`` deadline-bearing requests observed"."""
+
+    def __init__(self, windows=None, min_requests=8):
+        self.windows = _coerce_burn_windows(windows)
+        self.min_requests = max(1, int(min_requests))
+        self._samples = deque(maxlen=4096)
+        self._active = False
+
+    def _window_rate(self, window_s, now, attained, missed):
+        """Miss fraction over the trailing ``window_s`` (None when fewer
+        than ``min_requests`` terminals landed in the window)."""
+        base_a = base_m = 0
+        for t, a, m in self._samples:
+            if t < now - window_s:
+                base_a, base_m = a, m
+            else:
+                break
+        d_m = missed - base_m
+        d_total = (attained - base_a) + d_m
+        if d_total < self.min_requests:
+            return None
+        return d_m / float(d_total)
+
+    def observe(self, attained, missed, now):
+        """Record one counter reading; returns ``(newly_burning,
+        per-window detail list)``."""
+        attained, missed = int(attained), int(missed)
+        detail = []
+        burning = True
+        for window_s, threshold in self.windows:
+            rate = self._window_rate(window_s, now, attained, missed)
+            detail.append({"window_s": window_s, "threshold": threshold,
+                           "miss_rate": (round(rate, 4)
+                                         if rate is not None else None)})
+            if rate is None or rate < threshold:
+                burning = False
+        self._samples.append((float(now), attained, missed))
+        newly = burning and not self._active
+        self._active = burning
+        return newly, detail
+
+    @property
+    def active(self):
+        return self._active
+
+
+def correlate(events, window_s=1.0):
+    """Cross-plane correlation over a ring dump.
+
+    Buckets events into engine-step windows of ``window_s`` seconds
+    (recording the steps seen, serve/request terminals, compile misses,
+    ``mem/<span>/peak_bytes`` excursions, and collective timings per
+    window), then links each SLO-missed request to every cause candidate
+    within ``window_s`` of its terminal — time proximity rather than
+    bucket identity, so a miss and its cause straddling a bucket edge
+    still join."""
+    windows = {}
+    missed = []      # (ts, req_id)
+    compiles = []    # (ts, entry)
+    mem_peaks = []   # (ts, entry)
+    collectives = [] # (ts, entry)
+    for ev in events:
+        try:
+            ts = float(ev.get("ts", 0.0))
+        except (TypeError, ValueError):
+            continue
+        idx = int(ts // window_s)
+        w = windows.setdefault(idx, {
+            "window": idx, "t0": round(idx * window_s, 6), "steps": set(),
+            "requests": [], "slo_missed": [], "compile_misses": [],
+            "mem_peak_bytes": [], "collectives": []})
+        step = ev.get("step")
+        if isinstance(step, int) and not isinstance(step, bool):
+            w["steps"].add(step)
+        kind, name = ev.get("kind"), str(ev.get("name", ""))
+        if kind == "serve" and name.startswith("serve/request/"):
+            attrs = ev.get("attrs") or {}
+            req_id = attrs.get("req_id")
+            terminal = name.rsplit("/", 1)[1]
+            w["requests"].append({"req_id": req_id, "event": terminal,
+                                  "slo": attrs.get("slo")})
+            if attrs.get("slo") == "miss":
+                w["slo_missed"].append(req_id)
+                missed.append((ts, req_id))
+        elif kind == "compile" and name == "compile/miss":
+            entry = {"site": ev.get("site"), "cause": ev.get("cause"),
+                     "dur_ms": ev.get("dur_ms"), "step": step}
+            w["compile_misses"].append(entry)
+            compiles.append((ts, entry))
+        elif kind == "gauge" and name.startswith("mem/") and \
+                name.endswith("/peak_bytes"):
+            entry = {"span": name.split("/")[1], "peak_bytes":
+                     ev.get("value"), "step": step}
+            w["mem_peak_bytes"].append(entry)
+            mem_peaks.append((ts, entry))
+        elif kind == "comm":
+            entry = {"op": name, "bytes": ev.get("bytes"),
+                     "dur_ms": ev.get("dur_ms")}
+            w["collectives"].append(entry)
+            collectives.append((ts, entry))
+
+    links = []
+    for ts, req_id in missed:
+        near = lambda items: [e for t, e in items if abs(t - ts) <= window_s]
+        cm, mp, co = near(compiles), near(mem_peaks), near(collectives)
+        if cm or mp or co:
+            links.append({"req_id": req_id, "ts": round(ts, 6),
+                          "window": int(ts // window_s),
+                          "compile_misses": cm, "mem_peak_bytes": mp,
+                          "collectives": co})
+    out = []
+    for idx in sorted(windows):
+        w = windows[idx]
+        w["steps"] = sorted(w["steps"])
+        out.append(w)
+    return {"window_s": float(window_s), "windows": out, "links": links}
+
+
+class IncidentManager:
+    """Owns the flight-recorder ring and writes typed incident bundles.
+
+    The manager is wired by ``Telemetry.configure`` (the
+    ``telemetry.incidents`` config block) and reached by every verdict
+    source via ``getattr(telemetry, "incidents", None)`` — triggers are
+    best-effort and exception-safe: observability must never take down
+    the run.  Context providers (``health()``, in-flight request traces,
+    fleet health) register via :meth:`add_context` and are snapshotted
+    into every bundle."""
+
+    def __init__(self, telemetry, ring_capacity=2048, ring_max_age_s=600.0,
+                 bundle_dir="incidents", max_bundles=16, burn_windows=None,
+                 burn_min_requests=8, cooldown_s=60.0, clock=None):
+        self.telemetry = telemetry
+        self.ring = EventRingBuffer(ring_capacity, ring_max_age_s)
+        self.bundle_dir = str(bundle_dir)
+        self.max_bundles = max(1, int(max_bundles))
+        self.cooldown_s = float(cooldown_s)
+        self.burn = SloBurnAlerter(burn_windows,
+                                   min_requests=burn_min_requests)
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._last_fire = {}            # trigger kind -> clock time
+        self._contexts = {}             # name -> zero-arg provider
+        self._seq = 0
+        self.written = deque(maxlen=64)  # bundle summaries, oldest first
+
+    # -- flight recorder (Telemetry.emit calls this on every event) ----
+    def record(self, event):
+        self.ring.record(event)
+
+    # -- bundle context providers --------------------------------------
+    def add_context(self, name, fn):
+        """Register a zero-arg provider whose JSON-safe return value is
+        snapshotted into every bundle's ``context`` section (last
+        registration per name wins)."""
+        self._contexts[str(name)] = fn
+
+    # -- SLO burn-rate sweep (engine step loop calls this) -------------
+    def observe_slo(self, now=None):
+        """Feed the burn-rate alerter from the registry's cumulative
+        ``serve/slo_attained`` / ``serve/slo_missed`` counters; fires a
+        ``slo_burn`` incident on the rising edge.  ``now`` rides the
+        caller's (injectable) clock for deterministic tests."""
+        reg = self.telemetry.registry
+        att = reg.counters.get("serve/slo_attained")
+        mis = reg.counters.get("serve/slo_missed")
+        newly, detail = self.burn.observe(
+            att.value if att is not None else 0,
+            mis.value if mis is not None else 0,
+            self._clock() if now is None else now)
+        if newly:
+            worst = max((d["miss_rate"] for d in detail
+                         if d["miss_rate"] is not None), default=None)
+            self.trigger("slo_burn", source="serve/slo",
+                         detail=f"miss rate {worst} over "
+                                f"{len(detail)} windows")
+        return newly
+
+    # -- the trigger ----------------------------------------------------
+    def trigger(self, kind, source="", detail="", step=None):
+        """Open an incident of ``kind`` (one of
+        :data:`INCIDENT_TRIGGERS`) and write its bundle.  Returns the
+        incident id, or None when suppressed by the per-kind cooldown.
+        Never raises past the vocabulary check — a failed bundle write
+        is logged and swallowed."""
+        if kind not in INCIDENT_TRIGGERS:
+            raise ValueError(
+                f"unknown incident trigger {kind!r} "
+                f"(frozen vocabulary: {INCIDENT_TRIGGERS})")
+        now = self._clock()
+        with self._lock:
+            last = self._last_fire.get(kind)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            self._last_fire[kind] = now
+            self._seq += 1
+            inc_id = f"inc-{self._seq:04d}-{kind}"
+        try:
+            return self._write_bundle(inc_id, kind, source, detail, step)
+        except Exception as e:       # never take down the run
+            logger.warning(f"incident bundle {inc_id} failed: {e}")
+            return None
+
+    def _write_bundle(self, inc_id, kind, source, detail, step):
+        tel = self.telemetry
+        tel.emit("incident", "incident/open", id=inc_id, trigger=kind,
+                 source=str(source) or None, detail=str(detail) or None,
+                 step=step)
+        ring_events = self.ring.dump()
+        cluster = None
+        if getattr(tel, "cluster", None) is not None:
+            try:
+                cluster = tel.cluster.snapshot()
+            except Exception as e:
+                cluster = {"error": str(e)}
+        context = {}
+        for name, fn in list(self._contexts.items()):
+            try:
+                context[name] = fn()
+            except Exception as e:
+                context[name] = {"error": str(e)}
+        bundle = {
+            "id": inc_id,
+            "ts": round(time.time(), 6),
+            "trigger": {"kind": kind, "source": str(source),
+                        "detail": str(detail),
+                        "step": int(step) if step is not None else None},
+            "registry": tel.snapshot(),
+            "cluster": cluster,
+            "context": context,
+            "correlation": correlate(ring_events),
+            "ring": {"events": len(ring_events), "path": "ring.jsonl"},
+        }
+        out_dir = os.path.join(self.bundle_dir, inc_id)
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "ring.jsonl"), "w") as f:
+            for ev in ring_events:
+                f.write(json.dumps(ev, default=str) + "\n")
+        with open(os.path.join(out_dir, "incident.json"), "w") as f:
+            json.dump(bundle, f, indent=1, default=str)
+        self._prune_bundles()
+        self.written.append({"id": inc_id, "trigger": kind,
+                             "ts": bundle["ts"], "path": out_dir})
+        logger.warning(
+            f"incident {inc_id} ({kind}): bundle written to {out_dir} "
+            f"({len(ring_events)} ring events)")
+        tel.emit("incident", "incident/written", id=inc_id, trigger=kind,
+                 events=len(ring_events), path=out_dir)
+        return inc_id
+
+    def _prune_bundles(self):
+        """Keep at most ``max_bundles`` bundle directories (oldest
+        dropped — by mtime so ordering survives manager restarts)."""
+        try:
+            dirs = [os.path.join(self.bundle_dir, d)
+                    for d in os.listdir(self.bundle_dir)
+                    if os.path.isdir(os.path.join(self.bundle_dir, d))]
+        except OSError:
+            return
+        dirs.sort(key=os.path.getmtime)
+        for stale in dirs[:max(0, len(dirs) - self.max_bundles)]:
+            shutil.rmtree(stale, ignore_errors=True)
+
+    # -- /incidents endpoint payload -----------------------------------
+    def snapshot(self):
+        """JSON summary for ``GET /incidents`` on the metrics exporter."""
+        return {
+            "ring": {"events": len(self.ring),
+                     "capacity": self.ring.capacity,
+                     "max_age_s": self.ring.max_age_s,
+                     "recorded": self.ring.recorded},
+            "slo_burn": {"active": self.burn.active,
+                         "windows": [{"window_s": w, "threshold": t}
+                                     for w, t in self.burn.windows]},
+            "bundle_dir": self.bundle_dir,
+            "incidents": list(self.written),
+        }

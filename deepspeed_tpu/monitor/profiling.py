@@ -219,6 +219,14 @@ class CompileWatcher:
                 tel.emit("compile", "compile/storm", site="*",
                          count=len(recent),
                          window_s=round(self.storm_window_s, 3), step=step)
+                incidents = getattr(tel, "incidents", None)
+                if incidents is not None:
+                    # incident plane: the storm onset (rising edge) opens
+                    # one bundle snapshotting the flight recorder
+                    incidents.trigger(
+                        "storm", source="compile/storm", step=step,
+                        detail=f"{len(recent)} non-cold misses in "
+                               f"{self.storm_window_s:.0f}s")
         return newly
 
     @property

@@ -41,7 +41,7 @@ from deepspeed_tpu.utils.logging import logger
 # The closed set of event kinds.  Adding a kind means updating the frozen
 # schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
 EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
-               "meta", "fault", "serve", "compile", "fleet")
+               "meta", "fault", "serve", "compile", "fleet", "incident")
 
 
 def _profiler_annotation(name):
@@ -79,9 +79,12 @@ class Gauge:
 
     def set(self, value):
         value = float(value)
-        self.value = value
+        # peak is written BEFORE value: a concurrent snapshot may then see
+        # a stale value with a fresh peak, but never value > peak — the
+        # invariant scrapers rely on survives lock-free sets
         if value > self.peak:
             self.peak = value
+        self.value = value
 
 
 class Histogram:
@@ -89,27 +92,35 @@ class Histogram:
     ``window_secs`` (bounded by ``max_samples``); percentile queries prune
     lazily."""
 
-    __slots__ = ("name", "window_secs", "_samples")
+    __slots__ = ("name", "window_secs", "_samples", "_lock")
 
     def __init__(self, name, window_secs=600.0, max_samples=4096):
         self.name = name
         self.window_secs = float(window_secs)
         self._samples = deque(maxlen=max_samples)
+        # per-histogram lock: callers observe() OUTSIDE the registry lock
+        # while exporter scrape threads iterate the same deque via
+        # summary() — without this, values()'s comprehension races the
+        # append/popleft and raises "deque mutated during iteration"
+        self._lock = threading.Lock()
 
     def observe(self, value, now=None):
         now = now if now is not None else time.monotonic()
-        self._prune(now)
-        self._samples.append((now, float(value)))
+        with self._lock:
+            self._prune(now)
+            self._samples.append((now, float(value)))
 
     def _prune(self, now=None):
+        # caller holds self._lock
         now = now if now is not None else time.monotonic()
         cutoff = now - self.window_secs
         while self._samples and self._samples[0][0] < cutoff:
             self._samples.popleft()
 
     def values(self, now=None):
-        self._prune(now)
-        return [v for _, v in self._samples]
+        with self._lock:
+            self._prune(now)
+            return [v for _, v in self._samples]
 
     def percentile(self, q, now=None):
         """q-th percentile over the live window (stale samples are pruned
@@ -266,6 +277,28 @@ def _coerce_profiling(pcfg):
             "peak_hbm_gbps": float(get("peak_hbm_gbps", 0.0))}
 
 
+def _coerce_incidents(icfg):
+    """``telemetry.incidents`` block as a plain dict — accepts the
+    TelemetryIncidentsConfig object, a raw dict (hand-built configs), or
+    None (block absent: incident plane off)."""
+    defaults = {"enabled": False, "ring_capacity": 2048,
+                "ring_max_age_s": 600.0, "burn_windows": [],
+                "burn_min_requests": 8, "cooldown_s": 60.0,
+                "bundle_dir": "", "max_bundles": 16}
+    if icfg is None:
+        return defaults
+    get = (icfg.get if isinstance(icfg, dict)
+           else lambda k, d: getattr(icfg, k, d))
+    return {"enabled": bool(get("enabled", False)),
+            "ring_capacity": int(get("ring_capacity", 2048)),
+            "ring_max_age_s": float(get("ring_max_age_s", 600.0)),
+            "burn_windows": list(get("burn_windows", []) or []),
+            "burn_min_requests": int(get("burn_min_requests", 8)),
+            "cooldown_s": float(get("cooldown_s", 60.0)),
+            "bundle_dir": str(get("bundle_dir", "") or ""),
+            "max_bundles": int(get("max_bundles", 16))}
+
+
 # ----------------------------------------------------------------------
 # the telemetry object
 # ----------------------------------------------------------------------
@@ -286,6 +319,7 @@ class Telemetry:
         self.rank = 0
         self.cluster = None
         self.profiling = None
+        self.incidents = None
         self._stamp_rank = False
 
     def configure(self, config=None, rank=None):
@@ -310,6 +344,7 @@ class Telemetry:
             self.exporter = None
         self.cluster = None
         self.profiling = None
+        self.incidents = None
         self._stamp_rank = False
         self.config = config
         self.enabled = bool(config is not None and config.enabled)
@@ -332,6 +367,16 @@ class Telemetry:
         dcfg = _coerce_distributed(getattr(config, "distributed", None))
         out_dir = os.path.join(config.output_path or "./telemetry",
                                config.job_name)
+        icfg = _coerce_incidents(getattr(config, "incidents", None))
+        if icfg.pop("enabled"):
+            # incident plane (monitor/incidents.py): flight-recorder ring
+            # fed by emit() on EVERY rank, bundle writer + SLO burn-rate
+            # alerter; bundles default under the telemetry output dir
+            from deepspeed_tpu.monitor.incidents import IncidentManager
+            bundle_dir = icfg.pop("bundle_dir") or \
+                os.path.join(out_dir, "incidents")
+            self.incidents = IncidentManager(self, bundle_dir=bundle_dir,
+                                             **icfg)
         if dcfg["enabled"]:
             shard_dir = dcfg["shard_dir"] or out_dir
             self.sink = JsonlEventSink(
@@ -345,7 +390,8 @@ class Telemetry:
                     shard_dir,
                     skew_threshold=dcfg["skew_threshold"],
                     straggler_window=dcfg["straggler_window"],
-                    registry=self.registry)
+                    registry=self.registry,
+                    incidents=self.incidents)
                 self._start_exporter(getattr(config, "export", None))
         elif self.rank == 0:
             self.sink = JsonlEventSink(
@@ -377,9 +423,12 @@ class Telemetry:
             labels = {"rank": str(self.rank)} if self._stamp_rank else None
             cluster_fn = (self.cluster.snapshot
                           if self.cluster is not None else None)
+            incidents_fn = (self.incidents.snapshot
+                            if self.incidents is not None else None)
             self.exporter = MetricsExporter(self, host=host, port=port,
                                             labels=labels,
-                                            cluster_fn=cluster_fn)
+                                            cluster_fn=cluster_fn,
+                                            incidents_fn=incidents_fn)
             self.exporter.start()
         except Exception as e:
             logger.warning(f"metrics exporter failed to start: {e}")
@@ -400,7 +449,8 @@ class Telemetry:
 
     # -- events --------------------------------------------------------
     def emit(self, kind, name, **fields):
-        if not self.enabled or self.sink is None:
+        incidents = self.incidents
+        if not self.enabled or (self.sink is None and incidents is None):
             return
         event = {"ts": round(time.time(), 6), "kind": kind, "name": name}
         if self._stamp_rank:
@@ -408,7 +458,12 @@ class Telemetry:
             # rank so a merged stream keeps per-rank attribution
             event["rank"] = self.rank
         event.update({k: v for k, v in fields.items() if v is not None})
-        self.sink.emit(event)
+        if incidents is not None:
+            # flight recorder sees every event on every rank — the sink
+            # below may be rank-0-gated, the black box is not
+            incidents.record(event)
+        if self.sink is not None:
+            self.sink.emit(event)
 
     @contextmanager
     def span(self, name, step=None, attrs=None):
@@ -518,6 +573,7 @@ class Telemetry:
             self.sink = None
         self.cluster = None
         self.profiling = None
+        self.incidents = None
         self._stamp_rank = False
         self.enabled = False
 
@@ -645,6 +701,12 @@ class StepStallWatchdog:
         self.telemetry.emit(
             "stall", "engine/step", step=last_step, gap_s=round(gap, 3),
             median_step_s=round(median, 6), threshold_s=round(threshold, 3))
+        incidents = getattr(self.telemetry, "incidents", None)
+        if incidents is not None:
+            incidents.trigger(
+                "stall", source="engine/step", step=last_step,
+                detail=f"gap {gap:.1f}s > threshold {threshold:.1f}s "
+                       f"(median step {median:.3f}s)")
         return True
 
     def check_cluster(self, now=None):

@@ -141,6 +141,53 @@ class TelemetryProfilingConfig(DeepSpeedConfigModel):
                 "(growth needs at least two samples)")
 
 
+class TelemetryIncidentsConfig(DeepSpeedConfigModel):
+    """``"telemetry.incidents"`` block: the incident plane
+    (``monitor/incidents.py``) — an always-on flight-recorder ring over
+    recent telemetry events, a multi-window SLO burn-rate alerter, and a
+    bundle writer that every verdict source (stall, recompile storm,
+    straggler, leak, replica kill/fence, SLO burn) triggers.  Off by
+    default; enabled it costs one deque append per emitted event."""
+    enabled = False
+    ring_capacity = 2048            # flight-recorder events kept
+    ring_max_age_s = 600.0          # ...and no older than this at dump
+    burn_windows = []               # [[window_s, miss_rate], ...];
+    #                                 [] -> ((60, 0.5), (300, 0.1))
+    burn_min_requests = 8           # SLO terminals needed per window
+    cooldown_s = 60.0               # per-trigger-kind bundle cooldown
+    bundle_dir = ""                 # "" -> <telemetry out dir>/incidents
+    max_bundles = 16                # oldest bundle dirs pruned past this
+
+    def _validate(self):
+        if int(self.ring_capacity) < 1:
+            raise ValueError(
+                "telemetry.incidents.ring_capacity must be >= 1")
+        if float(self.ring_max_age_s) <= 0:
+            raise ValueError(
+                "telemetry.incidents.ring_max_age_s must be > 0")
+        if int(self.burn_min_requests) < 1:
+            raise ValueError(
+                "telemetry.incidents.burn_min_requests must be >= 1")
+        if float(self.cooldown_s) < 0:
+            raise ValueError(
+                "telemetry.incidents.cooldown_s must be >= 0")
+        if int(self.max_bundles) < 1:
+            raise ValueError(
+                "telemetry.incidents.max_bundles must be >= 1")
+        for w in (self.burn_windows or []):
+            try:
+                pair = ((w.get("window_s"), w.get("threshold"))
+                        if isinstance(w, dict) else tuple(w))
+                ok = (len(pair) == 2 and float(pair[0]) > 0 and
+                      0.0 < float(pair[1]) <= 1.0)
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    "telemetry.incidents.burn_windows entries must be "
+                    "[window_s > 0, 0 < miss_rate <= 1] pairs")
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``"telemetry"`` block: the unified JSONL event stream
     (``monitor/telemetry.py``) plus the step-stall watchdog and the
@@ -158,6 +205,7 @@ class TelemetryConfig(DeepSpeedConfigModel):
     export = {}                     # TelemetryExportConfig sub-block
     distributed = {}                # TelemetryDistributedConfig sub-block
     profiling = {}                  # TelemetryProfilingConfig sub-block
+    incidents = {}                  # TelemetryIncidentsConfig sub-block
 
     def _validate(self):
         if not isinstance(self.export, TelemetryExportConfig):
@@ -167,6 +215,8 @@ class TelemetryConfig(DeepSpeedConfigModel):
                 self.distributed or {})
         if not isinstance(self.profiling, TelemetryProfilingConfig):
             self.profiling = TelemetryProfilingConfig(self.profiling or {})
+        if not isinstance(self.incidents, TelemetryIncidentsConfig):
+            self.incidents = TelemetryIncidentsConfig(self.incidents or {})
 
 
 class AsyncPipelineConfig(DeepSpeedConfigModel):

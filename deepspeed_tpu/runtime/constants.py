@@ -81,6 +81,8 @@ MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 
 TELEMETRY = "telemetry"  # unified JSONL event stream + stall watchdog
+TELEMETRY_INCIDENTS = "incidents"  # telemetry sub-block: incident plane
+INCIDENT_DIRNAME_DEFAULT = "incidents"  # bundles under the telemetry dir
 
 ASYNC_PIPELINE = "async_pipeline"  # prefetched input feed + metric drain
 

@@ -13,6 +13,15 @@ Usage:
     python scripts/check_telemetry_schema.py --shards <shard_dir> [...]
     python scripts/check_telemetry_schema.py --cluster <payload.json> [...]
     python scripts/check_telemetry_schema.py --ledger <BENCH_LEDGER.jsonl>
+    python scripts/check_telemetry_schema.py --incidents <bundle_or_dir> [...]
+
+The ``--incidents`` mode validates incident bundles written by the
+incident plane (``monitor/incidents.py``): each bundle directory must
+contain a schema-valid ``incident.json`` (trigger kind from the frozen
+:data:`INCIDENT_TRIGGERS` vocabulary, registry snapshot, correlation
+section) plus ``ring.jsonl`` whose every line validates against the
+event schema.  A path may be one bundle or a parent ``incidents/``
+directory of bundles.
 
 The ``--ledger`` mode validates a perf-regression ledger
 (``bench.py`` appends one row per micro-bench metric; ``scripts/
@@ -127,6 +136,18 @@ SCHEMA = {
         "required": {"ts": _NUM, "kind": str, "name": str},
         "optional": {"attrs": dict, "step": int},
     },
+    # incident-plane events (monitor/incidents.py IncidentManager): one
+    # "incident/open" per trigger (id, trigger kind from
+    # INCIDENT_TRIGGERS, verdict source + detail) and one
+    # "incident/written" once its bundle landed on disk (ring-dump event
+    # count + bundle path).  The ``name`` field is validated against
+    # INCIDENT_EVENTS, ``trigger`` against INCIDENT_TRIGGERS.
+    "incident": {
+        "required": {"ts": _NUM, "kind": str, "name": str, "id": str,
+                     "trigger": str},
+        "optional": {"source": str, "detail": str, "step": int,
+                     "events": int, "path": str},
+    },
 }
 
 # FROZEN vocabulary of serve-kind event names — must stay byte-identical
@@ -212,6 +233,16 @@ PROFILE_SPANS = ("fwd", "bwd", "step", "train_batch", "serve_step",
 MEM_METRICS = ("live_bytes", "peak_bytes", "frag_bytes")
 ROOFLINE_METRICS = ("compute_frac", "bandwidth_frac")
 
+# FROZEN vocabularies of the incident plane — each must stay
+# byte-identical to its twin in ``deepspeed_tpu.monitor.incidents``
+# (the tier-1 test diffs both pairs).  Incident-kind event names, and
+# the closed set of trigger kinds (one per verdict source: watchdog
+# stall, recompile-storm onset, cluster straggler, non-empty
+# leak_report(), fleet replica kill / fence, SLO burn-rate alert).
+INCIDENT_EVENTS = ("incident/open", "incident/written")
+INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
+                     "replica_kill", "replica_fence", "slo_burn")
+
 EVENT_KINDS = tuple(SCHEMA)
 
 
@@ -263,6 +294,14 @@ def validate_event(event):
         cause = event.get("cause")
         if cause is not None and cause not in COMPILE_CAUSES:
             problems.append(f"compile: unknown cause {cause!r}")
+    if kind == "incident":
+        if isinstance(event.get("name"), str) and \
+                event["name"] not in INCIDENT_EVENTS:
+            problems.append(
+                f"incident: unknown event name {event['name']!r}")
+        trigger = event.get("trigger")
+        if isinstance(trigger, str) and trigger not in INCIDENT_TRIGGERS:
+            problems.append(f"incident: unknown trigger {trigger!r}")
     if kind == "gauge" and isinstance(event.get("name"), str):
         for prefix, metrics in (("mem/", MEM_METRICS),
                                 ("roofline/", ROOFLINE_METRICS)):
@@ -502,6 +541,99 @@ def validate_ledger_file(path):
 
 
 # ----------------------------------------------------------------------
+# incident bundles (monitor/incidents.py IncidentManager._write_bundle)
+# ----------------------------------------------------------------------
+# Each bundle is a directory ``<bundle_dir>/<inc-NNNN-kind>/`` holding
+# ``incident.json`` (the typed bundle) + ``ring.jsonl`` (the flight
+# recorder's dump, one schema-valid event per line).
+INCIDENT_BUNDLE_FILES = ("incident.json", "ring.jsonl")
+
+
+def validate_incident_bundle(dirpath):
+    """Validate one incident bundle directory.  Returns a list of
+    problem strings (empty = valid)."""
+    problems = []
+    inc_path = os.path.join(dirpath, "incident.json")
+    ring_path = os.path.join(dirpath, "ring.jsonl")
+    if not os.path.isfile(inc_path):
+        return [f"{dirpath}: missing incident.json"]
+    with open(inc_path) as f:
+        try:
+            obj = json.load(f)
+        except ValueError as e:
+            return [f"{inc_path}: not valid JSON: {e}"]
+    if not isinstance(obj, dict):
+        return [f"{inc_path}: bundle is {type(obj).__name__}, not an object"]
+    _check(problems, isinstance(obj.get("id"), str) and obj.get("id"),
+           f"{inc_path}: missing or non-string id")
+    _check(problems,
+           isinstance(obj.get("ts"), _NUM) and
+           not isinstance(obj.get("ts"), bool),
+           f"{inc_path}: missing or non-numeric ts")
+    trig = obj.get("trigger")
+    if not isinstance(trig, dict):
+        problems.append(f"{inc_path}: trigger is not an object")
+    else:
+        _check(problems, trig.get("kind") in INCIDENT_TRIGGERS,
+               f"{inc_path}: unknown trigger kind {trig.get('kind')!r}")
+        _check(problems, isinstance(trig.get("source"), str),
+               f"{inc_path}: trigger.source is not a string")
+    reg = obj.get("registry")
+    if not isinstance(reg, dict):
+        problems.append(f"{inc_path}: registry is not an object")
+    else:
+        for f_ in ("counters", "gauges", "histograms"):
+            _check(problems, isinstance(reg.get(f_), dict),
+                   f"{inc_path}: registry.{f_} is not an object")
+    corr = obj.get("correlation")
+    if not isinstance(corr, dict):
+        problems.append(f"{inc_path}: correlation is not an object")
+    else:
+        _check(problems,
+               isinstance(corr.get("window_s"), _NUM) and
+               not isinstance(corr.get("window_s"), bool),
+               f"{inc_path}: correlation.window_s is not numeric")
+        _check(problems, isinstance(corr.get("windows"), list),
+               f"{inc_path}: correlation.windows is not a list")
+        _check(problems, isinstance(corr.get("links"), list),
+               f"{inc_path}: correlation.links is not a list")
+    ring = obj.get("ring")
+    if not isinstance(ring, dict):
+        problems.append(f"{inc_path}: ring is not an object")
+    else:
+        _check(problems,
+               isinstance(ring.get("events"), int) and
+               not isinstance(ring.get("events"), bool),
+               f"{inc_path}: ring.events is not an int")
+        _check(problems, isinstance(ring.get("path"), str),
+               f"{inc_path}: ring.path is not a string")
+    if not os.path.isfile(ring_path):
+        problems.append(f"{dirpath}: missing ring.jsonl")
+    else:
+        for i, p in validate_file(ring_path):
+            problems.append(f"{ring_path}:{i}: {p}")
+    return problems
+
+
+def validate_incidents_path(path):
+    """Validate ``path`` as one bundle directory, or as a parent
+    ``incidents/`` directory of bundles.  Returns ``(problems,
+    bundles_seen)``."""
+    if os.path.isfile(os.path.join(path, "incident.json")):
+        return validate_incident_bundle(path), 1
+    problems = []
+    bundles = 0
+    for entry in sorted(os.listdir(path) if os.path.isdir(path) else []):
+        sub = os.path.join(path, entry)
+        if os.path.isfile(os.path.join(sub, "incident.json")):
+            bundles += 1
+            problems.extend(validate_incident_bundle(sub))
+    if not bundles:
+        problems.append(f"{path}: no incident bundles found")
+    return problems, bundles
+
+
+# ----------------------------------------------------------------------
 # exporter metric-name validation (monitor/export.py)
 # ----------------------------------------------------------------------
 # Prometheus text exposition format 0.0.4, the exporter's /metrics
@@ -615,6 +747,19 @@ def main(argv=None):
             print(f"FAIL: {bad} problem(s)")
             return 1
         print("OK: cluster payload validated")
+        return 0
+    if argv[0] == "--incidents":
+        bad = bundles = 0
+        for path in argv[1:]:
+            problems, n = validate_incidents_path(path)
+            bundles += n
+            for p in problems:
+                print(p)
+                bad += 1
+        if bad:
+            print(f"FAIL: {bad} problem(s) across {bundles} bundle(s)")
+            return 1
+        print(f"OK: {bundles} bundle(s) validated")
         return 0
     bad = 0
     total = 0

@@ -23,6 +23,15 @@ ledger exists (>= 2 runs) but exits 0 — with a note — when the ledger is
 missing or still single-run, so the gate can ride in the tier-1 flow
 before any baseline has been seeded.
 
+``--check`` additionally audits baseline FRESHNESS: when the newest
+on-chip train evidence (the latest ``bench == "train"`` ledger row, or
+``BENCH_onchip_latest.json`` next to the ledger) is older than the last
+``--stale-runs`` cpu-only bench runs, it prints an explicit
+``STALE-BASELINE`` warning — the cpu gate keeps ratcheting while the
+on-chip numbers it is meant to stand in for go quietly out of date.
+The warning never changes the exit code; it is a prompt to re-run the
+on-chip bench, not a gate.
+
 Exit codes: 0 ok / skipped, 1 regression(s), 2 usage or malformed ledger.
 """
 
@@ -149,6 +158,57 @@ def diff(baseline_rows, current_rows, tolerance):
     return results
 
 
+def check_stale_baseline(rows, onchip_path, stale_runs):
+    """Return a STALE-BASELINE warning string, or None when the on-chip
+    evidence is still fresh (or there are not yet ``stale_runs`` cpu-only
+    runs to judge against).
+
+    Evidence of an on-chip run is the newest of (a) any ``bench ==
+    "train"`` ledger row's ts and (b) ``captured_unix`` inside
+    ``onchip_path``.  A run counts as cpu-only when none of its rows is a
+    train metric."""
+    train_ts = max((float(r["ts"]) for r in rows if r["bench"] == "train"),
+                   default=None)
+    onchip_ts = None
+    if onchip_path and os.path.exists(onchip_path):
+        try:
+            with open(onchip_path) as f:
+                cap = json.load(f).get("captured_unix")
+            if isinstance(cap, (int, float)) and not isinstance(cap, bool):
+                onchip_ts = float(cap)
+        except (ValueError, OSError):
+            pass
+    evidence = [t for t in (train_ts, onchip_ts) if t is not None]
+    evidence_ts = max(evidence) if evidence else None
+
+    order, first_ts, has_train = [], {}, set()
+    for row in rows:
+        run = row["run"]
+        if run not in first_ts:
+            order.append(run)
+            first_ts[run] = float(row["ts"])
+        if row["bench"] == "train":
+            has_train.add(run)
+    cpu_runs = [r for r in order if r not in has_train]
+    recent = cpu_runs[-stale_runs:]
+    if len(recent) < stale_runs:
+        return None
+    if evidence_ts is None:
+        return (f"STALE-BASELINE: no on-chip train evidence at all (no "
+                f"train ledger rows, no {onchip_path}) behind the last "
+                f"{stale_runs} cpu bench run(s) — the cpu gate has "
+                f"nothing on-chip to stand in for; re-run the on-chip "
+                f"train bench")
+    if all(first_ts[r] > evidence_ts for r in recent):
+        return (f"STALE-BASELINE: newest on-chip train evidence "
+                f"(ts {evidence_ts:.0f}) predates the last {stale_runs} "
+                f"cpu bench run(s) (oldest at ts "
+                f"{min(first_ts[r] for r in recent):.0f}) — cpu gating "
+                f"may have drifted from hardware reality; re-run the "
+                f"on-chip train bench")
+    return None
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Gate the latest bench run against the ledger "
@@ -161,6 +221,13 @@ def main(argv=None):
     ap.add_argument("--check", action="store_true",
                     help="CI mode: exit 0 when the ledger is missing or "
                          "has no baseline yet")
+    ap.add_argument("--stale-runs", type=int, default=3,
+                    help="warn STALE-BASELINE when the newest on-chip "
+                         "train evidence is older than this many cpu "
+                         "runs (default 3; --check only)")
+    ap.add_argument("--onchip", default=None,
+                    help="on-chip evidence file (default "
+                         "BENCH_onchip_latest.json next to the ledger)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full diff as JSON")
     args = ap.parse_args(argv)
@@ -178,6 +245,13 @@ def main(argv=None):
         for p in problems:
             print(p, file=sys.stderr)
         return 2
+    if args.check:
+        onchip = args.onchip or os.path.join(
+            os.path.dirname(os.path.abspath(args.ledger)),
+            "BENCH_onchip_latest.json")
+        warn = check_stale_baseline(rows, onchip, args.stale_runs)
+        if warn:
+            print(warn)
     baseline_rows, current_rows, current = split_runs(rows)
     if not current_rows:
         msg = (f"perf-diff: ledger has "

@@ -1,0 +1,380 @@
+#!/usr/bin/env python
+"""Export a telemetry events.jsonl stream as a Chrome trace-event file.
+
+Standalone (stdlib-only, no deepspeed_tpu import): converts the JSONL
+event stream written by ``monitor/telemetry.py`` — a single-rank
+``events.jsonl`` (plus rotated ``events.jsonl.N`` generations) or a
+distributed shard directory of ``events.rank<k>.jsonl`` files — into
+Chrome trace-event JSON loadable by Perfetto (https://ui.perfetto.dev)
+and chrome://tracing.
+
+Mapping (one rank = one trace process):
+
+* ``span`` events become ``"X"`` complete events.  A span record's
+  ``ts`` is stamped at span END, so the slice start is
+  ``ts - dur_ms/1000``.
+* ``comm`` events with a host-observed ``dur_ms`` become ``"X"``
+  slices on a per-rank "collectives" track, joined ACROSS ranks by
+  flow events (``"s"``/``"t"``/``"f"``): the k-th timed occurrence of
+  each collective op is one flow, so rank skew at collective entry is
+  visible as slanted arrows.  Untimed comm censuses become instants.
+* ``serve/request/*`` lifecycle events become nestable async events
+  (``"b"`` at admitted, ``"n"`` at prefill_start / first_token,
+  ``"e"`` at the terminal) keyed by ``req_id`` — each request renders
+  as one async track spanning admission to terminal.
+* ``gauge`` / ``counter`` events become ``"C"`` counter events.
+* everything else (stall, compile, fleet, fault, incident, meta,
+  heartbeat, remaining serve events) becomes ``"i"`` instants.
+
+Usage:
+    python scripts/ds_trace_export.py <events.jsonl | telemetry-dir>
+        [-o trace.json] [--check]
+
+``-o`` defaults to ``trace.json`` next to the input.  ``--check``
+additionally validates the produced object against the trace-event
+format (also used by the tier-1 tests via :func:`validate_trace`) and
+exits non-zero on problems.
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+_NUM = (int, float)
+
+_SHARD_RE = re.compile(r"events\.rank(\d+)\.jsonl(\.\d+)?$")
+
+# fixed per-rank thread ids (Perfetto tracks)
+TID_SPANS = 1
+TID_COMM = 2
+TID_INSTANTS = 3
+TID_REQUESTS = 4
+
+_ASYNC_BEGIN = ("serve/request/admitted",)
+_ASYNC_STEP = ("serve/request/prefill_start", "serve/request/first_token")
+_ASYNC_END = ("serve/request/finish", "serve/request/shed",
+              "serve/request/deadline", "serve/request/evict")
+
+
+# ----------------------------------------------------------------------
+# input discovery / parsing
+# ----------------------------------------------------------------------
+def discover_inputs(path):
+    """Return ``[(filepath, rank_or_None), ...]`` for ``path``: a single
+    JSONL file, or a directory holding ``events.jsonl`` (+ rotations)
+    and/or ``events.rank<k>.jsonl`` shards."""
+    if os.path.isfile(path):
+        m = _SHARD_RE.search(path)
+        return [(path, int(m.group(1)) if m else None)]
+    inputs = []
+    for p in sorted(glob.glob(os.path.join(path, "events.jsonl")) +
+                    glob.glob(os.path.join(path, "events.jsonl.*"))):
+        inputs.append((p, None))
+    for p in sorted(glob.glob(os.path.join(path, "events.rank*.jsonl")) +
+                    glob.glob(os.path.join(path, "events.rank*.jsonl.*"))):
+        m = _SHARD_RE.search(p)
+        if m:
+            inputs.append((p, int(m.group(1))))
+    return inputs
+
+
+def load_events(path):
+    """Parse every input under ``path`` into a flat event list, each
+    stamped with its rank (filename rank for shards, else the record's
+    own ``rank`` field, else 0).  Unparseable lines are skipped — a live
+    writer's torn tail must not break an export."""
+    events = []
+    for filepath, file_rank in discover_inputs(path):
+        with open(filepath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(ev, dict) or \
+                        not isinstance(ev.get("ts"), _NUM):
+                    continue
+                rank = file_rank
+                if rank is None:
+                    rank = ev.get("rank")
+                ev["_rank"] = int(rank) if isinstance(rank, int) else 0
+                events.append(ev)
+    events.sort(key=lambda e: e["ts"])
+    return events
+
+
+# ----------------------------------------------------------------------
+# conversion
+# ----------------------------------------------------------------------
+def _args(ev):
+    """Everything informative the event carries, minus the envelope."""
+    out = {}
+    for k, v in ev.items():
+        if k in ("ts", "kind", "name", "rank", "_rank", "attrs"):
+            continue
+        out[k] = v
+    attrs = ev.get("attrs")
+    if isinstance(attrs, dict):
+        out.update(attrs)
+    return out
+
+
+def convert(events):
+    """Convert a loaded event list into a Chrome trace-event object."""
+    trace = []
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+
+    # the time origin is the earliest slice START, not the earliest
+    # record ts: span/comm records are stamped at END, so their slices
+    # begin dur earlier — anchoring on raw ts would go negative
+    def _start(ev):
+        ts = float(ev["ts"])
+        if ev.get("kind") in ("span", "comm") and \
+                isinstance(ev.get("dur_ms"), _NUM):
+            return ts - max(0.0, float(ev["dur_ms"])) / 1000.0
+        return ts
+
+    t0 = min(_start(e) for e in events)
+
+    def us(ts):
+        return round((ts - t0) * 1e6, 1)
+
+    ranks = set()
+    tids_used = {}          # (pid, tid) -> track name
+    comm_occurrence = {}    # (rank, op) -> timed-occurrence counter
+    flow_sites = {}         # (op, k) -> [(rank, start_us), ...]
+
+    for ev in events:
+        kind = ev.get("kind")
+        name = ev.get("name", "")
+        rank = ev["_rank"]
+        ranks.add(rank)
+        ts_us = us(ev["ts"])
+
+        if kind == "span":
+            dur_us = max(0.0, float(ev.get("dur_ms", 0.0)) * 1000.0)
+            trace.append({"ph": "X", "name": name, "cat": "span",
+                          "pid": rank, "tid": TID_SPANS,
+                          "ts": round(ts_us - dur_us, 1),
+                          "dur": round(dur_us, 1), "args": _args(ev)})
+            tids_used[(rank, TID_SPANS)] = "spans"
+        elif kind == "comm":
+            if isinstance(ev.get("dur_ms"), _NUM):
+                dur_us = max(0.0, float(ev["dur_ms"]) * 1000.0)
+                start_us = round(ts_us - dur_us, 1)
+                trace.append({"ph": "X", "name": name, "cat": "comm",
+                              "pid": rank, "tid": TID_COMM,
+                              "ts": start_us, "dur": round(dur_us, 1),
+                              "args": _args(ev)})
+                tids_used[(rank, TID_COMM)] = "collectives"
+                k = comm_occurrence.get((rank, name), 0)
+                comm_occurrence[(rank, name)] = k + 1
+                flow_sites.setdefault((name, k), []).append(
+                    (rank, start_us))
+            else:
+                trace.append({"ph": "i", "name": name, "cat": "comm",
+                              "pid": rank, "tid": TID_INSTANTS,
+                              "ts": ts_us, "s": "t", "args": _args(ev)})
+                tids_used[(rank, TID_INSTANTS)] = "events"
+        elif kind == "serve" and name.startswith("serve/request/"):
+            args = _args(ev)
+            req_id = str(args.get("req_id", "?"))
+            if name in _ASYNC_BEGIN:
+                ph = "b"
+            elif name in _ASYNC_END:
+                ph = "e"
+            else:
+                ph = "n"
+            trace.append({"ph": ph, "name": "request", "cat": "request",
+                          "id": req_id, "pid": rank, "tid": TID_REQUESTS,
+                          "ts": ts_us,
+                          "args": dict(args, state=name)})
+            tids_used[(rank, TID_REQUESTS)] = "requests"
+        elif kind in ("gauge", "counter"):
+            value = ev.get("value")
+            if isinstance(value, _NUM) and not isinstance(value, bool):
+                trace.append({"ph": "C", "name": name, "pid": rank,
+                              "ts": ts_us, "args": {"value": value}})
+        else:
+            trace.append({"ph": "i", "name": name, "cat": kind or "event",
+                          "pid": rank, "tid": TID_INSTANTS,
+                          "ts": ts_us, "s": "t", "args": _args(ev)})
+            tids_used[(rank, TID_INSTANTS)] = "events"
+
+    # cross-rank collective flows: the k-th timed occurrence of an op on
+    # every rank is one logical collective — arrow from the earliest
+    # entrant through every later one (the straggler reads directly off
+    # the arrow slant).  Flow ts must land inside the bound slice, so we
+    # anchor at slice start + epsilon.
+    for (op, k), sites in sorted(flow_sites.items()):
+        if len(sites) < 2:
+            continue
+        sites.sort(key=lambda s: s[1])
+        flow_id = f"{op}:{k}"
+        for i, (rank, start_us) in enumerate(sites):
+            if i == 0:
+                ph = "s"
+            elif i == len(sites) - 1:
+                ph = "f"
+            else:
+                ph = "t"
+            rec = {"ph": ph, "name": op, "cat": "comm-flow",
+                   "id": flow_id, "pid": rank, "tid": TID_COMM,
+                   "ts": round(start_us + 0.1, 1)}
+            if ph == "f":
+                rec["bp"] = "e"     # bind finish to enclosing slice
+            trace.append(rec)
+
+    meta = []
+    for rank in sorted(ranks):
+        meta.append({"ph": "M", "name": "process_name", "pid": rank,
+                     "args": {"name": f"rank {rank}"}})
+    for (rank, tid), label in sorted(tids_used.items()):
+        meta.append({"ph": "M", "name": "thread_name", "pid": rank,
+                     "tid": tid, "args": {"name": label}})
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------------------
+# trace-event format validation
+# ----------------------------------------------------------------------
+_PHASES = ("X", "B", "E", "i", "I", "C", "b", "n", "e", "s", "t", "f",
+           "M")
+
+
+def validate_trace(obj):
+    """Validate ``obj`` against the Chrome trace-event JSON format (the
+    subset this exporter emits).  Returns a list of problem strings
+    (empty = valid)."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"trace is {type(obj).__name__}, not an object"]
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is missing or not a list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            problems.append(f"{where}: unknown phase {ph!r}")
+            continue
+        if "pid" not in ev or isinstance(ev["pid"], bool) or \
+                not isinstance(ev["pid"], int):
+            problems.append(f"{where}: missing or non-int pid")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, _NUM) or isinstance(ts, bool):
+                problems.append(f"{where}: missing or non-numeric ts")
+            elif ts < 0:
+                problems.append(f"{where}: negative ts {ts}")
+        if ph in ("X", "C", "M", "b", "n", "e", "i", "I") and \
+                not isinstance(ev.get("name"), str):
+            problems.append(f"{where}: missing or non-string name")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, _NUM) or isinstance(dur, bool):
+                problems.append(f"{where}: X event missing numeric dur")
+            elif dur < 0:
+                problems.append(f"{where}: negative dur {dur}")
+        if ph in ("b", "n", "e", "s", "t", "f"):
+            if not isinstance(ev.get("id"), str):
+                problems.append(f"{where}: {ph!r} event missing string id")
+            if ph in ("b", "n", "e") and \
+                    not isinstance(ev.get("cat"), str):
+                problems.append(
+                    f"{where}: async event missing string cat")
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args or \
+                    not all(isinstance(v, _NUM) and
+                            not isinstance(v, bool)
+                            for v in args.values()):
+                problems.append(
+                    f"{where}: counter args must be numeric and "
+                    f"non-empty")
+        if ph == "M":
+            if ev.get("name") not in ("process_name", "thread_name",
+                                      "process_labels",
+                                      "process_sort_index",
+                                      "thread_sort_index"):
+                problems.append(
+                    f"{where}: unknown metadata name {ev.get('name')!r}")
+            elif not isinstance(ev.get("args"), dict):
+                problems.append(f"{where}: metadata missing args")
+    # every async begin must see a matching end (same cat+id+pid)
+    opened = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            continue
+        key = (ev.get("cat"), ev.get("id"), ev.get("pid"))
+        if ev.get("ph") == "b":
+            opened[key] = opened.get(key, 0) + 1
+        elif ev.get("ph") == "e":
+            if opened.get(key, 0) <= 0:
+                problems.append(
+                    f"traceEvents[{i}]: async end without begin "
+                    f"(cat={key[0]!r} id={key[1]!r})")
+            else:
+                opened[key] -= 1
+    return problems
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+def main(argv=None):
+    argv = list(argv if argv is not None else sys.argv[1:])
+    check = "--check" in argv
+    if check:
+        argv.remove("--check")
+    out_path = None
+    if "-o" in argv:
+        i = argv.index("-o")
+        try:
+            out_path = argv[i + 1]
+        except IndexError:
+            print("FAIL: -o requires a path")
+            return 2
+        del argv[i:i + 2]
+    if len(argv) != 1:
+        print(__doc__)
+        return 2
+    src = argv[0]
+    if not os.path.exists(src):
+        print(f"FAIL: no such path {src!r}")
+        return 1
+    events = load_events(src)
+    if not events:
+        print(f"FAIL: no telemetry events found under {src!r}")
+        return 1
+    obj = convert(events)
+    if out_path is None:
+        base = src if os.path.isdir(src) else os.path.dirname(src) or "."
+        out_path = os.path.join(base, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(obj, f)
+    n = len(obj["traceEvents"])
+    print(f"wrote {out_path}: {n} trace event(s) from "
+          f"{len(events)} telemetry event(s)")
+    if check:
+        problems = validate_trace(obj)
+        if problems:
+            for p in problems:
+                print(p)
+            print(f"FAIL: {len(problems)} problem(s)")
+            return 1
+        print("OK: trace validated")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,602 @@
+"""Incident plane tests (monitor/incidents.py): the always-on flight
+recorder's bounds, the multi-window SLO burn-rate alerter, the
+cross-plane correlation pass, exactly one schema-valid bundle per
+verdict source (stall, storm, straggler, leak, replica_kill, slo_burn),
+zero bundles on a quiet run, the ``GET /incidents`` surface, and the
+Perfetto timeline export.
+
+The acceptance scenario: an injected recompile storm during a
+deadline-missing serving workload produces exactly one bundle whose
+correlation section links the SLO-missed requests to the storm's
+compile-miss events, and ``ds_trace_export.py`` renders the same run as
+valid Chrome trace-event JSON."""
+
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.fleet import FleetRouter
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.monitor.aggregate import ClusterAggregator
+from deepspeed_tpu.monitor.incidents import (DEFAULT_BURN_WINDOWS,
+                                             EventRingBuffer,
+                                             INCIDENT_EVENTS,
+                                             INCIDENT_TRIGGERS,
+                                             IncidentManager,
+                                             SloBurnAlerter, correlate)
+from deepspeed_tpu.monitor.telemetry import StepStallWatchdog, Telemetry
+from deepspeed_tpu.runtime.config import (TelemetryConfig,
+                                          TelemetryIncidentsConfig)
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    return cfg, model, params
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def _prompts(cfg, seed, lengths):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, (n,)).tolist() for n in lengths]
+
+
+def _load_script(name):
+    path = os.path.join(REPO, "scripts", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def checker():
+    return _load_script("check_telemetry_schema")
+
+
+@pytest.fixture(scope="module")
+def exporter_mod():
+    return _load_script("ds_trace_export")
+
+
+def _tel(tmp_path, job="inc", incidents=None, **extra):
+    inc = {"enabled": True, "cooldown_s": 0.0}
+    inc.update(incidents or {})
+    raw = {"enabled": True, "output_path": str(tmp_path), "job_name": job,
+           "profiling": {"enabled": True, "storm_threshold": 3,
+                         "storm_window_s": 60.0},
+           "incidents": inc}
+    raw.update(extra)
+    return Telemetry().configure(TelemetryConfig(raw), rank=0)
+
+
+def _bundles(bdir):
+    return sorted(os.listdir(bdir)) if os.path.isdir(bdir) else []
+
+
+def _assert_one_valid_bundle(bdir, checker, kind):
+    """The per-trigger contract: exactly one bundle, checker-valid, of
+    the expected trigger kind.  Returns the decoded incident.json."""
+    dirs = _bundles(bdir)
+    assert len(dirs) == 1 and dirs[0].endswith(f"-{kind}")
+    problems, n = checker.validate_incidents_path(bdir)
+    assert problems == [] and n == 1
+    with open(os.path.join(bdir, dirs[0], "incident.json")) as f:
+        bundle = json.load(f)
+    assert bundle["trigger"]["kind"] == kind
+    return bundle
+
+
+def _events(tmp_path, job):
+    path = os.path.join(str(tmp_path), job, "events.jsonl")
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+def test_ring_capacity_bound():
+    ring = EventRingBuffer(capacity=4, max_age_s=1e9)
+    for i in range(10):
+        ring.record({"ts": float(i), "kind": "meta", "name": f"e{i}"})
+    assert len(ring) == 4 and ring.recorded == 10
+    assert [e["name"] for e in ring.dump(now=9.0)] == ["e6", "e7", "e8",
+                                                       "e9"]
+
+
+def test_ring_age_bound():
+    ring = EventRingBuffer(capacity=100, max_age_s=10.0)
+    ring.record({"ts": 0.0, "kind": "meta", "name": "stale"})
+    ring.record({"ts": 95.0, "kind": "meta", "name": "fresh"})
+    assert [e["name"] for e in ring.dump(now=100.0)] == ["fresh"]
+    # capacity still holds both; only the dump is age-filtered
+    assert len(ring) == 2
+
+
+# ----------------------------------------------------------------------
+# SLO burn-rate alerter
+# ----------------------------------------------------------------------
+def test_burn_alerter_fires_on_rising_edge_only():
+    b = SloBurnAlerter(windows=[(10.0, 0.5)], min_requests=4)
+    newly, _ = b.observe(0, 0, now=0.0)      # baseline sample
+    assert not newly
+    newly, detail = b.observe(1, 5, now=5.0)  # 5/6 missed in-window
+    assert newly and b.active
+    assert detail[0]["miss_rate"] == pytest.approx(5 / 6, abs=1e-3)
+    newly, _ = b.observe(1, 6, now=6.0)       # still burning: no re-fire
+    assert not newly and b.active
+    # recovery: plenty of attained traffic drops the windowed rate
+    newly, _ = b.observe(50, 6, now=8.0)
+    assert not newly and not b.active
+    # a fresh burn after recovery is a new rising edge
+    newly, _ = b.observe(50, 60, now=9.0)
+    assert newly
+
+
+def test_burn_alerter_needs_every_window():
+    """Multi-window semantics: a short-window blip alone must not fire —
+    the long window has to corroborate."""
+    b = SloBurnAlerter(windows=[(2.0, 0.5), (100.0, 0.5)], min_requests=2)
+    b.observe(0, 0, now=0.0)
+    b.observe(100, 0, now=48.0)    # long window dominated by attained
+    newly, detail = b.observe(100, 4, now=51.0)
+    by_w = {d["window_s"]: d["miss_rate"] for d in detail}
+    assert by_w[2.0] == 1.0                     # short window: burning
+    assert by_w[100.0] < 0.5                    # long window: healthy
+    assert not newly and not b.active
+
+
+def test_burn_alerter_min_requests_guard():
+    b = SloBurnAlerter(windows=[(10.0, 0.5)], min_requests=8)
+    b.observe(0, 0, now=0.0)
+    newly, detail = b.observe(0, 3, now=1.0)   # 100% missed, but only 3
+    assert not newly and detail[0]["miss_rate"] is None
+
+
+def test_default_burn_windows():
+    b = SloBurnAlerter()
+    assert b.windows == tuple(sorted(DEFAULT_BURN_WINDOWS))
+
+
+# ----------------------------------------------------------------------
+# cross-plane correlation
+# ----------------------------------------------------------------------
+def _miss(ts, rid):
+    return {"ts": ts, "kind": "serve", "name": "serve/request/deadline",
+            "attrs": {"req_id": rid, "slo": "miss"}}
+
+
+def test_correlate_links_miss_to_causes():
+    events = [
+        {"ts": 10.1, "kind": "compile", "name": "compile/miss",
+         "site": "f", "count": 2, "cause": "new_shape", "dur_ms": 50.0,
+         "step": 3},
+        {"ts": 10.2, "kind": "gauge", "name": "mem/serve_step/peak_bytes",
+         "value": 1 << 20, "peak": 1 << 20, "step": 3},
+        {"ts": 10.3, "kind": "comm", "name": "all_reduce", "bytes": 4096,
+         "axis": "dp", "dur_ms": 2.0},
+        _miss(10.4, "r1"),
+        {"ts": 50.0, "kind": "serve", "name": "serve/request/finish",
+         "attrs": {"req_id": "r2", "slo": "ok"}},
+    ]
+    out = correlate(events, window_s=1.0)
+    assert out["window_s"] == 1.0
+    (link,) = out["links"]
+    assert link["req_id"] == "r1"
+    assert link["compile_misses"][0]["cause"] == "new_shape"
+    assert link["mem_peak_bytes"][0]["span"] == "serve_step"
+    assert link["collectives"][0]["op"] == "all_reduce"
+    w10 = next(w for w in out["windows"] if w["window"] == 10)
+    assert w10["slo_missed"] == ["r1"] and w10["steps"] == [3]
+    # the on-time finish neither links nor counts as missed
+    w50 = next(w for w in out["windows"] if w["window"] == 50)
+    assert w50["slo_missed"] == []
+
+
+def test_correlate_joins_across_bucket_edges():
+    """Time proximity, not bucket identity: a miss at 11.05 still links
+    to a compile miss at 10.95 one bucket earlier."""
+    events = [
+        {"ts": 10.95, "kind": "compile", "name": "compile/miss",
+         "site": "f", "count": 1, "cause": "new_shape"},
+        _miss(11.05, "edge"),
+    ]
+    (link,) = correlate(events, window_s=1.0)["links"]
+    assert link["req_id"] == "edge" and link["compile_misses"]
+
+
+def test_correlate_unlinked_miss_produces_no_link():
+    assert correlate([_miss(10.0, "alone")], window_s=1.0)["links"] == []
+
+
+# ----------------------------------------------------------------------
+# trigger vocabulary + cooldown + pruning
+# ----------------------------------------------------------------------
+def test_unknown_trigger_raises():
+    mgr = IncidentManager(Telemetry(), bundle_dir="/nonexistent")
+    with pytest.raises(ValueError):
+        mgr.trigger("bogus")
+
+
+def test_trigger_cooldown_dedups_per_kind(tmp_path):
+    clk = FakeClock()
+    mgr = IncidentManager(Telemetry(), bundle_dir=str(tmp_path / "b"),
+                          cooldown_s=60.0, clock=clk)
+    assert mgr.trigger("stall") == "inc-0001-stall"
+    assert mgr.trigger("stall") is None          # same episode: suppressed
+    assert mgr.trigger("storm") == "inc-0002-storm"  # other kinds free
+    clk.tick(61.0)
+    assert mgr.trigger("stall") == "inc-0003-stall"  # episode over
+
+
+def test_bundle_pruning(tmp_path):
+    mgr = IncidentManager(Telemetry(), bundle_dir=str(tmp_path / "b"),
+                          cooldown_s=0.0, max_bundles=2)
+    for kind in ("stall", "storm", "leak", "slo_burn"):
+        assert mgr.trigger(kind)
+    kept = sorted(os.listdir(tmp_path / "b"))
+    assert kept == ["inc-0003-leak", "inc-0004-slo_burn"]
+    assert len(mgr.written) == 4                 # history outlives pruning
+
+
+# ----------------------------------------------------------------------
+# the six verdict sources, one bundle each
+# ----------------------------------------------------------------------
+def test_stall_trigger_writes_bundle(tmp_path, checker):
+    tel = _tel(tmp_path, job="stall")
+    wd = StepStallWatchdog(tel, stall_factor=1.0, min_stall_secs=0.0)
+    for s in range(3):
+        wd.beat(s)
+    import time as _time
+    future = _time.monotonic() + 1e6
+    assert wd.check(now=future)
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    bundle = _assert_one_valid_bundle(bdir, checker, "stall")
+    assert bundle["trigger"]["source"] == "engine/step"
+    assert bundle["trigger"]["step"] == 2
+    # the open event itself is in the bundle's ring; written comes after
+    evs = _events(tmp_path, "stall")
+    names = [e["name"] for e in evs if e["kind"] == "incident"]
+    assert names == ["incident/open", "incident/written"]
+    assert checker.validate_file(
+        os.path.join(str(tmp_path), "stall", "events.jsonl")) == []
+
+
+def test_storm_trigger_writes_bundle(tmp_path, checker):
+    tel = _tel(tmp_path, job="storm")
+    # first miss is "cold" and excluded from the storm window: 4 misses
+    # with distinct shapes cross threshold 3
+    for i in range(4):
+        tel.profiling.compiles.note_miss(
+            "f", ("f", ((f"s{i}", "f32"),)), 0.01, step=i)
+    # the storm stays active: further misses must not re-trigger
+    tel.profiling.compiles.note_miss(
+        "f", ("f", (("s9", "f32"),)), 0.01, step=9)
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    bundle = _assert_one_valid_bundle(bdir, checker, "storm")
+    assert "misses" in bundle["trigger"]["detail"]
+
+
+def _write_hb_shard(d, rank, step_ms, steps=4):
+    with open(os.path.join(d, f"events.rank{rank}.jsonl"), "w") as f:
+        for s in range(1, steps + 1):
+            f.write(json.dumps(
+                {"ts": 100.0 + s, "kind": "heartbeat",
+                 "name": "engine/heartbeat", "step": s,
+                 "step_ms": step_ms, "rank": rank}) + "\n")
+
+
+def test_straggler_trigger_writes_bundle(tmp_path, checker):
+    tel = _tel(tmp_path, job="strag")
+    d = str(tmp_path / "shards")
+    os.makedirs(d)
+    _write_hb_shard(d, 0, 10.0)
+    _write_hb_shard(d, 1, 50.0)                  # 5x the median: flagged
+    agg = ClusterAggregator(d, skew_threshold=2.0, min_refresh_secs=0.0,
+                            incidents=tel.incidents)
+    snap = agg.snapshot()
+    assert snap["straggler"]["rank"] == 1
+    agg.refresh(force=True)                      # same verdict: no refire
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    bundle = _assert_one_valid_bundle(bdir, checker, "straggler")
+    assert bundle["trigger"]["source"] == "rank1"
+
+
+def test_leak_trigger_writes_bundle(tiny, tmp_path, checker):
+    cfg, model, params = tiny
+    tel = _tel(tmp_path, job="leak")
+    eng = ServingEngine(model, params, max_batch=1, page_size=8,
+                        max_seq=32, dtype=jnp.float32, telemetry=tel)
+    # forced invariant violation: an RNG stream owned by no live slot
+    eng._rng["ghost"] = jax.random.key(0)
+    leaks = eng.leak_report()
+    assert "stray_rng" in leaks
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    bundle = _assert_one_valid_bundle(bdir, checker, "leak")
+    assert "stray_rng" in bundle["trigger"]["detail"]
+
+
+def test_replica_kill_trigger_writes_bundle(tiny, tmp_path, checker):
+    cfg, model, params = tiny
+
+    def factory(replica_id, epoch):
+        return ServingEngine(model, params, max_batch=4, page_size=8,
+                             max_seq=128, dtype=jnp.float32,
+                             replica_epoch=epoch)
+
+    tel = _tel(tmp_path, job="kill")
+    fleet = FleetRouter(factory, fleet={"replicas": 2, "max_replicas": 2},
+                        telemetry=tel)
+    (p,) = _prompts(cfg, 5, [8])
+    fleet.submit("r0", p, max_new_tokens=2)
+    fleet.kill_replica(next(iter(fleet.replicas)), detail="chaos drill")
+    fleet.join()
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    bundle = _assert_one_valid_bundle(bdir, checker, "replica_kill")
+    assert "chaos drill" in bundle["trigger"]["detail"]
+    # the fleet health context provider rode into the bundle
+    assert bundle["context"]["fleet_health"]["n_replicas"] == 2
+
+
+def test_slo_burn_trigger_writes_bundle(tmp_path, checker):
+    tel = _tel(tmp_path, job="burn",
+               incidents={"burn_windows": [[60.0, 0.3]],
+                          "burn_min_requests": 4})
+    tel.incidents.observe_slo(now=0.0)           # baseline reading
+    tel.count("serve/slo_missed", 5)
+    assert tel.incidents.observe_slo(now=1.0)
+    assert not tel.incidents.observe_slo(now=2.0)  # still burning: once
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    bundle = _assert_one_valid_bundle(bdir, checker, "slo_burn")
+    assert bundle["trigger"]["source"] == "serve/slo"
+
+
+def test_quiet_run_writes_no_bundles(tiny, tmp_path):
+    """A healthy serving run with the incident plane armed produces zero
+    bundles: no stall, no storm, no leak, no SLO pressure."""
+    cfg, model, params = tiny
+    tel = _tel(tmp_path, job="quiet")
+    eng = ServingEngine(model, params, max_batch=2, page_size=8,
+                        max_seq=32, dtype=jnp.float32, telemetry=tel)
+    for i, p in enumerate(_prompts(cfg, 7, [4, 5])):
+        eng.add_request(i, p, max_new_tokens=2)
+    while eng.queue or eng.n_active:
+        eng.step()
+    assert eng.leak_report() == {}
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    assert _bundles(bdir) == []
+
+
+# ----------------------------------------------------------------------
+# wiring: ring on every rank, config gating, /incidents endpoint
+# ----------------------------------------------------------------------
+def test_ring_records_on_sink_gated_ranks(tmp_path):
+    """The JSONL sink is rank-0-gated in single-stream mode; the flight
+    recorder must not be — rank 1's last seconds matter most in a
+    cross-rank incident."""
+    tel = _tel(tmp_path, job="r1")
+    # emulate a nonzero rank: no sink, incidents still armed
+    tel.sink, tel.rank = None, 1
+    tel.emit("meta", "rank1/event")
+    assert len(tel.incidents.ring) == 1
+    tel.close()
+
+
+def test_incidents_config_gating(tmp_path):
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "off"}), rank=0)
+    assert tel.incidents is None                 # default: plane off
+    tel.close()
+    cfg = TelemetryIncidentsConfig({"enabled": True, "ring_capacity": 7})
+    assert cfg.ring_capacity == 7
+    for bad in ({"ring_capacity": 0}, {"ring_max_age_s": 0},
+                {"burn_min_requests": 0}, {"cooldown_s": -1},
+                {"max_bundles": 0}, {"burn_windows": [[0, 0.5]]},
+                {"burn_windows": [[60.0, 1.5]]},
+                {"burn_windows": [60.0]}):
+        with pytest.raises(ValueError):
+            TelemetryIncidentsConfig(bad)
+
+
+def test_incidents_endpoint(tmp_path):
+    # exporter without an incident manager: typed 404
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path), "job_name": "no",
+         "export": {"enabled": True, "port": 0}}), rank=0)
+    try:
+        host, port = tel.exporter.address
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{host}:{port}/incidents")
+        assert ei.value.code == 404
+    finally:
+        tel.close()
+
+    tel = _tel(tmp_path, job="yes",
+               **{"export": {"enabled": True, "port": 0}})
+    try:
+        host, port = tel.exporter.address
+        tel.incidents.trigger("stall", source="t", detail="d")
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/incidents") as r:
+            snap = json.loads(r.read())
+        assert snap["ring"]["capacity"] == 2048
+        (inc,) = snap["incidents"]
+        assert inc["trigger"] == "stall" and inc["id"].endswith("-stall")
+    finally:
+        tel.close()
+
+
+# ----------------------------------------------------------------------
+# acceptance: storm during a deadline workload -> one correlated bundle,
+# and the run exports as a valid Perfetto timeline
+# ----------------------------------------------------------------------
+def test_e2e_storm_during_deadline_workload(tiny, tmp_path, checker,
+                                            exporter_mod):
+    cfg, model, params = tiny
+    clk = FakeClock()
+    # default burn_min_requests (8) > the 2 deadline requests here, so
+    # the burn alerter cannot double-fire: the storm is the ONLY trigger
+    tel = _tel(tmp_path, job="e2e", incidents={"cooldown_s": 60.0})
+    eng = ServingEngine(model, params, max_batch=1, page_size=8,
+                        max_seq=32, dtype=jnp.float32, clock=clk,
+                        telemetry=tel)
+    pa, pb = _prompts(cfg, 11, [4, 5])
+    eng.add_request("miss-a", pa, max_new_tokens=8, deadline_s=2.0)
+    eng.add_request("miss-b", pb, max_new_tokens=8, deadline_s=2.0)
+    while eng.queue or eng.n_active:
+        clk.tick(1.0)
+        eng.step()
+    assert eng.stats["slo_missed"] == 2
+    assert eng.leak_report() == {}               # misses are not leaks
+    # the recompile storm lands while the misses are still in the ring
+    for i in range(4):
+        tel.profiling.compiles.note_miss(
+            "serve/decode", ("f", ((f"s{i}", "f32"),)), 0.02, step=i)
+    tel.gauge("serve/queue_depth", 0.0)          # a counter for the trace
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+
+    bundle = _assert_one_valid_bundle(bdir, checker, "storm")
+    # correlation: every SLO-missed request links to the storm's
+    # compile-miss events in its step window
+    linked = {l["req_id"] for l in bundle["correlation"]["links"]}
+    assert linked == {"miss-a", "miss-b"}
+    for link in bundle["correlation"]["links"]:
+        assert any(m["site"] == "serve/decode"
+                   for m in link["compile_misses"])
+    missed_windows = [w for w in bundle["correlation"]["windows"]
+                      if w["slo_missed"]]
+    assert missed_windows and all(w["compile_misses"]
+                                  for w in missed_windows)
+    # the serving context providers rode into the bundle
+    assert bundle["context"]["serving_health"]["queue_depth"] == 0
+    assert bundle["context"]["inflight_traces"] == []
+
+    # the same run exports as a valid Chrome trace
+    out = str(tmp_path / "trace.json")
+    rc = exporter_mod.main([os.path.join(str(tmp_path), "e2e"),
+                            "-o", out, "--check"])
+    assert rc == 0
+    obj = json.load(open(out))
+    assert exporter_mod.validate_trace(obj) == []
+    phases = {e["ph"] for e in obj["traceEvents"]}
+    assert {"X", "b", "e", "C", "i", "M"} <= phases
+    # both requests render as async begin/end pairs
+    ids = {e["id"] for e in obj["traceEvents"] if e["ph"] == "b"}
+    assert ids == {"miss-a", "miss-b"}
+
+
+# ----------------------------------------------------------------------
+# timeline export unit coverage
+# ----------------------------------------------------------------------
+def test_trace_export_span_and_flow_shapes(tmp_path, exporter_mod):
+    d = str(tmp_path)
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        f.write(json.dumps({"ts": 100.5, "kind": "span", "name": "step",
+                            "dur_ms": 400.0, "step": 1}) + "\n")
+        f.write(json.dumps({"ts": 100.6, "kind": "gauge",
+                            "name": "mem/step/peak_bytes", "value": 42,
+                            "peak": 42}) + "\n")
+    for rank, skew in ((0, 0.0), (1, 0.03)):
+        with open(os.path.join(d, f"events.rank{rank}.jsonl"), "w") as f:
+            f.write(json.dumps({"ts": 100.2 + skew, "kind": "comm",
+                                "name": "all_reduce", "bytes": 1024,
+                                "axis": "dp", "dur_ms": 5.0,
+                                "rank": rank}) + "\n")
+    obj = exporter_mod.convert(exporter_mod.load_events(d))
+    assert exporter_mod.validate_trace(obj) == []
+    evs = obj["traceEvents"]
+    # span: ts is stamped at END, so the slice starts dur earlier
+    (span,) = [e for e in evs if e["ph"] == "X" and e["cat"] == "span"]
+    assert span["dur"] == pytest.approx(400e3)
+    assert span["ts"] == 0.0      # earliest slice start is the origin
+    comms = [e for e in evs if e["ph"] == "X" and e["cat"] == "comm"]
+    assert {c["pid"] for c in comms} == {0, 1}
+    # comm slice: ts stamped at END, start = ts - dur, relative to t0
+    assert min(c["ts"] for c in comms) == pytest.approx(
+        (100.2 - 5e-3 - 100.1) * 1e6, abs=1.0)
+    # the two ranks' k=0 all_reduce joins into one flow, earliest first
+    flows = sorted([e for e in evs if e.get("cat") == "comm-flow"],
+                   key=lambda e: e["ts"])
+    assert [f["ph"] for f in flows] == ["s", "f"]
+    assert flows[0]["pid"] == 0 and flows[1]["pid"] == 1
+    assert flows[0]["id"] == flows[1]["id"] == "all_reduce:0"
+    (counter,) = [e for e in evs if e["ph"] == "C"]
+    assert counter["args"] == {"value": 42}
+
+
+def test_trace_export_async_lifecycle(tmp_path, exporter_mod):
+    d = str(tmp_path)
+    rows = [
+        ("serve/request/admitted", 100.0), ("serve/request/prefill_start",
+                                            100.1),
+        ("serve/request/first_token", 100.2), ("serve/request/finish",
+                                               100.5),
+    ]
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        for name, ts in rows:
+            f.write(json.dumps({"ts": ts, "kind": "serve", "name": name,
+                                "attrs": {"req_id": "q"}}) + "\n")
+    obj = exporter_mod.convert(exporter_mod.load_events(d))
+    assert exporter_mod.validate_trace(obj) == []
+    phases = [e["ph"] for e in obj["traceEvents"]
+              if e.get("cat") == "request"]
+    assert phases == ["b", "n", "n", "e"]
+
+
+def test_validate_trace_rejects_malformed(exporter_mod):
+    v = exporter_mod.validate_trace
+    assert v({"traceEvents": [{"ph": "Z", "pid": 0}]})
+    assert v({"traceEvents": [{"ph": "X", "pid": 0, "name": "x",
+                               "ts": 1.0, "dur": -5.0}]})
+    assert v({"traceEvents": [{"ph": "e", "pid": 0, "name": "r",
+                               "cat": "request", "id": "q", "ts": 1.0}]})
+    assert v({"traceEvents": "nope"}) and v(None)
+    assert v({"traceEvents": []}) == []
+
+
+def test_trace_export_cli(tmp_path, exporter_mod):
+    assert exporter_mod.main([str(tmp_path / "missing")]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert exporter_mod.main([str(empty)]) == 1
+    d = tmp_path / "run"
+    d.mkdir()
+    with open(d / "events.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 1.0, "kind": "meta",
+                            "name": "run/start"}) + "\n")
+    out = str(tmp_path / "t.json")
+    assert exporter_mod.main([str(d), "-o", out, "--check"]) == 0
+    assert json.load(open(out))["displayTimeUnit"] == "ms"

@@ -382,3 +382,76 @@ def test_profile_spans_cover_engine_and_serving():
     for span in ("fwd", "bwd", "step", "train_batch", "serve_step",
                  "prefill"):
         assert span in PROFILE_SPANS
+
+
+# ----------------------------------------------------------------------
+# perf-diff: stale-baseline freshness check (--check)
+# ----------------------------------------------------------------------
+def _rows(specs):
+    """specs: (ts, run, bench, metric, value) tuples, in ledger order."""
+    return [{"ts": ts, "run": run, "bench": bench, "metric": metric,
+             "value": value} for ts, run, bench, metric, value in specs]
+
+
+def _write_rows(path, specs):
+    with open(path, "w") as f:
+        for row in _rows(specs):
+            f.write(json.dumps(row) + "\n")
+
+
+def test_stale_baseline_train_evidence_predates_cpu_runs(perf_diff):
+    rows = _rows([(100.0, "gpu-1", "train", "step_time_ms", 9.0)] +
+                 [(100.0 + 10 * i, f"cpu-{i}", "b", "m", 1.0)
+                  for i in range(1, 4)])
+    warn = perf_diff.check_stale_baseline(rows, None, 3)
+    assert warn and "STALE-BASELINE" in warn
+
+
+def test_stale_baseline_fresh_train_evidence(perf_diff):
+    # a train row newer than the oldest of the last-3 cpu runs: fresh
+    rows = _rows([(100.0, "cpu-1", "b", "m", 1.0),
+                  (110.0, "cpu-2", "b", "m", 1.0),
+                  (115.0, "gpu-1", "train", "step_time_ms", 9.0),
+                  (120.0, "cpu-3", "b", "m", 1.0)])
+    assert perf_diff.check_stale_baseline(rows, None, 3) is None
+
+
+def test_stale_baseline_no_evidence_at_all(perf_diff):
+    rows = _rows([(100.0 + i, f"cpu-{i}", "b", "m", 1.0)
+                  for i in range(3)])
+    warn = perf_diff.check_stale_baseline(rows, "/nonexistent", 3)
+    assert warn and "no on-chip train evidence" in warn
+    # not enough cpu runs yet: nothing to judge
+    assert perf_diff.check_stale_baseline(rows[:2], "/nonexistent", 3) \
+        is None
+
+
+def test_stale_baseline_onchip_capture_rescues(perf_diff, tmp_path):
+    rows = _rows([(100.0, "gpu-1", "train", "step_time_ms", 9.0)] +
+                 [(100.0 + 10 * i, f"cpu-{i}", "b", "m", 1.0)
+                  for i in range(1, 4)])
+    cap = tmp_path / "BENCH_onchip_latest.json"
+    cap.write_text(json.dumps({"captured_unix": 500.0}))
+    assert perf_diff.check_stale_baseline(rows, str(cap), 3) is None
+    cap.write_text(json.dumps({"captured_unix": 90.0}))   # older: stale
+    warn = perf_diff.check_stale_baseline(rows, str(cap), 3)
+    assert warn and "predates" in warn
+    cap.write_text("not json")                            # ignored
+    assert "STALE-BASELINE" in perf_diff.check_stale_baseline(
+        rows, str(cap), 3)
+
+
+def test_stale_baseline_in_check_mode_output(perf_diff, tmp_path, capsys):
+    led = tmp_path / "ledger.jsonl"
+    _write_rows(str(led), [(100.0, "gpu-1", "train", "step_time_ms", 9.0)] +
+                [(100.0 + 10 * i, f"cpu-{i}", "b", "m", 1.0)
+                 for i in range(1, 4)])
+    assert perf_diff.main(["--check", str(led)]) == 0   # warns, no gate
+    assert "STALE-BASELINE" in capsys.readouterr().out
+    # strict mode stays quiet about freshness (the gate is the signal)
+    _write_rows(str(led), [(100.0, "cpu-1", "b", "m", 1.0),
+                           (110.0, "cpu-2", "b", "m", 1.0),
+                           (115.0, "gpu-1", "train", "step", 9.0),
+                           (120.0, "cpu-3", "b", "m", 1.0)])
+    assert perf_diff.main(["--check", str(led)]) == 0
+    assert "STALE-BASELINE" not in capsys.readouterr().out

@@ -522,3 +522,48 @@ def test_prom_text_renders_engine_snapshot(tiny, tmp_path):
     assert "ds_serve_ttft_ms" in live
     exp.close()
     tel.close()
+
+
+def test_exporter_scrape_is_thread_safe(tmp_path):
+    """Regression: a /metrics scrape while writers hammer observe()/set()
+    must neither raise ("deque mutated during iteration") nor tear the
+    gauge value-above-peak invariant."""
+    import threading
+
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path), "job_name": "race",
+         "export": {"enabled": True, "port": 0}}), rank=0)
+    host, port = tel.exporter.address
+    base = f"http://{host}:{port}"
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            tel.registry.histogram("serve/ttft_ms").observe(i % 97)
+            tel.registry.gauge("serve/queue_depth").set(i % 13)
+
+    threads = [threading.Thread(target=writer, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(30):
+            txt = urllib.request.urlopen(base + "/metrics").read().decode()
+            assert "serve" in txt or txt == ""      # parses, no 500
+            snap = json.loads(
+                urllib.request.urlopen(base + "/metrics.json").read())
+            for g in snap.get("gauges", {}).values():
+                if isinstance(g, dict) and "peak" in g:
+                    assert g["value"] <= g["peak"]  # no torn reads
+            tel.snapshot()
+    except Exception as e:                          # pragma: no cover
+        errors.append(e)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        tel.close()
+    assert errors == []

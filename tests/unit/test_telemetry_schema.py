@@ -426,3 +426,56 @@ def test_ledger_cli_exit_codes(checker, tmp_path, capsys):
     assert checker.main(["--ledger", str(good), str(bad)]) == 1
     out = capsys.readouterr().out
     assert "not valid JSON" in out
+
+
+# ----------------------------------------------------------------------
+# incident plane: frozen trigger/event vocabularies + bundle layout
+# ----------------------------------------------------------------------
+def test_incident_vocabularies_in_lockstep(checker):
+    """The frozen incident vocabularies must stay byte-identical between
+    the incident plane (monitor/incidents.py) and the checker script."""
+    from deepspeed_tpu.monitor import incidents
+    assert checker.INCIDENT_EVENTS == incidents.INCIDENT_EVENTS
+    assert checker.INCIDENT_TRIGGERS == incidents.INCIDENT_TRIGGERS
+
+
+def test_incident_event_validation(checker):
+    good = {"ts": 1.0, "kind": "incident", "name": "incident/written",
+            "id": "inc-0001-stall", "trigger": "stall"}
+    assert checker.validate_event(good) == []
+    assert checker.validate_event(dict(good, name="incident/vibes"))
+    assert checker.validate_event(dict(good, trigger="gossip"))
+    assert checker.validate_event({k: v for k, v in good.items()
+                                   if k != "id"})
+
+
+def test_incidents_cli_and_bundle_validation(checker, tmp_path, capsys):
+    import json
+    from deepspeed_tpu.monitor.incidents import IncidentManager
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path), "job_name": "j",
+         "incidents": {"enabled": True, "cooldown_s": 0.0}}), rank=0)
+    tel.incidents.trigger("leak", source="test", detail="stray")
+    bdir = tel.incidents.bundle_dir
+    tel.close()
+    assert checker.main(["--incidents", bdir]) == 0
+    # single-bundle form: point straight at the bundle directory
+    (bundle,) = sorted(os.listdir(bdir))
+    assert checker.main(["--incidents", os.path.join(bdir, bundle)]) == 0
+    # mutations the validator must catch
+    inc_path = os.path.join(bdir, bundle, "incident.json")
+    with open(inc_path) as f:
+        payload = json.load(f)
+    with open(inc_path, "w") as f:
+        json.dump(dict(payload, trigger=dict(payload["trigger"],
+                                             kind="gossip")), f)
+    assert checker.main(["--incidents", bdir]) == 1
+    os.remove(os.path.join(bdir, bundle, "ring.jsonl"))
+    problems, n = checker.validate_incidents_path(bdir)
+    assert problems and n == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert checker.main(["--incidents", str(empty)]) == 1
+    capsys.readouterr()
