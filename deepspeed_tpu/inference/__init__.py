@@ -12,10 +12,15 @@ from deepspeed_tpu.inference.robustness import (AdmissionController,
                                                 RequestResult,
                                                 ServingRobustnessConfig,
                                                 ServingStalled)
+from deepspeed_tpu.inference.scheduler import (SchedulerConfig,
+                                               SpeculativeConfig,
+                                               SCHEDULER_POLICIES,
+                                               SLO_CLASSES)
 from deepspeed_tpu.inference.serving import ServingEngine
 
 __all__ = ["DeepSpeedInferenceConfig", "InferenceEngine", "ServingEngine",
            "RequestRejected", "RequestResult", "ServingRobustnessConfig",
            "ServingStalled", "AdmissionController", "PrefixCache",
            "PrefixCacheConfig", "PrefixMatch", "FleetConfig",
-           "FleetRouter", "FLEET_EVENTS"]
+           "FleetRouter", "FLEET_EVENTS", "SchedulerConfig",
+           "SpeculativeConfig", "SCHEDULER_POLICIES", "SLO_CLASSES"]

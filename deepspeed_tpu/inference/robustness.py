@@ -95,6 +95,17 @@ SERVE_EVENTS = (
     # attrs attention_backend / impl / interpret, so a telemetry stream's
     # serve/step spans are attributable to the kernel path that ran
     "serve/backend",
+    # scheduler plane (inference/scheduler.py).  "serve/sched" is the
+    # once-per-engine meta record (attrs: policy / prefill_chunk_tokens /
+    # speculative / num_draft_tokens); "serve/prefill_chunk" is one
+    # chunked-prefill dispatch (attrs: req_id / slot / start / tokens /
+    # remaining / slo_class); "serve/spec_draft" is one draft-model
+    # proposal dispatch (attrs: slots / window) and "serve/spec_verify"
+    # its target verification (attrs: slots / window / accepted /
+    # rejected — the same counts feed the serve/spec_accepted_tokens and
+    # serve/spec_rejected_tokens registry counters)
+    "serve/sched", "serve/prefill_chunk",
+    "serve/spec_draft", "serve/spec_verify",
     # per-request lifecycle trace (RequestTracer): one event per state
     # transition, each carrying req_id plus the derived latencies so a
     # request's full history is reconstructible from the JSONL stream
@@ -193,6 +204,11 @@ class ServingRobustnessConfig(DeepSpeedConfigModel):
     # min_replicas / max_replicas, health_interval, redispatch_max,
     # autoscale thresholds.  Ignored by a bare ServingEngine.
     fleet = {}
+    # step scheduler (inference/scheduler.py): policy ("monolithic" |
+    # "chunked"), prefill_chunk_tokens, max_prefill_chunks_per_step,
+    # slo_class_default / slo_classes, speculative {enabled,
+    # num_draft_tokens}
+    scheduler = {}
 
     def _validate(self):
         if isinstance(self.prefix_cache, dict):
@@ -202,6 +218,9 @@ class ServingRobustnessConfig(DeepSpeedConfigModel):
         if isinstance(self.fleet, dict):
             from deepspeed_tpu.inference.fleet import FleetConfig
             self.fleet = FleetConfig(self.fleet)
+        if isinstance(self.scheduler, dict):
+            from deepspeed_tpu.inference.scheduler import SchedulerConfig
+            self.scheduler = SchedulerConfig(self.scheduler)
         if self.overload_policy not in OVERLOAD_POLICIES:
             raise ValueError(
                 f"serving.overload_policy must be one of {OVERLOAD_POLICIES}")

@@ -45,6 +45,7 @@ from deepspeed_tpu.inference.robustness import (
     SHED_OLDEST, AdmissionController, RequestRejected, RequestResult,
     RequestTracer, ServingRobustnessConfig, ServingStalled)
 from deepspeed_tpu.inference.prefix_cache import PrefixCache, PrefixMatch
+from deepspeed_tpu.inference.scheduler import SLO_CLASSES, create_scheduler
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
                                                PagedAllocator,
@@ -77,6 +78,11 @@ class _Request:
     last_token: Optional[int] = None
     submit_time: float = 0.0
     deadline: float = 0.0       # absolute clock time; 0.0 = no deadline
+    slo_class: str = "throughput"   # scheduler SLO class (SLO_CLASSES)
+    # chunked-prefill progress: prompt tokens already written to the
+    # target / draft KV cache (the monolithic policy never reads these)
+    prefilled: int = 0
+    draft_filled: int = 0
 
 
 class ServingEngine:
@@ -95,7 +101,7 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None, tp_size: int = 1,
                  ep_size: int = 1, decode_chunk: int = 1,
                  serving=None, telemetry=None, injector=None, clock=None,
-                 replica_epoch=None):
+                 replica_epoch=None, draft_model=None, draft_params=None):
         """``serving``: a :class:`ServingRobustnessConfig` or its dict —
         defaults keep pre-hardening behaviour (unbounded queue, no
         deadlines).  ``injector``: a ``FaultInjector`` for the serving
@@ -105,7 +111,9 @@ class ServingEngine:
         None uses the process singleton at event time.  ``replica_epoch``:
         set by the fleet front-end — namespaces request ids in the tracer
         so a respawned replica re-serving a redispatched id cannot read as
-        a double admit in a merged audit."""
+        a double admit in a merged audit.  ``draft_model``/``draft_params``:
+        the speculative-decoding proposer (``serving.scheduler.speculative``
+        — inference/scheduler.py); ignored unless that block enables it."""
         self.model = model
         self.config = model.config
         self.max_batch = max_batch
@@ -144,6 +152,7 @@ class ServingEngine:
                                           P(None, None, "tp", None, None)))
         self.params = params
         self.caches = caches
+        self.cache_dtype = dtype
         if isinstance(serving, ServingRobustnessConfig):
             self.serving = serving
         else:
@@ -223,7 +232,6 @@ class ServingEngine:
         # so chunking multiplies serving throughput by ~decode_chunk.
         self.decode_chunk = int(decode_chunk)
         assert self.decode_chunk >= 1
-        self._chunk_fns = {}   # use_filters(bool) -> compiled chunk fn
 
         self._clock = clock if clock is not None else time.monotonic
         self._telemetry = telemetry
@@ -255,6 +263,14 @@ class ServingEngine:
                           attention_backend=self.attention_backend,
                           impl=attn_impl or "auto",
                           interpret=int(attn_interpret))
+        # pluggable step scheduler (inference/scheduler.py): the
+        # serving.scheduler block picks the policy; "monolithic" keeps
+        # the pre-scheduler behaviour bit-for-bit.  One frozen
+        # serve/sched event per engine records the policy the stream ran.
+        self.scheduler = create_scheduler(self, self.serving.scheduler,
+                                          draft_model=draft_model,
+                                          draft_params=draft_params)
+        self._serve_event("serve/sched", **self.scheduler.meta())
         # incident plane: bundles snapshot this engine's health() and its
         # in-flight request traces alongside the flight-recorder dump
         incidents = getattr(self.telemetry, "incidents", None)
@@ -337,7 +353,8 @@ class ServingEngine:
             queue_wait_ms=_round_ms(tr.queue_wait_ms()),
             ttft_ms=_round_ms(tr.ttft_ms()),
             tpot_ms=_round_ms(tr.tpot_ms()),
-            e2e_ms=_round_ms(tr.e2e_ms()), slo=slo)
+            e2e_ms=_round_ms(tr.e2e_ms()), slo=slo,
+            slo_class=req.slo_class)
 
     # -- host control flow ---------------------------------------------
     def _reject(self, req_id, reason, detail=""):
@@ -349,12 +366,16 @@ class ServingEngine:
     def add_request(self, req_id, prompt_ids, max_new_tokens: int = 32,
                     temperature: float = 0.0, seed: int = 0,
                     top_k: int = 0, top_p: float = 1.0,
-                    deadline_s: Optional[float] = None):
+                    deadline_s: Optional[float] = None,
+                    slo_class: Optional[str] = None):
         """Validate and enqueue one request.  Raises
         :class:`RequestRejected` (typed reason, engine state untouched)
         instead of asserting; ``deadline_s`` is a TTL from now — the
         request is cancelled at the next step boundary once it expires,
-        queued or mid-flight."""
+        queued or mid-flight.  ``slo_class`` ("latency" | "throughput",
+        default ``serving.scheduler.slo_class_default``) orders admission
+        and prefill-chunk scheduling under the chunked policy and picks
+        the per-class TTL default when ``deadline_s`` is omitted."""
         cfg = self.serving
         if self.draining:
             self._reject(req_id, REJECT_DRAINING,
@@ -373,8 +394,12 @@ class ServingEngine:
                          f"prompt {len(prompt)} exceeds "
                          f"serving.max_prompt_tokens {cfg.max_prompt_tokens}")
         total = len(prompt) + max_new_tokens
-        bucket = min(self._bucket(len(prompt)), self.max_seq)
-        need = -(-max(total, bucket) // self.page_size)
+        # worst-case reservation (no cached prefix), using the SAME
+        # padding the scheduler will request at slot-fill time
+        padded = self.scheduler.prefill_padded_len(len(prompt))
+        need = -(-min(max(total, padded),
+                      self.max_pages_per_seq * self.page_size)
+                 // self.page_size)
         usable = self.alloc.num_pages - 1   # minus the scratch page
         if need > usable:
             self._reject(req_id, REJECT_INFEASIBLE,
@@ -389,14 +414,25 @@ class ServingEngine:
             self._reject(req_id, REJECT_BAD_SAMPLING,
                          f"top_k={top_k}, top_p={top_p}, "
                          f"temperature={temperature}")
+        sched_cfg = cfg.scheduler
+        if slo_class is None:
+            slo_class = sched_cfg.slo_class_default
+        if slo_class not in SLO_CLASSES:
+            self._reject(req_id, REJECT_BAD_REQUEST,
+                         f"slo_class {slo_class!r} is not one of "
+                         f"{SLO_CLASSES}")
         self._apply_admission_policy(req_id)
         now = self._clock()
+        # TTL precedence: explicit deadline_s > the SLO class's default
+        # (serving.scheduler.slo_classes) > serving.default_deadline_s
         ttl = deadline_s if deadline_s is not None \
-            else (float(cfg.default_deadline_s) or None)
+            else (sched_cfg.class_deadline_s(slo_class)
+                  or float(cfg.default_deadline_s) or None)
         deadline = (now + ttl) if ttl else 0.0
         self.queue.append(_Request(req_id, prompt, max_new_tokens,
                                    temperature, seed, top_k, top_p,
-                                   submit_time=now, deadline=deadline))
+                                   submit_time=now, deadline=deadline,
+                                   slo_class=slo_class))
         self.stats["admitted"] += 1
         # lifecycle trace opens HERE: admission is the promise leak_report
         # audits — exactly one serve/request/* terminal closes it
@@ -408,7 +444,8 @@ class ServingEngine:
                           queue_depth=len(self.queue),
                           prompt_tokens=len(prompt),
                           max_new_tokens=int(max_new_tokens),
-                          deadline=int(bool(deadline)))
+                          deadline=int(bool(deadline)),
+                          slo_class=slo_class)
         self._admit()
 
     def _admission_pressure(self):
@@ -483,6 +520,7 @@ class ServingEngine:
         table row and length, record the terminal result.  The rest of the
         batch is untouched."""
         req = self.slots[slot]
+        self.scheduler.release_slot(slot, req)
         self.alloc.free_sequence(req.req_id)
         self.slots[slot] = None
         self.lengths[slot] = 0
@@ -519,6 +557,9 @@ class ServingEngine:
             self._admit()
 
     def _admit(self):
+        # policy hook: the chunked scheduler stable-sorts latency-class
+        # requests ahead of throughput-class ones (FIFO within a class)
+        self.scheduler.order_queue()
         for slot in range(self.max_batch):
             if not self.queue or self.slots[slot] is not None:
                 continue
@@ -530,13 +571,16 @@ class ServingEngine:
             match = (self.prefix_cache.lookup(req.prompt)
                      if self.prefix_cache is not None else PrefixMatch())
             cached = match.cached_tokens(self.page_size)
-            bucket = min(self._bucket(len(req.prompt) - cached),
-                         self.max_seq)
+            # the scheduler owns the prefill shape: the monolithic policy
+            # pads the suffix to a power-of-two bucket, the chunked one
+            # to a whole number of prefill chunks
+            padded = self.scheduler.prefill_padded_len(
+                len(req.prompt) - cached)
             # reservation covers the budget AND the padded suffix prefill;
             # the cap keeps an unaligned cached prefix from pushing the
-            # bucket past the table — padding writes past the reservation
+            # padding past the table — padding writes past the reservation
             # land on the sacrificial scratch page (clamped/zero columns)
-            need_tokens = min(max(total, cached + bucket),
+            need_tokens = min(max(total, cached + padded),
                               self.max_pages_per_seq * self.page_size)
             shared = list(match.pages)
             protect = (match.cow_src,) if match.cow_src is not None else ()
@@ -593,7 +637,7 @@ class ServingEngine:
                                       src=int(match.cow_src),
                                       dst=int(pages[len(shared)]),
                                       tokens=int(match.cow_tokens))
-                self._prefill(slot, req, bucket, cached)
+                complete = self.scheduler.fill_slot(slot, req, cached)
             except Exception as e:   # fault isolation: only THIS request
                 logger.warning(f"evicting request {req.req_id!r} after "
                                f"prefill fault: {e}")
@@ -603,13 +647,23 @@ class ServingEngine:
                 self._serve_event("serve/evict", req_id=req.req_id,
                                   reason=EVICT_FAULT, error=str(e))
                 continue
-            self._trim_reservation(slot, req)
-            if self.prefix_cache is not None:
-                added = self.prefix_cache.insert(
-                    req.prompt, self.alloc.seq_pages[req.req_id])
-                if added:
-                    self._serve_event("serve/prefix_insert",
-                                      req_id=req.req_id, pages=added)
+            if complete:
+                # monolithic: the whole prefill ran inside fill_slot;
+                # chunked defers both the prefill and this completion to
+                # later step() calls (_complete_prefill at the last chunk)
+                self._complete_prefill(slot, req)
+
+    def _complete_prefill(self, slot: int, req: _Request):
+        """Admission tail once the prompt is fully in cache: trim the
+        padded reservation to the true need and index the prompt's full
+        pages into the prefix cache."""
+        self._trim_reservation(slot, req)
+        if self.prefix_cache is not None:
+            added = self.prefix_cache.insert(
+                req.prompt, self.alloc.seq_pages[req.req_id])
+            if added:
+                self._serve_event("serve/prefix_insert",
+                                  req_id=req.req_id, pages=added)
 
     def _trim_reservation(self, slot: int, req: _Request):
         """Trim the slot's reservation to the request's TRUE page need.
@@ -689,11 +743,17 @@ class ServingEngine:
             jnp.asarray(self.tables[slot:slot + 1]),
             jnp.full((1,), cached, jnp.int32), phase="prefill")
         self.lengths[slot] = len(req.prompt)
+        req.prefilled = len(req.prompt)
         req.last_token = self._sample(
             req, np.asarray(logits[0, len(suffix) - 1]))
         # the first output token exists as of the sample above — a sampler
         # fault raises before this line, so an evicted-at-prefill request
         # correctly reports no TTFT
+        self._note_first_token(slot, req)
+
+    def _note_first_token(self, slot: int, req: _Request):
+        """TTFT bookkeeping shared by the monolithic prefill and the
+        chunked policy's final prefill chunk."""
         tr = self.tracer.first_token(req.req_id)
         if tr is not None:
             self._observe_ms("serve/ttft_ms", tr.ttft_ms())
@@ -745,6 +805,7 @@ class ServingEngine:
                 self._serve_event("serve/prefix_insert",
                                   req_id=req.req_id, pages=added,
                                   at="finish")
+        self.scheduler.release_slot(slot, req)
         self.alloc.free_sequence(req.req_id)
         self._rng.pop(req.req_id, None)
         self.slots[slot] = None
@@ -759,134 +820,6 @@ class ServingEngine:
     @property
     def n_active(self) -> int:
         return sum(s is not None for s in self.slots)
-
-    # -- the chunked decode step (K tokens per dispatch) ----------------
-    def _build_chunk_fn(self, use_filters: bool):
-        K = self.decode_chunk
-        paged_call = self._paged_call   # backend-bound apply_with_paged_cache
-
-        def chunk(params, caches, tables, lengths, last, temps, seeds,
-                  gen_counts, top_ks, top_ps):
-            """K decode iterations in one device program.  Emits the K
-            sampled tokens per slot; the host truncates past EOS /
-            max_new_tokens (overrun writes land on the reserved scratch
-            page — admission reserved every page a live request can
-            validly reach, vLLM-style multi-step scheduling).  Sampling
-            keys on (request seed, tokens generated so far), so a
-            request's random stream is independent of slot assignment
-            and arrival order — the per-token engine's req.seed contract."""
-            def one_sample(key, l, temp, top_k, top_p):
-                """One slot's filtered sampler: temperature -> top-k ->
-                top-p (nucleus) -> categorical.  Rank-based like the host
-                sampler: a single stable descending argsort; exactly
-                ``cut`` ranked tokens survive each stage (top_k=0 /
-                top_p=1.0 gate their stage off explicitly)."""
-                V = l.shape[-1]
-                l = l / jnp.maximum(temp, 1e-6)
-                order = jnp.argsort(-l, stable=True)
-                ranks = jnp.zeros(V, jnp.int32).at[order].set(
-                    jnp.arange(V, dtype=jnp.int32))
-                k_eff = jnp.where((top_k > 0) & (top_k < V), top_k, V)
-                l = jnp.where(ranks < k_eff, l, -1e30)
-                p = jax.nn.softmax(l)
-                cs = jnp.cumsum(p[order])
-                # smallest prefix reaching top_p mass (searchsorted+1)
-                cut = jnp.where(top_p < 1.0, jnp.sum(cs < top_p) + 1, V)
-                l = jnp.where(ranks < cut, l, -1e30)
-                return jax.random.categorical(key, l).astype(jnp.int32)
-
-            def one(carry, t):
-                caches, lengths, last = carry
-                logits, caches, _ = paged_call(
-                    params, last[:, None], caches, tables, lengths)
-                lg = logits[:, 0]
-                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                keys = jax.vmap(
-                    lambda s, g: jax.random.fold_in(jax.random.key(s),
-                                                    g + t))(seeds, gen_counts)
-                if use_filters:
-                    sampled = jax.vmap(one_sample)(keys, lg, temps,
-                                                   top_ks, top_ps)
-                else:   # plain temperature: no vocab sorts in the loop
-                    sampled = jax.vmap(
-                        lambda k, l, tt: jax.random.categorical(
-                            k, l / jnp.maximum(tt, 1e-6)))(
-                        keys, lg, temps).astype(jnp.int32)
-                nxt = jnp.where(temps > 0, sampled, greedy)
-                return (caches, lengths + 1, nxt), nxt
-
-            (caches, lengths, last), toks = jax.lax.scan(
-                one, (caches, lengths, last), jnp.arange(K))
-            return toks.T, caches   # [B, K]
-
-        return jax.jit(chunk, donate_argnums=(1,))
-
-    def _step_chunk(self) -> Dict[Any, List[int]]:
-        K = self.decode_chunk
-        use_filters = any(r is not None and (r.top_k or r.top_p < 1.0)
-                          for r in self.slots)
-        if self._chunk_fns.get(use_filters) is None:
-            self._chunk_fns[use_filters] = self._wrap_compiled(
-                self._build_chunk_fn(use_filters),
-                f"serve/decode_chunk:{int(use_filters)}")
-        chunk_fn = self._chunk_fns[use_filters]
-        last = np.zeros(self.max_batch, np.int32)
-        temps = np.zeros(self.max_batch, np.float32)
-        seeds = np.zeros(self.max_batch, np.uint32)
-        gen_counts = np.zeros(self.max_batch, np.int32)
-        top_ks = np.zeros(self.max_batch, np.int32)
-        top_ps = np.ones(self.max_batch, np.float32)
-        for slot, req in enumerate(self.slots):
-            if req is not None:
-                last[slot] = req.last_token
-                temps[slot] = max(0.0, req.temperature)
-                seeds[slot] = np.uint32(req.seed)
-                gen_counts[slot] = len(req.out)
-                top_ks[slot] = req.top_k
-                top_ps[slot] = req.top_p
-        args = (self.params, self.caches, jnp.asarray(self.tables),
-                jnp.asarray(self.lengths), jnp.asarray(last),
-                jnp.asarray(temps), jnp.asarray(seeds),
-                jnp.asarray(gen_counts), jnp.asarray(top_ks),
-                jnp.asarray(top_ps))
-        with self.telemetry.span("serve/step",
-                                 attrs={"backend": self.attention_backend,
-                                        "phase": "decode_chunk",
-                                        "batch": int(self.max_batch),
-                                        "tokens": int(K)}), \
-                self._prof_track("serve_step"):
-            if self.mesh is not None:
-                with self.mesh:
-                    toks, self.caches = chunk_fn(*args)
-            else:
-                toks, self.caches = chunk_fn(*args)
-        toks = np.asarray(toks)
-
-        done_slots, done_now = [], {}
-        for slot, req in enumerate(self.slots):
-            if req is None:
-                continue
-            # tokens appended to the cache this chunk: the pre-chunk last
-            # token, then the first K-1 samples; sample K-1 is the next
-            # chunk's carry (per-token step() semantics, K times)
-            seq = [req.last_token] + toks[slot, :-1].tolist()
-            finished = False
-            for tok in seq:
-                req.out.append(int(tok))
-                self.lengths[slot] += 1
-                if (self.eos is not None and int(tok) == self.eos) or \
-                        len(req.out) >= req.max_new_tokens:
-                    finished = True
-                    break
-            if finished:
-                done_slots.append(slot)
-            else:
-                req.last_token = int(toks[slot, -1])
-        for slot in done_slots:
-            rid = self.slots[slot].req_id
-            self._finish(slot)
-            done_now[rid] = self.finished.pop(rid)
-        return done_now
 
     def _check_compile_storm(self):
         """Rising-edge serve event when the CompileWatcher flags a
@@ -905,13 +838,17 @@ class ServingEngine:
 
     # -- the batched decode step ---------------------------------------
     def step(self) -> Dict[Any, List[int]]:
-        """Advance every active request by one token (``decode_chunk``
-        tokens when configured); returns ONLY the requests that finished
-        during this step (req_id → full tokens).  Expired deadlines are
-        cancelled first; an injected ``serve_step`` fault returns {}
-        WITHOUT mutating any request (the retry serves identically), and
-        raises only after ``serving.step_fault_limit`` consecutive
-        faults."""
+        """Advance the engine by one scheduler step — under the default
+        monolithic policy, every active request by one token
+        (``decode_chunk`` tokens when configured); under the chunked
+        policy, up to ``max_prefill_chunks_per_step`` prefill chunks
+        first, then one decode (or speculative draft+verify) dispatch for
+        every fully-prefilled slot.  Returns ONLY the requests that
+        finished during this step (req_id → full tokens).  Expired
+        deadlines are cancelled first; an injected ``serve_step`` fault
+        returns {} WITHOUT mutating any request (the retry serves
+        identically), and raises only after ``serving.step_fault_limit``
+        consecutive faults."""
         self._expire_deadlines()
         if self.injector is not None:
             try:
@@ -933,55 +870,7 @@ class ServingEngine:
             # SLO burn-rate sweep on the engine's (injectable) clock — a
             # sustained multi-window miss fraction opens one incident
             incidents.observe_slo(now=self._clock())
-        if self.n_active == 0:
-            return {}
-        if self.decode_chunk > 1:
-            return self._step_chunk()
-        last = np.zeros((self.max_batch, 1), np.int32)
-        for slot, req in enumerate(self.slots):
-            if req is not None:
-                last[slot, 0] = req.last_token
-        logits, self.caches, _ = self._run_step(
-            jnp.asarray(last), jnp.asarray(self.tables),
-            jnp.asarray(self.lengths))
-        logits_np = np.asarray(logits[:, 0])
-
-        # finishing frees slots, which admits (and PREFILLS) queued
-        # requests — defer that until after the loop so a mid-loop
-        # admission is never mistaken for a slot this decode step served
-        done_slots, fault_slots = [], []
-        done_now = {}
-        for slot, req in enumerate(self.slots):
-            if req is None:
-                continue
-            # the token we just fed is now part of the sequence
-            req.out.append(req.last_token)
-            self.lengths[slot] += 1
-            ended = (self.eos is not None and req.last_token == self.eos)
-            if ended or len(req.out) >= req.max_new_tokens:
-                done_slots.append(slot)
-            else:
-                try:
-                    req.last_token = self._sample(req, logits_np[slot])
-                except Exception as e:   # per-slot fault isolation
-                    fault_slots.append((slot, str(e)))
-        for slot, err in fault_slots:
-            rid = self.slots[slot].req_id
-            logger.warning(f"evicting request {rid!r} after sampler "
-                           f"fault: {err}")
-            self._evict_slot(slot, "evicted", EVICT_FAULT, detail=err)
-            self.stats["evicted"] += 1
-            self._serve_event("serve/evict", req_id=rid,
-                              reason=EVICT_FAULT, error=err)
-        if fault_slots:
-            self._admit()
-        for slot in done_slots:
-            rid = self.slots[slot].req_id
-            self._finish(slot)
-            # hand the result back ONCE and evict: a long-running server
-            # must not accumulate every finished token list forever
-            done_now[rid] = self.finished.pop(rid)
-        return done_now
+        return self.scheduler.run_step()
 
     # -- lifecycle / introspection --------------------------------------
     def pop_terminated(self) -> Dict[Any, RequestResult]:
@@ -1016,6 +905,9 @@ class ServingEngine:
                          for r in self.slots if r is not None]
             max_steps = (-(-max(remaining) // self.decode_chunk) + 4) \
                 if remaining else 0
+            # chunked policy: in-flight prefills consume whole steps
+            # before any decode happens — budget them in
+            max_steps += self.scheduler.pending_prefill_steps()
         start = self._clock()
         finished: Dict[Any, List[int]] = {}
         steps = 0
@@ -1068,6 +960,7 @@ class ServingEngine:
                        "closed": self.tracer.closed,
                        "terminals": dict(self.tracer.terminals)},
         }
+        snap["scheduler"] = self.scheduler.snapshot()
         if self.prefix_cache is not None:
             snap["prefix_cache"] = self.prefix_cache.snapshot()
         prof = self._profiling
@@ -1098,6 +991,9 @@ class ServingEngine:
                                    ("serve/prefix_cached_pages",
                                     "cached_pages")):
                     tel.registry.gauge(gauge).set(pc[key])
+            if "spec_acceptance_rate" in snap["scheduler"]:
+                tel.registry.gauge("serve/spec_acceptance_rate").set(
+                    snap["scheduler"]["spec_acceptance_rate"])
         if tel is not None and getattr(tel, "cluster", None) is not None:
             # distributed telemetry: cross-rank skew/straggler view rides
             # along on the same health surface operators already poll
@@ -1142,6 +1038,9 @@ class ServingEngine:
                 over[str(req.req_id)] = {"held": held, "expected": expected}
         if over:
             leaks["over_reserved_slots"] = over
+        # scheduler-held state (speculative draft allocator): pages owned
+        # by requests no longer active, allocator-internal inconsistencies
+        leaks.update(self.scheduler.leak_report())
         # trace completeness: every admitted request is either still live
         # (queued/active) or reached exactly one serve/request/* terminal
         live = {r.req_id for r in self.queue} | active
@@ -1178,6 +1077,12 @@ class ServingEngine:
         results: Dict[Any, List[int]] = {}
         limit = (max(len(p) for p in prompts) + max_new_tokens + 4) * \
             (len(prompts) + 1)
+        if self.scheduler.policy == "chunked":
+            # prefill chunks (and the draft's own prefill under
+            # speculative decoding) consume whole steps before a slot
+            # decodes — the monolithic bound already covers one step per
+            # prompt token, so 3x covers target + draft chunks with slack
+            limit *= 3
         while (self.queue or self.n_active) and steps < limit:
             results.update(self.step())
             steps += 1

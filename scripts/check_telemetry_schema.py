@@ -168,6 +168,15 @@ SERVE_EVENTS = (
     # are churning faster than the jit cache amortises (attrs: misses).
     "serve/compile_storm",
     "serve/backend",
+    # scheduler plane (inference/scheduler.py): the once-per-engine
+    # policy meta record ("serve/sched": policy / prefill_chunk_tokens /
+    # speculative / num_draft_tokens), one chunked-prefill dispatch
+    # ("serve/prefill_chunk": req_id / slot / start / tokens / remaining /
+    # slo_class), one draft-model proposal ("serve/spec_draft": slots /
+    # window) and its target verification ("serve/spec_verify": slots /
+    # window / accepted / rejected)
+    "serve/sched", "serve/prefill_chunk",
+    "serve/spec_draft", "serve/spec_verify",
     # per-request lifecycle trace (RequestTracer): one event per state
     # transition, each carrying req_id plus the derived latencies so a
     # request's full history is reconstructible from the JSONL stream

@@ -175,6 +175,26 @@ def aggregate(events):
                     int(attrs.get("pages", 0))
             elif ev["name"] == "serve/backend":
                 rec["backend"] = attrs.get("attention_backend", "?")
+            # scheduler-plane events: the chunked/speculative policies
+            # stamp their work on attrs — sum them here so the report can
+            # print chunks-per-prefill / acceptance without the engine
+            elif ev["name"] == "serve/sched":
+                rec["policy"] = attrs.get("policy", "?")
+                rec["attrs"] = dict(attrs)
+            elif ev["name"] == "serve/prefill_chunk":
+                rec["tokens"] = rec.get("tokens", 0) + \
+                    int(attrs.get("tokens", 0))
+                by_req = rec.setdefault("by_req", {})
+                rid = attrs.get("req_id")
+                by_req[rid] = by_req.get(rid, 0) + 1
+            elif ev["name"] == "serve/spec_draft":
+                rec["slots"] = rec.get("slots", 0) + \
+                    int(attrs.get("slots", 0))
+            elif ev["name"] == "serve/spec_verify":
+                rec["accepted"] = rec.get("accepted", 0) + \
+                    int(attrs.get("accepted", 0))
+                rec["rejected"] = rec.get("rejected", 0) + \
+                    int(attrs.get("rejected", 0))
             elif ev["name"].startswith("serve/request/"):
                 # rebuild per-request lifecycle traces from the stream;
                 # req_ids may recur across runs in one file, so a fresh
@@ -187,6 +207,7 @@ def aggregate(events):
                                      "prompt_tokens":
                                          attrs.get("prompt_tokens"),
                                      "deadline": attrs.get("deadline", 0),
+                                     "slo_class": attrs.get("slo_class"),
                                      "terminal": None})
                     continue
                 idx = open_reqs.get(rid)
@@ -259,6 +280,7 @@ def summarize(agg):
             "serving": serve_rows,
             "fleet": fleet_rows,
             "serving_attention": _serving_attention_summary(agg),
+            "scheduler": _scheduler_summary(agg),
             "prefix_cache": _prefix_cache_summary(agg),
             "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
@@ -440,6 +462,71 @@ def _prefix_cache_summary(agg):
     }
 
 
+def _scheduler_summary(agg):
+    """Scheduler-plane digest from the ``serve/sched`` announcement and
+    the chunked policy's ``serve/prefill_chunk`` / ``serve/spec_*``
+    events: chunks-per-prefill, the prefill/decode interleave ratio,
+    speculative acceptance, and per-SLO-class TTFT/TPOT percentiles from
+    the reconstructed request traces.  None when the stream predates the
+    scheduler plane (no ``serve/sched`` event and no chunk events)."""
+    serves = agg.get("serves", {})
+    sched = serves.get("serve/sched", {})
+    chunks = serves.get("serve/prefill_chunk", {})
+    verify = serves.get("serve/spec_verify", {})
+    if not sched and not chunks:
+        return None
+    by_req = chunks.get("by_req", {})
+    n_chunks = chunks.get("count", 0)
+    # decode work from the closed traces: every generated token was one
+    # decode-step's worth of output for that slot
+    traces = agg.get("requests") or []
+    decode_tokens = sum(int(t.get("n_generated") or 0) for t in traces
+                        if t.get("terminal"))
+    accepted = verify.get("accepted", 0)
+    rejected = verify.get("rejected", 0)
+    by_class = {}
+    for t in traces:
+        cls = t.get("slo_class")
+        if cls is None:
+            continue
+        rec = by_class.setdefault(cls, {"requests": 0, "ttft_ms": [],
+                                        "tpot_ms": []})
+        rec["requests"] += 1
+        for k in ("ttft_ms", "tpot_ms"):
+            if t.get(k) is not None:
+                rec[k].append(float(t[k]))
+    class_rows = {}
+    for cls, rec in sorted(by_class.items()):
+        row = {"requests": rec["requests"]}
+        for k in ("ttft_ms", "tpot_ms"):
+            vals = sorted(rec[k])
+            row[k] = ({"p50": round(_pct(vals, 50), 3),
+                       "p90": round(_pct(vals, 90), 3),
+                       "p99": round(_pct(vals, 99), 3)}
+                      if vals else None)
+        class_rows[cls] = row
+    return {
+        "policy": sched.get("policy"),
+        "config": sched.get("attrs"),
+        "prefill_chunks": n_chunks,
+        "prefill_chunk_tokens": chunks.get("tokens", 0),
+        "prefills_chunked": len(by_req),
+        "chunks_per_prefill": (round(n_chunks / len(by_req), 3)
+                               if by_req else None),
+        # share of cache-writing dispatches that were prefill chunks —
+        # how much decode had to share the step loop with prefill
+        "interleave_ratio": (round(n_chunks / (n_chunks + decode_tokens),
+                                   4)
+                             if n_chunks + decode_tokens else None),
+        "spec_windows": serves.get("serve/spec_draft", {}).get("count", 0),
+        "spec_accepted": accepted,
+        "spec_rejected": rejected,
+        "spec_acceptance_rate": (round(accepted / (accepted + rejected), 4)
+                                 if accepted + rejected else None),
+        "slo_classes": class_rows,
+    }
+
+
 # a warm prefetch queue pops in microseconds — any input wait past this is
 # a dispatch stall (the feed couldn't keep ahead of compute)
 STALL_WAIT_MS = 1.0
@@ -608,6 +695,46 @@ def print_tables(summary, out=sys.stdout):
             w(f"  |  page hit rate (gauge): "
               f"{pc['page_hit_rate_gauge'] * 100:.1f}%")
         w("\n\n")
+    sc = summary.get("scheduler")
+    if sc:
+        w("== scheduler ==\n")
+        w(f"policy: {sc['policy'] or '?'}")
+        cfg = sc.get("config") or {}
+        if cfg.get("prefill_chunk_tokens"):
+            w(f"  chunk: {cfg['prefill_chunk_tokens']} tok")
+        if cfg.get("speculative"):
+            w(f"  speculative: gamma={cfg.get('num_draft_tokens', '?')}")
+        w("\n")
+        if sc["prefill_chunks"]:
+            w(f"prefill chunks: {sc['prefill_chunks']} "
+              f"({sc['prefill_chunk_tokens']} tok) over "
+              f"{sc['prefills_chunked']} prefills")
+            if sc["chunks_per_prefill"] is not None:
+                w(f"  |  chunks/prefill: {sc['chunks_per_prefill']}")
+            if sc["interleave_ratio"] is not None:
+                w(f"  |  interleave: "
+                  f"{sc['interleave_ratio'] * 100:.1f}%")
+            w("\n")
+        if sc["spec_accepted"] or sc["spec_rejected"]:
+            w(f"speculative: {sc['spec_windows']} windows  "
+              f"accepted {sc['spec_accepted']}  "
+              f"rejected {sc['spec_rejected']}")
+            if sc["spec_acceptance_rate"] is not None:
+                w(f"  |  acceptance: "
+                  f"{sc['spec_acceptance_rate'] * 100:.1f}%")
+            w("\n")
+        if sc["slo_classes"]:
+            w(f"{'slo class':<14}{'reqs':>6}{'ttft p50':>10}"
+              f"{'ttft p90':>10}{'ttft p99':>10}{'tpot p50':>10}"
+              f"{'tpot p99':>10}\n")
+            for cls, row in sc["slo_classes"].items():
+                ttft = row.get("ttft_ms") or {}
+                tpot = row.get("tpot_ms") or {}
+                w(f"{cls:<14}{row['requests']:>6}"
+                  f"{ttft.get('p50', '-'):>10}{ttft.get('p90', '-'):>10}"
+                  f"{ttft.get('p99', '-'):>10}{tpot.get('p50', '-'):>10}"
+                  f"{tpot.get('p99', '-'):>10}\n")
+        w("\n")
     rl = summary.get("request_latency")
     if rl:
         w("== request latency (serve/request/* traces) ==\n")

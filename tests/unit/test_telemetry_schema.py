@@ -150,6 +150,19 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.serve("serve/prefix_evict", attrs={"page": 7})
     tel.serve("serve/backend", attrs={"attention_backend": "pallas",
                                       "impl": "pallas", "interpret": 0})
+    # scheduler plane (inference/scheduler.py): policy meta, one prefill
+    # chunk, one speculative draft proposal and its verification
+    tel.serve("serve/sched", attrs={"policy": "chunked",
+                                    "prefill_chunk_tokens": 256,
+                                    "speculative": 1,
+                                    "num_draft_tokens": 4})
+    tel.serve("serve/prefill_chunk",
+              attrs={"req_id": "r10", "slot": 1, "start": 256,
+                     "tokens": 256, "remaining": 128,
+                     "slo_class": "latency"})
+    tel.serve("serve/spec_draft", attrs={"slots": 3, "window": 4})
+    tel.serve("serve/spec_verify", attrs={"slots": 3, "window": 4,
+                                          "accepted": 9, "rejected": 3})
     # the per-request lifecycle trace (RequestTracer): admitted ->
     # prefill_start -> first_token -> exactly one terminal
     tel.serve("serve/request/admitted",
