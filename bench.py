@@ -664,6 +664,133 @@ def _worker_fleet(spec):
     print(json.dumps(_fleet_bench(spec)))
 
 
+def _fleet_disagg_bench(spec=None):
+    """CPU-runnable disaggregated-fleet micro-bench: a mixed workload of
+    long-prefill requests and short shared-prefix chat requests served
+    once by a unified fleet and once by a prefill/decode-specialised
+    fleet (transactional KV-page migration).  Reports chat TTFT p50/p99
+    under each mode — the interference claim: long prefills on a
+    dedicated pool must not sit in front of chat first tokens — plus the
+    migration ledger (pages moved vs dedup-skipped, bytes saved by the
+    content-addressed transport) and the zero-loss/bit-identity checks.
+    Replicas step serially in this single process, so TTFT deltas are
+    scheduling-order effects, not parallel-hardware speedups; the
+    transferable numbers are the page/byte counts and the invariants."""
+    spec = spec or {}
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+
+    n_chat = int(spec.get("chat_requests", 12))
+    n_long = int(spec.get("long_requests", 4))
+    max_new = int(spec.get("max_new_tokens", 6))
+    n_families = int(spec.get("chat_families", 3))
+
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(7)
+    # chat: 3-page shared prefixes so sibling migrations dedup; long: one
+    # 96-token prefill that monopolises a step's prefill capacity
+    families = [rng.integers(0, cfg.vocab_size, (24,)).tolist()
+                for _ in range(n_families)]
+    long_prefix = rng.integers(0, cfg.vocab_size, (96,)).tolist()
+    prompts, kinds = {}, {}
+    for i in range(n_chat):
+        prompts[f"c{i}"] = families[i % n_families] + \
+            rng.integers(0, cfg.vocab_size, (4,)).tolist()
+        kinds[f"c{i}"] = "chat"
+    for i in range(n_long):
+        prompts[f"l{i}"] = long_prefix + \
+            rng.integers(0, cfg.vocab_size, (8,)).tolist()
+        kinds[f"l{i}"] = "long"
+
+    def factory(rid, epoch):
+        return ServingEngine(
+            model, params, max_batch=4, page_size=8, max_seq=128,
+            dtype=jnp.float32, replica_epoch=epoch,
+            serving={"prefix_cache": {"enabled": True}})
+
+    def run(fleet_cfg):
+        fleet = FleetRouter(factory, fleet=dict(fleet_cfg))
+        for rep in fleet.replicas.values():
+            rep.engine.generate([prompts["c0"]], max_new_tokens=2)
+        t_submit = {}
+        t0 = time.perf_counter()
+        for rid, p in prompts.items():
+            # timestamp BEFORE submit: admission prefills inline when a
+            # slot is free, so the first token can arrive during the call
+            t_submit[rid] = time.monotonic()
+            fleet.submit(rid, p, max_new_tokens=max_new,
+                         temperature=0.7, seed=13)
+        done = fleet.join(max_steps=4000)
+        wall = time.perf_counter() - t0
+        # fleet-level TTFT: submit instant (recorded above) to the first
+        # engine-side first-token instant for that request.  Migrated
+        # requests trace on both source and target engines — the min
+        # picks the prefill-side sample, the true first token.
+        first = {}
+        for rep in fleet.replicas.values():
+            traces = list(rep.engine.tracer.completed) + \
+                list(rep.engine.tracer.open.values())
+            for tr in traces:
+                rid = str(tr.req_id).split(":", 1)[-1]
+                if tr.t_first_token >= 0 and rid in t_submit:
+                    prev = first.get(rid)
+                    first[rid] = tr.t_first_token if prev is None \
+                        else min(prev, tr.t_first_token)
+        ttft_ms = {rid: (t - t_submit[rid]) * 1000.0
+                   for rid, t in first.items()}
+        chat = sorted(v for rid, v in ttft_ms.items()
+                      if kinds[rid] == "chat")
+
+        def pct(q):
+            if not chat:
+                return 0.0
+            return chat[min(len(chat) - 1, int(q * (len(chat) - 1) + 0.5))]
+
+        st = fleet.stats
+        return {"fleet": fleet, "done": done, "wall_s": wall,
+                "chat_ttft_p50_ms": pct(0.50), "chat_ttft_p99_ms": pct(0.99),
+                "lost": st["submitted"] - st["finished"] - st["terminated"],
+                "leaks": fleet.leak_report()}
+
+    uni = run({"replicas": 3, "max_replicas": 4})
+    dis = run({"roles": {"enabled": True, "prefill_replicas": 1,
+                         "decode_replicas": 2}})
+    st = dis["fleet"].stats
+    return {
+        "chat_requests": n_chat,
+        "long_requests": n_long,
+        "chat_ttft_p50_ms_unified": round(uni["chat_ttft_p50_ms"], 3),
+        "chat_ttft_p99_ms_unified": round(uni["chat_ttft_p99_ms"], 3),
+        "chat_ttft_p50_ms_disagg": round(dis["chat_ttft_p50_ms"], 3),
+        "chat_ttft_p99_ms_disagg": round(dis["chat_ttft_p99_ms"], 3),
+        "wall_s_unified": round(uni["wall_s"], 3),
+        "wall_s_disagg": round(dis["wall_s"], 3),
+        "migrations": st["migrations"],
+        "migrated_pages": st["migrated_pages"],
+        "dedup_skipped_pages": st["dedup_skipped_pages"],
+        "migrate_bytes": st["migrate_bytes"],
+        "migrate_bytes_saved": st["migrate_bytes_saved"],
+        "local_prefills": st["local_prefills"],
+        "bit_identical": dis["done"] == uni["done"],
+        "lost_requests_unified": uni["lost"],
+        "lost_requests_disagg": dis["lost"],
+        "leaks_unified": uni["leaks"],
+        "leaks_disagg": dis["leaks"],
+    }
+
+
+def _worker_fleet_disagg(spec):
+    print(json.dumps(_fleet_disagg_bench(spec)))
+
+
 def _serving_attn_bench(spec=None):
     """CPU-runnable serving-attention micro-bench: the jnp gather path vs
     the fused ragged Pallas kernel (interpret mode) on ONE mixed
@@ -1661,6 +1788,25 @@ def _attach_fleet(out):
     return out
 
 
+def _attach_fleet_disagg(out):
+    """Attach the disaggregated-fleet micro-bench under the stable key
+    ``cpu_fleet_disagg`` (CPU-runnable: chat TTFT p99 unified vs
+    prefill/decode-specialised, migrated vs dedup-skipped page counts,
+    zero-loss + bit-identity).  Budget-gated; a failure is recorded in
+    notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "fleet_disagg", {},
+        timeout=max(60, min(300, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_fleet_disagg"] = res
+    else:
+        out.setdefault("notes", {})["fleet_disagg"] = (err or "")[:200]
+    return out
+
+
 def _attach_incident(out):
     """Attach the incident-plane micro-bench under the stable key
     ``cpu_incident`` (CPU-runnable: ring-buffer record overhead, injected
@@ -1757,7 +1903,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_incident(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))
+            print(json.dumps(_append_ledger(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -1845,7 +1991,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_incident(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))
+        print(json.dumps(_append_ledger(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -1920,7 +2066,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_incident(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))
+    print(json.dumps(_append_ledger(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))
 
 
 if __name__ == "__main__":
@@ -1949,6 +2095,8 @@ if __name__ == "__main__":
             _worker_serving_prefix(spec)
         elif which == "fleet":
             _worker_fleet(spec)
+        elif which == "fleet_disagg":
+            _worker_fleet_disagg(spec)
         elif which == "serving_attn":
             _worker_serving_attn(spec)
         elif which == "serving_slo":

@@ -3,6 +3,7 @@
 from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
                                                     HeartbeatMonitor,
                                                     ReplicaAutoscaler,
+                                                    RoleAwareAutoscaler,
                                                     ScaleEvent)
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityConfig, ElasticityConfigError, ElasticityError,
@@ -10,6 +11,7 @@ from deepspeed_tpu.elasticity.elasticity import (
     ensure_immutable_elastic_config, get_valid_gpus)
 
 __all__ = ["DSElasticAgent", "HeartbeatMonitor", "ReplicaAutoscaler",
+           "RoleAwareAutoscaler",
            "ScaleEvent",
            "ElasticityConfig",
            "ElasticityError", "ElasticityConfigError",

@@ -153,6 +153,45 @@ class ReplicaAutoscaler:
         return n_replicas
 
 
+class RoleAwareAutoscaler:
+    """Per-pool hysteretic scale decisions for a role-specialized fleet
+    (``serving.fleet.roles`` — inference/fleet.py).
+
+    A disaggregated fleet has independent bottlenecks: the prefill pool
+    saturates on queued prompts, the decode pool on migration backlog
+    and KV-page pressure.  One shared :class:`ReplicaAutoscaler` would
+    couple them (a prefill burst scaling decode, or vice versa), so this
+    wrapper owns one INDEPENDENT autoscaler per pool — each with its own
+    cooldown and counters — and returns one decision per pool."""
+
+    def __init__(self, pools: Dict[str, ReplicaAutoscaler]):
+        if not pools:
+            raise ValueError("RoleAwareAutoscaler needs >= 1 pool")
+        self.pools = dict(pools)
+
+    def decide(self, n_by_pool: Dict[str, int],
+               queue_by_pool: Optional[Dict[str, int]] = None,
+               shed_by_pool: Optional[Dict[str, int]] = None,
+               free_frac_by_pool: Optional[Dict[str, float]] = None) \
+            -> Dict[str, int]:
+        """Desired replica count per pool (each moves by at most 1)."""
+        queue_by_pool = queue_by_pool or {}
+        shed_by_pool = shed_by_pool or {}
+        free_frac_by_pool = free_frac_by_pool or {}
+        return {
+            pool: scaler.decide(
+                max(1, int(n_by_pool.get(pool, 1))),
+                queue_depth=int(queue_by_pool.get(pool, 0)),
+                shed_delta=int(shed_by_pool.get(pool, 0)),
+                free_page_frac=float(free_frac_by_pool.get(pool, 1.0)))
+            for pool, scaler in self.pools.items()}
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        return {pool: {"scale_ups": s.scale_ups,
+                       "scale_downs": s.scale_downs}
+                for pool, s in self.pools.items()}
+
+
 class DSElasticAgent:
 
     def __init__(self, ds_config: Dict, start_world_size: int,

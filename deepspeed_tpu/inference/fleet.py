@@ -43,6 +43,24 @@ Scaling rides ``elasticity.ReplicaAutoscaler``: aggregated queue depth,
 shed deltas, and the tightest free-page fraction feed hysteretic
 one-replica-at-a-time decisions between ``min_replicas`` and
 ``max_replicas``.
+
+**Disaggregated prefill/decode pools** (``serving.fleet.roles``; default
+off = the unified behaviour above, bit-for-bit).  Prefill replicas run
+prompts to the first token and capture a ``PrefillHandoff`` (pages
+pinned at the source); the router then migrates the KV pages to a
+decode replica as a TRANSACTION on the ``page_alloc`` atomicity idiom:
+the ``page_migrate`` site is consulted before the transfer and
+``migrate_commit`` before the routing table flips, the transfer is
+content-addressed so pages already resident in the destination's prefix
+cache are skipped (a hot shared prefix migrates once per decode
+replica, not once per request), a per-step ``page_transfer_budget``
+bounds the router's migration bandwidth, and source pages stay pinned
+until the destination commits — a kill of EITHER side mid-migration
+leaves the request redispatchable from one consistent copy.  If the
+prefill pool drains to zero healthy replicas, dispatch degrades to
+local (monolithic) prefill on the decode pool instead of stalling
+admissions, and autoscaling becomes per-pool
+(``elasticity.RoleAwareAutoscaler``).
 """
 
 import hashlib
@@ -53,7 +71,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from deepspeed_tpu.elasticity.elastic_agent import ReplicaAutoscaler
+from deepspeed_tpu.elasticity.elastic_agent import (ReplicaAutoscaler,
+                                                    RoleAwareAutoscaler)
 from deepspeed_tpu.inference.robustness import (
     REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING, REJECT_DRAINING,
     REJECT_DUPLICATE, REJECT_INFEASIBLE, REJECT_OVERSIZED, SHED_DEADLINE,
@@ -71,10 +90,17 @@ FLEET_EVENTS = (
     "fleet/dispatch_fault", "fleet/redispatch", "fleet/kill",
     "fleet/fence", "fleet/drain", "fleet/shed",
     "fleet/scale_up", "fleet/scale_down",
+    "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
+    "fleet/migrate_abort", "fleet/local_prefill",
 )
 
 # the closed set of replica supervision states (docs/serving.md)
 REPLICA_STATES = ("healthy", "fenced", "dead")
+
+# the closed set of replica roles: a roleless fleet is all-"unified";
+# a disaggregated fleet (serving.fleet.roles.enabled) splits into a
+# prefill pool and a decode pool with KV-page migration between them
+REPLICA_ROLES = ("unified", "prefill", "decode")
 
 # typed shed reason: the per-request redispatch budget ran out — the
 # request bounced off too many dying/overloaded replicas
@@ -85,6 +111,52 @@ SHED_REDISPATCH_BUDGET = "redispatch_budget"
 # so the fleet terminates the request instead of retrying forever
 _FATAL_REJECTS = (REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING,
                   REJECT_OVERSIZED, REJECT_INFEASIBLE)
+
+
+class FleetRolesConfig(DeepSpeedConfigModel):
+    """The ``serving.fleet.roles`` block (docs/config-json.md):
+    disaggregated prefill/decode pools with transactional KV-page
+    migration.  Disabled by default — a roleless fleet is bit-for-bit
+    the unified :class:`FleetRouter`.  When enabled, the pool sizes here
+    REPLACE ``serving.fleet.replicas``/``min_replicas``/``max_replicas``
+    (each pool scales independently)."""
+
+    enabled = False
+    prefill_replicas = 1            # initial prefill-pool size
+    decode_replicas = 2             # initial decode-pool size
+    min_prefill_replicas = 1        # per-pool supervision floors /
+    max_prefill_replicas = 4        # autoscale ceilings
+    min_decode_replicas = 1
+    max_decode_replicas = 8
+    page_transfer_budget = 0        # pages migrated per fleet step
+    #                                 (0 = unlimited; >=1 migration per
+    #                                 step always proceeds — no livelock)
+    migrate_backoff_steps = 2       # fleet steps a faulted migration
+    #                                 waits before retrying
+
+    def _validate(self):
+        if not self.enabled:
+            return
+        for k in ("prefill_replicas", "decode_replicas",
+                  "min_prefill_replicas", "max_prefill_replicas",
+                  "min_decode_replicas", "max_decode_replicas"):
+            if int(getattr(self, k)) < 1:
+                raise ValueError(f"serving.fleet.roles.{k} must be >= 1")
+        for k in ("page_transfer_budget", "migrate_backoff_steps"):
+            if int(getattr(self, k)) < 0:
+                raise ValueError(f"serving.fleet.roles.{k} must be >= 0")
+        for role in ("prefill", "decode"):
+            lo = int(getattr(self, f"min_{role}_replicas"))
+            hi = int(getattr(self, f"max_{role}_replicas"))
+            n = int(getattr(self, f"{role}_replicas"))
+            if hi < lo:
+                raise ValueError(
+                    f"serving.fleet.roles.max_{role}_replicas must be "
+                    f">= min_{role}_replicas")
+            if not lo <= n <= hi:
+                raise ValueError(
+                    f"serving.fleet.roles.{role}_replicas must lie in "
+                    f"[min_{role}_replicas, max_{role}_replicas]")
 
 
 class FleetConfig(DeepSpeedConfigModel):
@@ -102,8 +174,11 @@ class FleetConfig(DeepSpeedConfigModel):
     free_page_low_frac = 0.1
     cooldown_sweeps = 8
     fault_injection = {}            # FaultInjector spec (fleet sites)
+    roles = {}                      # FleetRolesConfig (disaggregation)
 
     def _validate(self):
+        if not isinstance(self.roles, FleetRolesConfig):
+            self.roles = FleetRolesConfig(self.roles or {})
         for k in ("replicas", "min_replicas", "health_interval"):
             if int(getattr(self, k)) < 1:
                 raise ValueError(f"serving.fleet.{k} must be >= 1")
@@ -128,7 +203,11 @@ class FleetConfig(DeepSpeedConfigModel):
 class _FleetRequest:
     """Fleet-side bookkeeping for one submitted request.  ``state`` walks
     pending → dispatched → (pending …) → finished | terminated; the
-    dispatch counter enforces the redispatch budget."""
+    dispatch counter enforces the redispatch budget.  Under a
+    role-specialized fleet a prefill-phase request additionally passes
+    through ``migrating`` (handoff captured on the source replica —
+    ``replica_id`` — and queued for transfer to a decode replica) before
+    returning to ``dispatched`` on its decode replica at commit."""
     req_id: Any
     prompt: List[int]
     kwargs: Dict[str, Any]
@@ -137,6 +216,8 @@ class _FleetRequest:
     state: str = "pending"
     replica_id: Optional[str] = None
     dispatches: int = 0
+    handoff: Any = None             # PrefillHandoff while ``migrating``
+    migrate_after: int = 0          # earliest fleet step to (re)try
 
 
 @dataclass
@@ -145,6 +226,7 @@ class _Replica:
     epoch: str
     engine: Any
     state: str = "healthy"
+    role: str = "unified"
 
 
 class FleetRouter:
@@ -181,28 +263,67 @@ class FleetRouter:
                       "shed": 0, "deadline": 0, "redispatches": 0,
                       "spills": 0, "dispatch_faults": 0, "kills": 0,
                       "fences": 0, "respawns": 0, "scale_ups": 0,
-                      "scale_downs": 0}
+                      "scale_downs": 0,
+                      "migrations": 0, "migrated_pages": 0,
+                      "dedup_skipped_pages": 0, "migrate_bytes": 0,
+                      "migrate_bytes_saved": 0, "migrate_faults": 0,
+                      "migrate_commit_faults": 0, "migrate_aborts": 0,
+                      "local_prefills": 0}
         self._gens: Dict[str, int] = {}     # replica_id -> spawn generation
+        self._role_of: Dict[str, str] = {}  # replica_id -> role (sticky
+        #                                     across respawns, so a dead
+        #                                     ring slot re-takes its pool)
         self._next_rid = 0
-        self._target = int(cfg.replicas)
+        self._next_rids = {"prefill": 0, "decode": 0}
+        self._roles_enabled = bool(cfg.roles.enabled)
+        self.migrations = deque()       # req_ids in the "migrating" state
         self._last_shed_total = 0
-        self._autoscaler = ReplicaAutoscaler(
-            min_replicas=int(cfg.min_replicas),
-            max_replicas=int(cfg.max_replicas),
-            scale_up_queue_per_replica=int(cfg.scale_up_queue_per_replica),
-            scale_down_queue_per_replica=int(
-                cfg.scale_down_queue_per_replica),
-            free_page_low_frac=float(cfg.free_page_low_frac),
-            cooldown_sweeps=int(cfg.cooldown_sweeps)) \
-            if cfg.autoscale else None
+        self._last_shed_by = {"prefill": 0, "decode": 0}
+        if self._roles_enabled:
+            self._targets = {"prefill": int(cfg.roles.prefill_replicas),
+                             "decode": int(cfg.roles.decode_replicas)}
+            self._target = sum(self._targets.values())
+            self._autoscaler = RoleAwareAutoscaler({
+                role: ReplicaAutoscaler(
+                    min_replicas=int(
+                        getattr(cfg.roles, f"min_{role}_replicas")),
+                    max_replicas=int(
+                        getattr(cfg.roles, f"max_{role}_replicas")),
+                    scale_up_queue_per_replica=int(
+                        cfg.scale_up_queue_per_replica),
+                    scale_down_queue_per_replica=int(
+                        cfg.scale_down_queue_per_replica),
+                    free_page_low_frac=float(cfg.free_page_low_frac),
+                    cooldown_sweeps=int(cfg.cooldown_sweeps))
+                for role in ("prefill", "decode")}) \
+                if cfg.autoscale else None
+        else:
+            self._targets = None
+            self._target = int(cfg.replicas)
+            self._autoscaler = ReplicaAutoscaler(
+                min_replicas=int(cfg.min_replicas),
+                max_replicas=int(cfg.max_replicas),
+                scale_up_queue_per_replica=int(
+                    cfg.scale_up_queue_per_replica),
+                scale_down_queue_per_replica=int(
+                    cfg.scale_down_queue_per_replica),
+                free_page_low_frac=float(cfg.free_page_low_frac),
+                cooldown_sweeps=int(cfg.cooldown_sweeps)) \
+                if cfg.autoscale else None
         # the routing key hashes the first N prompt tokens; N defaults to
         # one KV page so the key matches exactly the prefix-cache chain
         # key of the request's first page
         self._route_tokens = int(cfg.route_prefix_tokens)
         self._route_root = hashlib.blake2b(
             b"ds:fleet-route", digest_size=16).digest()
-        for _ in range(int(cfg.replicas)):
-            self._spawn()
+        if self._roles_enabled:
+            for _ in range(int(cfg.roles.prefill_replicas)):
+                self._spawn(role="prefill")
+            for _ in range(int(cfg.roles.decode_replicas)):
+                self._spawn(role="decode")
+        else:
+            for _ in range(int(cfg.replicas)):
+                self._spawn()
         self.attach_exporter()
 
     # -- plumbing --------------------------------------------------------
@@ -242,27 +363,41 @@ class FleetRouter:
             incidents.add_context("fleet_health", self.health)
 
     # -- replica lifecycle ----------------------------------------------
-    def _spawn(self, replica_id=None, respawn=False):
+    def _spawn(self, replica_id=None, respawn=False, role=None):
         rid = replica_id
+        if rid is not None and role is None:
+            role = self._role_of.get(rid)      # respawn keeps its pool
+        if self._roles_enabled and role is None:
+            raise ValueError("role-specialized fleet: _spawn needs a role")
         if rid is None:
-            rid = f"r{self._next_rid}"
-            self._next_rid += 1
+            if self._roles_enabled:
+                prefix = "p" if role == "prefill" else "d"
+                rid = f"{prefix}{self._next_rids[role]}"
+                self._next_rids[role] += 1
+            else:
+                rid = f"r{self._next_rid}"
+                self._next_rid += 1
         gen = self._gens.get(rid, -1) + 1
         self._gens[rid] = gen
         epoch = f"{rid}g{gen}"
         engine = self._factory(rid, epoch)
-        rep = _Replica(rid, epoch, engine)
+        rep = _Replica(rid, epoch, engine,
+                       role=(role or "unified"))
         self.replicas[rid] = rep
+        self._role_of[rid] = rep.role
         if self._route_tokens == 0:
             self._route_tokens = int(engine.page_size)
         if respawn:
             self.stats["respawns"] += 1
         self._fleet_event("fleet/respawn" if respawn else "fleet/spawn",
-                          replica=rid, epoch=epoch)
+                          replica=rid, epoch=epoch,
+                          role=(rep.role if self._roles_enabled else None))
         return rep
 
-    def _healthy(self) -> List[_Replica]:
-        return [r for r in self.replicas.values() if r.state == "healthy"]
+    def _healthy(self, role: Optional[str] = None) -> List[_Replica]:
+        return [r for r in self.replicas.values()
+                if r.state == "healthy" and
+                (role is None or r.role == role)]
 
     def _retire(self, rep: _Replica):
         """Drop a replica from the routing ring (engine already drained
@@ -272,11 +407,21 @@ class FleetRouter:
     def _requeue_owned(self, rep: _Replica) -> List[Any]:
         """Every fleet request dispatched to ``rep`` goes back to pending
         (redispatch-from-scratch) — or to a typed terminal when its
-        redispatch budget is spent."""
+        redispatch budget is spent.  A ``migrating`` request whose
+        SOURCE is ``rep`` loses its handoff (the pinned pages died with
+        the replica) and re-prefills from scratch — that is the
+        mid-migration source-kill recovery path."""
         moved = []
         for fr in self.requests.values():
-            if fr.state == "dispatched" and \
+            if fr.state in ("dispatched", "migrating") and \
                     fr.replica_id == rep.replica_id:
+                if fr.state == "migrating":
+                    fr.handoff = None
+                    self.stats["migrate_aborts"] += 1
+                    self._fleet_event("fleet/migrate_abort",
+                                      req_id=fr.req_id,
+                                      replica=rep.replica_id,
+                                      reason="source_lost")
                 self._requeue(fr)
                 moved.append(fr.req_id)
         return moved
@@ -352,13 +497,14 @@ class FleetRouter:
         h.update(np.asarray(prompt[:n], np.int64).tobytes())
         return h.digest()
 
-    def _pick(self, key: bytes) -> Optional[_Replica]:
+    def _pick(self, key: bytes,
+              role: Optional[str] = None) -> Optional[_Replica]:
         """Rendezvous hashing: highest ``blake2b(key ‖ replica_id)``
-        among healthy replicas.  Membership changes only remap keys whose
-        winner died; a respawn under the same replica_id re-takes its
-        slot."""
+        among healthy replicas (of ``role``'s pool when given).
+        Membership changes only remap keys whose winner died; a respawn
+        under the same replica_id re-takes its slot."""
         best, best_score = None, None
-        for rep in self._healthy():
+        for rep in self._healthy(role):
             h = hashlib.blake2b(key, digest_size=8)
             h.update(rep.replica_id.encode())
             score = (int.from_bytes(h.digest(), "big"), rep.replica_id)
@@ -371,19 +517,31 @@ class FleetRouter:
         routing table or any engine mutates (the page_alloc atomicity
         idiom): a fault here leaves the request exactly as it was and it
         retries on the next step.  Returns True when the request left the
-        pending state (dispatched OR typed into a terminal)."""
+        pending state (dispatched OR typed into a terminal).
+
+        Role-specialized fleets dispatch to the PREFILL pool with
+        ``prefill_only`` set (the engine hands the KV pages off after the
+        first token); with zero healthy prefill replicas, dispatch
+        degrades to local monolithic prefill on the decode pool so
+        admissions never stall on a dead pool."""
         if self.injector is not None:
             self.injector.check("route_dispatch")
         now = self._clock()
         if fr.deadline and now >= fr.deadline:
             self._deadline_terminal(fr)
             return True
-        target = self._pick(fr.route_key)
+        pool, prefill_only = None, False
+        if self._roles_enabled:
+            if self._healthy("prefill"):
+                pool, prefill_only = "prefill", True
+            else:
+                pool = "decode"     # degraded: local monolithic prefill
+        target = self._pick(fr.route_key, pool)
         if target is None:
             return False                 # no healthy replicas right now
         # affinity target first; spill order by least load
         order = [target] + sorted(
-            (r for r in self._healthy() if r is not target),
+            (r for r in self._healthy(pool) if r is not target),
             key=lambda r: (len(r.engine.queue) + r.engine.n_active,
                            r.replica_id))
         rejects = []
@@ -391,6 +549,8 @@ class FleetRouter:
             kwargs = dict(fr.kwargs)
             if fr.deadline:
                 kwargs["deadline_s"] = fr.deadline - now
+            if prefill_only:
+                kwargs["prefill_only"] = True
             try:
                 rep.engine.add_request(fr.req_id, fr.prompt, **kwargs)
             except RequestRejected as e:
@@ -404,6 +564,10 @@ class FleetRouter:
                 self._fleet_event("fleet/spill", req_id=fr.req_id,
                                   replica=rep.replica_id,
                                   affinity=target.replica_id)
+            if pool == "decode":
+                self.stats["local_prefills"] += 1
+                self._fleet_event("fleet/local_prefill", req_id=fr.req_id,
+                                  replica=rep.replica_id)
             self._fleet_event("fleet/route", req_id=fr.req_id,
                               replica=rep.replica_id,
                               dispatches=fr.dispatches)
@@ -492,6 +656,159 @@ class FleetRouter:
             else:
                 self._requeue(fr)
 
+    # -- KV-page migration (prefill -> decode) ---------------------------
+    def _collect_handoffs(self, rep: _Replica):
+        """Fold a prefill replica's completed prefills into fleet state:
+        each request enters ``migrating`` (handoff captured, source
+        pages pinned under ``rep``) and joins the migration queue."""
+        for rid, handoff in rep.engine.pop_prefilled().items():
+            fr = self.requests.get(rid)
+            if fr is None or fr.state != "dispatched" or \
+                    fr.replica_id != rep.replica_id:
+                # stale handoff (the request was re-homed) — unpin now
+                rep.engine.release_handoff(rid)
+                continue
+            fr.state = "migrating"
+            fr.handoff = handoff
+            fr.migrate_after = self.steps
+            self.migrations.append(rid)
+            self._fleet_event("fleet/migrate_start", req_id=rid,
+                              replica=rep.replica_id,
+                              pages=len(handoff.pages))
+
+    def _migrate(self, fr: _FleetRequest, src: _Replica):
+        """One migration attempt for ``fr`` (state ``migrating``, handoff
+        pinned on ``src``).  Returns ``("committed", pages_sent)`` on
+        success, ``("retry", 0)`` when no decode replica can take it
+        right now, ``("commit_fault", 0)`` after a rolled-back commit
+        (backoff already booked).  Raises on a faulted ``page_migrate``
+        transfer — the caller books that fault.  Both injector sites run
+        BEFORE the state they guard mutates, so every failure leaves the
+        source pin and the fleet routing table untouched."""
+        handoff = fr.handoff
+        now = self._clock()
+        target = self._pick(fr.route_key, "decode")
+        if target is None:
+            return ("retry", 0)
+        order = [target] + sorted(
+            (r for r in self._healthy("decode") if r is not target),
+            key=lambda r: (len(r.engine.queue) + r.engine.n_active,
+                           r.replica_id))
+        # transfer fault site — consulted before any engine mutates
+        if self.injector is not None:
+            self.injector.check("page_migrate")
+        for rep in order:
+            eng = rep.engine
+            # content-addressed dedup: full prompt pages already resident
+            # in the destination's prefix cache (same rolling-blake2b
+            # chain) are attached by reference instead of transferred —
+            # a hot shared prefix migrates ONCE per decode replica
+            resident = (eng.prefix_cache.resident_prefix(handoff.prompt)
+                        if eng.prefix_cache is not None else [])
+            to_send = handoff.pages[len(resident):]
+            payload = (src.engine.export_pages(to_send)
+                       if to_send else None)
+            deadline_s = (fr.deadline - now) if fr.deadline else None
+            if not eng.import_request(handoff, payload=payload,
+                                      shared_pages=resident,
+                                      deadline_s=deadline_s):
+                continue        # full right now; try the next replica
+            # commit fault site — consulted before the routing table
+            # flips; a fault rolls the import back to NOTHING while the
+            # source stays pinned (all-or-nothing)
+            if self.injector is not None:
+                try:
+                    self.injector.check("migrate_commit")
+                except Exception as e:
+                    eng.cancel_import(fr.req_id)
+                    self.stats["migrate_commit_faults"] += 1
+                    self._fleet_event(
+                        "fleet/migrate_fault", req_id=fr.req_id,
+                        site="migrate_commit", error=str(e))
+                    fr.migrate_after = self.steps + max(
+                        1, int(self.fleet.roles.migrate_backoff_steps))
+                    return ("commit_fault", 0)
+            eng.commit_import(fr.req_id)
+            fr.state = "dispatched"
+            fr.replica_id = rep.replica_id
+            fr.dispatches += 1
+            fr.handoff = None
+            src.engine.release_handoff(fr.req_id)
+            page_bytes = int(eng.kv_page_bytes)
+            self.stats["migrations"] += 1
+            self.stats["migrated_pages"] += len(to_send)
+            self.stats["dedup_skipped_pages"] += len(resident)
+            self.stats["migrate_bytes"] += len(to_send) * page_bytes
+            self.stats["migrate_bytes_saved"] += len(resident) * page_bytes
+            self._fleet_event("fleet/migrate_commit", req_id=fr.req_id,
+                              replica=rep.replica_id,
+                              source=src.replica_id,
+                              pages=len(to_send), skipped=len(resident),
+                              bytes=len(to_send) * page_bytes,
+                              bytes_saved=len(resident) * page_bytes)
+            return ("committed", len(to_send))
+        return ("retry", 0)
+
+    def _pump_migrations(self):
+        """Drive every ``migrating`` request one transaction attempt
+        forward, under the per-step page-transfer budget (the first
+        migration of a step always proceeds, so one large handoff can
+        never livelock).  A dead source aborts the migration and the
+        request re-prefills from scratch; a faulted transfer or commit
+        retries after ``migrate_backoff_steps``; an expired deadline is
+        final."""
+        if not self.migrations:
+            return
+        budget = int(self.fleet.roles.page_transfer_budget)
+        backoff = max(1, int(self.fleet.roles.migrate_backoff_steps))
+        sent, migrated_any = 0, False
+        for _ in range(len(self.migrations)):
+            rid = self.migrations.popleft()
+            fr = self.requests.get(rid)
+            if fr is None or fr.state != "migrating":
+                continue        # re-homed or already terminal
+            if fr.deadline and self._clock() >= fr.deadline:
+                src = self.replicas.get(fr.replica_id)
+                if src is not None:
+                    src.engine.release_handoff(rid)
+                fr.handoff = None
+                self.stats["migrate_aborts"] += 1
+                self._fleet_event("fleet/migrate_abort", req_id=rid,
+                                  reason="deadline")
+                self._deadline_terminal(fr)
+                continue
+            src = self.replicas.get(fr.replica_id)
+            if src is None or src.state != "healthy":
+                # source died between capture and transfer: the pinned
+                # copy is gone — re-prefill from scratch
+                fr.handoff = None
+                self.stats["migrate_aborts"] += 1
+                self._fleet_event("fleet/migrate_abort", req_id=rid,
+                                  reason="source_lost")
+                self._requeue(fr)
+                continue
+            if self.steps < fr.migrate_after:
+                self.migrations.append(rid)     # backing off
+                continue
+            if budget and migrated_any and \
+                    sent + len(fr.handoff.pages) > budget:
+                self.migrations.append(rid)     # over budget this step
+                continue
+            try:
+                verdict, moved = self._migrate(fr, src)
+            except Exception as e:      # injected page_migrate fault
+                self.stats["migrate_faults"] += 1
+                self._fleet_event("fleet/migrate_fault", req_id=rid,
+                                  site="page_migrate", error=str(e))
+                fr.migrate_after = self.steps + backoff
+                self.migrations.append(rid)
+                continue
+            if verdict == "committed":
+                sent += moved
+                migrated_any = True
+            else:
+                self.migrations.append(rid)
+
     # -- public surface --------------------------------------------------
     def submit(self, req_id, prompt_ids, max_new_tokens: int = 32,
                temperature: float = 0.0, seed: int = 0, top_k: int = 0,
@@ -544,8 +861,12 @@ class FleetRouter:
             before = set(self.finished)
             self._collect_finished(rep, done)
             self._collect_terminated(rep)
+            if self._roles_enabled and rep.role == "prefill":
+                self._collect_handoffs(rep)
             for rid in set(self.finished) - before:
                 done_now[rid] = self.finished[rid]
+        if self._roles_enabled:
+            self._pump_migrations()
         if self.steps % int(self.fleet.health_interval) == 0:
             self._supervise()
         self._ensure_target()
@@ -571,7 +892,7 @@ class FleetRouter:
 
     def _unresolved(self) -> int:
         return sum(1 for fr in self.requests.values()
-                   if fr.state in ("pending", "dispatched"))
+                   if fr.state in ("pending", "dispatched", "migrating"))
 
     # -- supervision -----------------------------------------------------
     def _supervise(self):
@@ -599,6 +920,9 @@ class FleetRouter:
 
     def _autoscale(self):
         if self._autoscaler is None:
+            return
+        if self._roles_enabled:
+            self._autoscale_roles()
             return
         healthy = self._healthy()
         queue_depth = len(self.pending) + sum(
@@ -631,9 +955,68 @@ class FleetRouter:
                 self._fence(victim, "scale_down")
         self._target = desired
 
+    def _autoscale_roles(self):
+        """Per-pool hysteretic scaling: the prefill pool feels fleet
+        admission backlog, the decode pool feels the migration queue on
+        top of its own decode queues; each pool grows/sheds ±1 within
+        its own min/max band (``RoleAwareAutoscaler``)."""
+        n_by, q_by, shed_by, frac_by = {}, {}, {}, {}
+        for role in ("prefill", "decode"):
+            healthy = self._healthy(role)
+            n_by[role] = max(1, len(healthy))
+            q_by[role] = sum(len(r.engine.queue) for r in healthy) + (
+                len(self.pending) if role == "prefill"
+                else len(self.migrations))
+            shed_total = sum(r.engine.stats["shed"] for r in healthy)
+            if role == "prefill":
+                shed_total += self.stats["shed"]    # admission sheds
+            shed_by[role] = max(0,
+                                shed_total - self._last_shed_by[role])
+            self._last_shed_by[role] = shed_total
+            fracs = [r.engine.alloc.free_page_count /
+                     max(1, r.engine.alloc.num_pages - 1)
+                     for r in healthy]
+            frac_by[role] = min(fracs) if fracs else 1.0
+        desired = self._autoscaler.decide(n_by, queue_by_pool=q_by,
+                                          shed_by_pool=shed_by,
+                                          free_frac_by_pool=frac_by)
+        for role in ("prefill", "decode"):
+            if desired[role] > self._targets[role]:
+                self.stats["scale_ups"] += 1
+                self._fleet_event("fleet/scale_up", role=role,
+                                  replicas=desired[role],
+                                  queue_depth=q_by[role])
+            elif desired[role] < self._targets[role]:
+                self.stats["scale_downs"] += 1
+                self._fleet_event("fleet/scale_down", role=role,
+                                  replicas=desired[role],
+                                  queue_depth=q_by[role])
+                victim = min(
+                    self._healthy(role),
+                    key=lambda r: (len(r.engine.queue) +
+                                   r.engine.n_active, r.replica_id),
+                    default=None)
+                if victim is not None:
+                    self._fence(victim, "scale_down")
+            self._targets[role] = desired[role]
+        self._target = sum(self._targets.values())
+
     def _ensure_target(self):
         """Respawn (dead ring slots first, so rendezvous affinity is
         restored) until the fleet is back at the target size."""
+        if self._roles_enabled:
+            for role in ("prefill", "decode"):
+                floor = max(
+                    int(getattr(self.fleet.roles, f"min_{role}_replicas")),
+                    self._targets[role])
+                while sum(1 for r in self.replicas.values()
+                          if r.role == role) < floor:
+                    dead = sorted(
+                        r for r in set(self._gens) - set(self.replicas)
+                        if self._role_of.get(r) == role)
+                    self._spawn(replica_id=dead[0] if dead else None,
+                                respawn=bool(dead), role=role)
+            return
         floor = max(int(self.fleet.min_replicas), self._target)
         while len(self.replicas) < floor:
             dead = sorted(set(self._gens) - set(self.replicas))
@@ -679,6 +1062,7 @@ class FleetRouter:
             per_replica[rep.replica_id] = {
                 "state": rep.state,
                 "epoch": rep.epoch,
+                "role": rep.role,
                 "queue_depth": len(eng.queue),
                 "active_slots": eng.n_active,
                 "free_pages": eng.alloc.free_page_count,
@@ -702,6 +1086,20 @@ class FleetRouter:
                        "closed": self.tracer.closed,
                        "terminals": dict(self.tracer.terminals)},
         }
+        if self._roles_enabled:
+            pools = {}
+            for role in ("prefill", "decode"):
+                healthy = self._healthy(role)
+                pools[role] = {
+                    "n_healthy": len(healthy),
+                    "target": self._targets[role],
+                    "queue_depth": sum(len(r.engine.queue)
+                                       for r in healthy),
+                }
+            snap["pools"] = pools
+            snap["migrating"] = len([
+                fr for fr in self.requests.values()
+                if fr.state == "migrating"])
         tel = self._tel()
         if tel is not None:
             for gauge, key in (("fleet/replicas", "n_replicas"),
@@ -711,6 +1109,17 @@ class FleetRouter:
                 tel.registry.gauge(gauge).set(snap[key])
             tel.registry.gauge("fleet/redispatches").set(
                 self.stats["redispatches"])
+            if self._roles_enabled:
+                tel.registry.gauge("fleet/migrating").set(
+                    snap["migrating"])
+                tel.registry.gauge("fleet/migrated_pages").set(
+                    self.stats["migrated_pages"])
+                tel.registry.gauge("fleet/dedup_skipped_pages").set(
+                    self.stats["dedup_skipped_pages"])
+                for role, pool in snap["pools"].items():
+                    tel.registry.gauge(
+                        f"fleet/{role}_queue_depth").set(
+                        pool["queue_depth"])
         return snap
 
     def leak_report(self) -> Dict[str, Any]:
@@ -723,7 +1132,7 @@ class FleetRouter:
             for k, v in rep.engine.leak_report().items():
                 leaks[f"{rep.replica_id}:{k}"] = v
         live = [fr.req_id for fr in self.requests.values()
-                if fr.state in ("pending", "dispatched")]
+                if fr.state in ("pending", "dispatched", "migrating")]
         leaks.update(self.tracer.audit(live))
         resolved = self.stats["finished"] + self.stats["terminated"]
         if self.stats["submitted"] != resolved + self._unresolved():

@@ -154,6 +154,26 @@ class PrefixCache:
             self.stats["tokens_reused"] += reused
         return match
 
+    def resident_prefix(self, tokens: List[int]) -> List[int]:
+        """Page ids for the leading FULL pages of ``tokens`` resident in
+        this cache, in chain order — the migration-import dedup plan.
+        Unlike :meth:`lookup` there is no ``len - 1`` cap and no COW leg:
+        a migrated request's first output token rides the handoff, so the
+        destination never re-prefills and may attach even a fully
+        page-aligned prompt's final page.  Pure read — the importer must
+        attach the pages (``allocate(shared=...)``) in the same host step
+        for the ids to stay valid."""
+        ps = self.page_size
+        pages, key, pos = [], self._root, 0
+        while pos + ps <= len(tokens):
+            nxt = self._chain_key(key, tokens[pos:pos + ps])
+            page = self.index.get(nxt)
+            if page is None:
+                break
+            pages.append(page)
+            key, pos = nxt, pos + ps
+        return pages
+
     # -- insert ----------------------------------------------------------
     def insert(self, tokens: List[int], pages: List[int]) -> int:
         """Index every FULL page of ``(tokens, pages)`` not yet cached

@@ -83,6 +83,33 @@ class _Request:
     # target / draft KV cache (the monolithic policy never reads these)
     prefilled: int = 0
     draft_filled: int = 0
+    # disaggregated fleets: this replica only prefills — the engine
+    # captures a PrefillHandoff at prefill completion instead of decoding
+    prefill_only: bool = False
+
+
+@dataclass
+class PrefillHandoff:
+    """Everything a decode replica needs to continue a request whose
+    prefill ran elsewhere: the sampling recipe, the first token (sampled
+    on the source — its logits came off the prefill dispatch), the host
+    sampler's RNG stream state, and the SOURCE page ids of the prompt's
+    KV pages.  The pages stay pinned under the source allocator (keyed by
+    ``req_id``) until :meth:`ServingEngine.release_handoff` — the commit
+    acknowledgement — so a kill of either side mid-migration always
+    leaves one consistent copy to redispatch from."""
+    req_id: Any
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    top_k: int
+    top_p: float
+    slo_class: str
+    last_token: int
+    out: List[int]
+    rng_state: Optional[dict]
+    pages: List[int]
 
 
 class ServingEngine:
@@ -186,6 +213,15 @@ class ServingEngine:
                 min_prefix_tokens=int(pc_cfg.min_prefix_tokens),
                 on_evict=self._on_prefix_evict)
         self._copy_page_fn = None   # compiled COW page copy (lazy)
+        # KV-page migration plumbing (disaggregated fleets): compiled
+        # gather/scatter over page ids (lazy), handed-off prefills whose
+        # pages stay pinned here, and imports awaiting their commit
+        self._gather_pages_fn = None
+        self._scatter_pages_fn = None
+        self._kv_page_bytes = None
+        self.handoffs: Dict[Any, PrefillHandoff] = {}
+        self._new_handoffs: List[Any] = []
+        self._pending_imports: Dict[Any, Any] = {}
         self.eos = eos_token_id
         if not self.config.use_rope and not self.config.use_alibi:
             # learned positions: gathers past the table CLAMP under jit
@@ -255,7 +291,8 @@ class ServingEngine:
                       "step_faults": 0, "drains": 0, "prefix_hits": 0,
                       "prefix_cow_copies": 0, "prefix_evictions": 0,
                       "slo_attained": 0, "slo_missed": 0,
-                      "goodput_tokens": 0}
+                      "goodput_tokens": 0,
+                      "prefill_handoffs": 0, "imports": 0}
         # one frozen event per engine records which attention path every
         # serve/step span of this stream ran (ds_telemetry_report keys
         # its serving-attention table off it)
@@ -367,7 +404,8 @@ class ServingEngine:
                     temperature: float = 0.0, seed: int = 0,
                     top_k: int = 0, top_p: float = 1.0,
                     deadline_s: Optional[float] = None,
-                    slo_class: Optional[str] = None):
+                    slo_class: Optional[str] = None,
+                    prefill_only: bool = False):
         """Validate and enqueue one request.  Raises
         :class:`RequestRejected` (typed reason, engine state untouched)
         instead of asserting; ``deadline_s`` is a TTL from now — the
@@ -375,7 +413,11 @@ class ServingEngine:
         queued or mid-flight.  ``slo_class`` ("latency" | "throughput",
         default ``serving.scheduler.slo_class_default``) orders admission
         and prefill-chunk scheduling under the chunked policy and picks
-        the per-class TTL default when ``deadline_s`` is omitted."""
+        the per-class TTL default when ``deadline_s`` is omitted.
+        ``prefill_only`` (disaggregated fleets): validate and reserve
+        exactly as a full request — same buckets, same feasibility — but
+        capture a :class:`PrefillHandoff` at prefill completion instead
+        of decoding; collect with :meth:`pop_prefilled`."""
         cfg = self.serving
         if self.draining:
             self._reject(req_id, REJECT_DRAINING,
@@ -432,7 +474,8 @@ class ServingEngine:
         self.queue.append(_Request(req_id, prompt, max_new_tokens,
                                    temperature, seed, top_k, top_p,
                                    submit_time=now, deadline=deadline,
-                                   slo_class=slo_class))
+                                   slo_class=slo_class,
+                                   prefill_only=bool(prefill_only)))
         self.stats["admitted"] += 1
         # lifecycle trace opens HERE: admission is the promise leak_report
         # audits — exactly one serve/request/* terminal closes it
@@ -664,6 +707,234 @@ class ServingEngine:
             if added:
                 self._serve_event("serve/prefix_insert",
                                   req_id=req.req_id, pages=added)
+        if req.prefill_only:
+            self._capture_handoff(slot, req)
+
+    def _capture_handoff(self, slot: int, req: _Request):
+        """Prefill-only admission tail: the prompt is fully in cache and
+        the first token is sampled, so capture everything a decode
+        replica needs, shrink the reservation to the prompt pages, and
+        keep them PINNED under this request id until
+        :meth:`release_handoff`.  The slot frees immediately for the next
+        prefill — that asymmetry is the whole point of the role split."""
+        self.alloc.shrink(req.req_id, len(req.prompt))
+        rng = self._rng.pop(req.req_id, None)
+        self.handoffs[req.req_id] = PrefillHandoff(
+            req_id=req.req_id, prompt=list(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature, seed=req.seed,
+            top_k=req.top_k, top_p=req.top_p, slo_class=req.slo_class,
+            last_token=int(req.last_token), out=list(req.out),
+            rng_state=(rng.bit_generator.state if rng is not None
+                       else None),
+            pages=list(self.alloc.seq_pages[req.req_id]))
+        self._new_handoffs.append(req.req_id)
+        self.scheduler.release_slot(slot, req)
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        self.tables[slot, :] = 0
+        self.stats["prefill_handoffs"] += 1
+        self._close_trace(req, "finish", reason="prefill_handoff")
+
+    # -- KV-page migration (disaggregated fleets) ------------------------
+    @property
+    def kv_page_bytes(self) -> int:
+        """Analytic bytes of ONE KV page across every cache leaf (all
+        layers, K and V) — the unit the fleet's page-transfer budget and
+        bytes-saved accounting multiply by."""
+        if self._kv_page_bytes is None:
+            self._kv_page_bytes = sum(
+                int(np.prod(leaf.shape[:1] + leaf.shape[2:])) *
+                jnp.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree_util.tree_leaves(self.caches))
+        return self._kv_page_bytes
+
+    @staticmethod
+    def _pad_pow2(ids) -> np.ndarray:
+        """Page-id vector padded to a power-of-two length with the
+        scratch page (0): bounds the gather/scatter jit cache to log2
+        distinct shapes, and pad traffic lands on the sacrificial scratch
+        page by construction."""
+        n = max(1, len(ids))
+        out = np.zeros(1 << (n - 1).bit_length(), np.int32)
+        out[:len(ids)] = ids
+        return out
+
+    def pop_prefilled(self) -> Dict[Any, PrefillHandoff]:
+        """Hand back the handoffs captured since the last call (req_id →
+        :class:`PrefillHandoff`).  Pages stay pinned under this engine's
+        allocator until :meth:`release_handoff` — the fleet releases only
+        AFTER the decode side commits, so a kill of either replica
+        mid-migration leaves one consistent copy to redispatch from."""
+        out = {rid: self.handoffs[rid] for rid in self._new_handoffs
+               if rid in self.handoffs}
+        self._new_handoffs = []
+        return out
+
+    def release_handoff(self, req_id) -> bool:
+        """Unpin a handed-off request's prompt pages (the decode side
+        acknowledged, or the fleet abandoned the migration).  The full
+        prompt pages were indexed into this replica's prefix cache at
+        capture, so they park in the reclaimable tier — the hot prefix
+        stays warm for the next prefill instead of dissolving."""
+        if self.handoffs.pop(req_id, None) is None:
+            return False
+        self.alloc.free_sequence(req_id)
+        return True
+
+    def export_pages(self, page_ids):
+        """Device-gather the KV content of ``page_ids`` (every layer,
+        every cache leaf) into a standalone payload pytree shaped like
+        the cache with P = pow2-padded ``len(page_ids)`` — the migration
+        wire format.  Pure read, no donation."""
+        padded = self._pad_pow2(page_ids)
+        if self._gather_pages_fn is None:
+            def gather(caches, ids):
+                return jax.tree_util.tree_map(
+                    lambda leaf: leaf[:, ids], caches)
+            self._gather_pages_fn = self._wrap_compiled(
+                jax.jit(gather), "serve/export_pages")
+        if self.mesh is not None:
+            with self.mesh:
+                return self._gather_pages_fn(self.caches,
+                                             jnp.asarray(padded))
+        return self._gather_pages_fn(self.caches, jnp.asarray(padded))
+
+    def import_pages(self, payload, page_ids):
+        """Scatter an exported payload into this engine's ``page_ids``
+        (the :meth:`export_pages` counterpart; donation makes it an
+        in-place page write).  Payload pad lanes beyond ``len(page_ids)``
+        scatter onto the sacrificial scratch page."""
+        leaves = jax.tree_util.tree_leaves(payload)
+        padded = np.zeros(leaves[0].shape[1], np.int32)
+        padded[:len(page_ids)] = page_ids
+        if self._scatter_pages_fn is None:
+            def scatter(caches, payload, ids):
+                return jax.tree_util.tree_map(
+                    lambda leaf, pay: leaf.at[:, ids].set(pay),
+                    caches, payload)
+            self._scatter_pages_fn = self._wrap_compiled(
+                jax.jit(scatter, donate_argnums=(0,)),
+                "serve/import_pages")
+        if self.mesh is not None:
+            with self.mesh:
+                self.caches = self._scatter_pages_fn(
+                    self.caches, payload, jnp.asarray(padded))
+        else:
+            self.caches = self._scatter_pages_fn(self.caches, payload,
+                                                 jnp.asarray(padded))
+
+    def import_request(self, handoff: PrefillHandoff, payload=None,
+                       shared_pages=(), deadline_s=None) -> bool:
+        """Install a migrated request directly into a decode slot: full
+        reservation (prompt + budget) attaching ``shared_pages`` (pages
+        already resident here by content — the dedup plan from
+        ``prefix_cache.resident_prefix``), scatter ``payload`` (the
+        source's exported non-shared prompt pages) into freshly owned
+        pages, and restore the sampler stream.  NOTHING observable —
+        tracer, events, stats, prefix index — mutates until
+        :meth:`commit_import`, and :meth:`cancel_import` rolls the
+        installation back to nothing, so the fleet's ``migrate_commit``
+        fault site is all-or-nothing.  Returns True when installed, False
+        when this engine cannot take it right now (draining, no free
+        slot, page pressure, id collision)."""
+        if self.draining:
+            return False
+        slot = next((s for s in range(self.max_batch)
+                     if self.slots[s] is None), None)
+        if slot is None:
+            return False
+        rid = handoff.req_id
+        if rid in self.alloc.seq_pages or rid in self.finished or \
+                any(r.req_id == rid for r in self.queue):
+            return False
+        total = len(handoff.prompt) + handoff.max_new_tokens
+        shared = list(shared_pages)
+        try:
+            pages = self.alloc.allocate(rid, total, shared=shared)
+        except PageAllocationError:
+            return False
+        try:
+            n_import = len(handoff.pages) - len(shared)
+            if n_import > 0:
+                self.import_pages(
+                    payload, pages[len(shared):len(shared) + n_import])
+        except Exception:
+            self.alloc.free_sequence(rid)
+            raise
+        req = _Request(rid, list(handoff.prompt),
+                       handoff.max_new_tokens, handoff.temperature,
+                       handoff.seed, handoff.top_k, handoff.top_p,
+                       out=list(handoff.out),
+                       last_token=handoff.last_token,
+                       submit_time=self._clock(),
+                       slo_class=handoff.slo_class,
+                       prefilled=len(handoff.prompt))
+        if deadline_s is not None:
+            req.deadline = self._clock() + float(deadline_s)
+        if handoff.rng_state is not None:
+            rng = np.random.default_rng(handoff.seed)
+            rng.bit_generator.state = handoff.rng_state
+            self._rng[rid] = rng
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(pages)] = pages
+        self.lengths[slot] = len(handoff.prompt)
+        self.slots[slot] = req
+        self._pending_imports[rid] = (slot, handoff, len(shared))
+        return True
+
+    def commit_import(self, req_id):
+        """Make an installed import observable: open the lifecycle trace
+        (admit → prefill_start → first_token; the source already sampled
+        the first token), bump counters, and index the prompt pages into
+        this replica's prefix cache so the NEXT request sharing the
+        prefix skips its transfer entirely (migrate-once-per-replica)."""
+        slot, handoff, n_shared = self._pending_imports.pop(req_id)
+        req = self.slots[slot]
+        self.stats["admitted"] += 1
+        self.stats["imports"] += 1
+        self.tracer.admit(req_id, deadline=req.deadline,
+                          now=self._clock())
+        self._serve_event("serve/admit", req_id=req_id,
+                          queue_depth=len(self.queue),
+                          free_pages=self.alloc.free_page_count)
+        self._serve_event("serve/request/admitted", req_id=req_id,
+                          queue_depth=len(self.queue),
+                          prompt_tokens=len(req.prompt),
+                          max_new_tokens=int(req.max_new_tokens),
+                          deadline=int(bool(req.deadline)),
+                          slo_class=req.slo_class)
+        tr = self.tracer.prefill_start(req_id, slot)
+        if tr is not None:
+            self._serve_event("serve/request/prefill_start",
+                              req_id=req_id, slot=slot,
+                              pages=len(self.alloc.seq_pages[req_id]),
+                              cached_tokens=n_shared * self.page_size,
+                              queue_wait_ms=_round_ms(tr.queue_wait_ms()))
+        self._note_first_token(slot, req)
+        if self.prefix_cache is not None:
+            added = self.prefix_cache.insert(
+                req.prompt, self.alloc.seq_pages[req_id])
+            if added:
+                self._serve_event("serve/prefix_insert", req_id=req_id,
+                                  pages=added, at="import")
+        return req
+
+    def cancel_import(self, req_id) -> bool:
+        """Roll an installed (uncommitted) import back to nothing: free
+        the pages, clear the slot, drop the restored RNG.  No trace was
+        opened and no event fired, so a faulted ``migrate_commit`` leaves
+        this engine exactly as it was (all-or-nothing)."""
+        entry = self._pending_imports.pop(req_id, None)
+        if entry is None:
+            return False
+        slot, _, _ = entry
+        self.alloc.free_sequence(req_id)
+        self._rng.pop(req_id, None)
+        self.slots[slot] = None
+        self.lengths[slot] = 0
+        self.tables[slot, :] = 0
+        return True
 
     def _trim_reservation(self, slot: int, req: _Request):
         """Trim the slot's reservation to the request's TRUE page need.
@@ -891,6 +1162,11 @@ class ServingEngine:
         ``{"finished", "shed", "steps", "health"}``; afterwards the
         engine holds zero active slots and zero allocated pages."""
         self.draining = True
+        # handed-off prefills: unpin their pages — the fleet owns those
+        # requests' lifecycles and re-homes them after the drain
+        for rid in list(self.handoffs):
+            self.release_handoff(rid)
+        self._new_handoffs = []
         shed_ids = []
         for req in list(self.queue):
             self._terminate(req, "drained", SHED_DRAIN,
@@ -926,6 +1202,11 @@ class ServingEngine:
                 self._serve_event("serve/shed", req_id=rid,
                                   reason=SHED_DRAIN)
                 shed_ids.append(rid)
+        # prefill_only requests that completed DURING the drain steps
+        # captured fresh handoffs — unpin those too
+        for rid in list(self.handoffs):
+            self.release_handoff(rid)
+        self._new_handoffs = []
         self.stats["drains"] += 1
         self._serve_event("serve/drain", finished=len(finished),
                           shed=len(shed_ids), steps=steps)
@@ -951,6 +1232,7 @@ class ServingEngine:
             "draining": self.draining,
             "overloaded": self._admission.overloaded,
             "undelivered_terminated": len(self.terminated),
+            "handoffs_pinned": len(self.handoffs),
             "counters": dict(self.stats),
             "slo": {"attained": self.stats["slo_attained"],
                     "missed": self.stats["slo_missed"],
@@ -1008,7 +1290,10 @@ class ServingEngine:
         with the allocator's cached set.  Returns {} when clean — every
         exit path (finish, shed, deadline, evict, drain) must keep it
         that way."""
-        active = {r.req_id for r in self.slots if r is not None}
+        # handed-off prefills own their pinned prompt pages by design —
+        # the fleet's migration transaction is their live owner
+        active = {r.req_id for r in self.slots if r is not None} | \
+            set(self.handoffs)
         leaks: Dict[str, Any] = {}
         stray_pages = sorted(set(self.alloc.seq_pages) - active, key=str)
         if stray_pages:
@@ -1043,7 +1328,9 @@ class ServingEngine:
         leaks.update(self.scheduler.leak_report())
         # trace completeness: every admitted request is either still live
         # (queued/active) or reached exactly one serve/request/* terminal
-        live = {r.req_id for r in self.queue} | active
+        # — a handoff's trace CLOSED at capture, so it is not live here
+        live = {r.req_id for r in self.queue} | \
+            {r.req_id for r in self.slots if r is not None}
         leaks.update(self.tracer.audit(live))
         # HBM leak detector (profiling plane): monotonic live-byte growth
         # across snapshots — device memory the page allocator can't see

@@ -214,6 +214,7 @@ class MetricsExporter:
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True
         self._thread = None
+        self._closed = False
 
     @property
     def address(self):
@@ -221,6 +222,8 @@ class MetricsExporter:
         return self._server.server_address[:2]
 
     def start(self):
+        if self._closed:
+            raise RuntimeError("metrics exporter already closed")
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._server.serve_forever, daemon=True,
@@ -229,8 +232,25 @@ class MetricsExporter:
         return self
 
     def close(self):
-        self._server.shutdown()
+        """Stop serving and CLOSE the listening socket (idempotent).
+
+        ``shutdown()`` only unblocks a RUNNING ``serve_forever`` loop —
+        calling it when ``start()`` never ran would wait forever on an
+        event that loop never sets — while ``server_close()`` must run
+        unconditionally: the constructor binds the port, so it is what
+        releases the address and makes it immediately rebindable after a
+        drain."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+
+    def drain(self):
+        """Lifecycle alias for :meth:`close` — the quiesce verb the
+        serving plane's drain paths call."""
+        self.close()

@@ -177,10 +177,15 @@ _EXC_TABLE = {
 # (inference/fleet.py): abrupt replica death during a supervision sweep,
 # and the routing-table dispatch — consulted BEFORE any routing state
 # mutates, so a faulted dispatch never half-registers a request (the
-# page_alloc atomicity idiom).
+# page_alloc atomicity idiom).  page_migrate / migrate_commit are the
+# KV-page migration transaction's two sites (disaggregated fleets): the
+# cross-replica page transfer and the all-or-nothing commit — both
+# consulted BEFORE any routing-table or allocator mutation becomes
+# durable, so a faulted migration retries from a consistent state.
 FAULT_SITES = ("ckpt_save", "ckpt_load", "fs", "dataloader_next",
                "serve_step", "serve_sample", "page_alloc",
-               "replica_kill", "route_dispatch")
+               "replica_kill", "route_dispatch",
+               "page_migrate", "migrate_commit")
 
 
 class FaultInjector:

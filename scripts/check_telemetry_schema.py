@@ -199,6 +199,8 @@ FLEET_EVENTS = (
     "fleet/dispatch_fault", "fleet/redispatch", "fleet/kill",
     "fleet/fence", "fleet/drain", "fleet/shed",
     "fleet/scale_up", "fleet/scale_down",
+    "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
+    "fleet/migrate_abort", "fleet/local_prefill",
 )
 
 # Distributed (sharded) mode stamps every record with its origin rank so

@@ -89,6 +89,7 @@ def aggregate(events):
     metas = []
     serves = {}      # event name -> {count, reasons: {reason: n}}
     fleets = {}      # fleet event name -> {count, reasons, replicas}
+    fleet_roles = {} # replica id -> role (disaggregated fleets)
     requests = []    # reconstructed serve/request/* lifecycle traces
     open_reqs = {}   # req_id -> index into requests (trace not yet closed)
     compiles = {"sites": {}, "storms": 0, "total_misses": 0}
@@ -156,6 +157,20 @@ def aggregate(events):
             replica = attrs.get("replica")
             if replica:
                 rec["replicas"].add(str(replica))
+            # disaggregated fleets: spawn/respawn stamp each replica's
+            # role; migrate_commit carries the page-transfer ledger and
+            # migrate_fault its injector site
+            role = attrs.get("role")
+            if role and replica:
+                fleet_roles[str(replica)] = str(role)
+            if ev["name"] == "fleet/migrate_commit":
+                for k in ("pages", "skipped", "bytes", "bytes_saved"):
+                    rec[k] = rec.get(k, 0) + int(attrs.get(k) or 0)
+            elif ev["name"] == "fleet/migrate_fault":
+                site = attrs.get("site")
+                if site:
+                    sites = rec.setdefault("sites", {})
+                    sites[site] = sites.get(site, 0) + 1
         elif kind == "serve":
             rec = serves.setdefault(ev["name"], {"count": 0, "reasons": {}})
             rec["count"] += 1
@@ -231,6 +246,7 @@ def aggregate(events):
             "heartbeats": heartbeats, "rank_steps": rank_steps,
             "steps": steps, "stalls": stalls,
             "metas": metas, "serves": serves, "fleets": fleets,
+            "fleet_roles": fleet_roles,
             "requests": requests, "compiles": compiles}
 
 
@@ -272,6 +288,11 @@ def summarize(agg):
                "reasons": dict(sorted(rec["reasons"].items())),
                "replicas": sorted(rec["replicas"])}
         for name, rec in sorted(agg.get("fleets", {}).items())}
+    for name, rec in agg.get("fleets", {}).items():
+        # migration ledger columns ride the per-event rows too
+        for k in ("pages", "skipped", "bytes", "bytes_saved", "sites"):
+            if k in rec:
+                fleet_rows[name][k] = rec[k]
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
             "profiling": _profiling_summary(agg),
@@ -279,12 +300,51 @@ def summarize(agg):
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
             "fleet": fleet_rows,
+            "fleet_disagg": _disagg_summary(agg),
             "serving_attention": _serving_attention_summary(agg),
             "scheduler": _scheduler_summary(agg),
             "prefix_cache": _prefix_cache_summary(agg),
             "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _disagg_summary(agg):
+    """Disaggregated-fleet digest: the per-role replica census (from
+    role-stamped spawn/respawn events), per-pool queue-depth gauges, and
+    the migration ledger summed from the frozen ``fleet/migrate_*``
+    stream.  None when the run never stamped a non-unified role."""
+    roles = agg.get("fleet_roles") or {}
+    if not (set(roles.values()) - {"unified"}):
+        return None
+    fleets = agg.get("fleets", {})
+    gauges = agg.get("gauges", {})
+
+    def _gauge(name):
+        g = gauges.get(name)
+        return g["last"] if g else None
+
+    by_role = {}
+    for rid, role in sorted(roles.items()):
+        by_role.setdefault(role, []).append(rid)
+    commit = fleets.get("fleet/migrate_commit", {})
+    return {
+        "roles": {role: sorted(rids)
+                  for role, rids in sorted(by_role.items())},
+        "queue_depth": {role: _gauge(f"fleet/{role}_queue_depth")
+                        for role in sorted(by_role)},
+        "migrations": commit.get("count", 0),
+        "migrated_pages": commit.get("pages", 0),
+        "dedup_skipped_pages": commit.get("skipped", 0),
+        "migrate_bytes": commit.get("bytes", 0),
+        "bytes_saved": commit.get("bytes_saved", 0),
+        "faults": dict(sorted(fleets.get("fleet/migrate_fault", {})
+                              .get("sites", {}).items())),
+        "aborts": dict(sorted(fleets.get("fleet/migrate_abort", {})
+                              .get("reasons", {}).items())),
+        "local_prefills": fleets.get("fleet/local_prefill",
+                                     {}).get("count", 0),
+    }
 
 
 def _profiling_summary(agg):
@@ -666,6 +726,31 @@ def print_tables(summary, out=sys.stdout):
                 parts.append(", ".join(f"{k}={v}"
                                        for k, v in r["reasons"].items()))
             w(f"{name:<24}{r['count']:>7}  {' | '.join(parts)}\n")
+        w("\n")
+    dis = summary.get("fleet_disagg")
+    if dis:
+        w("== disaggregated fleet ==\n")
+        w(f"{'role':<10}{'replicas':<20}{'queue':>6}\n")
+        for role, rids in dis["roles"].items():
+            q = dis["queue_depth"].get(role)
+            w(f"{role:<10}{','.join(rids):<20}"
+              f"{q if q is not None else '?':>6}\n")
+        w(f"migrations: {dis['migrations']}  "
+          f"pages migrated: {dis['migrated_pages']}  "
+          f"dedup skipped: {dis['dedup_skipped_pages']}  "
+          f"bytes saved: {dis['bytes_saved']}\n")
+        extras = []
+        if dis["faults"]:
+            extras.append("faults: " + ", ".join(
+                f"{k}={v}" for k, v in dis["faults"].items()))
+        if dis["aborts"]:
+            extras.append("aborts: " + ", ".join(
+                f"{k}={v}" for k, v in dis["aborts"].items()))
+        if dis["local_prefills"]:
+            extras.append(
+                f"local prefills (degraded): {dis['local_prefills']}")
+        if extras:
+            w("  |  ".join(extras) + "\n")
         w("\n")
     sa = summary.get("serving_attention")
     if sa:

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Chain every offline quality gate in one command:
+#
+#   scripts/run_gates.sh [TELEMETRY_DIR] [INCIDENTS_DIR]
+#
+#   1. check_telemetry_schema.py <events.jsonl...>   frozen event vocab
+#   2. check_telemetry_schema.py --ledger            BENCH_LEDGER.jsonl rows
+#   3. check_telemetry_schema.py --incidents         incident bundles
+#   4. ds_perf_diff.py --check                       perf regression gate
+#
+# TELEMETRY_DIR (optional) is searched recursively for events*.jsonl
+# streams; INCIDENTS_DIR (optional) holds incident bundles.  Gates whose
+# input is absent are SKIPPED, not failed — the script is safe to run on
+# a fresh checkout and in CI alike.  Exit 0 iff every gate that ran
+# passed.
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+PY="${PYTHON:-python}"
+TELEMETRY_DIR="${1:-}"
+INCIDENTS_DIR="${2:-}"
+LEDGER="${LEDGER:-$REPO/BENCH_LEDGER.jsonl}"
+fail=0
+
+run_gate() {
+    local name="$1"; shift
+    echo "== gate: $name =="
+    if "$@"; then
+        echo "-- $name: PASS"
+    else
+        echo "-- $name: FAIL"
+        fail=1
+    fi
+}
+
+# 1. event-stream schema (every events*.jsonl under TELEMETRY_DIR)
+if [ -n "$TELEMETRY_DIR" ] && [ -d "$TELEMETRY_DIR" ]; then
+    mapfile -t streams < <(find "$TELEMETRY_DIR" -name 'events*.jsonl' \
+                                -type f | sort)
+    if [ "${#streams[@]}" -gt 0 ]; then
+        run_gate "event schema" \
+            "$PY" "$REPO/scripts/check_telemetry_schema.py" "${streams[@]}"
+    else
+        echo "== gate: event schema == SKIP (no events*.jsonl under" \
+             "$TELEMETRY_DIR)"
+    fi
+else
+    echo "== gate: event schema == SKIP (no telemetry dir given)"
+fi
+
+# 2. bench ledger rows
+if [ -f "$LEDGER" ]; then
+    run_gate "bench ledger" \
+        "$PY" "$REPO/scripts/check_telemetry_schema.py" --ledger "$LEDGER"
+else
+    echo "== gate: bench ledger == SKIP ($LEDGER missing)"
+fi
+
+# 3. incident bundles
+if [ -n "$INCIDENTS_DIR" ] && [ -d "$INCIDENTS_DIR" ]; then
+    run_gate "incident bundles" \
+        "$PY" "$REPO/scripts/check_telemetry_schema.py" --incidents \
+        "$INCIDENTS_DIR"
+else
+    echo "== gate: incident bundles == SKIP (no incidents dir given)"
+fi
+
+# 4. perf regression (exits 0 quietly on a missing/single-run ledger)
+run_gate "perf diff" "$PY" "$REPO/scripts/ds_perf_diff.py" --check \
+    "$LEDGER"
+
+if [ "$fail" -ne 0 ]; then
+    echo "GATES: FAIL"
+    exit 1
+fi
+echo "GATES: OK"

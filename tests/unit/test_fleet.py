@@ -452,3 +452,46 @@ def test_exporter_fleet_endpoint(tiny, tmp_path):
         assert set(snap["replicas"]) == set(fleet.replicas)
     finally:
         tel.close()
+
+
+# ----------------------------------------------------------------------
+# rendezvous routing: minimal-disruption property
+# ----------------------------------------------------------------------
+class _StubEngine:
+    """Routing-only stand-in: ``_pick`` never steps an engine, so the
+    ring-size sweep needs no device work."""
+
+    def __init__(self):
+        self.queue = []
+        self.n_active = 0
+        self.page_size = 8
+
+
+def test_rendezvous_kill_remaps_only_victims_keys():
+    """Property sweep over ring sizes 2–8: killing ONE replica remaps
+    exactly the keys it owned (every other key keeps its owner), and a
+    respawn under the same replica id re-takes its slot — the full
+    pre-kill mapping comes back bit-for-bit."""
+    import hashlib as _hl
+
+    for n in range(2, 9):
+        fleet = FleetRouter(lambda rid, epoch: _StubEngine(),
+                            fleet={"replicas": n, "max_replicas": 8})
+        keys = [_hl.blake2b(f"k{i}".encode(), digest_size=16).digest()
+                for i in range(200)]
+        before = {k: fleet._pick(k).replica_id for k in keys}
+        assert len(set(before.values())) == n   # every replica owns keys
+        victim = before[keys[0]]
+        fleet.kill_replica(victim, detail="property drill")
+        moved = 0
+        for k in keys:
+            now = fleet._pick(k).replica_id
+            if before[k] == victim:
+                assert now != victim
+                moved += 1
+            else:
+                assert now == before[k]         # untouched keys stay put
+        assert moved == sum(1 for o in before.values() if o == victim)
+        fleet._ensure_target()                  # respawn re-takes the slot
+        assert victim in fleet.replicas
+        assert {k: fleet._pick(k).replica_id for k in keys} == before

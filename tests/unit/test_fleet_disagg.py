@@ -1,0 +1,400 @@
+"""Disaggregated prefill/decode fleet tests (inference/fleet.py +
+inference/serving.py handoff surface): transactional KV-page migration,
+content-addressed dedup, mid-migration kills on EITHER side, commit
+atomicity, per-step transfer budgets, prefill-pool-death degradation,
+and schema-valid ``fleet/migrate_*`` telemetry.
+
+Oracle discipline (inherited from test_fleet.py): a request's output
+depends only on (prompt, sampling params, seed) — never on which replica
+prefilled it, which replica decoded it, or how many migration attempts
+it took — so every disaggregated / faulted / degraded run must produce
+outputs bit-identical to the unified no-fault baseline."""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.fleet import (FLEET_EVENTS, FleetConfig,
+                                           FleetRolesConfig, FleetRouter)
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+from deepspeed_tpu.runtime.resilience import FAULT_SITES, FaultInjector
+
+SAMPLING = dict(max_new_tokens=8, temperature=0.7, seed=11)
+ROLES = {"roles": {"enabled": True, "prefill_replicas": 1,
+                   "decode_replicas": 2}}
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    return cfg, model, params
+
+
+def _factory(model, params, **overrides):
+    def build(replica_id, epoch):
+        kw = dict(max_batch=4, page_size=8, max_seq=128,
+                  dtype=jnp.float32, replica_epoch=epoch,
+                  serving={"prefix_cache": {"enabled": True}})
+        kw.update(overrides)
+        return ServingEngine(model, params, **kw)
+    return build
+
+
+def _family_prompts(cfg, n_families=3, per_family=2, prefix_len=24,
+                    suffix_len=4, seed=0):
+    """Shared 24-token prefixes (3 full KV pages at page_size=8) with
+    distinct short suffixes — the migration-dedup-friendly workload."""
+    rng = np.random.default_rng(seed)
+    fams = [rng.integers(0, cfg.vocab_size, (prefix_len,)).tolist()
+            for _ in range(n_families)]
+    prompts = {}
+    for fi, fam in enumerate(fams):
+        for j in range(per_family):
+            suffix = rng.integers(0, cfg.vocab_size,
+                                  (suffix_len,)).tolist()
+            prompts[f"f{fi}q{j}"] = fam + suffix
+    return prompts
+
+
+@pytest.fixture(scope="module")
+def workload(tiny):
+    cfg, model, params = tiny
+    return _family_prompts(cfg)
+
+
+@pytest.fixture(scope="module")
+def baseline(tiny, workload):
+    """Unified (roleless) no-fault run — the bit-identity oracle."""
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig({"replicas": 3}))
+    for rid, p in workload.items():
+        fleet.submit(rid, p, **SAMPLING)
+    done = fleet.join()
+    assert len(done) == len(workload)
+    assert fleet.leak_report() == {}
+    return done
+
+
+def _run_disagg(tiny, workload, fleet_cfg=None, injector=None):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig(fleet_cfg or dict(ROLES)))
+    if injector is not None:
+        fleet.injector = injector
+    for rid, p in workload.items():
+        fleet.submit(rid, p, **SAMPLING)
+    return fleet, fleet.join()
+
+
+def _assert_zero_loss(fleet, n_submitted):
+    st = fleet.stats
+    assert st["submitted"] == n_submitted
+    assert st["finished"] + st["terminated"] == n_submitted
+    assert fleet.leak_report() == {}
+
+
+# ----------------------------------------------------------------------
+# config + frozen vocabularies
+# ----------------------------------------------------------------------
+def test_roles_config_validation():
+    for bad in ({"enabled": True, "prefill_replicas": 0},
+                {"enabled": True, "decode_replicas": 0},
+                {"enabled": True, "min_prefill_replicas": 3,
+                 "max_prefill_replicas": 2},
+                {"enabled": True, "page_transfer_budget": -1},
+                {"enabled": True, "migrate_backoff_steps": -1}):
+        with pytest.raises(ValueError):
+            FleetRolesConfig(bad)
+    # disabled blocks skip range validation (defaults stay inert)
+    FleetRolesConfig({"enabled": False, "prefill_replicas": 0})
+    # the fleet config nests and promotes the roles block
+    cfg = FleetConfig({"roles": {"enabled": True, "decode_replicas": 3,
+                                 "page_transfer_budget": 8}})
+    assert isinstance(cfg.roles, FleetRolesConfig)
+    assert cfg.roles.decode_replicas == 3
+    assert cfg.roles.page_transfer_budget == 8
+
+
+def test_migration_fault_sites_frozen():
+    assert "page_migrate" in FAULT_SITES
+    assert "migrate_commit" in FAULT_SITES
+    for name in ("fleet/migrate_start", "fleet/migrate_commit",
+                 "fleet/migrate_fault", "fleet/migrate_abort",
+                 "fleet/local_prefill"):
+        assert name in FLEET_EVENTS
+
+
+def test_unified_default_is_roleless(tiny):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig({"replicas": 2}))
+    assert all(r.role == "unified" for r in fleet.replicas.values())
+    assert sorted(fleet.replicas) == ["r0", "r1"]
+    fleet.submit("a", [1, 2, 3, 4], max_new_tokens=2)
+    fleet.join()
+    assert fleet.stats["migrations"] == 0
+    assert fleet.leak_report() == {}
+
+
+# ----------------------------------------------------------------------
+# engine-level handoff surface
+# ----------------------------------------------------------------------
+def _drive(eng):
+    done = {}
+    while eng.queue or eng.n_active:
+        done.update(eng.step())
+    return done
+
+
+def test_engine_handoff_roundtrip(tiny):
+    """prefill_only on engine A + import/commit on engine B reproduces a
+    single-engine run bit-for-bit."""
+    cfg, model, params = tiny
+    prompt = list(range(2, 30))
+    solo = ServingEngine(model, params, max_batch=2, page_size=8,
+                         max_seq=128, dtype=jnp.float32)
+    solo.add_request("r", prompt, **SAMPLING)
+    want = _drive(solo)["r"]
+
+    a = ServingEngine(model, params, max_batch=2, page_size=8,
+                      max_seq=128, dtype=jnp.float32)
+    b = ServingEngine(model, params, max_batch=2, page_size=8,
+                      max_seq=128, dtype=jnp.float32)
+    a.add_request("r", prompt, prefill_only=True, **SAMPLING)
+    while not a.handoffs:
+        a.step()
+    handoffs = a.pop_prefilled()
+    assert set(handoffs) == {"r"}
+    h = handoffs["r"]
+    # the first token rides the handoff as the sampled-but-uncommitted
+    # last_token; out stays empty until the first decode step commits it
+    assert h.out == [] and isinstance(h.last_token, int)
+    payload = a.export_pages(h.pages)
+    assert b.import_request(h, payload=payload)
+    b.commit_import("r")
+    a.release_handoff("r")
+    got = _drive(b)["r"]
+    assert got == want
+    assert a.leak_report() == {} and b.leak_report() == {}
+    assert a.stats["prefill_handoffs"] == 1 and b.stats["imports"] == 1
+
+
+def test_engine_cancel_import_is_all_or_nothing(tiny):
+    cfg, model, params = tiny
+    prompt = list(range(2, 30))
+    a = ServingEngine(model, params, max_batch=2, page_size=8,
+                      max_seq=128, dtype=jnp.float32)
+    b = ServingEngine(model, params, max_batch=2, page_size=8,
+                      max_seq=128, dtype=jnp.float32)
+    a.add_request("r", prompt, prefill_only=True, **SAMPLING)
+    while not a.handoffs:
+        a.step()
+    h = a.pop_prefilled()["r"]
+    free_before = b.alloc.free_page_count
+    assert b.import_request(h, payload=a.export_pages(h.pages))
+    b.cancel_import("r")
+    # rollback leaves NO trace: pages, slots, tracer, stats all pristine
+    assert b.alloc.free_page_count == free_before
+    assert b.n_active == 0 and b.stats["admitted"] == 0
+    assert b.leak_report() == {}
+    # and the import is retryable afterwards
+    assert b.import_request(h, payload=a.export_pages(h.pages))
+    b.commit_import("r")
+    a.release_handoff("r")
+    assert _drive(b)["r"]
+    assert a.leak_report() == {} and b.leak_report() == {}
+
+
+# ----------------------------------------------------------------------
+# acceptance: disagg == unified, dedup, budgets
+# ----------------------------------------------------------------------
+def test_disagg_matches_unified_bit_identical(tiny, workload, baseline):
+    fleet, done = _run_disagg(tiny, workload)
+    assert done == baseline
+    assert fleet.stats["migrations"] == len(workload)
+    assert fleet.stats["local_prefills"] == 0
+    _assert_zero_loss(fleet, len(workload))
+    h = fleet.health()
+    assert h["pools"]["prefill"]["n_healthy"] == 1
+    assert h["pools"]["decode"]["n_healthy"] == 2
+    assert h["migrating"] == 0
+    roles = {r["role"] for r in h["replicas"].values()}
+    assert roles == {"prefill", "decode"}
+
+
+def test_shared_prefix_migrates_once_per_replica(tiny, workload,
+                                                 baseline):
+    """Affinity routes a family to one decode replica; after the first
+    member lands, every sibling's 3 full prefix pages are dedup-skipped
+    (content-addressed chain match) instead of re-transferred."""
+    fleet, done = _run_disagg(tiny, workload)
+    assert done == baseline
+    # 3 families x 1 second-member x 3 full prefix pages
+    assert fleet.stats["dedup_skipped_pages"] == 9
+    assert fleet.stats["migrate_bytes_saved"] == \
+        9 * next(iter(fleet.replicas.values())).engine.kv_page_bytes
+
+
+def test_page_transfer_budget_throttles_not_starves(tiny, workload,
+                                                    baseline):
+    cfg = dict(ROLES)
+    cfg["roles"] = dict(cfg["roles"], page_transfer_budget=4)
+    fleet, done = _run_disagg(tiny, workload, fleet_cfg=cfg)
+    assert done == baseline
+    assert fleet.stats["migrations"] == len(workload)
+    _assert_zero_loss(fleet, len(workload))
+
+
+# ----------------------------------------------------------------------
+# faults: transfer, commit, kills on either side, pool death
+# ----------------------------------------------------------------------
+def test_transient_migration_faults_retry_to_zero_loss(tiny, workload,
+                                                       baseline):
+    inj = FaultInjector({"page_migrate": {"fail_times": 2},
+                         "migrate_commit": {"fail_times": 1}})
+    fleet, done = _run_disagg(tiny, workload, injector=inj)
+    assert done == baseline
+    assert fleet.stats["migrate_faults"] == 2
+    assert fleet.stats["migrate_commit_faults"] == 1
+    _assert_zero_loss(fleet, len(workload))
+
+
+def test_kill_prefill_source_mid_migration(tiny, workload, baseline):
+    """Pin every request in ``migrating`` (transfer faults), then kill
+    the prefill source: the pinned copies are gone, requests re-prefill
+    from scratch (degraded local prefill until the respawn lands) and
+    finish bit-identically."""
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig(dict(ROLES)))
+    fleet.injector = FaultInjector({"page_migrate": {"fail_times": 99}})
+    for rid, p in workload.items():
+        fleet.submit(rid, p, **SAMPLING)
+    for _ in range(6):
+        fleet.step()
+    n_migr = sum(1 for fr in fleet.requests.values()
+                 if fr.state == "migrating")
+    assert n_migr > 0
+    fleet.injector = None
+    fleet.kill_replica("p0", detail="drill: source kill mid-migration")
+    done = fleet.join()
+    assert done == baseline
+    assert fleet.stats["migrate_aborts"] >= n_migr
+    _assert_zero_loss(fleet, len(workload))
+    # the respawned ring slot keeps its role
+    assert fleet.replicas["p0"].role == "prefill"
+
+
+def test_kill_decode_target_after_commit(tiny, workload, baseline):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig(dict(ROLES)))
+    for rid, p in workload.items():
+        fleet.submit(rid, p, **SAMPLING)
+    while not fleet.stats["migrations"]:
+        fleet.step()
+    victims = sorted({fr.replica_id for fr in fleet.requests.values()
+                      if fr.state == "dispatched"
+                      and fr.replica_id.startswith("d")})
+    assert victims
+    fleet.kill_replica(victims[0], detail="drill: target kill")
+    done = fleet.join()
+    assert done == baseline
+    assert fleet.stats["redispatches"] > 0
+    _assert_zero_loss(fleet, len(workload))
+
+
+def test_prefill_pool_death_degrades_to_local_prefill(tiny, workload,
+                                                      baseline):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig(dict(ROLES)))
+    fleet.kill_replica("p0", detail="drill: pool death")
+    for rid, p in workload.items():
+        fleet.submit(rid, p, **SAMPLING)
+    done = fleet.join()
+    assert done == baseline
+    assert fleet.stats["local_prefills"] > 0
+    _assert_zero_loss(fleet, len(workload))
+
+
+def test_drain_mid_migration_reaches_typed_terminals(tiny, workload):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        FleetConfig(dict(ROLES)))
+    fleet.injector = FaultInjector({"page_migrate": {"fail_times": 99}})
+    for rid, p in workload.items():
+        fleet.submit(rid, p, **SAMPLING)
+    for _ in range(6):
+        fleet.step()
+    assert any(fr.state == "migrating"
+               for fr in fleet.requests.values())
+    fleet.injector = None
+    fleet.drain()
+    _assert_zero_loss(fleet, len(workload))
+
+
+# ----------------------------------------------------------------------
+# observability: schema-valid migrate event stream
+# ----------------------------------------------------------------------
+def _load_script(name):
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_disagg_event_stream_is_schema_valid(tiny, workload, tmp_path):
+    cfg, model, params = tiny
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "disagg"}), rank=0)
+    try:
+        fleet = FleetRouter(_factory(model, params),
+                            fleet=dict(ROLES), telemetry=tel)
+        fleet.injector = FaultInjector(
+            {"migrate_commit": {"fail_times": 1}})
+        for rid, p in workload.items():
+            fleet.submit(rid, p, **SAMPLING)
+        fleet.join()
+        fleet.health()
+        fleet.drain()
+    finally:
+        tel.close()
+    path = os.path.join(str(tmp_path), "disagg", "events.jsonl")
+    checker = _load_script("check_telemetry_schema")
+    assert checker.validate_file(path) == []
+    with open(path) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    names = {e["name"] for e in events if e["kind"] == "fleet"}
+    assert {"fleet/migrate_start", "fleet/migrate_commit",
+            "fleet/migrate_fault"} <= names
+    assert names <= set(FLEET_EVENTS)
+    # the offline report reconstructs the disagg digest from the stream
+    report = _load_script("ds_telemetry_report")
+    files = report.discover_files(os.path.join(str(tmp_path), "disagg"))
+    summary = report.summarize(
+        report.aggregate(report.load_events(files)))
+    dis = summary["fleet_disagg"]
+    assert dis is not None
+    assert dis["roles"] == {"decode": ["d0", "d1"], "prefill": ["p0"]}
+    assert dis["migrations"] == len(workload)
+    assert dis["migrated_pages"] > 0
+    assert dis["dedup_skipped_pages"] > 0
+    assert dis["bytes_saved"] > 0
+    assert dis["faults"] == {"migrate_commit": 1}

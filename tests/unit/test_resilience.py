@@ -135,6 +135,20 @@ def test_fault_injector_from_config_empty_is_none():
     assert FaultInjector.from_config({"fs": {"fail_times": 1}}) is not None
 
 
+def test_fault_sites_doc_lockstep():
+    """docs/resilience.md's site table IS the frozen ``FAULT_SITES``
+    vocabulary — same names, same order; doc and code cannot drift."""
+    import re
+
+    from deepspeed_tpu.runtime.resilience import FAULT_SITES
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    with open(os.path.join(repo, "docs", "resilience.md")) as f:
+        doc = f.read()
+    documented = re.findall(r"^\| `(\w+)` \|", doc, flags=re.MULTILINE)
+    assert tuple(documented) == FAULT_SITES
+
+
 def test_poison_tree():
     tree = {"a": np.ones((2, 2), np.float32), "b": np.arange(3),
             "c": {"d": np.ones(4, np.float64)}}

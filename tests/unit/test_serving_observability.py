@@ -524,6 +524,37 @@ def test_prom_text_renders_engine_snapshot(tiny, tmp_path):
     tel.close()
 
 
+def test_exporter_close_releases_port_for_rebind(tmp_path):
+    """Regression: close()/drain() must CLOSE the listening socket so
+    the same address is immediately rebindable (drain → restart on a
+    pinned port), must not hang when start() never ran (the constructor
+    binds, but ``shutdown()`` only unblocks a running ``serve_forever``
+    loop), and must be idempotent."""
+    tel = Telemetry().configure(
+        TelemetryConfig({"enabled": True, "output_path": str(tmp_path),
+                         "job_name": "lc"}), rank=0)
+    try:
+        exp = MetricsExporter(tel, port=0)
+        exp.start()
+        host, port = exp.address
+        exp.drain()                     # lifecycle alias for close()
+        # bind-after-close: a fresh exporter takes the SAME address
+        exp2 = MetricsExporter(tel, host=host, port=port)
+        exp2.start()
+        assert exp2.address == (host, port)
+        urllib.request.urlopen(f"http://{host}:{port}/metrics",
+                               timeout=5).read()
+        exp2.close()
+        exp2.close()                    # idempotent
+        # close() without start(): must return, not wait forever
+        exp3 = MetricsExporter(tel, port=0)
+        exp3.close()
+        with pytest.raises(RuntimeError):
+            exp3.start()                # a closed exporter stays closed
+    finally:
+        tel.close()
+
+
 def test_exporter_scrape_is_thread_safe(tmp_path):
     """Regression: a /metrics scrape while writers hammer observe()/set()
     must neither raise ("deque mutated during iteration") nor tear the
