@@ -1252,6 +1252,309 @@ def _worker_serving_sched(spec):
     print(json.dumps(_serving_sched_bench(spec)))
 
 
+def _autotune_bench(spec=None):
+    """CPU-runnable closed-loop autotuner micro-bench: an end-to-end tune
+    over a small serving knob grid (prefill chunk tokens x speculative
+    draft length) on the same simulated-dispatch-clock workload as the
+    scheduler bench.  The ControlPlane prunes the infeasible corner
+    (draft + 1 > page_size), scores every surviving trial from its own
+    Telemetry snapshot, ledgers each trial as a ``tune-<id>`` run under
+    bench ``autotune``, and persists the winner as a provenance-stamped
+    overlay.  The bench then replays the DEFAULT config (chunk=256,
+    no draft) and the overlay-merged config through the identical
+    harness and asserts the tuned point beats the default on >= 2
+    ledgered metrics with zero regressions, that the overlay round-trips
+    through ``create_serving_engine``, and that the tune artifacts pass
+    ``check_telemetry_schema --tune`` / ``--ledger`` and a rehearsal
+    ``ds_perf_diff --check``."""
+    spec = spec or {}
+    import subprocess as sp
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import (ControlPlane, Knob, KnobSpace,
+                                          Objective, apply_overlay,
+                                          load_overlay)
+    from deepspeed_tpu.inference.serving import ServingEngine
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+
+    n_requests = int(spec.get("requests", 12))
+    max_new = int(spec.get("max_new_tokens", 12))
+    long_len = int(spec.get("long_prompt_tokens", 192))
+    overhead_s = float(spec.get("dispatch_overhead_s", 5e-4))
+    per_tok_s = float(spec.get("per_token_s", 5e-5))
+    chunk_grid = [int(v) for v in spec.get("chunk_grid", [32, 64])]
+    # 16 is the deliberately infeasible corner: draft + 1 > page_size,
+    # so the memory-model pruner (not the engine) must reject it
+    draft_grid = [int(v) for v in spec.get("draft_grid", [0, 3, 16])]
+    page_size = int(spec.get("page_size", 16))
+
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(jax.random.key(1), len(leaves))
+    draft_params = jax.tree_util.tree_unflatten(
+        treedef, [l + 3e-3 * jax.random.normal(k, l.shape, l.dtype)
+                  for l, k in zip(leaves, keys)])
+
+    rng = np.random.default_rng(0)
+    prompts, classes, arrival = [], [], []
+    for i in range(n_requests):
+        if i % 3 == 0:
+            n, cls = long_len, "throughput"
+        else:
+            n, cls = int(rng.integers(4, 9)), "latency"
+        prompts.append(rng.integers(0, cfg.vocab_size, (n,)).tolist())
+        classes.append(cls)
+        arrival.append(i * 3e-3)
+
+    class SimClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    def run_workload(trial_cfg, tel):
+        serving = dict(trial_cfg.get("serving") or {})
+        sched_blk = dict(serving.get("scheduler") or {})
+        gamma = int(dict(sched_blk.get("speculative") or {})
+                    .get("num_draft_tokens", 0))
+        clk = SimClock()
+        sched_cfg = {"policy": "chunked",
+                     "prefill_chunk_tokens":
+                         int(sched_blk.get("prefill_chunk_tokens", 256)),
+                     "max_prefill_chunks_per_step":
+                         int(sched_blk.get("max_prefill_chunks_per_step",
+                                           3))}
+        if gamma > 0:
+            sched_cfg["speculative"] = {"enabled": True,
+                                        "num_draft_tokens": gamma}
+        eng = ServingEngine(
+            model, params, max_batch=4,
+            page_size=int(serving.get("page_size", page_size)),
+            max_seq=512, dtype=jnp.float32, clock=clk,
+            serving={"scheduler": sched_cfg}, telemetry=tel,
+            draft_model=model if gamma > 0 else None,
+            draft_params=draft_params if gamma > 0 else None)
+        real_step = eng._run_step
+
+        def charged_step(ids, tables, lengths, phase="decode"):
+            clk.t += overhead_s + per_tok_s * float(ids.size)
+            return real_step(ids, tables, lengths, phase=phase)
+
+        eng._run_step = charged_step
+        if gamma > 0:
+            sch = eng.scheduler
+            real_draft = sch._run_draft
+
+            def charged_draft(ids, tables, lengths, phase):
+                clk.t += overhead_s + per_tok_s / 4.0 * float(ids.size)
+                return real_draft(ids, tables, lengths, phase)
+
+            sch._run_draft = charged_draft
+            real_propose = sch._propose_fn
+
+            def charged_propose(params, caches, tables, lengths, last):
+                clk.t += overhead_s + per_tok_s / 4.0 * \
+                    float(last.shape[0] * (gamma + 1))
+                return real_propose(params, caches, tables, lengths, last)
+
+            sch._propose_fn = charged_propose
+
+        total = 0
+        next_req = 0
+        while next_req < n_requests or eng.queue or eng.n_active:
+            clk.t += 1e-4
+            while next_req < n_requests and arrival[next_req] <= clk.t:
+                eng.add_request(next_req, prompts[next_req],
+                                max_new_tokens=max_new,
+                                slo_class=classes[next_req])
+                next_req += 1
+            for toks in eng.step().values():
+                total += len(toks)
+        # TTFT/TPOT/e2e histograms (simulated ms) land in ``tel`` via the
+        # engine; tokens/s over the simulated clock is harness-computed
+        return {"tokens_per_sec": round(total / clk.t, 3)
+                if clk.t else 0.0}
+
+    base_cfg = {"serving": {"page_size": page_size,
+                            "scheduler": {
+                                "policy": "chunked",
+                                "prefill_chunk_tokens": 256,
+                                "max_prefill_chunks_per_step": 3}}}
+    space = KnobSpace([
+        Knob("prefill_chunk_tokens",
+             "serving/scheduler/prefill_chunk_tokens", chunk_grid),
+        Knob("num_draft_tokens",
+             "serving/scheduler/speculative/num_draft_tokens", draft_grid),
+    ])
+    objective = Objective({"tokens_per_sec": 1.0,
+                           "ttft_p99_ms": -0.05,
+                           "tpot_p99_ms": -0.5})
+
+    results_dir = tempfile.mkdtemp(prefix="dstpu_autotune_")
+    trial_ledger = os.path.join(results_dir, "trial_ledger.jsonl")
+    cp = ControlPlane(base_config=base_cfg, knob_space=space,
+                      objective=objective, results_dir=results_dir,
+                      ledger_path=trial_ledger, bench="autotune")
+    summary = cp.tune(run_workload)
+    payload = load_overlay(summary["overlay_path"])
+    winner = ((payload or {}).get("provenance") or {}).get("knobs") or {}
+
+    def measure(cfg_d):
+        tel = Telemetry()
+        tel.enabled = True   # registry-only: accumulate, no event sink
+        extra = run_workload(cfg_d, tel)
+        return objective.metrics(tel.snapshot(), extra)
+
+    default_vec = measure(base_cfg)
+    tuned_vec = measure(apply_overlay(base_cfg, payload))
+
+    directions = {"tokens_per_sec": 1, "ttft_p50_ms": -1,
+                  "ttft_p99_ms": -1, "tpot_p50_ms": -1, "tpot_p99_ms": -1,
+                  "e2e_p99_ms": -1, "queue_wait_p99_ms": -1}
+    improved, regressed = [], []
+    for name, sign in directions.items():
+        d, t = default_vec.get(name), tuned_vec.get(name)
+        if d is None or t is None:
+            continue
+        delta = sign * (t - d)
+        if delta > 0.01 * abs(d):
+            improved.append(name)
+        elif delta < -0.01 * abs(d):
+            regressed.append(name)
+
+    # consumption path: the overlay must round-trip through
+    # create_serving_engine (autotuning.overlay_path in the ds config)
+    eng = deepspeed_tpu.create_serving_engine(
+        model, params,
+        config={"max_batch": 4, "max_seq": 512,
+                "serving": base_cfg["serving"],
+                "autotuning": {"overlay_path": summary["overlay_path"]}},
+        dtype=jnp.float32)
+    consumed = (getattr(eng, "overlay_provenance", None) is not None and
+                getattr(eng.scheduler, "chunk", None) ==
+                int(winner.get("prefill_chunk_tokens", -1)))
+
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts")
+    real_ledger = os.environ.get(
+        "BENCH_LEDGER",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_LEDGER.jsonl"))
+    with open(trial_ledger) as f:
+        trial_rows_text = f.read()
+    trial_rows = [ln for ln in trial_rows_text.splitlines() if ln.strip()]
+    # perf-diff rehearsal: history + this tune's trial runs + a candidate
+    # run carrying the summary metrics the parent will ledger — proves
+    # the tune rows never trip the gate before touching the real ledger
+    check_ledger = os.path.join(results_dir, "check_ledger.jsonl")
+    ts = time.time()
+    with open(check_ledger, "w") as f:
+        if os.path.exists(real_ledger):
+            with open(real_ledger) as src:
+                f.write(src.read())
+        f.write(trial_rows_text)
+        for metric, value in (
+                ("tuned_tokens_per_sec", tuned_vec.get("tokens_per_sec")),
+                ("tuned_ttft_p99_ms", tuned_vec.get("ttft_p99_ms")),
+                ("default_tokens_per_sec",
+                 default_vec.get("tokens_per_sec")),
+                ("default_ttft_p99_ms", default_vec.get("ttft_p99_ms"))):
+            if isinstance(value, (int, float)):
+                f.write(json.dumps(
+                    {"ts": ts, "run": f"run-tunecheck-{int(ts)}",
+                     "bench": "cpu_autotune", "metric": metric,
+                     "value": value}) + "\n")
+
+    def _rc(args):
+        try:
+            return sp.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=120).returncode
+        except Exception:
+            return -1
+
+    checker = os.path.join(scripts_dir, "check_telemetry_schema.py")
+    tune_gate_rc = _rc([checker, "--tune", results_dir])
+    ledger_gate_rc = _rc([checker, "--ledger", check_ledger])
+    perf_diff_rc = _rc([os.path.join(scripts_dir, "ds_perf_diff.py"),
+                        check_ledger, "--check"])
+
+    beats = len(improved) >= 2 and not regressed
+    problems = []
+    if summary.get("best") is None:
+        problems.append("no winning trial")
+    if not beats:
+        problems.append(
+            f"tuned does not beat default: improved={improved} "
+            f"regressed={regressed}")
+    if not consumed:
+        problems.append("overlay not consumed by create_serving_engine")
+    if tune_gate_rc != 0:
+        problems.append(f"--tune gate rc={tune_gate_rc}")
+    if ledger_gate_rc != 0:
+        problems.append(f"--ledger gate rc={ledger_gate_rc}")
+    if perf_diff_rc != 0:
+        problems.append(f"ds_perf_diff --check rc={perf_diff_rc}")
+    if problems:
+        raise RuntimeError("autotune bench failed: " + "; ".join(problems))
+
+    # trial rows reach the real ledger only after every gate passed — a
+    # failed tune must never pollute the perf baseline
+    appended = 0
+    try:
+        with open(real_ledger, "a") as f:
+            f.write(trial_rows_text)
+        appended = len(trial_rows)
+    except OSError:
+        pass
+
+    def _r(v):
+        return round(v, 3) if isinstance(v, (int, float)) else None
+
+    return {
+        "trials": summary["trials"],
+        "pruned_trials": summary["pruned"],
+        "winner_chunk": int(winner.get("prefill_chunk_tokens", 0)),
+        "winner_draft": int(winner.get("num_draft_tokens", 0)),
+        "winner_objective": _r((summary.get("best") or {})
+                               .get("objective")),
+        "default_tokens_per_sec": _r(default_vec.get("tokens_per_sec")),
+        "tuned_tokens_per_sec": _r(tuned_vec.get("tokens_per_sec")),
+        "default_ttft_p99_ms": _r(default_vec.get("ttft_p99_ms")),
+        "tuned_ttft_p99_ms": _r(tuned_vec.get("ttft_p99_ms")),
+        "default_tpot_p99_ms": _r(default_vec.get("tpot_p99_ms")),
+        "tuned_tpot_p99_ms": _r(tuned_vec.get("tpot_p99_ms")),
+        "default_e2e_p99_ms": _r(default_vec.get("e2e_p99_ms")),
+        "tuned_e2e_p99_ms": _r(tuned_vec.get("e2e_p99_ms")),
+        "improved_metric_count": len(improved),
+        "regressed_metric_count": len(regressed),
+        "improved": improved,
+        "regressed": regressed,
+        "tuned_beats_default": beats,
+        "overlay_consumed": consumed,
+        "tune_gate_rc": tune_gate_rc,
+        "ledger_gate_rc": ledger_gate_rc,
+        "perf_diff_rc": perf_diff_rc,
+        "trial_rows_appended": appended,
+        "note": "simulated dispatch clock; tuned-vs-default deltas and "
+                "gate rcs are the transferable outputs, not CPU wall "
+                "time",
+    }
+
+
+def _worker_autotune(spec):
+    print(json.dumps(_autotune_bench(spec)))
+
+
 def _comm_census_bench(spec=None):
     """CPU-runnable distributed-telemetry micro-bench: a simulated 4-rank
     run (N threads, each owning its own Telemetry configured with a
@@ -1825,6 +2128,25 @@ def _attach_incident(out):
     return out
 
 
+def _attach_autotune(out):
+    """Attach the closed-loop autotuner micro-bench under the stable key
+    ``cpu_autotune`` (CPU-runnable: end-to-end tune over a serving knob
+    grid on the simulated dispatch clock, tuned-vs-default verdict,
+    overlay round-trip, tune/ledger/perf-diff gate rcs).  Budget-gated;
+    a failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "autotune", {},
+        timeout=max(60, min(300, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_autotune"] = res
+    else:
+        out.setdefault("notes", {})["autotune"] = (err or "")[:200]
+    return out
+
+
 def _append_ledger(out):
     """Append this run's numeric bench metrics to the perf-regression
     ledger (``BENCH_LEDGER`` env override; default BENCH_LEDGER.jsonl
@@ -1903,7 +2225,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))
+            print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -1991,7 +2313,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))
+        print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -2066,7 +2388,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))
+    print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))))
 
 
 if __name__ == "__main__":
@@ -2109,6 +2431,8 @@ if __name__ == "__main__":
             _worker_compile_churn(spec)
         elif which == "incident":
             _worker_incident(spec)
+        elif which == "autotune":
+            _worker_autotune(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

@@ -90,6 +90,18 @@ def initialize(args=None,
     return engine, engine.tx, engine.training_dataloader, engine.lr_scheduler
 
 
+def create_serving_engine(model, params, config=None, overlay_path=None,
+                          **kwargs):
+    """Build a paged-KV :class:`~deepspeed_tpu.inference.serving
+    .ServingEngine` from a ds-style config dict, applying a persisted
+    autotuner overlay (``autotuning.overlay_path`` or the explicit
+    ``overlay_path``) first — the serving twin of :func:`initialize`'s
+    overlay hook."""
+    from deepspeed_tpu.inference.serving import create_serving_engine as _f
+    return _f(model, params, config=config, overlay_path=overlay_path,
+              **kwargs)
+
+
 def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
     """Parity: reference ``deepspeed/__init__.py:233``.  Config kwargs
     (``mp_size=2`` etc.) merge into ``config`` like the reference; ``params``

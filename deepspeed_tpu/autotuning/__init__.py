@@ -1,9 +1,28 @@
-"""Autotuning (reference ``deepspeed/autotuning/``)."""
+"""Autotuning (reference ``deepspeed/autotuning/``).
+
+Two drivers share one journaled trial runner (``scheduler.py``):
+
+* :class:`Autotuner` — the reference-parity launcher-driven grid search
+  (stage × micro-batch, then template coordinate descent);
+* :class:`ControlPlane` — the closed-loop tuner: declared knob space,
+  memory-model + gauge feasibility pruning, telemetry-snapshot scoring,
+  and a provenance-stamped config overlay as the persisted winner.
+"""
 
 from deepspeed_tpu.autotuning.autotuner import (Autotuner,
                                                 model_memory_per_chip)
 from deepspeed_tpu.autotuning.config import AutotuningConfig
+from deepspeed_tpu.autotuning.controlplane import TUNE_EVENTS, ControlPlane
+from deepspeed_tpu.autotuning.knobs import Knob, KnobSpace
+from deepspeed_tpu.autotuning.objective import Objective, extract_metrics
+from deepspeed_tpu.autotuning.overlay import (apply_overlay, deep_merge,
+                                              load_overlay,
+                                              maybe_apply_overlay,
+                                              write_overlay)
 from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
 
-__all__ = ["Autotuner", "AutotuningConfig", "Experiment", "ResourceManager",
-           "model_memory_per_chip"]
+__all__ = ["Autotuner", "AutotuningConfig", "ControlPlane", "Experiment",
+           "Knob", "KnobSpace", "Objective", "ResourceManager",
+           "TUNE_EVENTS", "apply_overlay", "deep_merge", "extract_metrics",
+           "load_overlay", "maybe_apply_overlay", "model_memory_per_chip",
+           "write_overlay"]

@@ -44,3 +44,21 @@ class AutotuningConfig(DeepSpeedConfigModel):
     # launcher-driven tuning: a serialisable trial model
     # {"kind": "causal_lm", "config": {...TransformerConfig kwargs}}
     model_spec = None
+    # ---- autotuning-v2 (closed-loop control plane) -------------------
+    # declared knob space: {name: {"path": "a/b/c", "values": [...],
+    # "domain": "training"|"serving", "kind": "ds"|"model"}} or
+    # {name: [values]}; None = the built-in default space for `domain`
+    knobs = None
+    # knob domain the default space covers ("training" | "serving";
+    # None = both)
+    domain = None
+    # objective weights {metric: weight} over the snapshot-scored metric
+    # vector (negative = lower is better); None = Objective defaults
+    objective = None
+    # where the winning overlay persists, and where initialize() /
+    # create_serving_engine() look for one to deep-merge over the user
+    # config; None = <results_dir>/overlay.json when tuning, no overlay
+    # applied when consuming
+    overlay_path = None
+    # cap on searched grid points (None = the full grid)
+    max_trials = None

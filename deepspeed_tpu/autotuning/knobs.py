@@ -1,0 +1,144 @@
+"""The declared knob space the control plane sweeps.
+
+Every knob names a slash path into the ds-config (``kind="ds"``) or a
+TransformerConfig field (``kind="model"``, surfaced through
+``autotuning_model_overrides`` exactly like the legacy template tuner),
+plus its candidate values.  The default spaces cover the knobs the
+observability planes showed actually move the gauges:
+
+* training — gradient-accumulation steps, the async checkpoint/dataloader
+  pipeline's prefetch depth, and the remat policy (a model knob);
+* serving — KV page size, the scheduler's prefill chunk tokens and
+  speculative draft length, the admission watermarks, and the fleet's
+  prefill/decode replica mix.
+
+``KnobSpace.grid()`` enumerates the cartesian product;
+``fragment_for(point)`` turns one point into the ds-config fragment that
+becomes the trial config (and, for a winner, the persisted overlay).
+"""
+
+import itertools
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+from deepspeed_tpu.autotuning.config_templates import set_ds_path
+
+
+class Knob:
+    """One tunable dimension: ``path`` is a ``/``-separated ds-config path
+    (``kind="ds"``) or a TransformerConfig field name (``kind="model"``)."""
+
+    def __init__(self, name: str, path: str, values: Sequence[Any],
+                 domain: str = "serving", kind: str = "ds"):
+        if domain not in ("training", "serving"):
+            raise ValueError(f"knob {name!r}: unknown domain {domain!r}")
+        if kind not in ("ds", "model"):
+            raise ValueError(f"knob {name!r}: unknown kind {kind!r}")
+        if not values:
+            raise ValueError(f"knob {name!r}: empty candidate list")
+        self.name = name
+        self.path = path
+        self.values = list(values)
+        self.domain = domain
+        self.kind = kind
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"path": self.path, "values": self.values,
+                "domain": self.domain, "kind": self.kind}
+
+    def __repr__(self):
+        return f"Knob({self.name!r}, {self.path!r}, {self.values})"
+
+
+def default_training_knobs() -> List[Knob]:
+    return [
+        Knob("gas", "gradient_accumulation_steps", [1, 2, 4, 8],
+             domain="training"),
+        Knob("prefetch_depth", "async_pipeline/prefetch_depth", [1, 2, 4],
+             domain="training"),
+        Knob("remat_policy", "remat_policy",
+             ["nothing_saveable", "dots_saveable"],
+             domain="training", kind="model"),
+    ]
+
+
+def default_serving_knobs() -> List[Knob]:
+    return [
+        Knob("page_size", "serving/page_size", [8, 16, 32]),
+        Knob("prefill_chunk_tokens",
+             "serving/scheduler/prefill_chunk_tokens", [32, 64, 128, 256]),
+        Knob("num_draft_tokens",
+             "serving/scheduler/speculative/num_draft_tokens", [0, 2, 4]),
+        Knob("queue_high_watermark", "serving/queue_high_watermark",
+             [0.6, 0.8, 0.9]),
+        Knob("queue_low_watermark", "serving/queue_low_watermark",
+             [0.3, 0.5]),
+        Knob("prefill_replicas", "serving/fleet/roles/prefill_replicas",
+             [1, 2]),
+        Knob("decode_replicas", "serving/fleet/roles/decode_replicas",
+             [1, 2, 3]),
+    ]
+
+
+class KnobSpace:
+
+    def __init__(self, knobs: Sequence[Knob]):
+        names = [k.name for k in knobs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate knob names in {names}")
+        self.knobs = list(knobs)
+
+    def __len__(self):
+        return len(self.knobs)
+
+    def size(self) -> int:
+        n = 1
+        for k in self.knobs:
+            n *= len(k.values)
+        return n
+
+    def grid(self) -> Iterator[Dict[str, Any]]:
+        """Enumerate every point as ``{knob name: value}`` in a stable
+        order (first knob varies slowest)."""
+        for combo in itertools.product(*(k.values for k in self.knobs)):
+            yield dict(zip((k.name for k in self.knobs), combo))
+
+    def fragment_for(self, point: Dict[str, Any]) -> Dict[str, Any]:
+        """The ds-config fragment for one grid point.  Model knobs land
+        under ``autotuning_model_overrides`` — the key the trial workers
+        and ``initialize()`` already surface to model construction."""
+        frag: Dict[str, Any] = {}
+        by_name = {k.name: k for k in self.knobs}
+        for name, value in point.items():
+            knob = by_name[name]
+            if knob.kind == "model":
+                frag = set_ds_path(
+                    frag, f"autotuning_model_overrides/{knob.path}", value)
+            else:
+                frag = set_ds_path(frag, knob.path, value)
+        return frag
+
+    @classmethod
+    def from_config(cls, spec: Optional[Dict[str, Any]],
+                    domain: Optional[str] = None) -> "KnobSpace":
+        """Build a space from the ``autotuning.knobs`` config block:
+        ``{name: {"path": …, "values": […], "domain": …, "kind": …}}`` or
+        ``{name: [values]}`` (path defaults to the name).  With no block,
+        the default space for ``domain`` (both domains when None)."""
+        if not spec:
+            knobs = []
+            if domain in (None, "training"):
+                knobs += default_training_knobs()
+            if domain in (None, "serving"):
+                knobs += default_serving_knobs()
+            return cls(knobs)
+        knobs = []
+        for name, v in spec.items():
+            if isinstance(v, dict):
+                knobs.append(Knob(
+                    name, v.get("path", name), v.get("values", []),
+                    domain=v.get("domain", domain or "serving"),
+                    kind=v.get("kind", "ds")))
+            else:
+                knobs.append(Knob(name, name, list(v),
+                                  domain=domain or "serving"))
+        return cls(knobs)

@@ -1,0 +1,124 @@
+"""Snapshot-scored objectives: trials are ranked on what the telemetry
+registry measured, not on wall-clock alone.
+
+``extract_metrics`` pulls the ledger-able metric vector out of one
+``Telemetry.snapshot()`` — latency percentiles from the ``serve/*_ms``
+histograms, SLO attainment from the serve counters, roofline fractions
+from the profiling gauges, HBM peaks from the ``mem/<span>/peak_bytes``
+family.  ``Objective`` then collapses a metric vector to one score as a
+weighted sum: positive weight = higher is better (tokens/s, attainment,
+compute fraction), negative weight = lower is better (millisecond
+percentiles, peak bytes).  Two trials with identical wall-clock but
+different SLO histograms therefore score differently — the property the
+acceptance test pins.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+_HIST = "histograms"
+_CTR = "counters"
+_GAUGE = "gauges"
+
+
+def _hist_pct(name: str, pct: str) -> Callable[[Dict[str, Any]], Any]:
+    def get(snap):
+        h = snap.get(_HIST, {}).get(name)
+        return None if not h or not h.get("count") else h.get(pct)
+    return get
+
+
+def _slo_attainment(snap: Dict[str, Any]) -> Optional[float]:
+    ctrs = snap.get(_CTR, {})
+    ok = ctrs.get("serve/slo_attained", 0)
+    miss = ctrs.get("serve/slo_missed", 0)
+    total = ok + miss
+    return None if total == 0 else ok / total
+
+
+def _gauge_family_max(prefix: str, suffix: str, field: str = "value"):
+    """Max over the per-span gauge family ``<prefix><span>/<suffix>`` —
+    e.g. the worst ``mem/<span>/peak_bytes`` peak across spans."""
+    def get(snap):
+        vals = [g.get(field) for name, g in snap.get(_GAUGE, {}).items()
+                if name.startswith(prefix) and name.endswith("/" + suffix)
+                and isinstance(g, dict) and g.get(field) is not None]
+        return max(vals) if vals else None
+    return get
+
+
+# The frozen metric vector: every extractor returns None when the
+# snapshot has no signal for it (metric simply absent from the trial's
+# vector — the objective skips it rather than inventing a zero).
+SNAPSHOT_METRICS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
+    "ttft_p50_ms": _hist_pct("serve/ttft_ms", "p50"),
+    "ttft_p99_ms": _hist_pct("serve/ttft_ms", "p99"),
+    "tpot_p50_ms": _hist_pct("serve/tpot_ms", "p50"),
+    "tpot_p99_ms": _hist_pct("serve/tpot_ms", "p99"),
+    "e2e_p99_ms": _hist_pct("serve/e2e_ms", "p99"),
+    "queue_wait_p99_ms": _hist_pct("serve/queue_wait_ms", "p99"),
+    "slo_attainment_frac": _slo_attainment,
+    "goodput_tokens":
+        lambda s: s.get(_CTR, {}).get("serve/goodput_tokens") or None,
+    "roofline_compute_frac":
+        _gauge_family_max("roofline/", "compute_frac"),
+    "roofline_bandwidth_frac":
+        _gauge_family_max("roofline/", "bandwidth_frac"),
+    "mem_peak_bytes": _gauge_family_max("mem/", "peak_bytes", field="peak"),
+}
+
+
+def extract_metrics(snapshot: Dict[str, Any]) -> Dict[str, float]:
+    """The ledger-able metric vector present in one registry snapshot."""
+    out = {}
+    for name, get in SNAPSHOT_METRICS.items():
+        v = get(snapshot)
+        if v is not None:
+            out[name] = float(v)
+    return out
+
+
+class Objective:
+    """Weighted scalarization of a metric vector.  ``weights`` maps
+    metric name → weight; metrics absent from a trial's vector contribute
+    nothing (so a training trial isn't penalized for having no TTFT
+    histogram).  The defaults reward throughput and SLO attainment and
+    charge for tail latency — per-unit magnitudes chosen so one token/s
+    trades against ~10 ms of p99 tail."""
+
+    DEFAULT_WEIGHTS: Dict[str, float] = {
+        "tokens_per_sec": 1.0,
+        "slo_attainment_frac": 1000.0,
+        "ttft_p99_ms": -0.1,
+        "tpot_p99_ms": -0.1,
+        "roofline_compute_frac": 100.0,
+    }
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None):
+        self.weights = dict(weights if weights is not None
+                            else self.DEFAULT_WEIGHTS)
+
+    def metrics(self, snapshot: Dict[str, Any],
+                extra: Optional[Dict[str, float]] = None) \
+            -> Dict[str, float]:
+        """The full metric vector for one trial: everything the snapshot
+        carries, plus caller-measured extras (e.g. the trial harness's own
+        tokens/s).  Extras win on name collision — they are direct
+        measurements."""
+        vec = extract_metrics(snapshot)
+        for k, v in (extra or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                vec[k] = float(v)
+        return vec
+
+    def score(self, metrics: Dict[str, float]) -> float:
+        return float(sum(w * metrics[name]
+                         for name, w in self.weights.items()
+                         if name in metrics))
+
+    @classmethod
+    def from_config(cls, spec: Optional[Dict[str, Any]]) -> "Objective":
+        """Build from the ``autotuning.objective`` config block
+        (``{metric: weight}``); defaults when absent."""
+        if not spec:
+            return cls()
+        return cls({str(k): float(v) for k, v in spec.items()})

@@ -1,0 +1,132 @@
+"""Config overlays: how tuned configurations persist and apply.
+
+The control plane does not write a new config file — a winner persists as
+an *overlay*: a ds-config fragment that is deep-merged over the user's
+config at ``deepspeed.initialize()`` / ``create_serving_engine()`` time,
+provenance-stamped with the winning trial id and a hash of the telemetry
+snapshot that scored it.  The user config stays the source of truth; the
+overlay is an auditable, revocable diff on top of it, and
+``scripts/check_telemetry_schema.py --tune`` validates the persisted file.
+
+Payload shape (frozen — the checker's ``validate_overlay_payload`` is the
+twin)::
+
+    {"overlay":    {<ds-config fragment>},
+     "provenance": {"trial": "tune-3", "snapshot_hash": "sha256:…",
+                    "objective": 12.4, "ts": 1754…, "knobs": {…}}}
+"""
+
+import copy
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+OVERLAY_BASENAME = "overlay.json"
+
+
+def deep_merge(base: Dict[str, Any],
+               overlay: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge ``overlay`` over ``base``: dicts recurse, everything else
+    (scalars, lists) is replaced by the overlay value.  Neither input is
+    mutated."""
+    merged = copy.deepcopy(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(merged.get(k), dict):
+            merged[k] = deep_merge(merged[k], v)
+        else:
+            merged[k] = copy.deepcopy(v)
+    return merged
+
+
+def snapshot_hash(snapshot: Dict[str, Any]) -> str:
+    """Content hash of a ``Telemetry.snapshot()`` — canonical-JSON sha256,
+    so the overlay's provenance pins the exact measurements that won."""
+    blob = json.dumps(snapshot, sort_keys=True, default=str)
+    return "sha256:" + hashlib.sha256(blob.encode()).hexdigest()
+
+
+def make_overlay(fragment: Dict[str, Any], trial: str,
+                 snapshot: Dict[str, Any], objective: float,
+                 knobs: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "overlay": fragment,
+        "provenance": {
+            "trial": trial,
+            "snapshot_hash": snapshot_hash(snapshot),
+            "objective": float(objective),
+            "ts": round(time.time(), 6),
+            "knobs": dict(knobs),
+        },
+    }
+
+
+def write_overlay(path: str, payload: Dict[str, Any]) -> str:
+    """Atomically persist an overlay payload (tmp + rename, so a reader
+    never sees a torn file)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=str, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_overlay(path: str) -> Optional[Dict[str, Any]]:
+    """Load an overlay payload; ``None`` (with a warning) when the file is
+    missing or malformed — a broken overlay must never take the job down,
+    the user config alone is always a valid fallback."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except FileNotFoundError:
+        logger.warning(f"autotuning: overlay {path} not found; "
+                       "running with the base config")
+        return None
+    except ValueError as e:
+        logger.warning(f"autotuning: overlay {path} is not valid JSON "
+                       f"({e}); running with the base config")
+        return None
+    if not isinstance(payload, dict) or \
+            not isinstance(payload.get("overlay"), dict):
+        logger.warning(f"autotuning: overlay {path} has no 'overlay' "
+                       "fragment; running with the base config")
+        return None
+    return payload
+
+
+def apply_overlay(config: Dict[str, Any],
+                  payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep-merge a loaded overlay payload's fragment over ``config``."""
+    return deep_merge(config, payload.get("overlay", {}))
+
+
+def maybe_apply_overlay(param_dict: Dict[str, Any],
+                        overlay_path: Optional[str] = None) \
+        -> Tuple[Dict[str, Any], Optional[Dict[str, Any]]]:
+    """The initialize()/create_serving_engine() hook: when
+    ``autotuning.overlay_path`` names a persisted overlay (or
+    ``overlay_path`` is passed explicitly), deep-merge it over
+    ``param_dict``.  Returns ``(merged_config, provenance_or_None)``;
+    the input dict is never mutated."""
+    if overlay_path is None:
+        at = param_dict.get("autotuning")
+        if isinstance(at, dict):
+            overlay_path = at.get("overlay_path")
+    if not overlay_path:
+        return param_dict, None
+    payload = load_overlay(overlay_path)
+    if payload is None:
+        return param_dict, None
+    prov = payload.get("provenance")
+    merged = apply_overlay(param_dict, payload)
+    if isinstance(prov, dict):
+        logger.info(
+            f"autotuning: applied overlay {overlay_path} "
+            f"(trial={prov.get('trial')}, "
+            f"snapshot={str(prov.get('snapshot_hash'))[:19]}…)")
+    return merged, prov if isinstance(prov, dict) else None

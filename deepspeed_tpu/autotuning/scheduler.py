@@ -47,6 +47,62 @@ class ResourceManager:
     def schedule_experiments(self, exps: List[Experiment]):
         self.experiments.extend(exps)
 
+    def _load_journaled(self, exp: Experiment) -> bool:
+        """Try to satisfy ``exp`` from its on-disk journal (crash/resume:
+        a re-run skips finished experiments).  Returns True when the
+        journal was reused.  A torn trailing journal — the experiment
+        whose result write the crash interrupted — is tolerated: the
+        unparseable file is treated as absent and the experiment re-runs."""
+        path = self._result_path(exp)
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path) as f:
+                journaled = json.load(f)
+        except ValueError:
+            logger.warning(f"autotuning: journal for {exp.name} is torn "
+                           "(crash mid-write?); re-running")
+            return False
+        if not isinstance(journaled, dict):
+            return False
+        if journaled.get("ds_config") == json.loads(
+                json.dumps(exp.ds_config, default=str)) and \
+                journaled.get("model_overrides", {}) == json.loads(
+                    json.dumps(exp.model_overrides, default=str)):
+            exp.result = journaled
+            logger.info(f"autotuning: reusing journaled {exp.name}")
+            return True
+        logger.info(f"autotuning: journaled {exp.name} has a "
+                    "different ds_config; re-running")
+        return False
+
+    def run_one(self, exp: Experiment,
+                run_fn: Callable[[Experiment], Dict[str, Any]]) \
+            -> Dict[str, Any]:
+        """THE shared trial runner: the legacy :class:`Autotuner` grid
+        phases and the closed-loop control plane
+        (``autotuning/controlplane.py``) both execute every trial through
+        this one body — timing, failure capture, and journaling live in
+        exactly one place.  Returns the (journaled) metrics dict."""
+        if exp.result is None and not self.overwrite:
+            self._load_journaled(exp)
+        if exp.result is not None:
+            return exp.result
+        t0 = time.time()
+        try:
+            metrics = run_fn(exp)
+        except Exception as e:  # infeasible config (e.g. OOM) scores 0
+            logger.warning(f"autotuning: {exp.name} failed: {e}")
+            metrics = {self.metric: 0.0, "error": str(e)}
+        metrics["wall_s"] = time.time() - t0
+        metrics["ds_config"] = exp.ds_config
+        if exp.model_overrides:
+            metrics["model_overrides"] = exp.model_overrides
+        exp.result = metrics
+        with open(self._result_path(exp), "w") as f:
+            json.dump(metrics, f, indent=1, default=str)
+        return metrics
+
     def run(self, run_fn: Callable[[Experiment], Dict[str, Any]]):
         """Run all pending experiments.  With ``overwrite=False``,
         previously-journaled results are reused (reference skip-finished
@@ -55,35 +111,7 @@ class ResourceManager:
         different model can't supply wrong measurements under the same
         experiment name."""
         for exp in self.experiments:
-            path = self._result_path(exp)
-            if exp.result is None and not self.overwrite \
-                    and os.path.exists(path):
-                with open(path) as f:
-                    journaled = json.load(f)
-                if journaled.get("ds_config") == json.loads(
-                        json.dumps(exp.ds_config, default=str)) and \
-                        journaled.get("model_overrides", {}) == json.loads(
-                            json.dumps(exp.model_overrides, default=str)):
-                    exp.result = journaled
-                    logger.info(f"autotuning: reusing journaled {exp.name}")
-                    continue
-                logger.info(f"autotuning: journaled {exp.name} has a "
-                            "different ds_config; re-running")
-            if exp.result is not None:
-                continue
-            t0 = time.time()
-            try:
-                metrics = run_fn(exp)
-            except Exception as e:  # infeasible config (e.g. OOM) scores 0
-                logger.warning(f"autotuning: {exp.name} failed: {e}")
-                metrics = {self.metric: 0.0, "error": str(e)}
-            metrics["wall_s"] = time.time() - t0
-            metrics["ds_config"] = exp.ds_config
-            if exp.model_overrides:
-                metrics["model_overrides"] = exp.model_overrides
-            exp.result = metrics
-            with open(path, "w") as f:
-                json.dump(metrics, f, indent=1, default=str)
+            self.run_one(exp, run_fn)
 
     @staticmethod
     def best_of(exps: List[Experiment],

@@ -129,6 +129,31 @@ class ReplicaAutoscaler:
         self.scale_ups = 0
         self.scale_downs = 0
 
+    # the threshold fields a tuned overlay may supply
+    THRESHOLD_KEYS = ("min_replicas", "max_replicas",
+                      "scale_up_queue_per_replica",
+                      "scale_down_queue_per_replica",
+                      "free_page_low_frac", "cooldown_sweeps")
+
+    @classmethod
+    def from_overlay(cls, overlay_path: str,
+                     defaults: Optional[Dict] = None) -> "ReplicaAutoscaler":
+        """Thresholds from a persisted autotuner overlay
+        (``autotuning/overlay.py``) instead of hand-set policy: any of
+        :data:`THRESHOLD_KEYS` found under the overlay fragment's
+        ``serving.fleet`` block wins over ``defaults``; a missing or
+        malformed overlay degrades to ``defaults`` alone."""
+        from deepspeed_tpu.autotuning.overlay import load_overlay
+        kwargs = dict(defaults or {})
+        payload = load_overlay(overlay_path) if overlay_path else None
+        if payload is not None:
+            fleet = ((payload.get("overlay") or {})
+                     .get("serving") or {}).get("fleet") or {}
+            for key in cls.THRESHOLD_KEYS:
+                if key in fleet:
+                    kwargs[key] = fleet[key]
+        return cls(**kwargs)
+
     def decide(self, n_replicas: int, queue_depth: int = 0,
                shed_delta: int = 0, free_page_frac: float = 1.0) -> int:
         """Desired replica count for the next sweep (moves by at most 1)."""

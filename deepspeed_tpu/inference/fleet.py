@@ -175,6 +175,12 @@ class FleetConfig(DeepSpeedConfigModel):
     cooldown_sweeps = 8
     fault_injection = {}            # FaultInjector spec (fleet sites)
     roles = {}                      # FleetRolesConfig (disaggregation)
+    # autotuning-v2: path to a persisted autotuner overlay
+    # (autotuning/overlay.py).  When set, the autoscaler thresholds above
+    # are DEFAULTS only — any threshold the overlay's serving.fleet
+    # fragment carries wins, so scale policy comes from measured trials
+    # rather than hand-set numbers.
+    overlay_path = None
 
     def _validate(self):
         if not isinstance(self.roles, FleetRolesConfig):
@@ -279,6 +285,11 @@ class FleetRouter:
         self.migrations = deque()       # req_ids in the "migrating" state
         self._last_shed_total = 0
         self._last_shed_by = {"prefill": 0, "decode": 0}
+        # autoscaler thresholds: config values are the DEFAULTS; with
+        # serving.fleet.overlay_path set, whatever the tuned overlay's
+        # serving.fleet fragment carries wins (autotuning-v2 — scale
+        # policy from measured trials, not hand-set numbers)
+        thresholds = self._autoscaler_thresholds(cfg)
         if self._roles_enabled:
             self._targets = {"prefill": int(cfg.roles.prefill_replicas),
                              "decode": int(cfg.roles.decode_replicas)}
@@ -289,26 +300,17 @@ class FleetRouter:
                         getattr(cfg.roles, f"min_{role}_replicas")),
                     max_replicas=int(
                         getattr(cfg.roles, f"max_{role}_replicas")),
-                    scale_up_queue_per_replica=int(
-                        cfg.scale_up_queue_per_replica),
-                    scale_down_queue_per_replica=int(
-                        cfg.scale_down_queue_per_replica),
-                    free_page_low_frac=float(cfg.free_page_low_frac),
-                    cooldown_sweeps=int(cfg.cooldown_sweeps))
+                    **thresholds)
                 for role in ("prefill", "decode")}) \
                 if cfg.autoscale else None
         else:
             self._targets = None
             self._target = int(cfg.replicas)
-            self._autoscaler = ReplicaAutoscaler(
-                min_replicas=int(cfg.min_replicas),
-                max_replicas=int(cfg.max_replicas),
-                scale_up_queue_per_replica=int(
-                    cfg.scale_up_queue_per_replica),
-                scale_down_queue_per_replica=int(
-                    cfg.scale_down_queue_per_replica),
-                free_page_low_frac=float(cfg.free_page_low_frac),
-                cooldown_sweeps=int(cfg.cooldown_sweeps)) \
+            self._autoscaler = ReplicaAutoscaler.from_overlay(
+                cfg.overlay_path,
+                defaults=dict(min_replicas=int(cfg.min_replicas),
+                              max_replicas=int(cfg.max_replicas),
+                              **thresholds)) \
                 if cfg.autoscale else None
         # the routing key hashes the first N prompt tokens; N defaults to
         # one KV page so the key matches exactly the prefix-cache chain
@@ -327,6 +329,34 @@ class FleetRouter:
         self.attach_exporter()
 
     # -- plumbing --------------------------------------------------------
+    @staticmethod
+    def _autoscaler_thresholds(cfg):
+        """The shared scale-decision thresholds, config defaults
+        overridden by the tuned overlay's ``serving.fleet`` fragment
+        when ``serving.fleet.overlay_path`` names one."""
+        thresholds = {
+            "scale_up_queue_per_replica":
+                int(cfg.scale_up_queue_per_replica),
+            "scale_down_queue_per_replica":
+                int(cfg.scale_down_queue_per_replica),
+            "free_page_low_frac": float(cfg.free_page_low_frac),
+            "cooldown_sweeps": int(cfg.cooldown_sweeps),
+        }
+        if cfg.overlay_path:
+            from deepspeed_tpu.autotuning.overlay import load_overlay
+            payload = load_overlay(cfg.overlay_path)
+            if payload is not None:
+                frag = ((payload.get("overlay") or {})
+                        .get("serving") or {}).get("fleet") or {}
+                for key, cast in (
+                        ("scale_up_queue_per_replica", int),
+                        ("scale_down_queue_per_replica", int),
+                        ("free_page_low_frac", float),
+                        ("cooldown_sweeps", int)):
+                    if key in frag:
+                        thresholds[key] = cast(frag[key])
+        return thresholds
+
     def _tel(self):
         tel = self._telemetry if self._telemetry is not None \
             else get_telemetry()

@@ -65,10 +65,15 @@ class SpeculativeConfig(DeepSpeedConfigModel):
     num_draft_tokens = 4
 
     def _validate(self):
-        if int(self.num_draft_tokens) < 1:
+        n = int(self.num_draft_tokens)
+        if n < 0:
             raise ValueError(
                 "serving.scheduler.speculative.num_draft_tokens must be "
-                ">= 1")
+                ">= 0")
+        if n == 0:
+            # 0 is the "speculation off" point — the autotuner's
+            # draft-length knob sweeps it alongside real draft lengths
+            self.enabled = False
 
 
 class SchedulerConfig(DeepSpeedConfigModel):

@@ -1388,3 +1388,37 @@ class ServingEngine:
             else:   # terminated mid-flight: partial tokens, in place
                 out.append(self.terminated.pop(i).tokens)
         return out
+
+
+def create_serving_engine(model, params, config=None, overlay_path=None,
+                          **kwargs):
+    """Build a :class:`ServingEngine` from a ds-style config dict.
+
+    ``config`` is the combined config the autotuner sweeps: engine
+    geometry (``max_batch`` / ``page_size`` / ``num_pages`` / ``max_seq``
+    / ``decode_chunk`` / ``tp_size`` / ``ep_size``) may sit at top level
+    or inside the ``serving`` block; everything else in ``serving``
+    (watermarks, scheduler, fleet) passes through as the engine's
+    robustness config.  When ``config["autotuning"]["overlay_path"]`` (or
+    the explicit ``overlay_path``) names a persisted overlay, the tuned
+    fragment is deep-merged over ``config`` first — the serving twin of
+    the ``deepspeed.initialize()`` hook.  Explicit ``**kwargs`` win over
+    everything (caller overrides).  The applied overlay's provenance is
+    exposed as ``engine.overlay_provenance`` (None when no overlay)."""
+    from deepspeed_tpu.autotuning.overlay import maybe_apply_overlay
+    cfg = dict(config or {})
+    cfg, provenance = maybe_apply_overlay(cfg, overlay_path)
+    serving = dict(cfg.get("serving") or {})
+    geometry = ("max_batch", "page_size", "num_pages", "max_seq",
+                "decode_chunk", "tp_size", "ep_size", "eos_token_id")
+    eng_kwargs = {}
+    for key in geometry:
+        if key in cfg:
+            eng_kwargs[key] = cfg[key]
+        if key in serving:   # the serving block wins over top level
+            eng_kwargs[key] = serving.pop(key)
+    eng_kwargs["serving"] = serving
+    eng_kwargs.update(kwargs)
+    engine = ServingEngine(model, params, **eng_kwargs)
+    engine.overlay_provenance = provenance
+    return engine

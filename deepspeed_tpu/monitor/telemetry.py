@@ -41,7 +41,8 @@ from deepspeed_tpu.utils.logging import logger
 # The closed set of event kinds.  Adding a kind means updating the frozen
 # schema in scripts/check_telemetry_schema.py (a tier-1 test diffs the two).
 EVENT_KINDS = ("span", "gauge", "counter", "comm", "heartbeat", "stall",
-               "meta", "fault", "serve", "compile", "fleet", "incident")
+               "meta", "fault", "serve", "compile", "fleet", "incident",
+               "tune")
 
 
 def _profiler_annotation(name):
@@ -526,6 +527,16 @@ class Telemetry:
             return
         self.registry.counter(f"{name}/count").inc()
         self.emit("fleet", name, step=step, attrs=attrs or None)
+
+    def tune(self, name, step=None, attrs=None):
+        """Structured autotuning event (autotuning/controlplane.py): trial
+        starts/results, feasibility prunes, and overlay persistence.  Like
+        :meth:`serve`, each also bumps counter ``<name>/count`` so the
+        registry carries tuning totals without replaying the stream."""
+        if not self.enabled:
+            return
+        self.registry.counter(f"{name}/count").inc()
+        self.emit("tune", name, step=step, attrs=attrs or None)
 
     def comm(self, op_name, size_bytes, axis):
         """Per-op comm census (trace-time: a shape traces once, executes

@@ -358,6 +358,15 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"Expected a dict or json path, got {type(config)}")
 
+        # autotuning-v2: when the config names a persisted overlay
+        # (autotuning.overlay_path), deep-merge the tuned fragment over
+        # the user config before any parsing — initialize() consumes
+        # tuned winners with zero caller changes.  Provenance (trial id +
+        # snapshot hash) is kept for audit.
+        from deepspeed_tpu.autotuning.overlay import maybe_apply_overlay
+        self._param_dict, self.overlay_provenance = maybe_apply_overlay(
+            self._param_dict)
+
         pd = self._param_dict
         self._warn_unknown_keys(pd)
         self._note_inert_sparse_attention(pd)
@@ -463,6 +472,11 @@ class DeepSpeedConfig:
         C.ELASTICITY, C.COMPRESSION_TRAINING,
         C.PIPELINE, C.SEED, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
         "eigenvalue", "progressive_layer_drop", "autotuning",
+        # serving-side knobs (page size, scheduler, fleet) ride the same
+        # config file so one tuned overlay can cover both domains; the
+        # training engine ignores the block, create_serving_engine()
+        # consumes it
+        "serving",
         # reference top-level keys accepted for config portability but
         # intentionally inert here (amp -> XLA owns mixed precision, the
         # dtype/memory knobs have no TPU analogue); listed so ported

@@ -14,6 +14,7 @@ Usage:
     python scripts/check_telemetry_schema.py --cluster <payload.json> [...]
     python scripts/check_telemetry_schema.py --ledger <BENCH_LEDGER.jsonl>
     python scripts/check_telemetry_schema.py --incidents <bundle_or_dir> [...]
+    python scripts/check_telemetry_schema.py --tune <overlay_or_dir> [...]
 
 The ``--incidents`` mode validates incident bundles written by the
 incident plane (``monitor/incidents.py``): each bundle directory must
@@ -148,6 +149,19 @@ SCHEMA = {
         "optional": {"source": str, "detail": str, "step": int,
                      "events": int, "path": str},
     },
+    # autotuning control-plane events (autotuning/controlplane.py
+    # ControlPlane): one "tune/trial_start" per launched trial (attrs:
+    # trial / knobs), one "tune/trial_result" per scored trial (attrs:
+    # trial / objective / metrics / snapshot_hash), one
+    # "tune/trial_pruned" per point rejected by the feasibility model
+    # before running (attrs: trial / knobs / reason), and one
+    # "tune/overlay_written" when the winning overlay lands on disk
+    # (attrs: trial / path / snapshot_hash).  The ``name`` field is
+    # validated against TUNE_EVENTS below.
+    "tune": {
+        "required": {"ts": _NUM, "kind": str, "name": str},
+        "optional": {"attrs": dict, "step": int},
+    },
 }
 
 # FROZEN vocabulary of serve-kind event names — must stay byte-identical
@@ -201,6 +215,15 @@ FLEET_EVENTS = (
     "fleet/scale_up", "fleet/scale_down",
     "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
     "fleet/migrate_abort", "fleet/local_prefill",
+)
+
+# FROZEN vocabulary of tune-kind event names — must stay byte-identical
+# to ``deepspeed_tpu.autotuning.controlplane.TUNE_EVENTS`` (the tier-1
+# test diffs the two).  Trial ids / knob dicts / objective scores ride
+# in attrs.
+TUNE_EVENTS = (
+    "tune/trial_start", "tune/trial_result", "tune/trial_pruned",
+    "tune/overlay_written",
 )
 
 # Distributed (sharded) mode stamps every record with its origin rank so
@@ -291,6 +314,9 @@ def validate_event(event):
     if kind == "fleet" and isinstance(event.get("name"), str) and \
             event["name"] not in FLEET_EVENTS:
         problems.append(f"fleet: unknown event name {event['name']!r}")
+    if kind == "tune" and isinstance(event.get("name"), str) and \
+            event["name"] not in TUNE_EVENTS:
+        problems.append(f"tune: unknown event name {event['name']!r}")
     if kind == "comm" and isinstance(event.get("name"), str) and \
             event["name"] not in COMM_OPS:
         problems.append(f"comm: unknown collective {event['name']!r}")
@@ -552,6 +578,88 @@ def validate_ledger_file(path):
 
 
 # ----------------------------------------------------------------------
+# autotuning overlays + tune journals (autotuning/controlplane.py)
+# ----------------------------------------------------------------------
+# A persisted overlay is ``{"overlay": <ds-config fragment>,
+# "provenance": {trial, snapshot_hash, objective, ts, knobs}}`` — the
+# fragment is deep-merged over the user config at initialize() /
+# create_serving_engine() time, and the provenance stamp ties it back to
+# the trial + telemetry snapshot that won.
+OVERLAY_PROVENANCE = {"trial": str, "snapshot_hash": str,
+                      "objective": _NUM, "ts": _NUM, "knobs": dict}
+
+
+def validate_overlay_payload(obj):
+    """Validate one decoded overlay file.  Returns a list of problem
+    strings (empty = valid)."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"overlay is {type(obj).__name__}, not an object"]
+    if not isinstance(obj.get("overlay"), dict):
+        problems.append("overlay: missing or non-object 'overlay' fragment")
+    prov = obj.get("provenance")
+    if not isinstance(prov, dict):
+        problems.append("overlay: missing or non-object 'provenance'")
+        return problems
+    for field, types in OVERLAY_PROVENANCE.items():
+        if field not in prov:
+            problems.append(
+                f"overlay: provenance missing required field {field!r}")
+        elif not isinstance(prov[field], types) or \
+                isinstance(prov[field], bool):
+            problems.append(
+                f"overlay: provenance field {field!r} has type "
+                f"{type(prov[field]).__name__}")
+    return problems
+
+
+def validate_overlay_file(path):
+    with open(path) as f:
+        try:
+            obj = json.load(f)
+        except ValueError as e:
+            return [f"{path}: not valid JSON: {e}"]
+    return [f"{path}: {p}" for p in validate_overlay_payload(obj)]
+
+
+def validate_tune_path(path):
+    """Validate ``path`` as one overlay JSON file, or as a tune results
+    directory (the control plane's ``results_dir``): the overlay (if
+    present), every ``events*.jsonl`` tune stream, and every trial
+    journal ``*.json``.  Returns ``(problems, artifacts_seen)``."""
+    if os.path.isfile(path):
+        return validate_overlay_file(path), 1
+    problems = []
+    seen = 0
+    if not os.path.isdir(path):
+        return [f"{path}: not a file or directory"], 0
+    for stream in sorted(glob.glob(os.path.join(path, "**",
+                                                "events*.jsonl"),
+                                   recursive=True)):
+        seen += 1
+        for i, p in validate_file(stream):
+            problems.append(f"{stream}:{i}: {p}")
+    for jpath in sorted(glob.glob(os.path.join(path, "*.json"))):
+        seen += 1
+        if os.path.basename(jpath) == "overlay.json":
+            problems.extend(validate_overlay_file(jpath))
+            continue
+        with open(jpath) as f:
+            try:
+                obj = json.load(f)
+            except ValueError as e:
+                problems.append(f"{jpath}: not valid JSON: {e}")
+                continue
+        if not isinstance(obj, dict) or \
+                not isinstance(obj.get("ds_config"), dict):
+            problems.append(
+                f"{jpath}: trial journal missing ds_config object")
+    if not seen:
+        problems.append(f"{path}: no tune artifacts found")
+    return problems, seen
+
+
+# ----------------------------------------------------------------------
 # incident bundles (monitor/incidents.py IncidentManager._write_bundle)
 # ----------------------------------------------------------------------
 # Each bundle is a directory ``<bundle_dir>/<inc-NNNN-kind>/`` holding
@@ -758,6 +866,19 @@ def main(argv=None):
             print(f"FAIL: {bad} problem(s)")
             return 1
         print("OK: cluster payload validated")
+        return 0
+    if argv[0] == "--tune":
+        bad = artifacts = 0
+        for path in argv[1:]:
+            problems, n = validate_tune_path(path)
+            artifacts += n
+            for p in problems:
+                print(p)
+                bad += 1
+        if bad:
+            print(f"FAIL: {bad} problem(s) across {artifacts} artifact(s)")
+            return 1
+        print(f"OK: {artifacts} tune artifact(s) validated")
         return 0
     if argv[0] == "--incidents":
         bad = bundles = 0

@@ -111,14 +111,20 @@ def _median(vals):
 def split_runs(rows):
     """(baseline_rows, current_rows, current_run) — runs ordered by first
     appearance (appends are chronological); the last run is the
-    candidate, everything earlier is baseline."""
+    candidate, everything earlier is baseline.  ``tune-*`` runs (the
+    autotuner's per-trial rows) are never the candidate: each one
+    measures a DIFFERENT knob point, so trial-vs-trial deltas are search
+    results, not regressions — they ride as baseline history only and
+    the tuned-vs-default verdict gates via the ``cpu_autotune`` summary
+    rows of the surrounding bench run instead."""
     order = []
     for row in rows:
         if row["run"] not in order:
             order.append(row["run"])
-    if len(order) < 2:
+    candidates = [r for r in order if not r.startswith("tune-")]
+    if len(order) < 2 or not candidates:
         return [], [], order[-1] if order else None
-    current = order[-1]
+    current = candidates[-1]
     return ([r for r in rows if r["run"] != current],
             [r for r in rows if r["run"] == current], current)
 

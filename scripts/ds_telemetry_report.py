@@ -93,6 +93,7 @@ def aggregate(events):
     requests = []    # reconstructed serve/request/* lifecycle traces
     open_reqs = {}   # req_id -> index into requests (trace not yet closed)
     compiles = {"sites": {}, "storms": 0, "total_misses": 0}
+    tunes = {"trials": {}, "pruned": {}, "overlay": None}
     for ev in events:
         kind = ev.get("kind")
         if kind == "span":
@@ -171,6 +172,33 @@ def aggregate(events):
                 if site:
                     sites = rec.setdefault("sites", {})
                     sites[site] = sites.get(site, 0) + 1
+        elif kind == "tune":
+            # closed-loop autotuner stream (frozen tune/* vocabulary):
+            # trial_start stamps the knob point, trial_result the
+            # snapshot-scored objective, trial_pruned the memory-model
+            # verdict, overlay_written the persisted winner
+            attrs = ev.get("attrs") or {}
+            trial = attrs.get("trial")
+            if ev["name"] == "tune/trial_start":
+                rec = tunes["trials"].setdefault(trial, {})
+                rec["knobs"] = attrs.get("knobs")
+            elif ev["name"] == "tune/trial_result":
+                rec = tunes["trials"].setdefault(trial, {})
+                rec["objective"] = attrs.get("objective")
+                rec["snapshot_hash"] = attrs.get("snapshot_hash")
+                try:
+                    rec["metrics"] = json.loads(attrs.get("metrics")
+                                                or "{}")
+                except ValueError:
+                    rec["metrics"] = {}
+            elif ev["name"] == "tune/trial_pruned":
+                tunes["pruned"][trial] = {"reason": attrs.get("reason"),
+                                          "knobs": attrs.get("knobs")}
+            elif ev["name"] == "tune/overlay_written":
+                tunes["overlay"] = {"trial": trial,
+                                    "path": attrs.get("path"),
+                                    "snapshot_hash":
+                                        attrs.get("snapshot_hash")}
         elif kind == "serve":
             rec = serves.setdefault(ev["name"], {"count": 0, "reasons": {}})
             rec["count"] += 1
@@ -246,7 +274,7 @@ def aggregate(events):
             "heartbeats": heartbeats, "rank_steps": rank_steps,
             "steps": steps, "stalls": stalls,
             "metas": metas, "serves": serves, "fleets": fleets,
-            "fleet_roles": fleet_roles,
+            "fleet_roles": fleet_roles, "tunes": tunes,
             "requests": requests, "compiles": compiles}
 
 
@@ -301,12 +329,57 @@ def summarize(agg):
             "serving": serve_rows,
             "fleet": fleet_rows,
             "fleet_disagg": _disagg_summary(agg),
+            "autotuning": _autotuning_summary(agg),
             "serving_attention": _serving_attention_summary(agg),
             "scheduler": _scheduler_summary(agg),
             "prefix_cache": _prefix_cache_summary(agg),
             "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _autotuning_summary(agg):
+    """Closed-loop autotuner digest from the frozen ``tune/*`` stream:
+    trials run/pruned with their knob points, the snapshot-scored
+    objective per trial, the winning overlay's knobs and provenance,
+    and the BENCH_LEDGER rows the trial runner appended (one per scored
+    metric plus the objective row).  None when the stream carries no
+    tune events."""
+    tunes = agg.get("tunes") or {}
+    trials, pruned = tunes.get("trials") or {}, tunes.get("pruned") or {}
+    if not trials and not pruned and not tunes.get("overlay"):
+        return None
+
+    def _knobs(raw):
+        if isinstance(raw, str):
+            try:
+                return json.loads(raw)
+            except ValueError:
+                return raw
+        return raw
+
+    rows = []
+    for tid, rec in sorted(trials.items(), key=lambda kv: str(kv[0])):
+        metrics = rec.get("metrics") or {}
+        rows.append({"trial": tid, "knobs": _knobs(rec.get("knobs")),
+                     "objective": rec.get("objective"),
+                     "snapshot_hash": rec.get("snapshot_hash"),
+                     "ledger_rows": len(metrics) + 1 if metrics else 0})
+    pruned_rows = [
+        {"trial": tid, "reason": rec.get("reason"),
+         "knobs": _knobs(rec.get("knobs"))}
+        for tid, rec in sorted(pruned.items(), key=lambda kv: str(kv[0]))]
+    overlay = tunes.get("overlay")
+    winner = None
+    if overlay:
+        winner = {"trial": overlay.get("trial")}
+        for r in rows:
+            if r["trial"] == overlay.get("trial"):
+                winner.update(knobs=r["knobs"], objective=r["objective"])
+    return {"trials_run": len(rows), "trials_pruned": len(pruned_rows),
+            "trials": rows, "pruned": pruned_rows, "overlay": overlay,
+            "winner": winner,
+            "ledger_rows_written": sum(r["ledger_rows"] for r in rows)}
 
 
 def _disagg_summary(agg):
@@ -726,6 +799,32 @@ def print_tables(summary, out=sys.stdout):
                 parts.append(", ".join(f"{k}={v}"
                                        for k, v in r["reasons"].items()))
             w(f"{name:<24}{r['count']:>7}  {' | '.join(parts)}\n")
+        w("\n")
+    tune = summary.get("autotuning")
+    if tune:
+        w("== autotuning ==\n")
+        w(f"trials: {tune['trials_run']} run, {tune['trials_pruned']} "
+          f"pruned  |  ledger rows written: "
+          f"{tune['ledger_rows_written']}\n")
+        w(f"{'trial':<12}{'objective':>14}  knobs\n")
+
+        def _kn(raw):
+            if isinstance(raw, dict):
+                return ", ".join(f"{k}={v}" for k, v in raw.items())
+            return str(raw or "")
+
+        for r in tune["trials"]:
+            obj = (f"{r['objective']:.3f}"
+                   if isinstance(r["objective"], (int, float)) else "-")
+            w(f"{str(r['trial']):<12}{obj:>14}  {_kn(r['knobs'])}\n")
+        for r in tune["pruned"]:
+            w(f"{str(r['trial']):<12}{'pruned':>14}  {_kn(r['knobs'])}"
+              f"  [{r['reason']}]\n")
+        win = tune.get("winner")
+        if win:
+            w(f"winner: {win['trial']}  knobs: {_kn(win.get('knobs'))}\n")
+        if (tune.get("overlay") or {}).get("path"):
+            w(f"overlay: {tune['overlay']['path']}\n")
         w("\n")
     dis = summary.get("fleet_disagg")
     if dis:

@@ -1,24 +1,28 @@
 #!/usr/bin/env bash
 # Chain every offline quality gate in one command:
 #
-#   scripts/run_gates.sh [TELEMETRY_DIR] [INCIDENTS_DIR]
+#   scripts/run_gates.sh [TELEMETRY_DIR] [INCIDENTS_DIR] [TUNE_DIR]
 #
 #   1. check_telemetry_schema.py <events.jsonl...>   frozen event vocab
 #   2. check_telemetry_schema.py --ledger            BENCH_LEDGER.jsonl rows
 #   3. check_telemetry_schema.py --incidents         incident bundles
 #   4. ds_perf_diff.py --check                       perf regression gate
+#   5. check_telemetry_schema.py --tune              tune journals/overlay
 #
 # TELEMETRY_DIR (optional) is searched recursively for events*.jsonl
-# streams; INCIDENTS_DIR (optional) holds incident bundles.  Gates whose
-# input is absent are SKIPPED, not failed — the script is safe to run on
-# a fresh checkout and in CI alike.  Exit 0 iff every gate that ran
-# passed.
+# streams; INCIDENTS_DIR (optional) holds incident bundles; TUNE_DIR
+# (optional, default autotuning_results/ when present) holds the
+# autotuner's trial journals, tune/* event stream, and overlay.json.
+# Gates whose input is absent are SKIPPED, not failed — the script is
+# safe to run on a fresh checkout and in CI alike.  Exit 0 iff every
+# gate that ran passed.
 
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 PY="${PYTHON:-python}"
 TELEMETRY_DIR="${1:-}"
 INCIDENTS_DIR="${2:-}"
+TUNE_DIR="${3:-}"
 LEDGER="${LEDGER:-$REPO/BENCH_LEDGER.jsonl}"
 fail=0
 
@@ -68,6 +72,18 @@ fi
 # 4. perf regression (exits 0 quietly on a missing/single-run ledger)
 run_gate "perf diff" "$PY" "$REPO/scripts/ds_perf_diff.py" --check \
     "$LEDGER"
+
+# 5. autotuner artifacts: trial journals, tune/* stream, overlay
+# provenance (defaults to the control plane's results_dir when present)
+if [ -z "$TUNE_DIR" ] && [ -d "$REPO/autotuning_results" ]; then
+    TUNE_DIR="$REPO/autotuning_results"
+fi
+if [ -n "$TUNE_DIR" ] && [ -e "$TUNE_DIR" ]; then
+    run_gate "tune artifacts" \
+        "$PY" "$REPO/scripts/check_telemetry_schema.py" --tune "$TUNE_DIR"
+else
+    echo "== gate: tune artifacts == SKIP (no tune dir given)"
+fi
 
 if [ "$fail" -ne 0 ]; then
     echo "GATES: FAIL"

@@ -1,0 +1,377 @@
+"""Closed-loop autotuner (autotuning/controlplane.py) tests.
+
+The control plane sweeps a declared knob space, prunes infeasible points
+with the ZeRO memory model + measured mem gauges, scores surviving
+trials from their end-of-trial ``Telemetry.snapshot()``, and persists
+the winner as a provenance-stamped overlay consumed at
+``deepspeed.initialize()`` / ``create_serving_engine()`` time.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.autotuning import (ControlPlane, Knob, KnobSpace,
+                                      Objective, apply_overlay, deep_merge,
+                                      extract_metrics, load_overlay,
+                                      write_overlay)
+from deepspeed_tpu.autotuning.controlplane import TUNE_EVENTS
+from deepspeed_tpu.monitor.telemetry import Telemetry
+
+
+def _load_checker():
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", "check_telemetry_schema.py")
+    spec = importlib.util.spec_from_file_location("check_telemetry_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fresh_tel():
+    tel = Telemetry()
+    tel.enabled = True   # registry-only: no sink, emit() no-ops
+    return tel
+
+
+def _payload(fragment, trial="tune-0000", objective=1.0, knobs=None):
+    return {"overlay": fragment,
+            "provenance": {"trial": trial, "snapshot_hash": "sha256:x",
+                           "objective": objective, "ts": 1.0,
+                           "knobs": dict(knobs or {})}}
+
+
+# ----------------------------------------------------------------------
+# knob space
+# ----------------------------------------------------------------------
+def test_knob_space_grid_and_fragments():
+    space = KnobSpace([
+        Knob("chunk", "serving/scheduler/prefill_chunk_tokens", [32, 64]),
+        Knob("remat", "remat_policy", ["nothing_saveable"],
+             domain="training", kind="model"),
+    ])
+    assert space.size() == 2
+    points = list(space.grid())
+    assert points == [{"chunk": 32, "remat": "nothing_saveable"},
+                      {"chunk": 64, "remat": "nothing_saveable"}]
+    frag = space.fragment_for(points[0])
+    assert frag["serving"]["scheduler"]["prefill_chunk_tokens"] == 32
+    # model knobs surface through the legacy override channel
+    assert frag["autotuning_model_overrides"]["remat_policy"] == \
+        "nothing_saveable"
+
+
+def test_knob_space_validation_and_from_config():
+    with pytest.raises(ValueError, match="empty"):
+        Knob("k", "p", [])
+    with pytest.raises(ValueError, match="domain"):
+        Knob("k", "p", [1], domain="vibes")
+    with pytest.raises(ValueError, match="duplicate"):
+        KnobSpace([Knob("k", "a", [1]), Knob("k", "b", [2])])
+    # config block: dict spec and bare value lists
+    space = KnobSpace.from_config(
+        {"page_size": {"path": "serving/page_size", "values": [8, 16]},
+         "gradient_accumulation_steps": [1, 2]})
+    assert space.size() == 4
+    # no block -> the built-in default space, filterable by domain
+    assert all(k.domain == "training"
+               for k in KnobSpace.from_config(None, "training").knobs)
+    assert all(k.domain == "serving"
+               for k in KnobSpace.from_config(None, "serving").knobs)
+    both = KnobSpace.from_config(None)
+    assert {k.domain for k in both.knobs} == {"training", "serving"}
+
+
+# ----------------------------------------------------------------------
+# snapshot-scored objective
+# ----------------------------------------------------------------------
+def test_extract_metrics_reads_snapshot():
+    tel = _fresh_tel()
+    for v in (10.0, 20.0, 30.0):
+        tel.registry.histogram("serve/ttft_ms").observe(v)
+    tel.registry.counter("serve/slo_attained").inc(3)
+    tel.registry.counter("serve/slo_missed").inc(1)
+    tel.registry.counter("serve/goodput_tokens").inc(640)
+    tel.registry.gauge("mem/fwd/peak_bytes").set(1024.0)
+    tel.registry.gauge("roofline/fwd/compute_frac").set(0.4)
+    vec = extract_metrics(tel.snapshot())
+    assert vec["ttft_p50_ms"] == 20.0
+    assert vec["slo_attainment_frac"] == pytest.approx(0.75)
+    assert vec["goodput_tokens"] == 640.0
+    assert vec["mem_peak_bytes"] == 1024.0
+    assert vec["roofline_compute_frac"] == pytest.approx(0.4)
+    # empty snapshot -> empty vector, score contributes nothing
+    assert extract_metrics(_fresh_tel().snapshot()) == {}
+
+
+def test_objective_weighting_and_extras():
+    obj = Objective({"tokens_per_sec": 1.0, "ttft_p99_ms": -0.1})
+    tel = _fresh_tel()
+    tel.registry.histogram("serve/ttft_ms").observe(100.0)
+    vec = obj.metrics(tel.snapshot(), {"tokens_per_sec": 50.0,
+                                       "flag": True})
+    assert "flag" not in vec            # bools are not metrics
+    assert obj.score(vec) == pytest.approx(50.0 - 0.1 * 100.0)
+    # absent metrics contribute nothing rather than scoring as zero
+    assert obj.score({"tokens_per_sec": 5.0}) == pytest.approx(5.0)
+    # extras win on collision: they are direct measurements
+    tel.registry.histogram("serve/ttft_ms").observe(100.0)
+    assert obj.metrics(tel.snapshot(),
+                       {"ttft_p99_ms": 7.0})["ttft_p99_ms"] == 7.0
+
+
+# ----------------------------------------------------------------------
+# overlay persistence
+# ----------------------------------------------------------------------
+def test_deep_merge_semantics():
+    base = {"serving": {"page_size": 16, "scheduler": {"policy": "chunked"}},
+            "train_batch_size": 8}
+    over = {"serving": {"scheduler": {"prefill_chunk_tokens": 64}}}
+    merged = deep_merge(base, over)
+    assert merged["serving"]["page_size"] == 16           # sibling kept
+    assert merged["serving"]["scheduler"] == {
+        "policy": "chunked", "prefill_chunk_tokens": 64}
+    assert base["serving"]["scheduler"] == {"policy": "chunked"}  # no mut
+    # scalars and lists replace, never merge
+    assert deep_merge({"a": [1, 2]}, {"a": [3]})["a"] == [3]
+
+
+def test_overlay_write_load_apply(tmp_path):
+    path = str(tmp_path / "overlay.json")
+    frag = {"serving": {"scheduler": {"prefill_chunk_tokens": 64}}}
+    write_overlay(path, _payload(frag, knobs={"chunk": 64}))
+    payload = load_overlay(path)
+    assert payload["provenance"]["trial"] == "tune-0000"
+    cfg = apply_overlay({"serving": {"page_size": 16}}, payload)
+    assert cfg["serving"]["scheduler"]["prefill_chunk_tokens"] == 64
+    assert cfg["serving"]["page_size"] == 16
+    # missing / malformed overlays degrade to None, never raise
+    assert load_overlay(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json")
+    assert load_overlay(str(bad)) is None
+    bad.write_text(json.dumps({"provenance": {}}))   # no fragment
+    assert load_overlay(str(bad)) is None
+
+
+# ----------------------------------------------------------------------
+# the control plane end to end
+# ----------------------------------------------------------------------
+def _serving_space(chunks=(32, 64), drafts=(0, 20)):
+    return KnobSpace([
+        Knob("chunk", "serving/scheduler/prefill_chunk_tokens",
+             list(chunks)),
+        Knob("draft", "serving/scheduler/speculative/num_draft_tokens",
+             list(drafts)),
+    ])
+
+
+def test_controlplane_end_to_end(tmp_path):
+    """Sweep -> prune -> snapshot-score -> ledger -> overlay, and every
+    artifact validates under the --tune gate."""
+    ledger = str(tmp_path / "ledger.jsonl")
+    results = str(tmp_path / "results")
+
+    def trial_fn(cfg, tel):
+        chunk = cfg["serving"]["scheduler"]["prefill_chunk_tokens"]
+        # smaller chunks -> lower simulated TTFT (what chunking buys)
+        for v in (float(chunk), 2.0 * chunk):
+            tel.registry.histogram("serve/ttft_ms").observe(v)
+        return {"tokens_per_sec": 1000.0 / chunk}
+
+    cp = ControlPlane(base_config={"serving": {"page_size": 16}},
+                      knob_space=_serving_space(),
+                      objective=Objective({"tokens_per_sec": 1.0,
+                                           "ttft_p99_ms": -0.1}),
+                      results_dir=results, ledger_path=ledger)
+    summary = cp.tune(trial_fn)
+    # draft=20 with page_size=16 can never run: pruned, never journaled
+    assert summary["trials"] == 2 and summary["pruned"] == 2
+    assert all("draft_exceeds_page" in p["reason"] for p in cp.pruned)
+    assert summary["best"]["knobs"] == {"chunk": 32, "draft": 0}
+    # winner overlay: fragment + provenance stamp
+    payload = load_overlay(summary["overlay_path"])
+    assert payload["overlay"]["serving"]["scheduler"][
+        "prefill_chunk_tokens"] == 32
+    prov = payload["provenance"]
+    assert prov["trial"] == summary["best"]["trial"]
+    assert prov["snapshot_hash"].startswith("sha256:")
+    assert prov["knobs"] == {"chunk": 32, "draft": 0}
+    # every trial ledgered under its tune-<id> run
+    rows = [json.loads(ln) for ln in open(ledger)]
+    assert len(rows) == summary["ledger_rows"] > 0
+    assert {r["run"] for r in rows} == {"tune-0000", "tune-0002"}
+    assert all(r["bench"] == "autotune" for r in rows)
+    # the full artifact tree (journals, overlay, tune/* stream) passes
+    # the checker's --tune gate
+    checker = _load_checker()
+    problems, n = checker.validate_tune_path(results)
+    assert problems == [] and n >= 4
+    kinds = [json.loads(ln)["name"]
+             for ln in open(os.path.join(results, "events.jsonl"))]
+    assert set(kinds) == set(TUNE_EVENTS)
+
+
+def test_identical_wallclock_different_histograms_different_winner(
+        tmp_path):
+    """THE closed-loop property: two sweeps whose trials are identical in
+    wall-clock but differ in what the telemetry histograms recorded must
+    pick different winners — trial scoring demonstrably reads the
+    snapshot, not the clock."""
+    space = lambda: KnobSpace([Knob("mode", "mode", [0, 1])])
+    obj = Objective({"ttft_p99_ms": -1.0})
+
+    def run_sweep(results_dir, ttft_by_mode):
+        def trial_fn(cfg, tel):
+            # identical wall-clock work; only the recorded SLO histogram
+            # differs between modes
+            tel.registry.histogram("serve/ttft_ms").observe(
+                float(ttft_by_mode[cfg["mode"]]))
+            return None
+        cp = ControlPlane(base_config={}, knob_space=space(),
+                          objective=obj, results_dir=str(results_dir))
+        return cp.tune(trial_fn)["best"]["knobs"]["mode"]
+
+    assert run_sweep(tmp_path / "a", {0: 10.0, 1: 100.0}) == 0
+    assert run_sweep(tmp_path / "b", {0: 100.0, 1: 10.0}) == 1
+
+
+def test_zero_mem_model_pruning(tmp_path):
+    """Training points are pruned when analytic ZeRO state bytes plus the
+    measured mem/<span>/peak_bytes residual exceed HBM."""
+    tel = _fresh_tel()
+    tel.registry.gauge("mem/fwd/peak_bytes").set(2 << 30)
+    baseline = tel.snapshot()
+    space = KnobSpace([Knob("stage", "zero_optimization/stage", [0, 3],
+                            domain="training")])
+    cp = ControlPlane(base_config={"dp": 8},
+                      knob_space=space, objective=Objective(),
+                      results_dir=str(tmp_path),
+                      hbm_bytes=16 << 30, model_num_params=1_000_000_000,
+                      baseline_snapshot=baseline)
+    summary = cp.tune(lambda cfg, tel_: {"tokens_per_sec": 1.0})
+    # stage 0 (18 GB of state + 2 GB measured residual) can't fit 16 GB;
+    # stage 3 shards across dp=8 and survives
+    assert summary["pruned"] == 1 and summary["trials"] == 1
+    assert "zero_mem_model" in cp.pruned[0]["reason"]
+    assert summary["best"]["knobs"] == {"stage": 3}
+
+
+def test_max_trials_caps_grid(tmp_path):
+    space = KnobSpace([Knob("x", "x", [1, 2, 3, 4])])
+    cp = ControlPlane(base_config={}, knob_space=space,
+                      objective=Objective({"tokens_per_sec": 1.0}),
+                      results_dir=str(tmp_path), max_trials=2)
+    summary = cp.tune(lambda cfg, tel: {"tokens_per_sec": float(cfg["x"])})
+    assert summary["trials"] == 2
+    assert summary["best"]["knobs"] == {"x": 2}
+
+
+def test_controlplane_reads_autotuning_config_block(tmp_path):
+    """knobs / objective / overlay_path / max_trials all come from the
+    ds-config ``autotuning`` block when not passed explicitly."""
+    overlay_path = str(tmp_path / "win.json")
+    base = {"autotuning": {"knobs": {"x": [1, 2, 3]},
+                           "objective": {"tokens_per_sec": 1.0},
+                           "overlay_path": overlay_path,
+                           "max_trials": 2}}
+    cp = ControlPlane(base_config=base, results_dir=str(tmp_path / "r"))
+    summary = cp.tune(lambda cfg, tel: {"tokens_per_sec": float(cfg["x"])})
+    assert summary["trials"] == 2
+    assert summary["overlay_path"] == overlay_path
+    assert os.path.exists(overlay_path)
+    # the autotuning block itself never leaks into trial configs
+    assert cp.rm.experiments[0].ds_config.get("autotuning") is None
+
+
+# ----------------------------------------------------------------------
+# overlay consumption: initialize() and create_serving_engine()
+# ----------------------------------------------------------------------
+def test_deepspeed_config_applies_overlay(tmp_path):
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    path = str(tmp_path / "overlay.json")
+    write_overlay(path, _payload(
+        {"serving": {"page_size": 32}}, trial="tune-0007"))
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "serving": {"page_size": 16},
+                           "autotuning": {"overlay_path": path}})
+    assert cfg._param_dict["serving"]["page_size"] == 32
+    assert cfg.overlay_provenance["trial"] == "tune-0007"
+    # no overlay configured -> untouched config, provenance None
+    cfg2 = DeepSpeedConfig({"train_batch_size": 8})
+    assert cfg2.overlay_provenance is None
+
+
+def test_create_serving_engine_consumes_overlay(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                                  TransformerConfig)
+    mcfg = TransformerConfig.tiny(hidden_size=32, n_heads=2, n_kv_heads=2)
+    model = CausalTransformerLM(mcfg)
+    params = model.init(jax.random.key(0))
+    path = str(tmp_path / "overlay.json")
+    write_overlay(path, _payload(
+        {"serving": {"scheduler": {"prefill_chunk_tokens": 48}}},
+        trial="tune-0003"))
+    eng = deepspeed_tpu.create_serving_engine(
+        model, params,
+        config={"max_batch": 2, "max_seq": 128,
+                "serving": {"page_size": 16,
+                            "scheduler": {"policy": "chunked"}},
+                "autotuning": {"overlay_path": path}},
+        dtype=jnp.float32)
+    assert eng.overlay_provenance["trial"] == "tune-0003"
+    assert eng.scheduler.chunk == 48          # tuned knob reached engine
+    assert eng.page_size == 16                # geometry keys still honored
+
+
+# ----------------------------------------------------------------------
+# autoscaler thresholds from the overlay
+# ----------------------------------------------------------------------
+def test_replica_autoscaler_from_overlay(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import ReplicaAutoscaler
+    path = str(tmp_path / "overlay.json")
+    write_overlay(path, _payload(
+        {"serving": {"fleet": {"scale_up_queue_per_replica": 3,
+                               "free_page_low_frac": 0.25,
+                               "max_replicas": 5}}}))
+    a = ReplicaAutoscaler.from_overlay(
+        path, defaults={"min_replicas": 2, "max_replicas": 4,
+                        "cooldown_sweeps": 0})
+    assert a.scale_up_queue_per_replica == 3    # overlay wins
+    assert a.free_page_low_frac == 0.25
+    assert a.max_replicas == 5                  # overlay beats default
+    assert a.min_replicas == 2                  # default kept
+    # tuned thresholds drive decisions: queue 6 over 2 replicas = 3/rep
+    assert a.decide(2, queue_depth=6) == 3
+    # missing/None overlay degrades to defaults alone
+    b = ReplicaAutoscaler.from_overlay(None, defaults={"min_replicas": 2})
+    assert b.min_replicas == 2 and b.max_replicas == 8
+    c = ReplicaAutoscaler.from_overlay(str(tmp_path / "nope.json"),
+                                       defaults={"max_replicas": 3})
+    assert c.max_replicas == 3
+
+
+def test_fleet_router_thresholds_from_overlay(tmp_path):
+    from deepspeed_tpu.inference.fleet import FleetConfig, FleetRouter
+    path = str(tmp_path / "overlay.json")
+    write_overlay(path, _payload(
+        {"serving": {"fleet": {"scale_up_queue_per_replica": 2,
+                               "cooldown_sweeps": 1}}}))
+    cfg = FleetConfig({"overlay_path": path})
+    th = FleetRouter._autoscaler_thresholds(cfg)
+    assert th["scale_up_queue_per_replica"] == 2
+    assert th["cooldown_sweeps"] == 1
+    # config values survive where the overlay is silent
+    assert th["scale_down_queue_per_replica"] == \
+        cfg.scale_down_queue_per_replica
+    # no overlay -> pure config thresholds
+    th2 = FleetRouter._autoscaler_thresholds(FleetConfig({}))
+    assert th2["scale_up_queue_per_replica"] == \
+        FleetConfig({}).scale_up_queue_per_replica

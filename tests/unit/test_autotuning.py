@@ -82,6 +82,46 @@ def test_resource_manager_journal_and_best(tmp_path):
     assert calls == ["a"]
 
 
+def test_resource_manager_crash_resume(tmp_path):
+    """Crash mid-sweep, resume with overwrite=False: finished journals
+    are reused without re-running, and the torn (crash-mid-write)
+    trailing journal is re-run instead of crashing the resume."""
+    def exps():
+        return [Experiment("a", {"x": 1}), Experiment("b", {"x": 2}),
+                Experiment("c", {"x": 3})]
+
+    scores = {"a": 5.0, "b": 7.0}
+    rm = ResourceManager(str(tmp_path), metric="throughput",
+                         overwrite=False)
+    rm.schedule_experiments(exps())
+    # the "crash": a and b finish, c dies mid-journal-write
+    rm.run_one(rm.experiments[0],
+               lambda e: {"throughput": scores[e.name]})
+    rm.run_one(rm.experiments[1],
+               lambda e: {"throughput": scores[e.name]})
+    (tmp_path / "c.json").write_text('{"throughput": 4.0, "ds_co')
+
+    rm2 = ResourceManager(str(tmp_path), metric="throughput",
+                          overwrite=False)
+    rm2.schedule_experiments(exps())
+    calls = []
+    rm2.run(lambda e: calls.append(e.name) or {"throughput": 9.9})
+    assert calls == ["c"]          # a, b reused; torn c re-ran
+    assert rm2.best_experiment().name == "c"
+    with open(tmp_path / "c.json") as f:
+        assert json.load(f)["throughput"] == 9.9   # rewritten whole
+
+
+def test_resource_manager_tolerates_non_dict_journal(tmp_path):
+    rm = ResourceManager(str(tmp_path), metric="throughput",
+                         overwrite=False)
+    (tmp_path / "a.json").write_text('[1, 2, 3]')
+    rm.schedule_experiments([Experiment("a", {"x": 1})])
+    calls = []
+    rm.run(lambda e: calls.append(e.name) or {"throughput": 1.0})
+    assert calls == ["a"]
+
+
 def test_failed_experiment_scores_zero(tmp_path):
     rm = ResourceManager(str(tmp_path))
 

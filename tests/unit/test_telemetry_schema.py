@@ -208,6 +208,21 @@ def test_accepts_every_emitter(checker, tmp_path):
                                    "reason": "redispatch_budget"})
     tel.fleet("fleet/scale_up", attrs={"replicas": 3, "queue_depth": 40})
     tel.fleet("fleet/scale_down", attrs={"replicas": 2, "queue_depth": 1})
+    # the autotuner control plane's full vocabulary (tune/*)
+    tel.tune("tune/trial_start",
+             attrs={"trial": "tune-0000",
+                    "knobs": '{"prefill_chunk_tokens": 64}'})
+    tel.tune("tune/trial_result",
+             attrs={"trial": "tune-0000", "objective": 12.5,
+                    "snapshot_hash": "sha256:abc",
+                    "metrics": '{"tokens_per_sec": 100.0}'})
+    tel.tune("tune/trial_pruned",
+             attrs={"trial": "tune-0001",
+                    "reason": "draft_exceeds_page (draft=20, page=16)",
+                    "knobs": '{"num_draft_tokens": 20}'})
+    tel.tune("tune/overlay_written",
+             attrs={"trial": "tune-0000", "path": "/tmp/overlay.json",
+                    "snapshot_hash": "sha256:abc"})
     # the per-step attention spans the serving engine wraps its dispatches
     # in (phase: prefill / decode / decode_chunk)
     with tel.span("serve/step", attrs={"backend": "pallas",
@@ -460,6 +475,71 @@ def test_incident_event_validation(checker):
     assert checker.validate_event(dict(good, trigger="gossip"))
     assert checker.validate_event({k: v for k, v in good.items()
                                    if k != "id"})
+
+
+def test_tune_event_names_in_lockstep(checker):
+    """The frozen tune-name vocabulary must stay byte-identical between
+    the control plane (autotuning/controlplane.py) and the checker."""
+    from deepspeed_tpu.autotuning.controlplane import TUNE_EVENTS
+    assert checker.TUNE_EVENTS == TUNE_EVENTS
+
+
+def test_rejects_unknown_tune_name(checker):
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "tune", "name": "tune/not_a_thing"})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "tune", "name": "tune/trial_start",
+         "attrs": {"trial": "tune-0000"}, "step": 1})
+
+
+def test_overlay_payload_validation(checker, tmp_path):
+    import json
+    good = {"overlay": {"serving": {"page_size": 32}},
+            "provenance": {"trial": "tune-0000", "snapshot_hash":
+                           "sha256:abc", "objective": 1.5, "ts": 1.0,
+                           "knobs": {"page_size": 32}}}
+    assert checker.validate_overlay_payload(good) == []
+    # missing fragment / missing provenance field / wrong types
+    assert checker.validate_overlay_payload({"provenance":
+                                             good["provenance"]})
+    bad_prov = {k: v for k, v in good["provenance"].items()
+                if k != "snapshot_hash"}
+    assert checker.validate_overlay_payload(
+        dict(good, provenance=bad_prov))
+    assert checker.validate_overlay_payload(
+        dict(good, provenance=dict(good["provenance"], objective="high")))
+    assert checker.validate_overlay_payload([1, 2])
+    p = tmp_path / "overlay.json"
+    p.write_text(json.dumps(good))
+    assert checker.validate_overlay_file(str(p)) == []
+    p.write_text("not json")
+    assert checker.validate_overlay_file(str(p))
+
+
+def test_tune_cli_exit_codes(checker, tmp_path, capsys):
+    import json
+    d = tmp_path / "results"
+    d.mkdir()
+    (d / "overlay.json").write_text(json.dumps(
+        {"overlay": {"serving": {"page_size": 32}},
+         "provenance": {"trial": "tune-0000", "snapshot_hash":
+                        "sha256:abc", "objective": 1.5, "ts": 1.0,
+                        "knobs": {}}}))
+    (d / "tune-0000.json").write_text(json.dumps(
+        {"objective": 1.5, "ds_config": {"serving": {"page_size": 32}}}))
+    (d / "events.jsonl").write_text(json.dumps(
+        {"ts": 1.0, "kind": "tune", "name": "tune/trial_start",
+         "attrs": {"trial": "tune-0000"}}) + "\n")
+    assert checker.main(["--tune", str(d)]) == 0
+    assert "3 tune artifact(s)" in capsys.readouterr().out
+    # a journal without a ds_config stamp is corrupt
+    (d / "tune-0001.json").write_text(json.dumps({"objective": 2.0}))
+    assert checker.main(["--tune", str(d)]) == 1
+    capsys.readouterr()
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert checker.main(["--tune", str(empty)]) == 1
+    capsys.readouterr()
 
 
 def test_incidents_cli_and_bundle_validation(checker, tmp_path, capsys):
