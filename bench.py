@@ -1668,6 +1668,160 @@ def _worker_comm_census(spec):
     print(json.dumps(_comm_census_bench(spec)))
 
 
+def _comm_quant_bench(spec=None):
+    """CPU-runnable quantized-collective micro-bench: a simulated 4-rank
+    grad reduce (shard_map over forced host devices) comparing the fp32
+    baseline against the blockwise-int8 two-phase codec in
+    comm/quantize.py.  Reports the wire accounting the comm census books
+    (bytes-saved ratio vs the analytic int8+scales model), the codec's
+    relative error on both verbs, wire-bandwidth rows computed from the
+    REDUCED wire bytes, and schema-checker validation of the annotated
+    ``comm`` events + frozen quant gauges.  CPU timings are compute-bound
+    by design — the codec's numerics and the accounting chain, not wire
+    speed, are what this bench measures."""
+    spec = spec or {}
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    import importlib.util
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.comm.quantize import (QUANT_GAUGES,
+                                             quant_bytes_saved,
+                                             quant_payload_bytes,
+                                             quantized_all_reduce,
+                                             quantized_reduce_scatter)
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    world = int(spec.get("ranks", 4))
+    numel = int(spec.get("numel", 1 << 20))     # fp32 grad shard, 4 MiB
+    block = int(spec.get("block_size", 256))
+    iters = int(spec.get("iters", 8))
+    assert numel % (world * block) == 0
+    devices = jax.devices()[:world]
+    assert len(devices) == world, \
+        f"need {world} host devices, have {len(devices)}"
+    mesh = Mesh(np.array(devices), ("dp",))
+
+    def _smap(f, out_specs):
+        try:
+            from jax import shard_map as sm
+            return sm(f, mesh=mesh, in_specs=(P("dp", None),),
+                      out_specs=out_specs, check_vma=False)
+        except (ImportError, TypeError):
+            from jax.experimental.shard_map import shard_map as sm
+            return sm(f, mesh=mesh, in_specs=(P("dp", None),),
+                      out_specs=out_specs, check_rep=False)
+
+    rng = np.random.default_rng(0)
+    # per-rank grad shards with realistic mixed magnitudes
+    x = (rng.standard_normal((world, numel)) *
+         rng.choice([1e-3, 1e-1, 1.0], (world, numel))).astype(np.float32)
+    x = jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh, P("dp", None)))
+
+    fp32_ar = jax.jit(_smap(
+        lambda g: jax.lax.psum(g, "dp"), P(None, None)))
+    int8_ar = jax.jit(_smap(
+        lambda g: quantized_all_reduce(g[0], "dp", block)[None],
+        P(None, None)))
+    int8_rs = jax.jit(_smap(
+        lambda g: quantized_reduce_scatter(g[0], "dp", block)[None],
+        P("dp", None)))
+
+    def _time(fn):
+        fn(x).block_until_ready()            # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x).block_until_ready()
+        return out, (time.perf_counter() - t0) / iters * 1e3
+
+    exact, fp32_ms = _time(fp32_ar)
+    quant, int8_ms = _time(int8_ar)
+    scattered, rs_ms = _time(int8_rs)
+    exact_np = np.asarray(exact)[0]
+    ar_err = float(np.linalg.norm(np.asarray(quant)[0] - exact_np) /
+                   np.linalg.norm(exact_np))
+    rs_full = np.asarray(scattered).reshape(-1)
+    rs_err = float(np.linalg.norm(rs_full - exact_np) /
+                   np.linalg.norm(exact_np))
+
+    # wire accounting, census semantics: payload bytes per collective
+    raw_bytes = numel * 4
+    wire_bytes = quant_payload_bytes(numel, block)
+    saved = quant_bytes_saved(numel, "float32", block)
+    ratio = raw_bytes / wire_bytes
+
+    # the annotated census chain: emit what the engine wiring emits and
+    # schema-check every event, including the frozen quant gauges
+    tmp = tempfile.mkdtemp(prefix="comm_quant_bench_")
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": tmp,
+         "job_name": "comm_quant"}), rank=0)
+    tel.collective("all_reduce", raw_bytes, "dp", dtype="float32",
+                   dur_ms=fp32_ms, world=world)
+    tel.collective("all_reduce", wire_bytes, "dp", dtype="float32",
+                   dur_ms=int8_ms, world=world,
+                   wire_dtype="int8", bytes_saved=saved)
+    tel.collective("reduce_scatter", wire_bytes, "dp", dtype="float32",
+                   dur_ms=rs_ms, world=world,
+                   wire_dtype="int8", bytes_saved=saved)
+    for g in QUANT_GAUGES:
+        tel.gauge(g, float(saved), step=1)
+    tel.close()
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sp = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(repo, "scripts", "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(checker)
+    problems, n_events = [], 0
+    with open(os.path.join(tmp, "comm_quant", "events.jsonl")) as f:
+        for line in f:
+            n_events += 1
+            problems += checker.validate_event(json.loads(line))
+
+    assert ratio >= 3.0, f"bytes-saved ratio {ratio:.3f} below 3x"
+    assert ar_err < 0.05 and rs_err < 0.05, (ar_err, rs_err)
+    assert not problems, problems[:3]
+    return {
+        "ranks": world,
+        "numel": numel,
+        "block_size": block,
+        "raw_bytes": raw_bytes,
+        "wire_bytes": wire_bytes,
+        "bytes_saved": int(saved),
+        "bytes_saved_ratio": round(ratio, 4),
+        "analytic_ratio": round(raw_bytes /
+                                quant_payload_bytes(numel, block), 4),
+        "allreduce_rel_err": round(ar_err, 6),
+        "reduce_scatter_rel_err": round(rs_err, 6),
+        "fp32_allreduce_ms": round(fp32_ms, 3),
+        "int8_allreduce_ms": round(int8_ms, 3),
+        "int8_reduce_scatter_ms": round(rs_ms, 3),
+        "busbw_gbps_fp32": round(raw_bytes / (fp32_ms / 1e3) / 1e9, 4),
+        "busbw_gbps_int8_wire": round(wire_bytes / (int8_ms / 1e3) / 1e9,
+                                      4),
+        "events_validated": n_events,
+        "schema_problems": len(problems),
+        "note": "CPU timings are compute-bound; the codec numerics and "
+                "the bytes-saved accounting chain are what this bench "
+                "measures",
+    }
+
+
+def _worker_comm_quant(spec):
+    print(json.dumps(_comm_quant_bench(spec)))
+
+
 def _compile_churn_bench(spec=None):
     """CPU-runnable profiling-plane micro-bench: a jitted kernel driven
     through a deliberately shape-churned workload so every new shape is a
@@ -2054,6 +2208,25 @@ def _attach_comm_census(out):
     return out
 
 
+def _attach_comm_quant(out):
+    """Attach the quantized-collective micro-bench under the stable key
+    ``cpu_comm_quant`` (CPU-runnable: 4-rank shard_map grad reduce, fp32
+    vs blockwise int8, bytes-saved ratio vs the analytic model, codec
+    error bound, checker-validated annotated events).  Budget-gated; a
+    failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "comm_quant", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_comm_quant"] = res
+    else:
+        out.setdefault("notes", {})["comm_quant"] = (err or "")[:200]
+    return out
+
+
 def _attach_compile_churn(out):
     """Attach the profiling-plane micro-bench under the stable key
     ``cpu_compile_churn`` (CPU-runnable: shape-churned jit workload,
@@ -2225,7 +2398,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))
+            print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -2388,7 +2561,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))))
+    print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))))
 
 
 if __name__ == "__main__":
@@ -2427,6 +2600,8 @@ if __name__ == "__main__":
             _worker_serving_sched(spec)
         elif which == "comm_census":
             _worker_comm_census(spec)
+        elif which == "comm_quant":
+            _worker_comm_quant(spec)
         elif which == "compile_churn":
             _worker_compile_churn(spec)
         elif which == "incident":

@@ -49,6 +49,25 @@ class Knob:
         return f"Knob({self.name!r}, {self.path!r}, {self.values})"
 
 
+_COMM_QUANT_BLOCK_CANDIDATES = (64, 128, 256, 512)
+
+
+def comm_quant_block_knob(pad_multiple: Optional[int] = None) -> Knob:
+    """The ``comm.quantization.block_size`` knob, candidates pruned to
+    divisors of the grad-bucket padding multiple: a block that does not
+    divide the bucket boundary would fold padding zeros into a real
+    block's absmax scale, quietly inflating quantization error for that
+    tail block.  ``pad_multiple`` defaults to the ZeRO
+    ``reduce_bucket_size`` default."""
+    if pad_multiple is None:
+        from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+        pad_multiple = int(DeepSpeedZeroConfig.reduce_bucket_size)
+    values = [b for b in _COMM_QUANT_BLOCK_CANDIDATES
+              if pad_multiple % b == 0]
+    return Knob("comm_quant_block_size", "comm/quantization/block_size",
+                values or [256], domain="training")
+
+
 def default_training_knobs() -> List[Knob]:
     return [
         Knob("gas", "gradient_accumulation_steps", [1, 2, 4, 8],
@@ -58,6 +77,11 @@ def default_training_knobs() -> List[Knob]:
         Knob("remat_policy", "remat_policy",
              ["nothing_saveable", "dots_saveable"],
              domain="training", kind="model"),
+        # quantized-collective wire codec (comm/quantize.py): whether the
+        # grad reduce rides int8, and at which scale-block granularity
+        Knob("comm_quant_enabled", "comm/quantization/enabled",
+             [False, True], domain="training"),
+        comm_quant_block_knob(),
     ]
 
 

@@ -59,13 +59,15 @@ class CommsLogger:
         self.prof_ops = prof_ops or []
 
     def append(self, op_name, size_bytes, axis, dtype=None, dur_ms=None,
-               world=None):
+               world=None, wire_dtype=None, bytes_saved=None):
         # unified telemetry census rides every traced op, independent of the
         # comms_logger's own enabled/prof_ops filters (no-op when telemetry
         # is off — one flag check inside collective())
         from deepspeed_tpu.monitor.telemetry import get_telemetry
         get_telemetry().collective(op_name, size_bytes, axis, dtype=dtype,
-                                   dur_ms=dur_ms, world=world)
+                                   dur_ms=dur_ms, world=world,
+                                   wire_dtype=wire_dtype,
+                                   bytes_saved=bytes_saved)
         if not self.enabled:
             return
         if self.prof_ops and op_name not in self.prof_ops:

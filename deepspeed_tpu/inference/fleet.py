@@ -71,6 +71,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.comm.quantize import QuantizedPayload
 from deepspeed_tpu.elasticity.elastic_agent import (ReplicaAutoscaler,
                                                     RoleAwareAutoscaler)
 from deepspeed_tpu.inference.robustness import (
@@ -272,7 +273,8 @@ class FleetRouter:
                       "scale_downs": 0,
                       "migrations": 0, "migrated_pages": 0,
                       "dedup_skipped_pages": 0, "migrate_bytes": 0,
-                      "migrate_bytes_saved": 0, "migrate_faults": 0,
+                      "migrate_bytes_saved": 0,
+                      "migrate_quant_bytes_saved": 0, "migrate_faults": 0,
                       "migrate_commit_faults": 0, "migrate_aborts": 0,
                       "local_prefills": 0}
         self._gens: Dict[str, int] = {}     # replica_id -> spawn generation
@@ -738,6 +740,16 @@ class FleetRouter:
             to_send = handoff.pages[len(resident):]
             payload = (src.engine.export_pages(to_send)
                        if to_send else None)
+            wire_frac = 1.0
+            if payload is not None:
+                # wire codec runs AFTER the dedup plan: chain keys are
+                # token-addressed, so content dedup is quantization-blind;
+                # the destination decodes the self-describing wrapper in
+                # import_pages with no matching config of its own
+                payload = src.engine.comm_quant.encode_payload(payload)
+                if isinstance(payload, QuantizedPayload):
+                    wire_frac = (payload.wire_bytes /
+                                 max(payload.raw_bytes, 1))
             deadline_s = (fr.deadline - now) if fr.deadline else None
             if not eng.import_request(handoff, payload=payload,
                                       shared_pages=resident,
@@ -765,17 +777,34 @@ class FleetRouter:
             fr.handoff = None
             src.engine.release_handoff(fr.req_id)
             page_bytes = int(eng.kv_page_bytes)
+            # per-page accounting stays analytic (pad lanes excluded):
+            # the quantized wire carries wire_frac of the dtype-true
+            # page bytes, the rest is quant saving on top of dedup
+            raw_bytes = len(to_send) * page_bytes
+            wire_bytes = int(raw_bytes * wire_frac)
+            quant_saved = raw_bytes - wire_bytes
             self.stats["migrations"] += 1
             self.stats["migrated_pages"] += len(to_send)
             self.stats["dedup_skipped_pages"] += len(resident)
-            self.stats["migrate_bytes"] += len(to_send) * page_bytes
-            self.stats["migrate_bytes_saved"] += len(resident) * page_bytes
+            self.stats["migrate_bytes"] += wire_bytes
+            self.stats["migrate_bytes_saved"] += \
+                len(resident) * page_bytes + quant_saved
+            self.stats["migrate_quant_bytes_saved"] += quant_saved
+            if quant_saved:
+                tel = self._tel()
+                if tel is not None:
+                    tel.gauge("comm/kv_migrate/quant_bytes_saved",
+                              float(self.stats["migrate_quant_bytes_saved"]),
+                              step=self.steps)
             self._fleet_event("fleet/migrate_commit", req_id=fr.req_id,
                               replica=rep.replica_id,
                               source=src.replica_id,
                               pages=len(to_send), skipped=len(resident),
-                              bytes=len(to_send) * page_bytes,
-                              bytes_saved=len(resident) * page_bytes)
+                              bytes=wire_bytes,
+                              bytes_saved=(len(resident) * page_bytes
+                                           + quant_saved),
+                              quant_bytes_saved=quant_saved or None,
+                              wire_dtype="int8" if quant_saved else None)
             return ("committed", len(to_send))
         return ("retry", 0)
 

@@ -44,6 +44,7 @@ from deepspeed_tpu.inference.robustness import (
     REJECT_OVERSIZED, REJECT_QUEUE_FULL, SHED_DEADLINE, SHED_DRAIN,
     SHED_OLDEST, AdmissionController, RequestRejected, RequestResult,
     RequestTracer, ServingRobustnessConfig, ServingStalled)
+from deepspeed_tpu.comm.quantize import CommQuantizer
 from deepspeed_tpu.inference.prefix_cache import PrefixCache, PrefixMatch
 from deepspeed_tpu.inference.scheduler import SLO_CLASSES, create_scheduler
 from deepspeed_tpu.monitor.telemetry import get_telemetry
@@ -128,7 +129,8 @@ class ServingEngine:
                  eos_token_id: Optional[int] = None, tp_size: int = 1,
                  ep_size: int = 1, decode_chunk: int = 1,
                  serving=None, telemetry=None, injector=None, clock=None,
-                 replica_epoch=None, draft_model=None, draft_params=None):
+                 replica_epoch=None, draft_model=None, draft_params=None,
+                 comm_quant=None):
         """``serving``: a :class:`ServingRobustnessConfig` or its dict —
         defaults keep pre-hardening behaviour (unbounded queue, no
         deadlines).  ``injector``: a ``FaultInjector`` for the serving
@@ -140,7 +142,11 @@ class ServingEngine:
         so a respawned replica re-serving a redispatched id cannot read as
         a double admit in a merged audit.  ``draft_model``/``draft_params``:
         the speculative-decoding proposer (``serving.scheduler.speculative``
-        — inference/scheduler.py); ignored unless that block enables it."""
+        — inference/scheduler.py); ignored unless that block enables it.
+        ``comm_quant``: wire codec for KV-page migration payloads — a
+        :class:`CommQuantizer`, the ``comm.quantization`` config block,
+        or None (off); only the EXPORT side consults it, imports decode
+        the self-describing payload regardless."""
         self.model = model
         self.config = model.config
         self.max_batch = max_batch
@@ -219,6 +225,9 @@ class ServingEngine:
         self._gather_pages_fn = None
         self._scatter_pages_fn = None
         self._kv_page_bytes = None
+        self.comm_quant = (comm_quant
+                           if isinstance(comm_quant, CommQuantizer)
+                           else CommQuantizer.from_config(comm_quant))
         self.handoffs: Dict[Any, PrefillHandoff] = {}
         self._new_handoffs: List[Any] = []
         self._pending_imports: Dict[Any, Any] = {}
@@ -804,7 +813,11 @@ class ServingEngine:
         """Scatter an exported payload into this engine's ``page_ids``
         (the :meth:`export_pages` counterpart; donation makes it an
         in-place page write).  Payload pad lanes beyond ``len(page_ids)``
-        scatter onto the sacrificial scratch page."""
+        scatter onto the sacrificial scratch page.  Quantized payloads
+        (the source replica's ``comm_quant`` wire codec) are
+        self-describing and dequantize here — the destination needs no
+        matching config."""
+        payload = CommQuantizer.decode_payload(payload)
         leaves = jax.tree_util.tree_leaves(payload)
         padded = np.zeros(leaves[0].shape[1], np.int32)
         padded[:len(page_ids)] = page_ids
@@ -1418,6 +1431,9 @@ def create_serving_engine(model, params, config=None, overlay_path=None,
         if key in serving:   # the serving block wins over top level
             eng_kwargs[key] = serving.pop(key)
     eng_kwargs["serving"] = serving
+    quant_cfg = (cfg.get("comm") or {}).get("quantization")
+    if quant_cfg:
+        eng_kwargs["comm_quant"] = quant_cfg
     eng_kwargs.update(kwargs)
     engine = ServingEngine(model, params, **eng_kwargs)
     engine.overlay_provenance = provenance

@@ -545,11 +545,19 @@ class Telemetry:
         self.collective(op_name, size_bytes, axis)
 
     def collective(self, op_name, size_bytes, axis, dtype=None, dur_ms=None,
-                   world=None):
+                   world=None, wire_dtype=None, bytes_saved=None):
         """One traced/timed collective: counters ``comm/{op}/calls|bytes``,
         duration histogram ``comm/{op}_ms``, and a ``comm`` event carrying
         payload dtype, axis/group, world size, and achieved bus bandwidth
         against the analytic per-link peak (comm/topology_model.py).
+
+        Quantized collectives (comm/quantize.py) pass ``size_bytes`` as
+        the actual WIRE payload (int8 codes + scales) so the busbw math
+        reflects the reduced traffic, plus ``wire_dtype`` (the on-wire
+        dtype, e.g. ``"int8"``) and ``bytes_saved`` (dtype-true baseline
+        minus wire bytes) — booked into counter
+        ``comm/{op}/bytes_saved`` and the frozen gauge
+        ``comm/{op}/quant_bytes_saved``.
 
         Durations are host-observed around the verb — trace time inside
         ``jit`` (the census convention), true wall time for host-level ops
@@ -567,13 +575,22 @@ class Telemetry:
             busbw, peak = bus_bandwidth(op_name, size_bytes, dur_ms, world)
             if busbw is not None:
                 self.registry.gauge(f"comm/{op_name}/busbw_gbps").set(busbw)
+        if bytes_saved:
+            self.registry.counter(
+                f"comm/{op_name}/bytes_saved").inc(int(bytes_saved))
+            self.registry.gauge(
+                f"comm/{op_name}/quant_bytes_saved").set(int(bytes_saved))
         self.emit("comm", op_name, bytes=int(size_bytes), axis=str(axis),
                   dtype=str(dtype) if dtype is not None else None,
                   dur_ms=round(dur_ms, 4) if dur_ms is not None else None,
                   world=int(world) if world is not None else None,
                   busbw_gbps=(round(busbw, 4) if busbw is not None
                               else None),
-                  peak_gbps=peak)
+                  peak_gbps=peak,
+                  wire_dtype=(str(wire_dtype) if wire_dtype is not None
+                              else None),
+                  bytes_saved=(int(bytes_saved) if bytes_saved is not None
+                               else None))
 
     def close(self):
         if self.exporter is not None:

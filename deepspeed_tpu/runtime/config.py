@@ -49,6 +49,53 @@ class CommsConfig(DeepSpeedConfigModel):
     prof_ops = []
 
 
+class CommQuantizationConfig(DeepSpeedConfigModel):
+    """``"comm.quantization"`` block: the blockwise-int8 wire codec for
+    bandwidth-bound collectives (``comm/quantize.py``, EQuARX-style).
+    Applies to the verbs listed in ``verbs``; integer tensors and tensors
+    under ``min_tensor_bytes`` always pass through unquantized."""
+    enabled = False
+    scheme = "int8_block"           # none | int8_block | onebit
+    dtype = "int8"                  # wire dtype (int8 is the only codec)
+    block_size = 256                # elements per absmax scale block
+    min_tensor_bytes = 1024         # smaller tensors ride full precision
+    verbs = []                      # [] -> all of QUANTIZABLE_VERBS
+
+    def _validate(self):
+        from deepspeed_tpu.comm.quantize import (QUANT_SCHEMES,
+                                                 QUANTIZABLE_VERBS)
+        if self.scheme not in QUANT_SCHEMES:
+            raise ValueError(
+                f"comm.quantization.scheme must be one of {QUANT_SCHEMES}, "
+                f"got {self.scheme!r}")
+        if str(self.dtype) != "int8":
+            raise ValueError(
+                "comm.quantization.dtype: only 'int8' is implemented, got "
+                f"{self.dtype!r}")
+        if int(self.block_size) < 8:
+            raise ValueError("comm.quantization.block_size must be >= 8")
+        if int(self.min_tensor_bytes) < 0:
+            raise ValueError(
+                "comm.quantization.min_tensor_bytes must be >= 0")
+        self.verbs = list(self.verbs or QUANTIZABLE_VERBS)
+        for v in self.verbs:
+            if v not in QUANTIZABLE_VERBS:
+                raise ValueError(
+                    f"comm.quantization.verbs: {v!r} is not quantizable "
+                    f"(expected a subset of {QUANTIZABLE_VERBS})")
+
+
+class CommConfig(DeepSpeedConfigModel):
+    """``"comm"`` top-level block (reference accepts ``comm_*`` sections;
+    here it holds the wire-codec policy)."""
+    quantization = {}
+
+    def _validate(self):
+        if not isinstance(self.quantization, CommQuantizationConfig):
+            self.quantization = CommQuantizationConfig(
+                self.quantization or {})
+
+
 class MonitorConfig(DeepSpeedConfigModel):
     enabled = False
     output_path = ""
@@ -427,6 +474,8 @@ class DeepSpeedConfig:
         self.scheduler_config = SchedulerConfig(sched_dict) if sched_dict else None
 
         self.comms_config = CommsConfig(pd.get(C.COMMS_LOGGER, {}))
+        self.comm_config = CommConfig(pd.get(C.COMM, {}))
+        self.comm_quantization = self.comm_config.quantization
         self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
         self.async_pipeline_config = AsyncPipelineConfig(
             pd.get(C.ASYNC_PIPELINE, {}))
@@ -464,7 +513,8 @@ class DeepSpeedConfig:
         C.BFLOAT16, C.BFLOAT16_OLD, C.AMP, C.GRADIENT_CLIPPING,
         C.PRESCALE_GRADIENTS, C.GRADIENT_PREDIVIDE_FACTOR,
         C.STEPS_PER_PRINT, C.WALL_CLOCK_BREAKDOWN, C.DUMP_STATE,
-        C.SPARSE_GRADIENTS, C.ZERO_OPTIMIZATION, C.COMMS_LOGGER, C.MESH,
+        C.SPARSE_GRADIENTS, C.ZERO_OPTIMIZATION, C.COMMS_LOGGER, C.COMM,
+        C.MESH,
         C.ACTIVATION_CHECKPOINTING, C.FLOPS_PROFILER,
         C.MONITOR_TENSORBOARD, C.MONITOR_WANDB, C.MONITOR_CSV, C.TELEMETRY,
         C.ASYNC_PIPELINE, C.RESILIENCE,

@@ -70,6 +70,9 @@ ZERO_OPTIMIZATION = "zero_optimization"
 
 COMMS_LOGGER = "comms_logger"
 
+# quantized-collective wire codec (comm/quantize.py): {"quantization": {...}}
+COMM = "comm"
+
 MESH = "mesh"  # TPU extension: {"dp": n, "fsdp": n, "tp": n, "pp": n, "sp": n, "ep": n}
 
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"

@@ -455,6 +455,11 @@ class ParamStreamRunner:
         self._adam_ex: Optional[ThreadPoolExecutor] = None
         self.boundary_pipelined = True   # ablation knob (benchmarks)
         self._tel = get_telemetry()
+        # quantized-collective wire codec for the multi-host REPLICATED-
+        # grad all-reduce (comm/quantize.py; "comm.quantization" block)
+        from deepspeed_tpu.comm.quantize import CommQuantizer
+        self.comm_quant = CommQuantizer.from_config(
+            getattr(config, "comm_quantization", None))
 
     def _xfer_pool(self) -> ThreadPoolExecutor:
         """Single-worker pool for boundary H2D uploads: the fused C++ Adam
@@ -564,11 +569,24 @@ class ParamStreamRunner:
             return tree
         # comm census for the implicit reduction: XLA inserts it at the
         # constraint below, so no dist.* verb ever sees these bytes.
-        # Dtype-true payload at gdt (the tree was just cast to it).
+        # Dtype-true payload at gdt (the tree was just cast to it).  The
+        # reduction spans every mesh axis (the constraint is fully
+        # REPLICATED), so the record carries the actual axis names — on a
+        # multi-slice mesh that is the DCN path, not ICI.
         from deepspeed_tpu.comm.comm import comms_logger
-        comms_logger.append("all_reduce", _tree_bytes(tree), "ici",
+        # optional wire codec: model the quantized all-reduce as a
+        # blockwise int8 QDQ (phase-2 re-quantization; see
+        # engine._quantize_grad_wire for the trace-level rationale)
+        saved = 0
+        if self.comm_quant.active():
+            tree, saved = self.comm_quant.qdq_tree(tree, "all_reduce")
+        nbytes = _tree_bytes(tree)
+        comms_logger.append("all_reduce", nbytes - saved,
+                            ",".join(self.mesh.axis_names),
                             dtype=str(jnp.dtype(gdt)),
-                            world=jax.process_count())
+                            world=jax.process_count(),
+                            wire_dtype="int8" if saved else None,
+                            bytes_saved=saved if saved else None)
         repl = NamedSharding(self.mesh, P())
         return jax.tree_util.tree_map(
             lambda g: jax.lax.with_sharding_constraint(g, repl), tree)

@@ -73,11 +73,16 @@ SCHEMA = {
     # host-observed duration, participant count, and achieved bus
     # bandwidth against the analytic per-link peak
     # (comm/topology_model.py).  ``name`` is validated against COMM_OPS.
+    # Quantized collectives (comm/quantize.py) add ``wire_dtype`` (the
+    # on-wire payload dtype, e.g. "int8" — ``bytes`` is then the reduced
+    # wire payload) and ``bytes_saved`` (dtype-true baseline minus wire
+    # bytes); unquantized records omit both.
     "comm": {
         "required": {"ts": _NUM, "kind": str, "name": str, "bytes": int,
                      "axis": str},
         "optional": {"dtype": str, "dur_ms": _NUM, "world": int,
-                     "busbw_gbps": _NUM, "peak_gbps": _NUM},
+                     "busbw_gbps": _NUM, "peak_gbps": _NUM,
+                     "wire_dtype": str, "bytes_saved": int},
     },
     "heartbeat": {
         "required": {"ts": _NUM, "kind": str, "name": str, "step": int},
@@ -240,6 +245,18 @@ COMM_OPS = (
     "broadcast", "scatter", "ppermute", "barrier",
 )
 
+# FROZEN vocabulary of the quantized-collective savings gauges — must
+# stay byte-identical to ``deepspeed_tpu.comm.quantize.QUANT_GAUGES``
+# (the tier-1 test diffs the two).  One gauge per quantizable wire path;
+# any gauge event under the ``comm/`` prefix is validated against this
+# tuple (the busbw gauges are registry-only and never emitted as gauge
+# events).
+QUANT_GAUGES = (
+    "comm/all_reduce/quant_bytes_saved",
+    "comm/reduce_scatter/quant_bytes_saved",
+    "comm/kv_migrate/quant_bytes_saved",
+)
+
 # FROZEN vocabulary of the cluster aggregation gauges — must stay
 # byte-identical to ``deepspeed_tpu.monitor.aggregate.CLUSTER_GAUGES``
 # (the tier-1 test diffs the two).
@@ -324,6 +341,10 @@ def validate_event(event):
             event["name"].startswith("cluster/") and \
             event["name"] not in CLUSTER_GAUGES:
         problems.append(f"gauge: unknown cluster gauge {event['name']!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str) and \
+            event["name"].startswith("comm/") and \
+            event["name"] not in QUANT_GAUGES:
+        problems.append(f"gauge: unknown comm gauge {event['name']!r}")
     if kind == "compile" and isinstance(event.get("name"), str):
         if event["name"] not in COMPILE_EVENTS:
             problems.append(

@@ -165,7 +165,8 @@ def aggregate(events):
             if role and replica:
                 fleet_roles[str(replica)] = str(role)
             if ev["name"] == "fleet/migrate_commit":
-                for k in ("pages", "skipped", "bytes", "bytes_saved"):
+                for k in ("pages", "skipped", "bytes", "bytes_saved",
+                          "quant_bytes_saved"):
                     rec[k] = rec.get(k, 0) + int(attrs.get(k) or 0)
             elif ev["name"] == "fleet/migrate_fault":
                 site = attrs.get("site")
@@ -318,7 +319,8 @@ def summarize(agg):
         for name, rec in sorted(agg.get("fleets", {}).items())}
     for name, rec in agg.get("fleets", {}).items():
         # migration ledger columns ride the per-event rows too
-        for k in ("pages", "skipped", "bytes", "bytes_saved", "sites"):
+        for k in ("pages", "skipped", "bytes", "bytes_saved",
+                  "quant_bytes_saved", "sites"):
             if k in rec:
                 fleet_rows[name][k] = rec[k]
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
@@ -411,6 +413,7 @@ def _disagg_summary(agg):
         "dedup_skipped_pages": commit.get("skipped", 0),
         "migrate_bytes": commit.get("bytes", 0),
         "bytes_saved": commit.get("bytes_saved", 0),
+        "quant_bytes_saved": commit.get("quant_bytes_saved", 0),
         "faults": dict(sorted(fleets.get("fleet/migrate_fault", {})
                               .get("sites", {}).items())),
         "aborts": dict(sorted(fleets.get("fleet/migrate_abort", {})
@@ -834,10 +837,12 @@ def print_tables(summary, out=sys.stdout):
             q = dis["queue_depth"].get(role)
             w(f"{role:<10}{','.join(rids):<20}"
               f"{q if q is not None else '?':>6}\n")
+        quant = (f"  quant bytes saved: {dis['quant_bytes_saved']}"
+                 if dis.get("quant_bytes_saved") else "")
         w(f"migrations: {dis['migrations']}  "
           f"pages migrated: {dis['migrated_pages']}  "
           f"dedup skipped: {dis['dedup_skipped_pages']}  "
-          f"bytes saved: {dis['bytes_saved']}\n")
+          f"bytes saved: {dis['bytes_saved']}{quant}\n")
         extras = []
         if dis["faults"]:
             extras.append("faults: " + ", ".join(

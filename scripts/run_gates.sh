@@ -85,6 +85,56 @@ else
     echo "== gate: tune artifacts == SKIP (no tune dir given)"
 fi
 
+# 6. quantized-collective smoke: the comm.quantization config block must
+# parse, activate the int8 codec, shrink the wire, and produce a
+# schema-valid annotated census event + frozen quant gauge
+run_gate "comm quant smoke" env JAX_PLATFORMS=cpu REPO="$REPO" "$PY" - <<'EOF'
+import importlib.util, json, os, sys, tempfile
+repo = os.environ["REPO"]
+sys.path.insert(0, repo)
+import numpy as np
+import jax.numpy as jnp
+from deepspeed_tpu.comm.quantize import CommQuantizer, quant_bytes_saved
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+
+cfg = DeepSpeedConfig({"train_batch_size": 4,
+                       "comm": {"quantization": {"enabled": True,
+                                                 "block_size": 64}}})
+q = CommQuantizer.from_config(cfg.comm_quantization)
+assert q.active(), "quantization config did not activate the codec"
+g = jnp.asarray(np.random.default_rng(0).standard_normal(4096),
+                dtype=jnp.float32)
+out, saved = q.qdq_tree({"w": g}, "all_reduce")
+assert saved == quant_bytes_saved(4096, "float32", 64) > 0
+err = float(jnp.linalg.norm(out["w"] - g) / jnp.linalg.norm(g))
+assert err < 0.05, f"codec error {err}"
+tmp = tempfile.mkdtemp()
+tel = Telemetry().configure(TelemetryConfig(
+    {"enabled": True, "output_path": tmp, "job_name": "quant_smoke"}),
+    rank=0)
+tel.collective("all_reduce", g.size * 4 - saved, "fsdp", dtype="float32",
+               world=4, wire_dtype="int8", bytes_saved=int(saved))
+tel.close()
+spec = importlib.util.spec_from_file_location(
+    "checker", os.path.join(repo, "scripts",
+                            "check_telemetry_schema.py"))
+checker = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(checker)
+events = [json.loads(l) for l in
+          open(os.path.join(tmp, "quant_smoke", "events.jsonl"))]
+problems = [p for ev in events for p in checker.validate_event(ev)]
+assert not problems, problems[:3]
+annotated = [ev for ev in events if ev.get("bytes_saved")]
+assert annotated, "no bytes_saved-annotated census event emitted"
+gauges = [ev for ev in events if ev.get("kind") == "gauge" and
+          str(ev.get("name", "")).startswith("comm/")]
+assert all(ev["name"] in checker.QUANT_GAUGES for ev in gauges)
+print(f"quant smoke: saved {int(saved)} bytes, rel err {err:.4f}, "
+      f"{len(events)} schema-valid events")
+EOF
+
 if [ "$fail" -ne 0 ]; then
     echo "GATES: FAIL"
     exit 1

@@ -64,6 +64,13 @@ def test_comm_ops_in_lockstep(checker):
     assert checker.COMM_OPS == COMM_OPS
 
 
+def test_quant_gauges_in_lockstep(checker):
+    """The frozen comm/*/quant_bytes_saved gauge vocabulary must stay
+    byte-identical between the codec (comm/quantize.py) and the checker."""
+    from deepspeed_tpu.comm.quantize import QUANT_GAUGES
+    assert checker.QUANT_GAUGES == QUANT_GAUGES
+
+
 def test_cluster_gauges_in_lockstep(checker):
     """The frozen cluster/* gauge vocabulary must stay byte-identical
     between the aggregator (monitor/aggregate.py) and the checker."""
@@ -79,6 +86,23 @@ def test_rejects_unknown_comm_and_cluster_names(checker):
         {"ts": 1.0, "kind": "comm", "name": "all_gather", "bytes": 4,
          "axis": "dp", "dtype": "float32", "dur_ms": 1.5, "world": 4,
          "busbw_gbps": 0.75, "peak_gbps": 100.0, "rank": 2})
+    # quantized-collective annotations: wire_dtype + bytes_saved are
+    # optional on every comm record; wrong types are rejected
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "comm", "name": "reduce_scatter",
+         "bytes": 1056, "axis": "fsdp", "dtype": "float32", "world": 4,
+         "wire_dtype": "int8", "bytes_saved": 3040})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "comm", "name": "reduce_scatter",
+         "bytes": 1056, "axis": "fsdp", "bytes_saved": "3040"})
+    # comm/ gauges are validated against the frozen QUANT_GAUGES tuple
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge",
+         "name": "comm/all_reduce/quant_bytes_saved", "value": 3040.0,
+         "peak": 3040.0})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "comm/all_reduce/vibes",
+         "value": 1.0, "peak": 1.0})
     assert checker.validate_event(
         {"ts": 1.0, "kind": "gauge", "name": "cluster/bogus", "value": 1.0,
          "peak": 1.0})
@@ -123,6 +147,12 @@ def test_accepts_every_emitter(checker, tmp_path):
     # the fully-annotated collective-tracing record (comm tracing)
     tel.collective("reduce_scatter", 1 << 20, "fsdp", dtype="bfloat16",
                    dur_ms=2.5, world=4)
+    # ...and its quantized twin (comm/quantize.py): wire payload bytes,
+    # on-wire dtype, and the saving vs the dtype-true baseline
+    tel.collective("all_reduce", 1082368, "dp", dtype="float32",
+                   dur_ms=1.5, world=4, wire_dtype="int8",
+                   bytes_saved=3111936)
+    tel.gauge("comm/all_reduce/quant_bytes_saved", 3111936.0, step=1)
     tel.emit("meta", "engine/init", attrs={"mesh": {"dp": 8}})
     tel.fault("fault/retry", attrs={"op": "ckpt_save[t1]", "attempt": 1,
                                     "max_retries": 3, "error": "OSError()",
