@@ -2042,6 +2042,146 @@ def _worker_incident(spec):
     print(json.dumps(_incident_bench(spec)))
 
 
+def _step_attr_bench(spec=None):
+    """CPU-runnable attribution-plane micro-bench: prices the per-event
+    record tap and the interval-algebra close, then pins the algebra to
+    an analytically constructed workload — a simulated 4-rank step with
+    known compute/collective overlap where the collective's only exposed
+    window is the 5 ms gap between forward and backward, so the expected
+    exposed fraction is EXACTLY 5/100 regardless of per-rank skew (the
+    skew shifts overlap between the two compute spans but never changes
+    its total).  The serving half round-trips one migrated request
+    through capture_handoff -> import_ctx on a fake clock and checks the
+    stage sum equals e2e exactly."""
+    spec = spec or {}
+    import importlib.util
+    import tempfile
+
+    from deepspeed_tpu.monitor.attribution import (RequestAttributor,
+                                                   decompose_step)
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    ranks = int(spec.get("ranks", 4))
+    n_record = int(spec.get("events", 20000))
+    tmp = tempfile.mkdtemp(prefix="step_attr_bench_")
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": tmp, "job_name": "step_attr",
+         "attribution": {"enabled": True}}))
+    plane = tel.attribution
+
+    # tap tax: record() sits on every emit path once the plane is on
+    ev = {"ts": time.time(), "kind": "span", "name": "engine/forward",
+          "dur_ms": 1.0}
+    t0 = time.perf_counter()
+    for _ in range(n_record):
+        plane.record(ev)
+    record_ns = (time.perf_counter() - t0) / n_record * 1e9
+    plane._compute.clear()      # drop the priming intervals
+
+    # analytic workload: window 100 ms, input_wait [0,10], forward
+    # [10,40], backward [45,85], all_reduce [30+k, 60+k] for per-rank
+    # skew k in 0..3 ms.  The collective's overlap with compute is
+    # (10-k) + (15+k) = 25 ms for every k: exposed = 5 ms, frac = 0.05.
+    expected_frac = 0.05
+    base = time.time()
+    for s in range(ranks):
+        w0 = base + s
+        skew = 0.001 * s
+        for name, end_s, dur_ms in (
+                ("engine/input_wait", 0.010, 10.0),
+                ("engine/forward", 0.040, 30.0),
+                ("engine/backward", 0.085, 40.0)):
+            plane.record({"ts": w0 + end_s, "kind": "span",
+                          "name": name, "dur_ms": dur_ms})
+        plane.record({"ts": w0 + 0.060 + skew, "kind": "comm",
+                      "name": "all_reduce", "dur_ms": 30.0})
+        plane.record({"ts": w0 + 0.100, "kind": "heartbeat",
+                      "name": "engine/step", "step": s,
+                      "step_ms": 100.0})
+    fracs = [r["exposed_comm_frac"] for r in plane.history]
+    rel_err = max(abs(f - expected_frac) / expected_frac for f in fracs) \
+        if fracs else 1.0
+    assert rel_err < 0.02, \
+        f"exposed fraction off by {rel_err:.4f} rel: {fracs}"
+
+    # algebra price: one decompose over the same interval mix
+    iters = 2000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        decompose_step(0.0, 0.1,
+                       compute=[(0.010, 0.040), (0.045, 0.085)],
+                       comm=[(0.030, 0.060)],
+                       input_wait=[(0.000, 0.010)])
+    decompose_ns = (time.perf_counter() - t0) / iters * 1e9
+
+    # serving half: one migrated request on a fake clock — the stage sum
+    # must equal e2e exactly (the gap stage absorbs the residual)
+    clock = [0.0]
+    src = RequestAttributor(clock=lambda: clock[0])
+    src.admit("req-m")
+    clock[0] = 0.040
+    src.prefill_start("req-m")
+    src.chunk("req-m", 25.0)
+    clock[0] = 0.080
+    wire = src.capture_handoff("req-m")
+    dst = RequestAttributor(clock=lambda: clock[0])
+    clock[0] = 0.095
+    dst.import_ctx("req-m", wire)
+    clock[0] = 0.100
+    dst.first_token("req-m")
+    clock[0] = 0.200
+    attrs = dst.finalize("req-m", "finish")
+    stage_sum = sum(attrs[f"{k}_ms"] for k in
+                    ("queue", "prefill", "migrate", "gap", "decode"))
+    sum_err_ms = abs(stage_sum - attrs["e2e_ms"])
+    assert sum_err_ms < 1e-6, f"stage sum {stage_sum} != e2e {attrs}"
+    # feed the attr event back through emit: schema-checks the frozen
+    # event and lands it in the plane's serve history for /attribution
+    tel.emit("serve", "serve/request/attr", attrs=attrs)
+    snap = plane.snapshot()
+    tel.close()
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sp = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(repo, "scripts", "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(checker)
+    stream = os.path.join(tmp, "step_attr", "events.jsonl")
+    stream_problems = checker.validate_file(stream)
+    with open(stream) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    attr_gauges = sum(1 for ev in events if ev.get("kind") == "gauge"
+                      and str(ev.get("name", "")).startswith("step/attr/"))
+    return {
+        "record_ns": round(record_ns, 1),
+        "decompose_ns": round(decompose_ns, 1),
+        "steps_attributed": len(fracs),
+        "exposed_comm_frac": round(sum(fracs) / len(fracs), 6),
+        "exposed_rel_err": round(rel_err, 6),
+        "attr_gauges_emitted": attr_gauges,
+        "events_ok": not stream_problems,
+        "serve_queue_ms": attrs["queue_ms"],
+        "serve_prefill_ms": attrs["prefill_ms"],
+        "serve_migrate_ms": attrs["migrate_ms"],
+        "serve_gap_ms": attrs["gap_ms"],
+        "serve_decode_ms": attrs["decode_ms"],
+        "serve_e2e_ms": attrs["e2e_ms"],
+        "serve_stage_sum_err_ms": round(sum_err_ms, 9),
+        "serve_migrated": attrs["migrated"],
+        "serve_paths_snapshotted": len(snap["requests"]),
+        "note": "analytic 4-rank step: skewed collective overlaps 25 ms "
+                "of compute at every skew, so exposed frac is exactly "
+                "0.05; serving half round-trips one migration on a fake "
+                "clock",
+    }
+
+
+def _worker_step_attr(spec):
+    print(json.dumps(_step_attr_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -2301,6 +2441,25 @@ def _attach_incident(out):
     return out
 
 
+def _attach_step_attr(out):
+    """Attach the attribution-plane micro-bench under the stable key
+    ``cpu_step_attr`` (CPU-runnable: record-tap/decompose pricing, the
+    analytic 4-rank exposed-comm fraction check, and one fake-clock
+    migrated request whose stage sum must equal e2e).  Budget-gated; a
+    failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "step_attr", {},
+        timeout=max(60, min(240, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_step_attr"] = res
+    else:
+        out.setdefault("notes", {})["step_attr"] = (err or "")[:200]
+    return out
+
+
 def _attach_autotune(out):
     """Attach the closed-loop autotuner micro-bench under the stable key
     ``cpu_autotune`` (CPU-runnable: end-to-end tune over a serving knob
@@ -2398,7 +2557,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))
+            print(json.dumps(_append_ledger(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -2486,7 +2645,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))
+        print(json.dumps(_append_ledger(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -2561,7 +2720,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_autotune(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))))
+    print(json.dumps(_append_ledger(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))))))
 
 
 if __name__ == "__main__":
@@ -2606,6 +2765,8 @@ if __name__ == "__main__":
             _worker_compile_churn(spec)
         elif which == "incident":
             _worker_incident(spec)
+        elif which == "step_attr":
+            _worker_step_attr(spec)
         elif which == "autotune":
             _worker_autotune(spec)
         else:

@@ -64,6 +64,12 @@ SNAPSHOT_METRICS: Dict[str, Callable[[Dict[str, Any]], Any]] = {
     "roofline_bandwidth_frac":
         _gauge_family_max("roofline/", "bandwidth_frac"),
     "mem_peak_bytes": _gauge_family_max("mem/", "peak_bytes", field="peak"),
+    # attribution plane (monitor/attribution.py): the step fraction spent
+    # in collectives NOT hidden behind compute — the number overlap work
+    # exists to drive down, so trials that trade it away score better
+    "exposed_comm_frac":
+        lambda s: (s.get(_GAUGE, {})
+                   .get("step/attr/exposed_comm_frac") or {}).get("value"),
 }
 
 
@@ -91,6 +97,9 @@ class Objective:
         "ttft_p99_ms": -0.1,
         "tpot_p99_ms": -0.1,
         "roofline_compute_frac": 100.0,
+        # exposed comm is pure loss: a fully-overlapped step scores 100
+        # points over one that serializes its collectives
+        "exposed_comm_frac": -100.0,
     }
 
     def __init__(self, weights: Optional[Dict[str, float]] = None):

@@ -118,6 +118,13 @@ SERVE_EVENTS = (
     "serve/request/first_token",
     "serve/request/finish", "serve/request/shed",
     "serve/request/deadline", "serve/request/evict",
+    # critical-path attribution (monitor/attribution.py): one record
+    # adjacent to each terminal carrying the ordered stage breakdown
+    # (queue/prefill/migrate/gap/decode _ms attrs, summing to e2e_ms by
+    # construction), the terminal it pairs with, chunk count, whether
+    # the request crossed a prefill->decode migration, and the "path"
+    # flow string ds_trace_export renders as arrows
+    "serve/request/attr",
 )
 
 # the closed set of trace terminals (the tail of the serve/request/*

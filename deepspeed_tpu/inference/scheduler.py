@@ -588,9 +588,14 @@ class ChunkedScheduler(SchedulerBase):
             n = len(toks)
             ids = np.zeros((1, self.chunk), np.int32)
             ids[0, :n] = toks
+            t0 = eng._clock()
             logits, eng.caches, _ = eng._run_step(
                 jnp.asarray(ids), jnp.asarray(eng.tables[slot:slot + 1]),
                 jnp.full((1,), start, jnp.int32), phase="prefill")
+            # chunk-active wall time feeds the critical path's prefill
+            # stage; the wait BETWEEN chunks lands in the gap stage —
+            # the split that separates scheduler wins from kernel wins
+            eng.attrib.chunk(req.req_id, (eng._clock() - t0) * 1000.0)
             req.prefilled = start + n
             eng.lengths[slot] = req.prefilled
             self.sched_stats["prefill_chunks"] += 1

@@ -47,6 +47,7 @@ from deepspeed_tpu.inference.robustness import (
 from deepspeed_tpu.comm.quantize import CommQuantizer
 from deepspeed_tpu.inference.prefix_cache import PrefixCache, PrefixMatch
 from deepspeed_tpu.inference.scheduler import SLO_CLASSES, create_scheduler
+from deepspeed_tpu.monitor.attribution import RequestAttributor
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.ops.paged_attention import (PageAllocationError,
                                                PagedAllocator,
@@ -111,6 +112,12 @@ class PrefillHandoff:
     out: List[int]
     rng_state: Optional[dict]
     pages: List[int]
+    # wire-serialized TraceContext (monitor/attribution.py): the source
+    # leg's timing history rides the handoff as plain primitives, so the
+    # decode side's serve/request/attr event reports the FULL critical
+    # path — queue and prefill on the source, the migration wait, then
+    # decode here — not just the decode leg
+    trace_ctx: Optional[dict] = None
 
 
 class ServingEngine:
@@ -293,6 +300,11 @@ class ServingEngine:
         # telemetry disabled
         self.replica_epoch = replica_epoch
         self.tracer = RequestTracer(clock=self._clock, epoch=replica_epoch)
+        # critical-path attribution on the same clock — always on like
+        # the tracer (host dict ops); each terminal pairs with one
+        # frozen serve/request/attr event whose stage sum equals the
+        # traced e2e by construction
+        self.attrib = RequestAttributor(clock=self._clock)
         self._consec_step_faults = 0
         self.draining = False
         self.stats = {"admitted": 0, "rejected": 0, "shed": 0,
@@ -401,6 +413,14 @@ class ServingEngine:
             tpot_ms=_round_ms(tr.tpot_ms()),
             e2e_ms=_round_ms(tr.e2e_ms()), slo=slo,
             slo_class=req.slo_class)
+        # critical-path attribution rides adjacent to the terminal: one
+        # frozen serve/request/attr event whose ordered stage breakdown
+        # sums to e2e_ms.  Closed at the tracer's terminal timestamp so
+        # both events agree on when the request ended.
+        attrs = self.attrib.finalize(req.req_id, terminal,
+                                     now=tr.t_terminal)
+        if attrs is not None:
+            self._serve_event("serve/request/attr", **attrs)
 
     # -- host control flow ---------------------------------------------
     def _reject(self, req_id, reason, detail=""):
@@ -489,6 +509,7 @@ class ServingEngine:
         # lifecycle trace opens HERE: admission is the promise leak_report
         # audits — exactly one serve/request/* terminal closes it
         self.tracer.admit(req_id, deadline=deadline, now=now)
+        self.attrib.admit(req_id, now=now)
         self._serve_event("serve/admit", req_id=req_id,
                           queue_depth=len(self.queue),
                           free_pages=self.alloc.free_page_count)
@@ -669,6 +690,7 @@ class ServingEngine:
             self.lengths[slot] = 0
             self.slots[slot] = req
             tr = self.tracer.prefill_start(req.req_id, slot)
+            self.attrib.prefill_start(req.req_id)
             if tr is not None:
                 self._observe_ms("serve/queue_wait_ms", tr.queue_wait_ms())
                 self._serve_event("serve/request/prefill_start",
@@ -728,6 +750,10 @@ class ServingEngine:
         prefill — that asymmetry is the whole point of the role split."""
         self.alloc.shrink(req.req_id, len(req.prompt))
         rng = self._rng.pop(req.req_id, None)
+        # serialize the timing context BEFORE the trace closes below —
+        # finalize pops it; the handoff-capture stamp starts the migrate
+        # stage the decode side's import will close
+        trace_ctx = self.attrib.capture_handoff(req.req_id)
         self.handoffs[req.req_id] = PrefillHandoff(
             req_id=req.req_id, prompt=list(req.prompt),
             max_new_tokens=req.max_new_tokens,
@@ -736,7 +762,8 @@ class ServingEngine:
             last_token=int(req.last_token), out=list(req.out),
             rng_state=(rng.bit_generator.state if rng is not None
                        else None),
-            pages=list(self.alloc.seq_pages[req.req_id]))
+            pages=list(self.alloc.seq_pages[req.req_id]),
+            trace_ctx=trace_ctx)
         self._new_handoffs.append(req.req_id)
         self.scheduler.release_slot(slot, req)
         self.slots[slot] = None
@@ -908,6 +935,10 @@ class ServingEngine:
         self.stats["imports"] += 1
         self.tracer.admit(req_id, deadline=req.deadline,
                           now=self._clock())
+        # adopt the migrated timing context: the attr event at this
+        # replica's terminal reports the FULL path (source queue +
+        # prefill, the migration wait closed by this import, decode here)
+        self.attrib.import_ctx(req_id, handoff.trace_ctx)
         self._serve_event("serve/admit", req_id=req_id,
                           queue_depth=len(self.queue),
                           free_pages=self.alloc.free_page_count)
@@ -942,6 +973,7 @@ class ServingEngine:
         if entry is None:
             return False
         slot, _, _ = entry
+        self.attrib.discard(req_id)
         self.alloc.free_sequence(req_id)
         self._rng.pop(req_id, None)
         self.slots[slot] = None
@@ -1022,10 +1054,15 @@ class ServingEngine:
         suffix = req.prompt[cached:]
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :len(suffix)] = suffix
+        t0 = self._clock()
         logits, self.caches, _ = self._run_step(
             jnp.asarray(ids),
             jnp.asarray(self.tables[slot:slot + 1]),
             jnp.full((1,), cached, jnp.int32), phase="prefill")
+        # monolithic prefill is one dispatch: fold its active wall time
+        # into the critical path's prefill stage (chunked prefills land
+        # here per chunk via the scheduler)
+        self.attrib.chunk(req.req_id, (self._clock() - t0) * 1000.0)
         self.lengths[slot] = len(req.prompt)
         req.prefilled = len(req.prompt)
         req.last_token = self._sample(
@@ -1039,6 +1076,7 @@ class ServingEngine:
         """TTFT bookkeeping shared by the monolithic prefill and the
         chunked policy's final prefill chunk."""
         tr = self.tracer.first_token(req.req_id)
+        self.attrib.first_token(req.req_id)
         if tr is not None:
             self._observe_ms("serve/ttft_ms", tr.ttft_ms())
             self._serve_event("serve/request/first_token",

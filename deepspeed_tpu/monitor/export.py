@@ -167,6 +167,18 @@ class _Handler(BaseHTTPRequestHandler):
                 except Exception as e:   # a snapshot must not 500 a scrape
                     self._reply(503, json.dumps({"error": str(e)}),
                                 "application/json")
+        elif path == "/attribution":
+            if self.exporter.attribution_fn is None:
+                self._reply(404, '{"error": "no attribution plane"}',
+                            "application/json")
+            else:
+                try:
+                    body = json.dumps(self.exporter.attribution_fn(),
+                                      default=str)
+                    self._reply(200, body, "application/json")
+                except Exception as e:   # a snapshot must not 500 a scrape
+                    self._reply(503, json.dumps({"error": str(e)}),
+                                "application/json")
         elif path == "/healthz":
             health = {"ok": True}
             # profiling plane: liveness scrapers get the recompile-storm
@@ -199,7 +211,8 @@ class MetricsExporter:
     """
 
     def __init__(self, telemetry, host="127.0.0.1", port=9866, labels=None,
-                 cluster_fn=None, fleet_fn=None, incidents_fn=None):
+                 cluster_fn=None, fleet_fn=None, incidents_fn=None,
+                 attribution_fn=None):
         self.telemetry = telemetry
         # distributed mode: per-sample labels ({"rank": "0"}) and the
         # shard aggregator behind GET /cluster
@@ -210,6 +223,10 @@ class MetricsExporter:
         self.fleet_fn = fleet_fn
         # incident plane: IncidentManager.snapshot behind GET /incidents
         self.incidents_fn = incidents_fn
+        # attribution plane: AttributionPlane.snapshot behind
+        # GET /attribution — per-step decompositions + recent request
+        # critical paths; 404 until the telemetry.attribution block is on
+        self.attribution_fn = attribution_fn
         handler = type("_BoundHandler", (_Handler,), {"exporter": self})
         self._server = ThreadingHTTPServer((host, int(port)), handler)
         self._server.daemon_threads = True

@@ -192,6 +192,7 @@ def correlate(events, window_s=1.0):
     compiles = []    # (ts, entry)
     mem_peaks = []   # (ts, entry)
     collectives = [] # (ts, entry)
+    attr_by_req = {} # req_id -> critical-path stage breakdown
     for ev in events:
         try:
             ts = float(ev.get("ts", 0.0))
@@ -206,7 +207,14 @@ def correlate(events, window_s=1.0):
         if isinstance(step, int) and not isinstance(step, bool):
             w["steps"].add(step)
         kind, name = ev.get("kind"), str(ev.get("name", ""))
-        if kind == "serve" and name.startswith("serve/request/"):
+        if kind == "serve" and name == "serve/request/attr":
+            # critical-path record, NOT a lifecycle terminal: keep the
+            # stage breakdown for the links below instead of letting it
+            # read as a bogus "attr" terminal in the request list
+            attrs = ev.get("attrs") or {}
+            if attrs.get("req_id") is not None:
+                attr_by_req[attrs["req_id"]] = dict(attrs)
+        elif kind == "serve" and name.startswith("serve/request/"):
             attrs = ev.get("attrs") or {}
             req_id = attrs.get("req_id")
             terminal = name.rsplit("/", 1)[1]
@@ -237,10 +245,16 @@ def correlate(events, window_s=1.0):
         near = lambda items: [e for t, e in items if abs(t - ts) <= window_s]
         cm, mp, co = near(compiles), near(mem_peaks), near(collectives)
         if cm or mp or co:
-            links.append({"req_id": req_id, "ts": round(ts, 6),
-                          "window": int(ts // window_s),
-                          "compile_misses": cm, "mem_peak_bytes": mp,
-                          "collectives": co})
+            link = {"req_id": req_id, "ts": round(ts, 6),
+                    "window": int(ts // window_s),
+                    "compile_misses": cm, "mem_peak_bytes": mp,
+                    "collectives": co}
+            # attribution plane: WHERE the missed request's time went —
+            # the stage breakdown turns "missed near a compile storm"
+            # into "spent 400ms in queue, 30ms computing"
+            if req_id in attr_by_req:
+                link["attribution"] = attr_by_req[req_id]
+            links.append(link)
     out = []
     for idx in sorted(windows):
         w = windows[idx]

@@ -300,6 +300,20 @@ def _coerce_incidents(icfg):
             "max_bundles": int(get("max_bundles", 16))}
 
 
+def _coerce_attribution(acfg):
+    """``telemetry.attribution`` block as a plain dict — accepts the
+    TelemetryAttributionConfig object, a raw dict (hand-built configs),
+    or None (block absent: attribution plane off)."""
+    defaults = {"enabled": False, "history": 64, "serve_history": 256}
+    if acfg is None:
+        return defaults
+    get = (acfg.get if isinstance(acfg, dict)
+           else lambda k, d: getattr(acfg, k, d))
+    return {"enabled": bool(get("enabled", False)),
+            "history": int(get("history", 64)),
+            "serve_history": int(get("serve_history", 256))}
+
+
 # ----------------------------------------------------------------------
 # the telemetry object
 # ----------------------------------------------------------------------
@@ -321,6 +335,7 @@ class Telemetry:
         self.cluster = None
         self.profiling = None
         self.incidents = None
+        self.attribution = None
         self._stamp_rank = False
 
     def configure(self, config=None, rank=None):
@@ -346,6 +361,7 @@ class Telemetry:
         self.cluster = None
         self.profiling = None
         self.incidents = None
+        self.attribution = None
         self._stamp_rank = False
         self.config = config
         self.enabled = bool(config is not None and config.enabled)
@@ -358,6 +374,14 @@ class Telemetry:
             # EVERY rank (registry + events; the sink gates writes)
             from deepspeed_tpu.monitor.profiling import ProfilingPlane
             self.profiling = ProfilingPlane(self, **pcfg)
+        acfg = _coerce_attribution(getattr(config, "attribution", None))
+        if acfg.pop("enabled"):
+            # time-attribution plane (monitor/attribution.py): per-step
+            # exposed-comm decomposition tapped into emit() like the
+            # incident ring, closed by the watchdog heartbeat (or the
+            # engine's direct beat when the watchdog is off)
+            from deepspeed_tpu.monitor.attribution import AttributionPlane
+            self.attribution = AttributionPlane(self, **acfg)
         if rank is None:
             try:
                 import jax
@@ -426,10 +450,13 @@ class Telemetry:
                           if self.cluster is not None else None)
             incidents_fn = (self.incidents.snapshot
                             if self.incidents is not None else None)
+            attribution_fn = (self.attribution.snapshot
+                              if self.attribution is not None else None)
             self.exporter = MetricsExporter(self, host=host, port=port,
                                             labels=labels,
                                             cluster_fn=cluster_fn,
-                                            incidents_fn=incidents_fn)
+                                            incidents_fn=incidents_fn,
+                                            attribution_fn=attribution_fn)
             self.exporter.start()
         except Exception as e:
             logger.warning(f"metrics exporter failed to start: {e}")
@@ -451,7 +478,9 @@ class Telemetry:
     # -- events --------------------------------------------------------
     def emit(self, kind, name, **fields):
         incidents = self.incidents
-        if not self.enabled or (self.sink is None and incidents is None):
+        attribution = self.attribution
+        if not self.enabled or (self.sink is None and incidents is None
+                                and attribution is None):
             return
         event = {"ts": round(time.time(), 6), "kind": kind, "name": name}
         if self._stamp_rank:
@@ -463,6 +492,12 @@ class Telemetry:
             # flight recorder sees every event on every rank — the sink
             # below may be rank-0-gated, the black box is not
             incidents.record(event)
+        if attribution is not None:
+            # attribution plane folds span/comm/compile intervals into
+            # the pending step and closes it on the heartbeat; its own
+            # gauge emissions recurse here once and fall through the
+            # plane's kind filter (re-entrancy safe by construction)
+            attribution.record(event)
         if self.sink is not None:
             self.sink.emit(event)
 
@@ -602,6 +637,7 @@ class Telemetry:
         self.cluster = None
         self.profiling = None
         self.incidents = None
+        self.attribution = None
         self._stamp_rank = False
         self.enabled = False
 

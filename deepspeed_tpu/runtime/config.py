@@ -235,6 +235,28 @@ class TelemetryIncidentsConfig(DeepSpeedConfigModel):
                     "[window_s > 0, 0 < miss_rate <= 1] pairs")
 
 
+class TelemetryAttributionConfig(DeepSpeedConfigModel):
+    """``"telemetry.attribution"`` block: the time-attribution plane
+    (``monitor/attribution.py``) — per-step exposed-comm decomposition
+    into the frozen ``step/attr/*`` gauges (compute / exposed collective
+    / input wait / host sync / compile, headline
+    ``exposed_comm_frac``) plus the exporter's ``GET /attribution``
+    snapshot of recent step decompositions and serving critical paths.
+    Off by default; enabled it costs one interval append per
+    span/comm/compile event."""
+    enabled = False
+    history = 64                    # per-step decompositions retained
+    serve_history = 256             # serving critical paths retained
+
+    def _validate(self):
+        if int(self.history) < 1:
+            raise ValueError(
+                "telemetry.attribution.history must be >= 1")
+        if int(self.serve_history) < 1:
+            raise ValueError(
+                "telemetry.attribution.serve_history must be >= 1")
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """``"telemetry"`` block: the unified JSONL event stream
     (``monitor/telemetry.py``) plus the step-stall watchdog and the
@@ -253,6 +275,7 @@ class TelemetryConfig(DeepSpeedConfigModel):
     distributed = {}                # TelemetryDistributedConfig sub-block
     profiling = {}                  # TelemetryProfilingConfig sub-block
     incidents = {}                  # TelemetryIncidentsConfig sub-block
+    attribution = {}                # TelemetryAttributionConfig sub-block
 
     def _validate(self):
         if not isinstance(self.export, TelemetryExportConfig):
@@ -264,6 +287,9 @@ class TelemetryConfig(DeepSpeedConfigModel):
             self.profiling = TelemetryProfilingConfig(self.profiling or {})
         if not isinstance(self.incidents, TelemetryIncidentsConfig):
             self.incidents = TelemetryIncidentsConfig(self.incidents or {})
+        if not isinstance(self.attribution, TelemetryAttributionConfig):
+            self.attribution = TelemetryAttributionConfig(
+                self.attribution or {})
 
 
 class AsyncPipelineConfig(DeepSpeedConfigModel):

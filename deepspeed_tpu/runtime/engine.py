@@ -1436,6 +1436,10 @@ class DeepSpeedEngine:
         step = self.global_steps
         if self._watchdog is not None:
             self._watchdog.beat(step)
+        elif getattr(tel, "attribution", None) is not None:
+            # no watchdog heartbeat to close the attribution window —
+            # beat the plane directly (same beat-to-beat step_ms contract)
+            tel.attribution.beat(step)
         if metrics is not None:
             vals = {"engine/loss": metrics.loss,
                     "engine/grad_norm": metrics.grad_norm}

@@ -208,6 +208,13 @@ SERVE_EVENTS = (
     "serve/request/first_token",
     "serve/request/finish", "serve/request/shed",
     "serve/request/deadline", "serve/request/evict",
+    # critical-path attribution (monitor/attribution.py): one record
+    # adjacent to each terminal carrying the ordered stage breakdown
+    # (queue/prefill/migrate/gap/decode _ms attrs, summing to e2e_ms by
+    # construction), the terminal it pairs with, chunk count, whether
+    # the request crossed a prefill->decode migration, and the "path"
+    # flow string ds_trace_export renders as arrows
+    "serve/request/attr",
 )
 
 # FROZEN vocabulary of fleet-kind event names — must stay byte-identical
@@ -294,6 +301,24 @@ INCIDENT_EVENTS = ("incident/open", "incident/written")
 INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
                      "replica_kill", "replica_fence", "slo_burn")
 
+# FROZEN vocabularies of the time-attribution plane — each must stay
+# byte-identical to its twin in ``deepspeed_tpu.monitor.attribution``
+# (the tier-1 test diffs both pairs).  STEP_ATTR_GAUGES is the per-step
+# decomposition gauge family (every gauge event under the ``step/attr/``
+# prefix is validated against it); ATTR_STAGES is the ordered stage
+# vocabulary of the ``serve/request/attr`` critical-path record — its
+# attrs must carry one ``<stage>_ms`` per entry plus ``e2e_ms`` the
+# stages sum to.
+STEP_ATTR_GAUGES = (
+    "step/attr/compute_ms",
+    "step/attr/exposed_comm_ms",
+    "step/attr/input_wait_ms",
+    "step/attr/host_sync_ms",
+    "step/attr/compile_ms",
+    "step/attr/exposed_comm_frac",
+)
+ATTR_STAGES = ("queue", "prefill", "migrate", "gap", "decode")
+
 EVENT_KINDS = tuple(SCHEMA)
 
 
@@ -328,6 +353,17 @@ def validate_event(event):
     if kind == "serve" and isinstance(event.get("name"), str) and \
             event["name"] not in SERVE_EVENTS:
         problems.append(f"serve: unknown event name {event['name']!r}")
+    if kind == "serve" and event.get("name") == "serve/request/attr":
+        attrs = event.get("attrs")
+        if not isinstance(attrs, dict):
+            problems.append("serve: serve/request/attr carries no attrs")
+        else:
+            for key in tuple(f"{s}_ms" for s in ATTR_STAGES) + ("e2e_ms",):
+                v = attrs.get(key)
+                if not isinstance(v, _NUM) or isinstance(v, bool):
+                    problems.append(
+                        f"serve: serve/request/attr attr {key!r} is "
+                        f"{type(v).__name__}, not a number")
     if kind == "fleet" and isinstance(event.get("name"), str) and \
             event["name"] not in FLEET_EVENTS:
         problems.append(f"fleet: unknown event name {event['name']!r}")
@@ -345,6 +381,11 @@ def validate_event(event):
             event["name"].startswith("comm/") and \
             event["name"] not in QUANT_GAUGES:
         problems.append(f"gauge: unknown comm gauge {event['name']!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str) and \
+            event["name"].startswith("step/attr/") and \
+            event["name"] not in STEP_ATTR_GAUGES:
+        problems.append(
+            f"gauge: unknown step/attr gauge {event['name']!r}")
     if kind == "compile" and isinstance(event.get("name"), str):
         if event["name"] not in COMPILE_EVENTS:
             problems.append(

@@ -46,6 +46,21 @@ DEFAULT_LEDGER = os.environ.get(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                  "BENCH_LEDGER.jsonl"))
 
+# exact-name direction overrides, checked BEFORE the substring
+# heuristics: the attribution plane's exposed-comm fraction is a "frac"
+# the heuristics would read as higher-is-better, but exposed collective
+# time is pure loss — and every critical-path stage scalar is a
+# millisecond cost even where the suffix heuristic can't see it.
+_DIRECTION_OVERRIDES = {
+    "exposed_comm_frac": "down",
+    "exposed_comm_ms": "down",
+    "host_sync_ms": "down",
+    "input_wait_ms": "down",
+    "queue_ms": "down",
+    "migrate_ms": "down",
+    "gap_ms": "down",
+}
+
 # metric-name direction heuristics: substring/suffix -> True when lower
 # is better.  Checked in order; first hit wins.
 _LOWER_BETTER = ("_ms", "_s", "_secs", "_seconds", "_bytes")
@@ -68,6 +83,9 @@ def metric_direction(metric):
     """'down' when lower is better, 'up' when higher is better, None when
     the name matches neither heuristic (such metrics never gate)."""
     m = metric.lower()
+    for name, direction in _DIRECTION_OVERRIDES.items():
+        if m == name or m.endswith("_" + name):
+            return direction
     for pat in _HIGHER_BETTER:
         if pat in m:
             return "up"

@@ -62,7 +62,13 @@ def discover_files(target):
 
 def load_events(files):
     for path in files:
-        with open(path) as f:
+        try:
+            f = open(path)
+        except OSError as e:
+            print(f"WARN: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        with f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -92,6 +98,7 @@ def aggregate(events):
     fleet_roles = {} # replica id -> role (disaggregated fleets)
     requests = []    # reconstructed serve/request/* lifecycle traces
     open_reqs = {}   # req_id -> index into requests (trace not yet closed)
+    closed_reqs = {} # req_id -> last closed trace index (attr attaches here)
     compiles = {"sites": {}, "storms": 0, "total_misses": 0}
     tunes = {"trials": {}, "pruned": {}, "overlay": None}
     for ev in events:
@@ -245,6 +252,25 @@ def aggregate(events):
                 # "admitted" after a terminal opens a NEW trace
                 stage = ev["name"].rsplit("/", 1)[1]
                 rid = attrs.get("req_id")
+                if stage == "attr":
+                    # critical-path attribution (emitted adjacent to the
+                    # terminal): total per-stage milliseconds for the
+                    # attribution digest and pin the breakdown onto the
+                    # just-closed trace
+                    for k in ("queue_ms", "prefill_ms", "migrate_ms",
+                              "gap_ms", "decode_ms", "e2e_ms"):
+                        if attrs.get(k) is not None:
+                            rec[k] = rec.get(k, 0.0) + float(attrs[k])
+                    rec["migrated"] = rec.get("migrated", 0) + \
+                        int(attrs.get("migrated") or 0)
+                    idx = closed_reqs.get(rid)
+                    if idx is not None:
+                        requests[idx]["attr"] = {
+                            k: attrs[k] for k in
+                            ("queue_ms", "prefill_ms", "migrate_ms",
+                             "gap_ms", "decode_ms", "e2e_ms", "path")
+                            if attrs.get(k) is not None}
+                    continue
                 if stage == "admitted":
                     open_reqs[rid] = len(requests)
                     requests.append({"req_id": rid, "t_admit": ev["ts"],
@@ -270,6 +296,7 @@ def aggregate(events):
                               "e2e_ms"):
                         if attrs.get(k) is not None:
                             trace[k] = attrs[k]
+                    closed_reqs[rid] = idx
                     del open_reqs[rid]
     return {"spans": spans, "comms": comms, "gauges": gauges,
             "heartbeats": heartbeats, "rank_steps": rank_steps,
@@ -326,6 +353,7 @@ def summarize(agg):
     return {"spans": span_rows, "comms": comm_rows, "gauges": gauge_rows,
             "heartbeat": heartbeat,
             "profiling": _profiling_summary(agg),
+            "attribution": _attribution_summary(agg),
             "cluster": _cluster_summary(agg),
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
@@ -449,6 +477,33 @@ def _profiling_summary(agg):
     return {"compile": {"total_misses": comp["total_misses"],
                         "storms": comp["storms"], "sites": sites},
             "mem": mem, "roofline": roofline}
+
+
+def _attribution_summary(agg):
+    """Attribution-plane digest (monitor/attribution.py): the training
+    step decomposition from the frozen ``step/attr/*`` gauges — the same
+    numbers the roofline tables sit next to — and the serving
+    critical-path stage totals summed over every ``serve/request/attr``
+    event.  None when the stream carries neither."""
+    step = {name.rsplit("/", 1)[1]: {"last": g["last"], "peak": g["peak"]}
+            for name, g in sorted(agg["gauges"].items())
+            if name.startswith("step/attr/")}
+    attr = agg.get("serves", {}).get("serve/request/attr", {})
+    serving = None
+    if attr.get("count"):
+        e2e = attr.get("e2e_ms", 0.0)
+        stages = {}
+        for k in ("queue_ms", "prefill_ms", "migrate_ms", "gap_ms",
+                  "decode_ms"):
+            ms = attr.get(k, 0.0)
+            stages[k] = {"total_ms": round(ms, 3),
+                         "frac": round(ms / e2e, 4) if e2e else None}
+        serving = {"requests": attr["count"],
+                   "migrated": attr.get("migrated", 0),
+                   "e2e_ms": round(e2e, 3), "stages": stages}
+    if not step and not serving:
+        return None
+    return {"step": step or None, "serving": serving}
 
 
 def _cluster_summary(agg):
@@ -770,6 +825,24 @@ def print_tables(summary, out=sys.stdout):
                                      rec["last"], (int, float)) else "-")
                 w(f"{span:<16}{cells[0]:>10}{cells[1]:>11}\n")
             w("\n")
+    at = summary.get("attribution")
+    if at:
+        w("== attribution ==\n")
+        if at.get("step"):
+            w("step decomposition (last / peak):\n")
+            for name, r in at["step"].items():
+                w(f"  {name:<20}{r['last']:>12}{r['peak']:>12}\n")
+        sv = at.get("serving")
+        if sv:
+            w(f"requests attributed: {sv['requests']} "
+              f"({sv['migrated']} migrated)  "
+              f"e2e total: {sv['e2e_ms']} ms\n")
+            w(f"{'stage':<12}{'total_ms':>12}{'share':>8}\n")
+            for k, r in sv["stages"].items():
+                share = (f"{r['frac'] * 100:.1f}%"
+                         if r["frac"] is not None else "-")
+                w(f"{k[:-3]:<12}{r['total_ms']:>12}{share:>8}\n")
+        w("\n")
     feed = summary.get("input_feed")
     if feed:
         w("== input feed (engine/input_wait) ==\n")
@@ -994,7 +1067,21 @@ def main(argv=None):
     if not files:
         print(f"no events.jsonl under {args.target!r}", file=sys.stderr)
         return 1
-    summary = summarize(aggregate(load_events(files)))
+    events = list(load_events(files))
+    if not events and os.path.isdir(args.target):
+        # a shard dir holding only torn/empty events.rank*.jsonl files
+        # must not take the report down with it: degrade to the
+        # single-stream events.jsonl path with a warning
+        single = [
+            p for p in
+            _with_rotations(os.path.join(args.target, "events.jsonl"))
+            if p not in files]
+        if single:
+            print("WARN: shard files held no parseable events; falling "
+                  "back to the single-stream events.jsonl",
+                  file=sys.stderr)
+            events = list(load_events(single))
+    summary = summarize(aggregate(events))
     if args.json:
         json.dump(summary, sys.stdout, indent=2)
         print()

@@ -22,6 +22,11 @@ Mapping (one rank = one trace process):
   (``"b"`` at admitted, ``"n"`` at prefill_start / first_token,
   ``"e"`` at the terminal) keyed by ``req_id`` — each request renders
   as one async track spanning admission to terminal.
+* ``serve/request/attr`` critical-path events become contiguous
+  per-stage ``"X"`` slices (queue → prefill → migrate → gap → decode)
+  ending at the terminal ts on a "critical path" track, chained by
+  flow arrows so each request's attribution reads as one arrow
+  through its stages.
 * ``gauge`` / ``counter`` events become ``"C"`` counter events.
 * everything else (stall, compile, fleet, fault, incident, meta,
   heartbeat, remaining serve events) becomes ``"i"`` instants.
@@ -51,6 +56,12 @@ TID_SPANS = 1
 TID_COMM = 2
 TID_INSTANTS = 3
 TID_REQUESTS = 4
+TID_ATTR = 5
+
+# ordered stage vocabulary of serve/request/attr (mirrors
+# monitor/attribution.py ATTR_STAGES — the lockstep schema test pins
+# the source tuples; this copy only orders the rendered slices)
+_ATTR_STAGES = ("queue", "prefill", "migrate", "gap", "decode")
 
 _ASYNC_BEGIN = ("serve/request/admitted",)
 _ASYNC_STEP = ("serve/request/prefill_start", "serve/request/first_token")
@@ -138,6 +149,11 @@ def convert(events):
         if ev.get("kind") in ("span", "comm") and \
                 isinstance(ev.get("dur_ms"), _NUM):
             return ts - max(0.0, float(ev["dur_ms"])) / 1000.0
+        if ev.get("kind") == "serve" and \
+                ev.get("name") == "serve/request/attr":
+            e2e = _args(ev).get("e2e_ms")
+            if isinstance(e2e, _NUM):
+                return ts - max(0.0, float(e2e)) / 1000.0
         return ts
 
     t0 = min(_start(e) for e in events)
@@ -182,6 +198,46 @@ def convert(events):
                               "pid": rank, "tid": TID_INSTANTS,
                               "ts": ts_us, "s": "t", "args": _args(ev)})
                 tids_used[(rank, TID_INSTANTS)] = "events"
+        elif kind == "serve" and name == "serve/request/attr":
+            # critical-path attribution: lay the ordered stage
+            # decomposition out as contiguous slices ending at the
+            # terminal ts (the stages sum to e2e_ms by construction),
+            # then chain them with flow arrows keyed by req_id
+            args = _args(ev)
+            req_id = str(args.get("req_id", "?"))
+            e2e = args.get("e2e_ms")
+            e2e_us = max(0.0, float(e2e)) * 1000.0 \
+                if isinstance(e2e, _NUM) else 0.0
+            # clamp: ts_us is rounded to 0.1us, so the anchor event's
+            # reconstructed start can dip fractionally below the origin
+            cursor = max(0.0, ts_us - e2e_us)
+            stage_starts = []
+            for stage in _ATTR_STAGES:
+                ms = args.get(f"{stage}_ms")
+                if not isinstance(ms, _NUM) or ms <= 0:
+                    continue
+                dur_us = float(ms) * 1000.0
+                trace.append({"ph": "X", "name": f"attr/{stage}",
+                              "cat": "attr", "pid": rank,
+                              "tid": TID_ATTR,
+                              "ts": round(cursor, 1),
+                              "dur": round(dur_us, 1),
+                              "args": dict(args)})
+                stage_starts.append(cursor)
+                cursor += dur_us
+                tids_used[(rank, TID_ATTR)] = "critical path"
+            if len(stage_starts) >= 2:
+                flow_id = f"attr:{req_id}"
+                last = len(stage_starts) - 1
+                for i, start_us in enumerate(stage_starts):
+                    ph = "s" if i == 0 else ("f" if i == last else "t")
+                    rec = {"ph": ph, "name": "critical-path",
+                           "cat": "attr-flow", "id": flow_id,
+                           "pid": rank, "tid": TID_ATTR,
+                           "ts": round(start_us + 0.1, 1)}
+                    if ph == "f":
+                        rec["bp"] = "e"
+                    trace.append(rec)
         elif kind == "serve" and name.startswith("serve/request/"):
             args = _args(ev)
             req_id = str(args.get("req_id", "?"))

@@ -8,6 +8,8 @@
 #   3. check_telemetry_schema.py --incidents         incident bundles
 #   4. ds_perf_diff.py --check                       perf regression gate
 #   5. check_telemetry_schema.py --tune              tune journals/overlay
+#   6. comm-quant smoke                              int8 codec roundtrip
+#   7. ds_trace_export.py --check                    Perfetto trace export
 #
 # TELEMETRY_DIR (optional) is searched recursively for events*.jsonl
 # streams; INCIDENTS_DIR (optional) holds incident bundles; TUNE_DIR
@@ -134,6 +136,32 @@ assert all(ev["name"] in checker.QUANT_GAUGES for ev in gauges)
 print(f"quant smoke: saved {int(saved)} bytes, rel err {err:.4f}, "
       f"{len(events)} schema-valid events")
 EOF
+
+# 7. trace export: every telemetry stream found under TELEMETRY_DIR must
+# convert to a valid Chrome trace-event file (attribution flow arrows
+# included) — the exporter is the debugging path of last resort, so a
+# stream it chokes on is a gate failure, not a rendering nit
+if [ -n "$TELEMETRY_DIR" ] && [ -d "$TELEMETRY_DIR" ]; then
+    mapfile -t trace_dirs < <(find "$TELEMETRY_DIR" -name 'events*.jsonl' \
+                                   -type f -exec dirname {} \; |
+                              sort -u)
+    if [ "${#trace_dirs[@]}" -gt 0 ]; then
+        trace_tmp="$(mktemp -d)"
+        trap 'rm -rf "$trace_tmp"' EXIT
+        i=0
+        for d in "${trace_dirs[@]}"; do
+            run_gate "trace export ($d)" \
+                "$PY" "$REPO/scripts/ds_trace_export.py" "$d" \
+                --check -o "$trace_tmp/trace.$i.json"
+            i=$((i + 1))
+        done
+    else
+        echo "== gate: trace export == SKIP (no events*.jsonl under" \
+             "$TELEMETRY_DIR)"
+    fi
+else
+    echo "== gate: trace export == SKIP (no telemetry dir given)"
+fi
 
 if [ "$fail" -ne 0 ]; then
     echo "GATES: FAIL"

@@ -1,0 +1,410 @@
+"""Time-attribution plane tests (monitor/attribution.py): the interval
+algebra and its precedence decomposition, the telemetry-tapped
+AttributionPlane (frozen ``step/attr/*`` gauges, ``/attribution``
+endpoint), the wire-propagable RequestAttributor, and the end-to-end
+FakeClock invariant this plane exists to guarantee — every traced
+serving request's stage attributions sum to its traced e2e latency,
+including requests that cross a prefill -> decode migration with their
+TraceContext round-tripped through a serialized PrefillHandoff under
+injected migration faults."""
+
+import importlib.util
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.fleet import FleetRouter
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.monitor.attribution import (ATTR_STAGES,
+                                               STEP_ATTR_GAUGES,
+                                               RequestAttributor,
+                                               TraceContext,
+                                               decompose_step,
+                                               merge_intervals,
+                                               overlap_length,
+                                               request_stages,
+                                               total_length)
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+from deepspeed_tpu.runtime.resilience import FaultInjector
+
+# sum of the rounded per-stage values vs the rounded e2e: each of the
+# five stages contributes at most 0.5e-3 ms of rounding — 0.01 ms is
+# an order of magnitude of headroom, zero behavioral slack
+SUM_TOL_MS = 0.01
+
+
+def _load_script(name):
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class Tick:
+    """Deterministic fake clock: every read advances 1 ms, so every
+    stage of every request gets a nonzero, reproducible duration."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 0.001
+        return self.t
+
+
+# ----------------------------------------------------------------------
+# interval algebra + precedence decomposition
+# ----------------------------------------------------------------------
+def test_interval_algebra():
+    assert merge_intervals([(3, 4), (1, 2), (1.5, 3.5)]) == [(1.0, 4.0)]
+    assert merge_intervals([(1, 1), (2, 1)]) == []   # degenerate dropped
+    assert total_length([(0, 1), (0.5, 2), (3, 4)]) == pytest.approx(3.0)
+    assert overlap_length([(0, 10)], [(2, 3), (5, 7)]) == \
+        pytest.approx(3.0)
+    assert overlap_length([(0, 1)], [(2, 3)]) == 0.0
+
+
+def test_decompose_components_sum_to_step():
+    rec = decompose_step(0.0, 0.1,
+                         compute=[(0.010, 0.040), (0.045, 0.085)],
+                         comm=[(0.030, 0.060)],
+                         input_wait=[(0.000, 0.010)])
+    parts = (rec["compute_ms"] + rec["exposed_comm_ms"] +
+             rec["input_wait_ms"] + rec["host_sync_ms"] +
+             rec["compile_ms"])
+    assert parts == pytest.approx(rec["step_ms"], abs=SUM_TOL_MS)
+    # the collective overlaps 25 of its 30 ms with compute: only the
+    # 5 ms inter-span gap is exposed
+    assert rec["exposed_comm_ms"] == pytest.approx(5.0, abs=1e-6)
+    assert rec["exposed_comm_frac"] == pytest.approx(0.05, rel=0.02)
+
+
+def test_decompose_compile_precedence_no_double_count():
+    """A compile nested inside the forward span (the cache-miss reality)
+    counts once as compile, not again as compute."""
+    rec = decompose_step(0.0, 0.1,
+                         compute=[(0.010, 0.090)],
+                         compiles=[(0.020, 0.050)])
+    assert rec["compile_ms"] == pytest.approx(30.0)
+    assert rec["compute_ms"] == pytest.approx(50.0)
+    assert rec["host_sync_ms"] == pytest.approx(20.0)
+
+
+def test_decompose_exposed_frac_matches_analytic_workload():
+    """The acceptance construction: per-rank comm skew shifts which
+    compute span the collective overlaps but never its total, so the
+    exposed fraction is exactly 0.05 at every skew (within 2%)."""
+    for skew_ms in range(4):
+        k = skew_ms / 1000.0
+        rec = decompose_step(0.0, 0.1,
+                             compute=[(0.010, 0.040), (0.045, 0.085)],
+                             comm=[(0.030 + k, 0.060 + k)],
+                             input_wait=[(0.000, 0.010)])
+        assert rec["exposed_comm_frac"] == pytest.approx(0.05, rel=0.02)
+
+
+# ----------------------------------------------------------------------
+# the telemetry-tapped plane
+# ----------------------------------------------------------------------
+def test_plane_decomposes_steps_and_serves_endpoint(tmp_path):
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "attr", "export": {"enabled": True, "port": 0},
+         "attribution": {"enabled": True, "history": 8}}), rank=0)
+    try:
+        plane = tel.attribution
+        assert plane is not None
+        import time
+        base = time.time()
+        for s in range(3):
+            w0 = base + s
+            plane.record({"ts": w0 + 0.040, "kind": "span",
+                          "name": "engine/forward", "dur_ms": 30.0})
+            plane.record({"ts": w0 + 0.085, "kind": "span",
+                          "name": "engine/backward", "dur_ms": 40.0})
+            plane.record({"ts": w0 + 0.060, "kind": "comm",
+                          "name": "all_reduce", "dur_ms": 30.0})
+            plane.record({"ts": w0 + 0.100, "kind": "heartbeat",
+                          "name": "engine/step", "step": s,
+                          "step_ms": 100.0})
+        snap = plane.snapshot()
+        assert snap["steps_attributed"] == 3
+        for rec in snap["steps"]:
+            parts = sum(rec[k] for k in
+                        ("compute_ms", "exposed_comm_ms",
+                         "input_wait_ms", "host_sync_ms", "compile_ms"))
+            assert parts == pytest.approx(rec["step_ms"],
+                                          abs=SUM_TOL_MS)
+        host, port = tel.exporter.address
+        scraped = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/attribution", timeout=5).read())
+        assert scraped["steps_attributed"] == 3
+        assert scraped["last"]["exposed_comm_frac"] == \
+            pytest.approx(0.05, rel=0.02)
+    finally:
+        tel.close()
+    checker = _load_script("check_telemetry_schema")
+    path = os.path.join(str(tmp_path), "attr", "events.jsonl")
+    assert checker.validate_file(path) == []
+    with open(path) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    names = {e["name"] for e in events if e["kind"] == "gauge"
+             and e["name"].startswith("step/attr/")}
+    assert names == set(STEP_ATTR_GAUGES)
+
+
+def test_plane_off_means_attribute_is_none(tmp_path):
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "noattr"}), rank=0)
+    try:
+        assert tel.attribution is None
+    finally:
+        tel.close()
+
+
+def test_first_beat_only_arms_the_window(tmp_path):
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "beat", "attribution": {"enabled": True}}), rank=0)
+    try:
+        plane = tel.attribution
+        plane.beat(0, now=10.0)
+        assert plane.steps_attributed == 0
+        plane.beat(1, now=10.1)
+        assert plane.steps_attributed == 1
+        assert plane.history[-1]["step_ms"] == pytest.approx(100.0)
+    finally:
+        tel.close()
+
+
+# ----------------------------------------------------------------------
+# the serving half: RequestAttributor + TraceContext wire round-trip
+# ----------------------------------------------------------------------
+def test_trace_context_wire_is_plain_primitives():
+    ctx = TraceContext(req_id="r1", t_admit=1.0, t_prefill_start=1.5,
+                       prefill_active_ms=12.5, chunks=3)
+    wire = ctx.to_wire()
+    assert json.loads(json.dumps(wire)) == wire   # wire-ready
+    back = TraceContext.from_wire(wire)
+    assert back.migrated                          # crossing marks it
+    assert back.t_admit == 1.0 and back.chunks == 3
+
+
+def test_request_stages_sum_exactly():
+    ctx = TraceContext(req_id="r", t_admit=0.0, t_prefill_start=0.040,
+                       t_first_token=0.100, t_handoff=0.080,
+                       t_import=0.095, prefill_active_ms=25.0, chunks=2,
+                       migrated=True)
+    st = request_stages(ctx, 0.200)
+    assert st["queue_ms"] == pytest.approx(40.0)
+    assert st["prefill_ms"] == pytest.approx(25.0)
+    assert st["migrate_ms"] == pytest.approx(15.0)
+    assert st["decode_ms"] == pytest.approx(85.0)
+    assert sum(st[f"{s}_ms"] for s in ATTR_STAGES) == \
+        pytest.approx(st["e2e_ms"], abs=1e-9)
+
+
+def test_attributor_migration_roundtrip_fake_clock():
+    clock = Tick()
+    src = RequestAttributor(clock=clock)
+    dst = RequestAttributor(clock=clock)
+    src.admit("m1")
+    src.prefill_start("m1")
+    src.chunk("m1", 0.4)
+    src.first_token("m1")          # source-side TTFT
+    wire = src.capture_handoff("m1")
+    src_attrs = src.finalize("m1", "finish")
+    dst.import_ctx("m1", json.loads(json.dumps(wire)))
+    dst.first_token("m1")          # later decode-side token: must LOSE
+    attrs = dst.finalize("m1", "finish")
+    assert attrs["migrated"] == 1 and src_attrs["migrated"] == 0
+    for a in (src_attrs, attrs):
+        assert sum(a[f"{s}_ms"] for s in ATTR_STAGES) == \
+            pytest.approx(a["e2e_ms"], abs=SUM_TOL_MS)
+    # first-wins: the source's first-token timestamp survived the wire,
+    # so decode stage spans from THAT stamp, not the decode-side re-stamp
+    assert attrs["path"].startswith("queue>")
+    assert "migrate" in attrs["path"]
+    assert dst.finalize("unknown", "finish") is None
+
+
+def test_attributor_discard_and_bad_wire():
+    att = RequestAttributor(clock=Tick())
+    att.import_ctx("x", None)          # legacy handoff without ctx
+    assert att.finalize("x", "finish")["migrated"] == 0
+    att.admit("y")
+    att.discard("y")
+    assert att.finalize("y", "evict") is None
+
+
+# ----------------------------------------------------------------------
+# end to end: FakeClock fleet with injected migration faults
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    return cfg, model, params
+
+
+def test_fleet_attr_events_sum_to_e2e_under_faults(tiny, tmp_path):
+    """Every traced request in a disaggregated fleet run — with the
+    shared FakeClock and transient migration faults injected — carries
+    ``serve/request/attr`` events whose stage sum equals the traced
+    ``e2e_ms`` within tolerance, with the migrated leg's context
+    round-tripped through the serialized PrefillHandoff."""
+    cfg, model, params = tiny
+    clock = Tick()
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "attr_fleet",
+         "attribution": {"enabled": True}}), rank=0)
+
+    def factory(replica_id, epoch):
+        return ServingEngine(model, params, max_batch=4, page_size=8,
+                             max_seq=128, dtype=jnp.float32,
+                             replica_epoch=epoch, clock=clock,
+                             telemetry=tel)
+
+    try:
+        fleet = FleetRouter(
+            factory,
+            fleet={"roles": {"enabled": True, "prefill_replicas": 1,
+                             "decode_replicas": 2}},
+            telemetry=tel, clock=clock)
+        fleet.injector = FaultInjector(
+            {"page_migrate": {"fail_times": 2},
+             "migrate_commit": {"fail_times": 1}})
+        import numpy as np
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            fleet.submit(f"q{i}",
+                         rng.integers(0, cfg.vocab_size, (12,)).tolist(),
+                         max_new_tokens=4, temperature=0.7, seed=11)
+        done = fleet.join()
+        assert len(done) == 6
+    finally:
+        tel.close()
+
+    path = os.path.join(str(tmp_path), "attr_fleet", "events.jsonl")
+    checker = _load_script("check_telemetry_schema")
+    assert checker.validate_file(path) == []
+    with open(path) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    terminals, attrs_by_req, e2e_by_key = {}, {}, {}
+    for ev in events:
+        if ev.get("kind") != "serve":
+            continue
+        name, a = ev["name"], ev.get("attrs") or {}
+        if name == "serve/request/attr":
+            attrs_by_req.setdefault(a["req_id"], []).append(a)
+        elif name.startswith("serve/request/") and \
+                name.rsplit("/", 1)[1] in ("finish", "shed", "deadline",
+                                           "evict"):
+            terminals.setdefault(a["req_id"], []).append(a)
+    assert set(terminals) == {f"q{i}" for i in range(6)}
+    for rid, terms in terminals.items():
+        paths = attrs_by_req.get(rid, [])
+        # one attr event adjacent to EVERY terminal (the migrated
+        # requests close twice: source leg at handoff, full path at
+        # finish)
+        assert len(paths) == len(terms), rid
+        for a in paths:
+            stage_sum = sum(a[f"{s}_ms"] for s in ATTR_STAGES)
+            assert stage_sum == pytest.approx(a["e2e_ms"],
+                                              abs=SUM_TOL_MS), rid
+        # the decode-side leg of each migrated request crossed the wire
+        migrated = [a for a in paths if a["migrated"] == 1]
+        for a in migrated:
+            assert a["migrate_ms"] > 0, rid
+            assert "migrate" in a["path"], rid
+    # the injected faults did not cost any request its attribution, and
+    # migration did happen (prefill -> decode handoffs with trace_ctx)
+    assert any(a["migrated"] == 1
+               for paths in attrs_by_req.values() for a in paths)
+    # non-migrated attr events agree exactly with a traced terminal e2e
+    # (finalize closes on the SAME clock value the tracer stamped); the
+    # migrated full-path leg spans the ORIGINAL admission, so it must
+    # cover at least its decode-side tracer's own leg
+    for rid, terms in terminals.items():
+        term_e2es = [t["e2e_ms"] for t in terms
+                     if t.get("e2e_ms") is not None]
+        for a in attrs_by_req[rid]:
+            if a["migrated"]:
+                assert a["e2e_ms"] >= max(term_e2es) - SUM_TOL_MS, rid
+            else:
+                assert any(a["e2e_ms"] == pytest.approx(t)
+                           for t in term_e2es), rid
+
+
+# ----------------------------------------------------------------------
+# downstream surfaces: trace export flow arrows, incident correlation
+# ----------------------------------------------------------------------
+def test_trace_export_renders_attr_critical_path(tmp_path):
+    exporter = _load_script("ds_trace_export")
+    stream = tmp_path / "events.jsonl"
+    rows = [
+        {"ts": 100.0, "kind": "serve", "name": "serve/request/admitted",
+         "attrs": {"req_id": "r1"}},
+        {"ts": 100.2, "kind": "serve", "name": "serve/request/finish",
+         "attrs": {"req_id": "r1", "n_generated": 4}},
+        {"ts": 100.2, "kind": "serve", "name": "serve/request/attr",
+         "attrs": {"req_id": "r1", "terminal": "finish", "migrated": 1,
+                   "chunks": 2, "path": "queue>prefill>migrate>decode",
+                   "queue_ms": 40.0, "prefill_ms": 25.0,
+                   "migrate_ms": 15.0, "gap_ms": 35.0,
+                   "decode_ms": 85.0, "e2e_ms": 200.0}},
+    ]
+    with open(stream, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    obj = exporter.convert(exporter.load_events(str(stream)))
+    assert exporter.validate_trace(obj) == []
+    evs = obj["traceEvents"]
+    slices = [e for e in evs if e.get("cat") == "attr"]
+    assert [e["name"] for e in slices] == \
+        ["attr/queue", "attr/prefill", "attr/migrate", "attr/gap",
+         "attr/decode"]
+    # contiguous: each slice starts where the previous ended
+    for prev, cur in zip(slices, slices[1:]):
+        assert cur["ts"] == pytest.approx(prev["ts"] + prev["dur"],
+                                          abs=0.2)
+    flows = [e for e in evs if e.get("cat") == "attr-flow"]
+    assert [e["ph"] for e in flows] == ["s", "t", "t", "t", "f"]
+    assert all(e["id"] == "attr:r1" for e in flows)
+
+
+def test_correlate_links_attribution_to_requests():
+    from deepspeed_tpu.monitor.incidents import correlate
+    events = [
+        {"ts": 10.0, "kind": "serve", "name": "serve/request/deadline",
+         "attrs": {"req_id": "r1", "e2e_ms": 55.0, "slo": "miss"}},
+        {"ts": 10.0, "kind": "serve", "name": "serve/request/attr",
+         "attrs": {"req_id": "r1", "terminal": "deadline",
+                   "queue_ms": 40.0, "prefill_ms": 10.0,
+                   "migrate_ms": 0.0, "gap_ms": 2.0, "decode_ms": 3.0,
+                   "e2e_ms": 55.0, "migrated": 0, "chunks": 1,
+                   "path": "queue>prefill>decode"}},
+        {"ts": 10.1, "kind": "compile", "name": "compile/miss",
+         "site": "serve_step", "dur_ms": 30.0},
+    ]
+    out = correlate(events)
+    assert out["links"], "expected a compile<->miss correlation link"
+    link = out["links"][0]
+    assert link["req_id"] == "r1"
+    assert link["attribution"]["queue_ms"] == 40.0
+    # the attr event must NOT read as a bogus extra terminal
+    window_reqs = [r for w in out["windows"] for r in w["requests"]]
+    assert [r["event"] for r in window_reqs] == ["deadline"]

@@ -216,6 +216,20 @@ def test_accepts_every_emitter(checker, tmp_path):
     tel.serve("serve/request/evict",
               attrs={"req_id": "r9", "slot": 2, "reason": "fault",
                      "n_generated": 1, "e2e_ms": 9.0})
+    # the terminal-adjacent critical-path attribution event
+    # (monitor/attribution.py): one <stage>_ms per frozen stage, summing
+    # to e2e_ms by construction
+    tel.serve("serve/request/attr",
+              attrs={"req_id": "r6", "terminal": "finish", "migrated": 1,
+                     "chunks": 2, "path": "queue>prefill>migrate>decode",
+                     "queue_ms": 1.25, "prefill_ms": 3.0,
+                     "migrate_ms": 0.5, "gap_ms": 0.25, "decode_ms": 13.5,
+                     "e2e_ms": 18.5})
+    # the attribution plane's frozen per-step decomposition gauges
+    for attr_name in ("compute_ms", "exposed_comm_ms", "input_wait_ms",
+                      "host_sync_ms", "compile_ms"):
+        tel.gauge(f"step/attr/{attr_name}", 1.0, step=1)
+    tel.gauge("step/attr/exposed_comm_frac", 0.05, step=1)
     # the fleet router's full vocabulary — every name the checker
     # freezes must pass through the live emitter
     tel.fleet("fleet/spawn", attrs={"replica": "r0", "epoch": "r0g0"})
@@ -279,6 +293,44 @@ def test_trace_terminals_are_tail_of_serve_vocabulary(checker):
     from deepspeed_tpu.inference.robustness import TRACE_TERMINALS
     for t in TRACE_TERMINALS:
         assert f"serve/request/{t}" in checker.SERVE_EVENTS
+
+
+def test_attribution_vocabularies_in_lockstep(checker):
+    """STEP_ATTR_GAUGES and ATTR_STAGES are frozen in lockstep between
+    monitor/attribution.py and the checker."""
+    from deepspeed_tpu.monitor import attribution
+    assert checker.STEP_ATTR_GAUGES == attribution.STEP_ATTR_GAUGES
+    assert checker.ATTR_STAGES == attribution.ATTR_STAGES
+
+
+def test_rejects_unknown_step_attr_gauge(checker):
+    import time
+    base = {"ts": time.time(), "kind": "gauge", "value": 1.0,
+            "peak": 1.0}
+    assert checker.validate_event(
+        dict(base, name="step/attr/compute_ms")) == []
+    assert checker.validate_event(
+        dict(base, name="step/attr/bogus_ms"))
+
+
+def test_attr_event_requires_every_stage(checker):
+    """serve/request/attr must carry one numeric <stage>_ms per frozen
+    stage plus e2e_ms — a dropped or non-numeric stage fails."""
+    import time
+    attrs = {"req_id": "r1", "terminal": "finish", "migrated": 0,
+             "chunks": 1, "path": "queue>decode",
+             "queue_ms": 1.0, "prefill_ms": 2.0, "migrate_ms": 0.0,
+             "gap_ms": 0.0, "decode_ms": 3.0, "e2e_ms": 6.0}
+    base = {"ts": time.time(), "kind": "serve",
+            "name": "serve/request/attr"}
+    assert checker.validate_event(dict(base, attrs=dict(attrs))) == []
+    for stage in checker.ATTR_STAGES + ("e2e",):
+        broken = dict(attrs)
+        del broken[f"{stage}_ms"]
+        assert checker.validate_event(dict(base, attrs=broken)), stage
+        broken = dict(attrs)
+        broken[f"{stage}_ms"] = "fast"
+        assert checker.validate_event(dict(base, attrs=broken)), stage
 
 
 def test_prom_exposition_validation(checker):
