@@ -55,6 +55,19 @@ def model_memory_per_chip(num_params: int, stage: int, dp: int,
     return p + g + o
 
 
+def gather_buffer_bytes(num_params: int, n_layers: int,
+                        prefetch_depth: int) -> int:
+    """HBM cost of the ``zero_optimization.overlap`` gather pipeline:
+    ``prefetch_depth + 1`` per-layer gathered (UNsharded) working sets
+    ride the scan carry, so deeper prefetch buys overlap with layer-sized
+    slabs of HBM.  The per-layer size is the stacked model's params
+    spread evenly over its layers — the right scale for the transformer
+    stacks ``layer_scan`` pipelines."""
+    per_layer = (int(num_params) // max(1, int(n_layers))) \
+        * BYTES_PER_PARAM_BF16
+    return (int(prefetch_depth) + 1) * per_layer
+
+
 class Autotuner:
 
     def __init__(self, ds_config: Dict[str, Any],

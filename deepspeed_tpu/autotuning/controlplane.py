@@ -31,7 +31,8 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from deepspeed_tpu.autotuning.autotuner import model_memory_per_chip
+from deepspeed_tpu.autotuning.autotuner import (gather_buffer_bytes,
+                                                model_memory_per_chip)
 from deepspeed_tpu.autotuning.knobs import KnobSpace
 from deepspeed_tpu.autotuning.objective import Objective
 from deepspeed_tpu.autotuning.overlay import (OVERLAY_BASENAME, deep_merge,
@@ -78,6 +79,7 @@ class ControlPlane:
                  telemetry: Optional[Telemetry] = None,
                  hbm_bytes: Optional[int] = None,
                  model_num_params: Optional[int] = None,
+                 model_num_layers: Optional[int] = None,
                  baseline_snapshot: Optional[Dict[str, Any]] = None,
                  ledger_path: Optional[str] = None,
                  bench: str = "autotune",
@@ -98,6 +100,7 @@ class ControlPlane:
             _fresh_telemetry(results_dir)
         self.hbm_bytes = hbm_bytes
         self.model_num_params = model_num_params
+        self.model_num_layers = model_num_layers
         self.baseline_snapshot = baseline_snapshot
         self.ledger_path = ledger_path
         self.bench = bench
@@ -135,7 +138,12 @@ class ControlPlane:
           slots per page, so a draft length >= page size can never run;
         * training: analytic ZeRO state bytes
           (:func:`model_memory_per_chip`) plus the baseline snapshot's
-          measured ``mem/<span>/peak_bytes`` must fit ``hbm_bytes``.
+          measured ``mem/<span>/peak_bytes`` must fit ``hbm_bytes``;
+        * overlap: the gather pipeline's ``prefetch_depth + 1``
+          per-layer buffers (:func:`gather_buffer_bytes`) are priced
+          into the same budget — a depth whose double-buffered working
+          sets don't fit is pruned before execution, like the other
+          ZeRO-memory-model knobs (needs ``model_num_layers``).
         """
         serving = trial_cfg.get("serving") or {}
         page = serving.get("page_size")
@@ -154,9 +162,20 @@ class ControlPlane:
             observed = self._observed_peak_bytes()
             if observed:
                 est += int(observed)
-            if est > self.hbm_bytes:
-                return (f"zero_mem_model ({est} > hbm {self.hbm_bytes}, "
-                        f"stage={stage})")
+            overlap = zero.get("overlap") or {}
+            buffers = 0
+            depth = int(overlap.get("gather_prefetch_depth", 1) or 1)
+            if overlap.get("enabled") and stage >= 3 and \
+                    self.model_num_layers:
+                buffers = gather_buffer_bytes(
+                    self.model_num_params, self.model_num_layers, depth)
+            if est + buffers > self.hbm_bytes:
+                if buffers and est <= self.hbm_bytes:
+                    return (f"overlap_depth_hbm (gather buffers {buffers} "
+                            f"push {est} over hbm {self.hbm_bytes}, "
+                            f"depth={depth})")
+                return (f"zero_mem_model ({est + buffers} > hbm "
+                        f"{self.hbm_bytes}, stage={stage})")
         return None
 
     # -- ledger --------------------------------------------------------

@@ -82,6 +82,21 @@ def default_training_knobs() -> List[Knob]:
         Knob("comm_quant_enabled", "comm/quantization/enabled",
              [False, True], domain="training"),
         comm_quant_block_knob(),
+        # explicit ZeRO-3 comm/compute overlap (stage_plan.layer_scan +
+        # the engine's bucketed reduce-scatter): the gather prefetch
+        # depth is HBM-priced — depth+1 gathered working sets stay live,
+        # so the control plane prunes infeasible depths through
+        # gather_buffer_bytes before spending a trial on them;
+        # step/attr/exposed_comm_frac (objective weight -100) scores the
+        # survivors
+        Knob("overlap_enabled", "zero_optimization/overlap/enabled",
+             [False, True], domain="training"),
+        Knob("gather_prefetch_depth",
+             "zero_optimization/overlap/gather_prefetch_depth", [1, 2, 4],
+             domain="training"),
+        Knob("rs_bucket_bytes",
+             "zero_optimization/overlap/rs_bucket_bytes",
+             [25_000_000, 50_000_000, 100_000_000], domain="training"),
     ]
 
 

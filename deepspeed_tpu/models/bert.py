@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.transformer import _norm
 from deepspeed_tpu.ops.attention import reference_attention
+from deepspeed_tpu.runtime.zero.stage_plan import layer_scan
 
 
 @dataclass(frozen=True)
@@ -159,7 +160,7 @@ class BertEncoder:
         def body(x, layer):
             return self._layer(x, layer, pad_mask), None
         body_fn = jax.checkpoint(body) if c.remat else body
-        x, _ = jax.lax.scan(body_fn, x, params["layers"])
+        x, _ = layer_scan(body_fn, x, params["layers"])
 
         h = jax.nn.gelu(x @ params["mlm_dense"] +
                         params["mlm_dense_b"].astype(x.dtype))

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.transformer import _norm
 from deepspeed_tpu.ops.attention import reference_attention
+from deepspeed_tpu.runtime.zero.stage_plan import layer_scan
 
 
 def quick_gelu(x):
@@ -145,7 +146,7 @@ class CLIPTextEncoder:
         def body(x, layer):
             return self._layer(x, layer), None
         body_fn = jax.checkpoint(body) if c.remat else body
-        x, _ = jax.lax.scan(body_fn, x, params["layers"])
+        x, _ = layer_scan(body_fn, x, params["layers"])
 
         x = _norm(x, params["final_norm"], c.norm_eps, False,
                   params["final_norm_b"])

@@ -33,7 +33,7 @@ from deepspeed_tpu.ops.decode_attention import (KVCache, decode_attention,
                                                 init_cache, update_cache)
 from deepspeed_tpu.parallel.topology import (BATCH_AXES, DP_AXIS, FSDP_AXIS,
                                              SP_AXIS, TP_AXIS)
-from deepspeed_tpu.runtime.zero.stage_plan import maybe_constrain
+from deepspeed_tpu.runtime.zero.stage_plan import layer_scan, maybe_constrain
 
 
 @dataclass(frozen=True)
@@ -840,7 +840,7 @@ class CausalTransformerLM:
                 body = jax.checkpoint(body, policy=policy)
             xs = (params["layers"] if windows is None
                   else (params["layers"], windows))
-            x, l_auxs = jax.lax.scan(body, x, xs)
+            x, l_auxs = layer_scan(body, x, xs)
             aux = jnp.sum(l_auxs)
 
         x = _norm(x, params["final_norm"], c.norm_eps, c.use_rmsnorm,

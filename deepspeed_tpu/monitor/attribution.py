@@ -153,6 +153,10 @@ def decompose_step(t0, t1, compute=(), comm=(), input_wait=(),
     return {
         "step_ms": round(step_ms, 3),
         "compute_ms": round(compute_ms, 3),
+        # total collective time regardless of overlap — comm_ms minus
+        # exposed_comm_ms is the OVERLAPPED (free) communication, the
+        # quantity the zero_optimization.overlap gauges report
+        "comm_ms": round(total_length(comm_c) * 1000.0, 3),
         "exposed_comm_ms": round(exposed_ms, 3),
         "input_wait_ms": round(input_ms, 3),
         "host_sync_ms": round(host_ms, 3),

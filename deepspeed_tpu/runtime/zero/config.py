@@ -5,7 +5,10 @@ Parity: reference ``deepspeed/runtime/zero/config.py:79``
 Keys keep reference spellings.  Keys that configured CUDA-side bucketing
 mechanics (bucket sizes, overlap_comm) are accepted and recorded but are
 advisory on TPU: XLA schedules and overlaps the collectives itself; we keep
-them because autotuning and user configs set them.
+them because autotuning and user configs set them.  The ``overlap`` block
+is the exception — it is NOT advisory: it turns on the explicit gather
+pipeline / bucketed reduce-scatter in ``stage_plan.layer_scan`` and the
+engine (see ``DeepSpeedZeroOverlapConfig``).
 """
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
@@ -50,6 +53,39 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     ratio = 1.0
 
 
+class DeepSpeedZeroOverlapConfig(DeepSpeedConfigModel):
+    """``zero_optimization.overlap``: the explicit comm/compute overlap
+    layer for the ZeRO-3 step (stage_plan.layer_scan + the engine's
+    bucketed grad reduce-scatter).  Unlike the advisory ``overlap_comm``
+    key this block changes the traced program: the forward scan gathers
+    layer k+1's parameters while layer k computes (``gather_prefetch_depth``
+    buffers in flight) and backward's grad reduction is issued in
+    ``rs_bucket_bytes`` buckets as layers' grads finalize.  Overlap may
+    reorder communication, never math — ``enabled=false`` is bit-for-bit
+    the serial step."""
+    enabled = False
+    # forward gather pipeline: how many layers ahead the all-gather runs.
+    # 1 = gather layer k+1 while k computes (double buffering: two gathered
+    # working sets live); depth d keeps d+1 buffers resident
+    gather_prefetch_depth = 1
+    # backward reduce-scatter bucketing: grads are flushed in buckets of at
+    # most this many bytes, last layers first, so the reduction of layer
+    # k's grads overlaps the backward compute of layers < k
+    rs_bucket_bytes = 50_000_000
+
+    def _validate(self):
+        if int(self.gather_prefetch_depth) < 1:
+            raise ValueError(
+                "zero_optimization.overlap.gather_prefetch_depth must be "
+                f">= 1, got {self.gather_prefetch_depth}")
+        if int(self.rs_bucket_bytes) <= 0:
+            raise ValueError(
+                "zero_optimization.overlap.rs_bucket_bytes must be > 0, "
+                f"got {self.rs_bucket_bytes}")
+        self.gather_prefetch_depth = int(self.gather_prefetch_depth)
+        self.rs_bucket_bytes = int(self.rs_bucket_bytes)
+
+
 class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     stage = 0
     contiguous_gradients = True
@@ -58,6 +94,7 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     allgather_partitions = True
     allgather_bucket_size = 500_000_000
     overlap_comm = None
+    overlap = None
     load_from_fp32_weights = True
     elastic_checkpoint = False
     offload_param = None
@@ -96,6 +133,10 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
         if isinstance(self.offload_optimizer, dict):
             self.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(
                 self.offload_optimizer)
+        if isinstance(self.overlap, dict):
+            self.overlap = DeepSpeedZeroOverlapConfig(self.overlap)
+        elif self.overlap is None:
+            self.overlap = DeepSpeedZeroOverlapConfig({})
 
     @property
     def offload_optimizer_device(self):

@@ -264,6 +264,20 @@ QUANT_GAUGES = (
     "comm/kv_migrate/quant_bytes_saved",
 )
 
+# FROZEN vocabulary of the comm/compute-overlap gauges — must stay
+# byte-identical to ``deepspeed_tpu.runtime.zero.stage_plan.
+# OVERLAP_GAUGES`` (the tier-1 test diffs the two).  Emitted per step by
+# the engine when ``zero_optimization.overlap.enabled``; every gauge
+# event under the ``comm/overlap/`` prefix is validated against this
+# tuple (other ``comm/`` gauges stay on the quantization vocabulary).
+OVERLAP_GAUGES = (
+    "comm/overlap/exposed_ms",
+    "comm/overlap/overlapped_ms",
+    "comm/overlap/gather_buckets",
+    "comm/overlap/rs_buckets",
+    "comm/overlap/prefetch_depth",
+)
+
 # FROZEN vocabulary of the cluster aggregation gauges — must stay
 # byte-identical to ``deepspeed_tpu.monitor.aggregate.CLUSTER_GAUGES``
 # (the tier-1 test diffs the two).
@@ -378,7 +392,12 @@ def validate_event(event):
             event["name"] not in CLUSTER_GAUGES:
         problems.append(f"gauge: unknown cluster gauge {event['name']!r}")
     if kind == "gauge" and isinstance(event.get("name"), str) and \
+            event["name"].startswith("comm/overlap/") and \
+            event["name"] not in OVERLAP_GAUGES:
+        problems.append(f"gauge: unknown overlap gauge {event['name']!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str) and \
             event["name"].startswith("comm/") and \
+            not event["name"].startswith("comm/overlap/") and \
             event["name"] not in QUANT_GAUGES:
         problems.append(f"gauge: unknown comm gauge {event['name']!r}")
     if kind == "gauge" and isinstance(event.get("name"), str) and \

@@ -222,14 +222,17 @@ def check_stale_baseline(rows, onchip_path, stale_runs):
                 f"train ledger rows, no {onchip_path}) behind the last "
                 f"{stale_runs} cpu bench run(s) — the cpu gate has "
                 f"nothing on-chip to stand in for; re-run the on-chip "
-                f"train bench")
+                f"train bench (ROADMAP.md open follow-up: 'Re-measure "
+                f"on-chip training' — a fresh on-chip row is still owed)")
     if all(first_ts[r] > evidence_ts for r in recent):
         return (f"STALE-BASELINE: newest on-chip train evidence "
                 f"(ts {evidence_ts:.0f}) predates the last {stale_runs} "
                 f"cpu bench run(s) (oldest at ts "
                 f"{min(first_ts[r] for r in recent):.0f}) — cpu gating "
                 f"may have drifted from hardware reality; re-run the "
-                f"on-chip train bench")
+                f"on-chip train bench (ROADMAP.md open follow-up: "
+                f"'Re-measure on-chip training' — a fresh on-chip row "
+                f"is still owed)")
     return None
 
 

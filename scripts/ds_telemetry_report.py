@@ -354,6 +354,7 @@ def summarize(agg):
             "heartbeat": heartbeat,
             "profiling": _profiling_summary(agg),
             "attribution": _attribution_summary(agg),
+            "overlap": _overlap_summary(agg),
             "cluster": _cluster_summary(agg),
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
@@ -504,6 +505,23 @@ def _attribution_summary(agg):
     if not step and not serving:
         return None
     return {"step": step or None, "serving": serving}
+
+
+def _overlap_summary(agg):
+    """Comm/compute-overlap digest (runtime/zero/stage_plan.py): the
+    frozen ``comm/overlap/*`` gauges the engine emits when
+    ``zero_optimization.overlap.enabled`` — exposed vs overlapped comm
+    time per step, the gather/reduce-scatter bucket census, and the
+    configured prefetch depth — plus the exposed-comm fraction the
+    overlap is meant to drive down.  None when the run never overlapped."""
+    rows = {name.rsplit("/", 1)[1]: {"last": g["last"], "peak": g["peak"]}
+            for name, g in sorted(agg["gauges"].items())
+            if name.startswith("comm/overlap/")}
+    if not rows:
+        return None
+    frac = agg["gauges"].get("step/attr/exposed_comm_frac")
+    return {"gauges": rows,
+            "exposed_comm_frac": frac["last"] if frac else None}
 
 
 def _cluster_summary(agg):
@@ -842,6 +860,16 @@ def print_tables(summary, out=sys.stdout):
                 share = (f"{r['frac'] * 100:.1f}%"
                          if r["frac"] is not None else "-")
                 w(f"{k[:-3]:<12}{r['total_ms']:>12}{share:>8}\n")
+        w("\n")
+    ov = summary.get("overlap")
+    if ov:
+        w("== comm/compute overlap ==\n")
+        w(f"{'gauge':<18}{'last':>12}{'peak':>12}\n")
+        for name, r in ov["gauges"].items():
+            w(f"{name:<18}{r['last']:>12}{r['peak']:>12}\n")
+        if ov["exposed_comm_frac"] is not None:
+            w(f"exposed comm fraction (step/attr): "
+              f"{ov['exposed_comm_frac']}\n")
         w("\n")
     feed = summary.get("input_feed")
     if feed:

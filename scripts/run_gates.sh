@@ -10,6 +10,7 @@
 #   5. check_telemetry_schema.py --tune              tune journals/overlay
 #   6. comm-quant smoke                              int8 codec roundtrip
 #   7. ds_trace_export.py --check                    Perfetto trace export
+#   8. overlap smoke                                 ZeRO-3 comm overlap
 #
 # TELEMETRY_DIR (optional) is searched recursively for events*.jsonl
 # streams; INCIDENTS_DIR (optional) holds incident bundles; TUNE_DIR
@@ -162,6 +163,102 @@ if [ -n "$TELEMETRY_DIR" ] && [ -d "$TELEMETRY_DIR" ]; then
 else
     echo "== gate: trace export == SKIP (no telemetry dir given)"
 fi
+
+# 8. overlap smoke: a ZeRO-3 config with zero_optimization.overlap on
+# must run the double-buffered step on the simulated 8-device mesh with
+# a bit-identical forward vs the serial oracle (the gather pipeline may
+# reorder communication, never math), the trajectory inside ulp
+# tolerance, and the frozen comm/overlap/* + step/attr/exposed_comm_frac
+# gauges riding a schema-valid stream
+run_gate "overlap smoke" env JAX_PLATFORMS=cpu REPO="$REPO" "$PY" - <<'EOF'
+import importlib.util, json, os, sys, tempfile
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+repo = os.environ["REPO"]
+sys.path.insert(0, repo)
+import numpy as np
+import jax
+import jax.numpy as jnp
+import deepspeed_tpu
+from deepspeed_tpu.parallel import groups
+from deepspeed_tpu.runtime.zero.stage_plan import layer_scan
+
+HIDDEN, LAYERS = 16, 4
+
+class Stacked:
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"layers": {"w": jax.random.normal(
+                               k1, (LAYERS, HIDDEN, HIDDEN)) * 0.1,
+                           "b": jnp.zeros((LAYERS, HIDDEN))},
+                "out": jax.random.normal(k2, (HIDDEN, HIDDEN)) * 0.1}
+
+    def tp_rules(self):
+        from jax.sharding import PartitionSpec as P
+        return [(r"\['w'\]$", P("fsdp")), (r"\['b'\]$", P("fsdp"))]
+
+    def apply(self, params, x):
+        def body(h, layer):
+            return jnp.tanh(h @ layer["w"] + layer["b"]), None
+        h, _ = layer_scan(body, x, params["layers"])
+        return h @ params["out"]
+
+    def loss(self, params, batch, rng=None):
+        x, y = jnp.asarray(batch["x"]), jnp.asarray(batch["y"])
+        return jnp.mean(jnp.square(self.apply(params, x) - y))
+
+def batch(i):
+    rng = np.random.default_rng(i)
+    x = rng.normal(size=(32, HIDDEN)).astype(np.float32)
+    return {"x": x, "y": np.roll(x, 1, axis=-1) * 0.5}
+
+def run(zero, tmp=None):
+    groups.reset_mesh()
+    model = Stacked()
+    params = model.init(jax.random.key(0))
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "optimizer": {"type": "Adam",
+                         "params": {"lr": 1e-2, "weight_decay": 0.0}},
+           "zero_optimization": dict({"stage": 3,
+                                      "param_persistence_threshold": 0},
+                                     **zero),
+           "mesh": {"dp": 2, "fsdp": 4}}
+    if tmp:
+        cfg["telemetry"] = {"enabled": True, "output_path": tmp,
+                            "job_name": "overlap_smoke",
+                            "attribution": {"enabled": True}}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=cfg)
+    losses = [float(engine.train_batch(batch=batch(i))) for i in range(3)]
+    if tmp:
+        engine.flush_telemetry()
+    return losses
+
+serial = run({})
+tmp = tempfile.mkdtemp()
+over = run({"overlap": {"enabled": True, "gather_prefetch_depth": 1,
+                        "rs_bucket_bytes": 2048}}, tmp=tmp)
+assert serial[0] == over[0], \
+    f"forward not bit-identical: {serial[0]} vs {over[0]}"
+np.testing.assert_allclose(serial, over, rtol=5e-6, atol=1e-7)
+stream = os.path.join(tmp, "overlap_smoke", "events.jsonl")
+events = [json.loads(l) for l in open(stream)]
+names = {ev.get("name") for ev in events if ev.get("kind") == "gauge"}
+assert "step/attr/exposed_comm_frac" in names, sorted(names)
+spec = importlib.util.spec_from_file_location(
+    "checker", os.path.join(repo, "scripts",
+                            "check_telemetry_schema.py"))
+checker = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(checker)
+missing = set(checker.OVERLAP_GAUGES) - names
+assert not missing, f"missing overlap gauges: {sorted(missing)}"
+problems = [p for ev in events for p in checker.validate_event(ev)]
+assert not problems, problems[:3]
+print(f"overlap smoke: 3 overlapped steps vs serial — step-0 loss "
+      f"bit-identical ({serial[0]:.6f}), trajectory within ulp "
+      f"tolerance, {len(checker.OVERLAP_GAUGES)} overlap gauges + "
+      f"exposed_comm_frac on a {len(events)}-event schema-valid stream")
+EOF
 
 if [ "$fail" -ne 0 ]; then
     echo "GATES: FAIL"

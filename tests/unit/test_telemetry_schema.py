@@ -71,6 +71,28 @@ def test_quant_gauges_in_lockstep(checker):
     assert checker.QUANT_GAUGES == QUANT_GAUGES
 
 
+def test_overlap_gauges_in_lockstep(checker):
+    """The frozen comm/overlap/* gauge vocabulary must stay byte-identical
+    between the overlap plan (runtime/zero/stage_plan.py) and the
+    checker."""
+    from deepspeed_tpu.runtime.zero.stage_plan import OVERLAP_GAUGES
+    assert checker.OVERLAP_GAUGES == OVERLAP_GAUGES
+
+
+def test_overlap_gauge_validation(checker):
+    # comm/overlap/ gauges ride their own frozen vocabulary; other comm/
+    # gauges stay on QUANT_GAUGES
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "comm/overlap/exposed_ms",
+         "value": 0.4, "peak": 0.4})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "comm/overlap/rs_buckets",
+         "value": 3.0, "peak": 3.0})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "comm/overlap/vibes",
+         "value": 1.0, "peak": 1.0})
+
+
 def test_cluster_gauges_in_lockstep(checker):
     """The frozen cluster/* gauge vocabulary must stay byte-identical
     between the aggregator (monitor/aggregate.py) and the checker."""
