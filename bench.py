@@ -791,6 +791,109 @@ def _worker_fleet_disagg(spec):
     print(json.dumps(_fleet_disagg_bench(spec)))
 
 
+def _fleet_xproc_bench(spec=None):
+    """CPU-runnable cross-process-fleet micro-bench: the same workload
+    served by an in-process fleet and by a fleet of real worker
+    processes over the socket transport, then again with a real
+    ``kill -9`` of one worker mid-decode.  Reports tokens per fleet
+    step on both sides of the process boundary (the transport-overhead
+    claim), the kill run's recovery latency (SIGKILL to respawned
+    replica), and zero lost requests with survivors bit-identical to
+    the no-kill run (the robustness claim)."""
+    spec = spec or {}
+    import os
+    import signal
+
+    from deepspeed_tpu.inference.fleet import FleetRouter
+    from deepspeed_tpu.inference.fleet_worker import tiny_engine_factory
+
+    n_replicas = int(spec.get("replicas", 2))
+    n_requests = int(spec.get("requests", 8))
+    max_new = int(spec.get("max_new_tokens", 6))
+    worker_spec = {
+        "factory":
+        "deepspeed_tpu.inference.fleet_worker:tiny_engine_factory",
+        "kwargs": {}}
+    xproc = {"mode": "subprocess", "heartbeat_interval_s": 0.2,
+             "heartbeat_deadline_s": 10.0}
+    prompts = {f"q{i}": [1 + i, 2 + i, 3 + i, 4 + i]
+               for i in range(n_requests)}
+
+    def run(factory, transport=None, kill_rid=None):
+        fleet_cfg = {"replicas": n_replicas,
+                     "max_replicas": n_replicas + 1, "health_interval": 4}
+        if transport:
+            fleet_cfg["transport"] = dict(transport)
+        router = FleetRouter(factory, fleet=fleet_cfg)
+        try:
+            # warm every engine's jit caches off the clock so the timed
+            # phase measures serving + transport, not compilation
+            for rep in router.replicas.values():
+                rep.handle.generate([prompts["q0"]], max_new_tokens=2)
+            t0 = time.perf_counter()
+            for rid, p in sorted(prompts.items()):
+                router.submit(rid, p, max_new_tokens=max_new,
+                              temperature=0.7, seed=11)
+            killed_at = recovery_s = None
+            respawns0 = router.stats["respawns"]
+            for step in range(600):
+                if kill_rid and step == 3 and killed_at is None:
+                    os.kill(router.replicas[kill_rid].handle.proc.pid,
+                            signal.SIGKILL)
+                    killed_at = time.perf_counter()
+                router.step()
+                if killed_at is not None and recovery_s is None and \
+                        router.stats["respawns"] > respawns0:
+                    recovery_s = time.perf_counter() - killed_at
+                if not router._unresolved():
+                    break
+            wall = time.perf_counter() - t0
+            done = dict(router.finished)
+            term = router.pop_terminated()
+            generated = sum(len(toks) - len(prompts[rid])
+                            for rid, toks in done.items())
+            st = router.stats
+            return {"done": done, "term": term, "wall_s": wall,
+                    "tokens_per_step": generated / max(router.steps, 1),
+                    "steps": router.steps, "recovery_s": recovery_s,
+                    "lost": (st["submitted"] - st["finished"] -
+                             st["terminated"]),
+                    "workers_lost": st["workers_lost"],
+                    "respawns": st["respawns"],
+                    "leaks": router.leak_report()}
+        finally:
+            router.close()
+
+    inp = run(tiny_engine_factory)
+    xp = run(worker_spec, transport=xproc)
+    kill = run(worker_spec, transport=xproc, kill_rid="r0")
+    survivors_identical = all(kill["done"][rid] == inp["done"][rid]
+                              for rid in kill["done"])
+    return {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "agg_tokens_per_step_inproc": round(inp["tokens_per_step"], 3),
+        "agg_tokens_per_step_xproc": round(xp["tokens_per_step"], 3),
+        "transport_wall_overhead_frac": round(
+            xp["wall_s"] / max(inp["wall_s"], 1e-9) - 1.0, 3),
+        "bit_identical_xproc": xp["done"] == inp["done"],
+        "kill_recovery_s": round(kill["recovery_s"] or 0.0, 3),
+        "kill_extra_wall_s": round(kill["wall_s"] - xp["wall_s"], 3),
+        "kill_extra_steps": kill["steps"] - xp["steps"],
+        "workers_lost": kill["workers_lost"],
+        "respawns": kill["respawns"],
+        "survivors_bit_identical": survivors_identical,
+        "lost_requests": (inp["lost"] + xp["lost"] + kill["lost"] +
+                          len(xp["term"]) + len(inp["term"])),
+        "leaks_xproc": xp["leaks"],
+        "leaks_kill": kill["leaks"],
+    }
+
+
+def _worker_fleet_xproc(spec):
+    print(json.dumps(_fleet_xproc_bench(spec)))
+
+
 def _serving_attn_bench(spec=None):
     """CPU-runnable serving-attention micro-bench: the jnp gather path vs
     the fused ragged Pallas kernel (interpret mode) on ONE mixed
@@ -2655,6 +2758,25 @@ def _attach_fleet_disagg(out):
     return out
 
 
+def _attach_fleet_xproc(out):
+    """Attach the cross-process-fleet micro-bench under the stable key
+    ``cpu_fleet_xproc`` (CPU-runnable: tokens/fleet-step in-process vs
+    real worker processes over the socket transport, kill -9 recovery
+    latency, zero-loss + survivors bit-identical).  Budget-gated; a
+    failure is recorded in notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "fleet_xproc", {},
+        timeout=max(60, min(300, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_fleet_xproc"] = res
+    else:
+        out.setdefault("notes", {})["fleet_xproc"] = (err or "")[:200]
+    return out
+
+
 def _attach_incident(out):
     """Attach the incident-plane micro-bench under the stable key
     ``cpu_incident`` (CPU-runnable: ring-buffer record overhead, injected
@@ -2809,7 +2931,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))))
+            print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -2897,7 +3019,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))
+        print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -2972,7 +3094,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))))))
+    print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))))))))
 
 
 if __name__ == "__main__":
@@ -3003,6 +3125,8 @@ if __name__ == "__main__":
             _worker_fleet(spec)
         elif which == "fleet_disagg":
             _worker_fleet_disagg(spec)
+        elif which == "fleet_xproc":
+            _worker_fleet_xproc(spec)
         elif which == "serving_attn":
             _worker_serving_attn(spec)
         elif which == "serving_slo":

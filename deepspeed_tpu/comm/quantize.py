@@ -207,6 +207,22 @@ class QuantizedPayload:
     def bytes_saved(self) -> int:
         return max(0, self.raw_bytes - self.wire_bytes)
 
+    def to_wire(self) -> dict:
+        """Versioned JSON-safe envelope for the cross-process fleet
+        transport (``inference/transport.py``).  Codes stay int8 on the
+        wire — serialization preserves the codec's byte saving."""
+        from deepspeed_tpu.inference.transport import payload_to_wire
+        return payload_to_wire(self)
+
+    @staticmethod
+    def from_wire(d: dict):
+        """Inverse of :meth:`to_wire`; rejects an unknown major wire
+        version with the typed ``WireVersionError``.  Also accepts (and
+        passes through) the raw-payload envelope, mirroring
+        :meth:`CommQuantizer.decode_payload`'s raw passthrough."""
+        from deepspeed_tpu.inference.transport import payload_from_wire
+        return payload_from_wire(d)
+
 
 def _is_quantized_leaf(x) -> bool:
     return isinstance(x, QuantizedLeaf)

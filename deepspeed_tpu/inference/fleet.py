@@ -64,6 +64,10 @@ admissions, and autoscaling becomes per-pool
 """
 
 import hashlib
+import os
+import socket
+import subprocess
+import sys
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -78,6 +82,7 @@ from deepspeed_tpu.inference.robustness import (
     REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING, REJECT_DRAINING,
     REJECT_DUPLICATE, REJECT_INFEASIBLE, REJECT_OVERSIZED, SHED_DEADLINE,
     SHED_DRAIN, RequestRejected, RequestResult, RequestTracer)
+from deepspeed_tpu.inference.transport import RpcChannel, TransportError
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.runtime.resilience import FaultInjector
@@ -93,6 +98,7 @@ FLEET_EVENTS = (
     "fleet/scale_up", "fleet/scale_down",
     "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
     "fleet/migrate_abort", "fleet/local_prefill",
+    "fleet/worker_lost",
 )
 
 # the closed set of replica supervision states (docs/serving.md)
@@ -160,6 +166,45 @@ class FleetRolesConfig(DeepSpeedConfigModel):
                     f"[min_{role}_replicas, max_{role}_replicas]")
 
 
+class FleetTransportConfig(DeepSpeedConfigModel):
+    """The ``serving.fleet.transport`` block (docs/config-json.md):
+    where replicas live.  ``mode="inprocess"`` (the default) keeps the
+    fleet bit-for-bit the in-process router; ``mode="subprocess"`` hosts
+    one ``ServingEngine`` per OS process (``inference/fleet_worker.py``)
+    behind the framed socket transport (``inference/transport.py``) with
+    heartbeat-based liveness: a worker that misses
+    ``heartbeat_deadline_s`` of heartbeats is declared dead, its process
+    killed, its requests redispatched, and its ring slot respawned after
+    ``respawn_backoff_s`` (the backoff bounds respawn storms when the
+    fault is environmental, not replica-local)."""
+
+    mode = "inprocess"              # "inprocess" | "subprocess"
+    heartbeat_interval_s = 1.0      # worker beat period
+    heartbeat_deadline_s = 10.0     # missed-beat window before death
+    respawn_backoff_s = 0.0         # wait before respawning a lost slot
+    call_timeout_s = 120.0          # per-RPC wall budget (engine build,
+    #                                 jit warm-up included)
+
+    def _validate(self):
+        if self.mode not in ("inprocess", "subprocess"):
+            raise ValueError(
+                "serving.fleet.transport.mode must be 'inprocess' or "
+                f"'subprocess', got {self.mode!r}")
+        for k in ("heartbeat_interval_s", "heartbeat_deadline_s",
+                  "respawn_backoff_s", "call_timeout_s"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(f"serving.fleet.transport.{k} must "
+                                 "be >= 0")
+        if float(self.call_timeout_s) <= 0:
+            raise ValueError(
+                "serving.fleet.transport.call_timeout_s must be > 0")
+        if float(self.heartbeat_deadline_s) < \
+                float(self.heartbeat_interval_s):
+            raise ValueError(
+                "serving.fleet.transport.heartbeat_deadline_s must be "
+                ">= heartbeat_interval_s")
+
+
 class FleetConfig(DeepSpeedConfigModel):
     """The ``serving.fleet`` config block (docs/config-json.md)."""
 
@@ -176,6 +221,7 @@ class FleetConfig(DeepSpeedConfigModel):
     cooldown_sweeps = 8
     fault_injection = {}            # FaultInjector spec (fleet sites)
     roles = {}                      # FleetRolesConfig (disaggregation)
+    transport = {}                  # FleetTransportConfig (process mode)
     # autotuning-v2: path to a persisted autotuner overlay
     # (autotuning/overlay.py).  When set, the autoscaler thresholds above
     # are DEFAULTS only — any threshold the overlay's serving.fleet
@@ -186,6 +232,8 @@ class FleetConfig(DeepSpeedConfigModel):
     def _validate(self):
         if not isinstance(self.roles, FleetRolesConfig):
             self.roles = FleetRolesConfig(self.roles or {})
+        if not isinstance(self.transport, FleetTransportConfig):
+            self.transport = FleetTransportConfig(self.transport or {})
         for k in ("replicas", "min_replicas", "health_interval"):
             if int(getattr(self, k)) < 1:
                 raise ValueError(f"serving.fleet.{k} must be >= 1")
@@ -204,6 +252,343 @@ class FleetConfig(DeepSpeedConfigModel):
         if not (0.0 <= float(self.free_page_low_frac) < 1.0):
             raise ValueError(
                 "serving.fleet.free_page_low_frac must be in [0, 1)")
+
+
+def _key(k):
+    """Hashable req_id from a wire-decoded value (tuples cross the wire
+    as lists)."""
+    return tuple(k) if isinstance(k, list) else k
+
+
+class InProcessReplicaHandle:
+    """The default replica handle: a thin shim over a local
+    :class:`ServingEngine`.  Every method is direct delegation in the
+    exact call order the pre-handle router used, so
+    ``transport.mode="inprocess"`` stays bit-for-bit the in-process
+    fleet.  ``last_heartbeat`` is None — in-process replicas are exempt
+    from heartbeat liveness (they cannot die without the router dying
+    with them)."""
+
+    mode = "inprocess"
+    last_heartbeat = None
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    # -- engine surface --------------------------------------------------
+    def add_request(self, req_id, prompt, **kwargs):
+        self.engine.add_request(req_id, prompt, **kwargs)
+
+    def step(self):
+        return self.engine.step()
+
+    def pop_terminated(self):
+        return self.engine.pop_terminated()
+
+    def pop_prefilled(self):
+        return self.engine.pop_prefilled()
+
+    def release_handoff(self, req_id):
+        return self.engine.release_handoff(req_id)
+
+    def resident_prefix(self, prompt):
+        cache = self.engine.prefix_cache
+        return cache.resident_prefix(prompt) if cache is not None else []
+
+    def export_payload(self, page_ids):
+        """Export + wire-encode the non-shared prompt pages.  Returns
+        ``(payload, wire_frac)`` — the payload in whatever form this
+        transport carries (here the live object) and the quantized
+        wire-byte fraction (1.0 when the codec passed it through)."""
+        if not page_ids:
+            return None, 1.0
+        payload = self.engine.comm_quant.encode_payload(
+            self.engine.export_pages(page_ids))
+        if isinstance(payload, QuantizedPayload):
+            return payload, payload.wire_bytes / max(payload.raw_bytes, 1)
+        return payload, 1.0
+
+    def import_request(self, handoff, payload=None, shared_pages=(),
+                       deadline_s=None):
+        return self.engine.import_request(handoff, payload=payload,
+                                          shared_pages=shared_pages,
+                                          deadline_s=deadline_s)
+
+    def commit_import(self, req_id):
+        self.engine.commit_import(req_id)
+
+    def cancel_import(self, req_id):
+        return self.engine.cancel_import(req_id)
+
+    def drain(self):
+        return self.engine.drain()
+
+    def generate(self, prompts, max_new_tokens=8):
+        return self.engine.generate(prompts,
+                                    max_new_tokens=max_new_tokens)
+
+    def leak_report(self):
+        return self.engine.leak_report()
+
+    def health(self):
+        return self.engine.health()
+
+    # -- load surface (the router's spill / autoscale inputs) ------------
+    @property
+    def queue_depth(self):
+        return len(self.engine.queue)
+
+    @property
+    def n_active(self):
+        return self.engine.n_active
+
+    @property
+    def load(self):
+        return len(self.engine.queue) + self.engine.n_active
+
+    @property
+    def free_pages(self):
+        return self.engine.alloc.free_page_count
+
+    @property
+    def num_pages(self):
+        return self.engine.alloc.num_pages
+
+    @property
+    def shed_count(self):
+        return self.engine.stats["shed"]
+
+    @property
+    def prefix_hit_rate(self):
+        cache = self.engine.prefix_cache
+        return cache.snapshot()["hit_rate"] if cache is not None else None
+
+    @property
+    def page_size(self):
+        return self.engine.page_size
+
+    @property
+    def kv_page_bytes(self):
+        return self.engine.kv_page_bytes
+
+    # -- lifecycle -------------------------------------------------------
+    def pump(self):
+        """No async frames to drain in-process."""
+
+    def close(self, kill=False):
+        """Nothing to tear down — the engine is garbage-collected."""
+
+
+class SubprocessReplicaHandle:
+    """A replica hosted in its own OS process (a REAL fault domain).
+
+    The constructor spawns ``python -m deepspeed_tpu.inference.
+    fleet_worker`` over one end of a socketpair and drives it through
+    the framed RPC protocol (``inference/transport.py``).  The factory
+    ``spec`` is a dotted path + kwargs — a deterministic recipe, so a
+    respawn rebuilds the exact same engine.  Load state (queue depth,
+    active slots, free pages, prefix hit rate, shed count) piggybacks on
+    every RPC response and is read from cache, so the router's
+    spill-order sort and autoscale sweep cost no extra round trips.
+    Liveness is the worker's asynchronous heartbeat stream, surfaced as
+    :attr:`last_heartbeat` (router-clock receipt stamps via the
+    channel); a torn connection raises :class:`TransportError` from
+    whatever call hits it first, which the router maps to the same
+    recovery path as a missed-heartbeat death."""
+
+    mode = "subprocess"
+
+    def __init__(self, spec, replica_id, epoch, transport_cfg,
+                 telemetry=None, rank=0, clock=None):
+        self.replica_id = replica_id
+        self.epoch = epoch
+        self.engine = None      # no in-process engine behind this handle
+        self._timeout = float(transport_cfg.call_timeout_s)
+        self._load = {}
+        parent, child = socket.socketpair()
+        # the worker must be able to import this package even when the
+        # router's cwd is not the source root — export the package
+        # parent on PYTHONPATH
+        import deepspeed_tpu
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(deepspeed_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "deepspeed_tpu.inference.fleet_worker",
+                 "--fd", str(child.fileno())],
+                pass_fds=(child.fileno(),), env=env)
+        finally:
+            child.close()
+        self.chan = RpcChannel(parent, clock=clock)
+        try:
+            init = self.chan.call(
+                "init", timeout=self._timeout, rid=replica_id,
+                epoch=epoch, spec=spec,
+                hb_interval_s=float(transport_cfg.heartbeat_interval_s),
+                telemetry=telemetry, rank=int(rank))
+        except Exception:
+            self.close(kill=True)
+            raise
+        self.page_size = int(init["page_size"])
+        self.kv_page_bytes = int(init["kv_page_bytes"])
+        self._load = dict(init.get("load") or {})
+
+    def _call(self, op, **kwargs):
+        resp = self.chan.call(op, timeout=self._timeout, **kwargs)
+        load = resp.get("load")
+        if load:
+            self._load = load
+        return resp
+
+    # -- engine surface --------------------------------------------------
+    def add_request(self, req_id, prompt, **kwargs):
+        self._call("add_request", req_id=req_id,
+                   prompt=[int(t) for t in prompt], kwargs=kwargs)
+
+    def step(self):
+        return {_key(rid): toks
+                for rid, toks in self._call("step")["done"]}
+
+    def pop_terminated(self):
+        out = {}
+        for rid, res in self._call("pop_terminated")["results"]:
+            rid = _key(rid)
+            out[rid] = RequestResult(
+                rid, res["status"], res["reason"],
+                tokens=list(res["tokens"]),
+                n_generated=int(res["n_generated"]),
+                detail=res.get("detail", ""))
+        return out
+
+    def pop_prefilled(self):
+        from deepspeed_tpu.inference.serving import PrefillHandoff
+        return {_key(rid): PrefillHandoff.from_wire(wire)
+                for rid, wire in self._call("pop_prefilled")["handoffs"]}
+
+    def release_handoff(self, req_id):
+        return bool(self._call("release_handoff",
+                               req_id=req_id)["ok"])
+
+    def resident_prefix(self, prompt):
+        return self._call("resident_prefix",
+                          prompt=[int(t) for t in prompt])["pages"]
+
+    def export_payload(self, page_ids):
+        """See :meth:`InProcessReplicaHandle.export_payload`; here the
+        payload stays in WIRE form (the worker already ran the int8
+        codec), ready to forward to the destination worker."""
+        if not page_ids:
+            return None, 1.0
+        resp = self._call("export_payload",
+                          pages=[int(p) for p in page_ids])
+        payload = resp["payload"]
+        if resp.get("quant") and payload is not None:
+            return payload, (int(payload["wire_bytes"]) /
+                             max(int(payload["raw_bytes"]), 1))
+        return payload, 1.0
+
+    def import_request(self, handoff, payload=None, shared_pages=(),
+                       deadline_s=None):
+        return bool(self._call(
+            "import_request", handoff=handoff.to_wire(), payload=payload,
+            shared_pages=[int(p) for p in shared_pages],
+            deadline_s=deadline_s)["ok"])
+
+    def commit_import(self, req_id):
+        """The explicit commit ack: raises :class:`TransportError` when
+        the connection tears before the worker acknowledges — the
+        uncommitted import died with the process, so the router rolls
+        back exactly like an injected ``migrate_commit`` fault."""
+        self._call("commit_import", req_id=req_id)
+
+    def cancel_import(self, req_id):
+        return bool(self._call("cancel_import", req_id=req_id)["ok"])
+
+    def drain(self):
+        resp = self._call("drain")
+        return {"finished": {_key(rid): toks
+                             for rid, toks in resp["finished"]},
+                "shed": [_key(r) for r in resp["shed"]],
+                "steps": int(resp["steps"]),
+                "health": resp["health"]}
+
+    def leak_report(self):
+        return self._call("leak_report")["leaks"]
+
+    def health(self):
+        return self._call("health")["health"]
+
+    def generate(self, prompts, max_new_tokens=8):
+        """Warm-up helper for benches/tests (mirrors the engine API)."""
+        return self._call(
+            "generate",
+            prompts=[[int(t) for t in p] for p in prompts],
+            max_new_tokens=int(max_new_tokens))["out"]
+
+    # -- load surface (cached from response piggybacks) ------------------
+    @property
+    def queue_depth(self):
+        return int(self._load.get("queue", 0))
+
+    @property
+    def n_active(self):
+        return int(self._load.get("active", 0))
+
+    @property
+    def load(self):
+        return self.queue_depth + self.n_active
+
+    @property
+    def free_pages(self):
+        return int(self._load.get("free_pages", 0))
+
+    @property
+    def num_pages(self):
+        return int(self._load.get("num_pages", 1))
+
+    @property
+    def shed_count(self):
+        return int(self._load.get("shed", 0))
+
+    @property
+    def prefix_hit_rate(self):
+        return self._load.get("hit_rate")
+
+    # -- liveness / lifecycle --------------------------------------------
+    @property
+    def last_heartbeat(self):
+        return self.chan.last_heartbeat
+
+    def pump(self):
+        self.chan.pump()
+
+    def close(self, kill=False):
+        """Tear the worker down: graceful (``shutdown`` op, then
+        SIGTERM fallback) or abrupt (SIGKILL — the fence vs kill split,
+        at the process level).  Always reaps the child."""
+        proc = getattr(self, "proc", None)
+        if not kill and proc is not None and proc.poll() is None and \
+                not self.chan.closed:
+            try:
+                self.chan.call("shutdown", timeout=5.0)
+            except Exception:
+                pass
+        self.chan.close()
+        if proc is not None:
+            if proc.poll() is None:
+                try:
+                    proc.kill() if kill else proc.terminate()
+                except OSError:
+                    pass
+            try:
+                proc.wait(timeout=10.0)
+            except Exception:
+                pass
 
 
 @dataclass
@@ -231,7 +616,8 @@ class _FleetRequest:
 class _Replica:
     replica_id: str
     epoch: str
-    engine: Any
+    engine: Any                 # None for subprocess-backed replicas
+    handle: Any = None          # ReplicaHandle (the router's only surface)
     state: str = "healthy"
     role: str = "unified"
 
@@ -249,13 +635,19 @@ class FleetRouter:
     """
 
     def __init__(self, engine_factory, fleet=None, injector=None,
-                 telemetry=None, clock=None):
+                 telemetry=None, clock=None, worker_telemetry=None):
         cfg = fleet if isinstance(fleet, FleetConfig) \
             else FleetConfig(fleet or {})
         self.fleet = cfg
         self._factory = engine_factory
         self._clock = clock if clock is not None else time.monotonic
         self._telemetry = telemetry
+        # subprocess mode: telemetry config dict forwarded to each worker
+        # (rank-stamped shard sink — the router stays rank 0)
+        self._worker_telemetry = worker_telemetry
+        self._worker_seq = 0            # next worker telemetry rank
+        self._respawn_after = {}        # rid -> clock time respawn allowed
+        self._engine_steps = 0          # replica steps actually executed
         self.injector = injector if injector is not None \
             else FaultInjector.from_config(cfg.fault_injection)
         self.replicas: Dict[str, _Replica] = {}
@@ -276,7 +668,7 @@ class FleetRouter:
                       "migrate_bytes_saved": 0,
                       "migrate_quant_bytes_saved": 0, "migrate_faults": 0,
                       "migrate_commit_faults": 0, "migrate_aborts": 0,
-                      "local_prefills": 0}
+                      "local_prefills": 0, "workers_lost": 0}
         self._gens: Dict[str, int] = {}     # replica_id -> spawn generation
         self._role_of: Dict[str, str] = {}  # replica_id -> role (sticky
         #                                     across respawns, so a dead
@@ -412,13 +804,13 @@ class FleetRouter:
         gen = self._gens.get(rid, -1) + 1
         self._gens[rid] = gen
         epoch = f"{rid}g{gen}"
-        engine = self._factory(rid, epoch)
-        rep = _Replica(rid, epoch, engine,
+        handle = self._make_handle(rid, epoch)
+        rep = _Replica(rid, epoch, handle.engine, handle=handle,
                        role=(role or "unified"))
         self.replicas[rid] = rep
         self._role_of[rid] = rep.role
         if self._route_tokens == 0:
-            self._route_tokens = int(engine.page_size)
+            self._route_tokens = int(handle.page_size)
         if respawn:
             self.stats["respawns"] += 1
         self._fleet_event("fleet/respawn" if respawn else "fleet/spawn",
@@ -426,15 +818,42 @@ class FleetRouter:
                           role=(rep.role if self._roles_enabled else None))
         return rep
 
+    def _make_handle(self, rid, epoch):
+        """Build one replica behind the transport-mode handle.  In
+        ``subprocess`` mode the factory must be a SPEC (dotted path +
+        kwargs dict, or the bare path string) the worker process can
+        re-import — a live callable cannot cross a process boundary."""
+        tcfg = self.fleet.transport
+        if tcfg.mode == "subprocess":
+            if callable(self._factory):
+                raise TypeError(
+                    "transport.mode='subprocess' needs a factory SPEC "
+                    "({'factory': 'module:fn', 'kwargs': {...}} or a "
+                    "'module:fn' string), not a live callable — the "
+                    "worker process rebuilds the engine by import")
+            self._worker_seq += 1
+            return SubprocessReplicaHandle(
+                self._factory, rid, epoch, tcfg,
+                telemetry=self._worker_telemetry,
+                rank=self._worker_seq, clock=self._clock)
+        return InProcessReplicaHandle(self._factory(rid, epoch))
+
     def _healthy(self, role: Optional[str] = None) -> List[_Replica]:
         return [r for r in self.replicas.values()
                 if r.state == "healthy" and
                 (role is None or r.role == role)]
 
-    def _retire(self, rep: _Replica):
+    def _retire(self, rep: _Replica, kill=False):
         """Drop a replica from the routing ring (engine already drained
-        or abandoned); its fleet requests must have been re-homed."""
+        or abandoned) and tear down its handle — in subprocess mode
+        that reaps the worker process (SIGKILL when ``kill``); its
+        fleet requests must have been re-homed."""
         self.replicas.pop(rep.replica_id, None)
+        if rep.handle is not None:
+            try:
+                rep.handle.close(kill=kill)
+            except Exception:
+                pass
 
     def _requeue_owned(self, rep: _Replica) -> List[Any]:
         """Every fleet request dispatched to ``rep`` goes back to pending
@@ -492,7 +911,35 @@ class FleetRouter:
                           detail=detail)
         self._incident("replica_kill", source=str(replica_id),
                        detail=f"{detail}; redispatched {len(moved)}")
-        self._retire(rep)
+        self._retire(rep, kill=True)
+
+    def _worker_lost(self, rep: _Replica, detail: str):
+        """A subprocess replica's wire died (torn connection or missed
+        heartbeats) — the PROCESS-level analogue of ``replica_kill``:
+        book the ``fleet/worker_lost`` event + incident, arm the
+        respawn backoff for the slot, and fall through to the abrupt
+        kill path (redispatch everything the worker owned)."""
+        if rep.state == "dead" or rep.replica_id not in self.replicas:
+            return
+        self.stats["workers_lost"] += 1
+        self._fleet_event("fleet/worker_lost", replica=rep.replica_id,
+                          epoch=rep.epoch, detail=detail)
+        self._incident("worker_lost", source=str(rep.replica_id),
+                       detail=detail)
+        backoff = float(self.fleet.transport.respawn_backoff_s)
+        if backoff > 0:
+            self._respawn_after[rep.replica_id] = self._clock() + backoff
+        self.kill_replica(rep.replica_id, detail=detail)
+
+    def _respawn_ready(self, rid) -> bool:
+        """Consume the slot's respawn-backoff stamp once the clock
+        passes it; a storm of worker deaths respawns at most once per
+        ``respawn_backoff_s`` per slot."""
+        after = self._respawn_after.get(rid)
+        if after is not None and self._clock() < after:
+            return False
+        self._respawn_after.pop(rid, None)
+        return True
 
     def _fence(self, rep: _Replica, why: str):
         """Graceful failover: stop routing to the replica, drain it (its
@@ -505,7 +952,11 @@ class FleetRouter:
         self._incident("replica_fence", source=str(rep.replica_id),
                        detail=why)
         try:
-            res = rep.engine.drain()
+            res = rep.handle.drain()
+        except TransportError as e:     # worker died mid-drain
+            rep.state = "healthy"       # let _worker_lost see it live
+            self._worker_lost(rep, f"worker died while fencing: {e}")
+            return
         except Exception as e:   # a broken drain degrades to a kill
             rep.state = "healthy"   # let kill_replica see it live
             self.kill_replica(rep.replica_id,
@@ -574,8 +1025,7 @@ class FleetRouter:
         # affinity target first; spill order by least load
         order = [target] + sorted(
             (r for r in self._healthy(pool) if r is not target),
-            key=lambda r: (len(r.engine.queue) + r.engine.n_active,
-                           r.replica_id))
+            key=lambda r: (r.handle.load, r.replica_id))
         rejects = []
         for i, rep in enumerate(order):
             kwargs = dict(fr.kwargs)
@@ -584,9 +1034,13 @@ class FleetRouter:
             if prefill_only:
                 kwargs["prefill_only"] = True
             try:
-                rep.engine.add_request(fr.req_id, fr.prompt, **kwargs)
+                rep.handle.add_request(fr.req_id, fr.prompt, **kwargs)
             except RequestRejected as e:
                 rejects.append(e)
+                continue
+            except TransportError as e:
+                self._worker_lost(rep, f"add_request transport "
+                                       f"failed: {e}")
                 continue
             fr.state = "dispatched"
             fr.replica_id = rep.replica_id
@@ -678,7 +1132,7 @@ class FleetRouter:
         are final (the TTL is absolute), everything else — shed, evicted,
         drained — is the REPLICA's fault, so the request redispatches
         while its budget lasts."""
-        for rid, result in rep.engine.pop_terminated().items():
+        for rid, result in rep.handle.pop_terminated().items():
             fr = self.requests.get(rid)
             if fr is None or fr.state != "dispatched" or \
                     fr.replica_id != rep.replica_id:
@@ -693,12 +1147,12 @@ class FleetRouter:
         """Fold a prefill replica's completed prefills into fleet state:
         each request enters ``migrating`` (handoff captured, source
         pages pinned under ``rep``) and joins the migration queue."""
-        for rid, handoff in rep.engine.pop_prefilled().items():
+        for rid, handoff in rep.handle.pop_prefilled().items():
             fr = self.requests.get(rid)
             if fr is None or fr.state != "dispatched" or \
                     fr.replica_id != rep.replica_id:
                 # stale handoff (the request was re-homed) — unpin now
-                rep.engine.release_handoff(rid)
+                rep.handle.release_handoff(rid)
                 continue
             fr.state = "migrating"
             fr.handoff = handoff
@@ -724,36 +1178,45 @@ class FleetRouter:
             return ("retry", 0)
         order = [target] + sorted(
             (r for r in self._healthy("decode") if r is not target),
-            key=lambda r: (len(r.engine.queue) + r.engine.n_active,
-                           r.replica_id))
+            key=lambda r: (r.handle.load, r.replica_id))
         # transfer fault site — consulted before any engine mutates
         if self.injector is not None:
             self.injector.check("page_migrate")
         for rep in order:
-            eng = rep.engine
+            h = rep.handle
             # content-addressed dedup: full prompt pages already resident
             # in the destination's prefix cache (same rolling-blake2b
             # chain) are attached by reference instead of transferred —
             # a hot shared prefix migrates ONCE per decode replica
-            resident = (eng.prefix_cache.resident_prefix(handoff.prompt)
-                        if eng.prefix_cache is not None else [])
+            try:
+                resident = h.resident_prefix(handoff.prompt)
+            except TransportError as e:
+                self._worker_lost(rep, f"resident_prefix transport "
+                                       f"failed: {e}")
+                continue        # try the next decode replica
             to_send = handoff.pages[len(resident):]
-            payload = (src.engine.export_pages(to_send)
-                       if to_send else None)
-            wire_frac = 1.0
-            if payload is not None:
-                # wire codec runs AFTER the dedup plan: chain keys are
-                # token-addressed, so content dedup is quantization-blind;
-                # the destination decodes the self-describing wrapper in
-                # import_pages with no matching config of its own
-                payload = src.engine.comm_quant.encode_payload(payload)
-                if isinstance(payload, QuantizedPayload):
-                    wire_frac = (payload.wire_bytes /
-                                 max(payload.raw_bytes, 1))
+            # wire codec runs AFTER the dedup plan: chain keys are
+            # token-addressed, so content dedup is quantization-blind;
+            # in subprocess mode export+encode run ON the source worker
+            # and the quantized payload is what actually crosses the
+            # process boundary (the int8 saving is real wire bytes)
+            try:
+                payload, wire_frac = src.handle.export_payload(to_send)
+            except TransportError as e:
+                # source wire died holding the pin — the pinned copy is
+                # gone; _worker_lost requeues this request for a
+                # from-scratch re-prefill
+                self._worker_lost(src, f"export transport failed: {e}")
+                return ("retry", 0)
             deadline_s = (fr.deadline - now) if fr.deadline else None
-            if not eng.import_request(handoff, payload=payload,
-                                      shared_pages=resident,
-                                      deadline_s=deadline_s):
+            try:
+                imported = h.import_request(handoff, payload=payload,
+                                            shared_pages=resident,
+                                            deadline_s=deadline_s)
+            except TransportError as e:
+                self._worker_lost(rep, f"import transport failed: {e}")
+                continue        # uncommitted import died with the worker
+            if not imported:
                 continue        # full right now; try the next replica
             # commit fault site — consulted before the routing table
             # flips; a fault rolls the import back to NOTHING while the
@@ -762,7 +1225,7 @@ class FleetRouter:
                 try:
                     self.injector.check("migrate_commit")
                 except Exception as e:
-                    eng.cancel_import(fr.req_id)
+                    h.cancel_import(fr.req_id)
                     self.stats["migrate_commit_faults"] += 1
                     self._fleet_event(
                         "fleet/migrate_fault", req_id=fr.req_id,
@@ -770,13 +1233,33 @@ class FleetRouter:
                     fr.migrate_after = self.steps + max(
                         1, int(self.fleet.roles.migrate_backoff_steps))
                     return ("commit_fault", 0)
-            eng.commit_import(fr.req_id)
+            try:
+                h.commit_import(fr.req_id)
+            except TransportError as e:
+                # TORN COMMIT ACK: the destination died (or the wire
+                # tore) before acknowledging — the uncommitted import
+                # died with the process, the source stays pinned, and
+                # the transaction rolls back exactly like an injected
+                # migrate_commit fault
+                self._worker_lost(rep, f"commit ack lost: {e}")
+                self.stats["migrate_commit_faults"] += 1
+                self._fleet_event("fleet/migrate_fault", req_id=fr.req_id,
+                                  site="migrate_commit",
+                                  error=f"commit ack lost: {e}")
+                fr.migrate_after = self.steps + max(
+                    1, int(self.fleet.roles.migrate_backoff_steps))
+                return ("commit_fault", 0)
             fr.state = "dispatched"
             fr.replica_id = rep.replica_id
             fr.dispatches += 1
             fr.handoff = None
-            src.engine.release_handoff(fr.req_id)
-            page_bytes = int(eng.kv_page_bytes)
+            try:
+                src.handle.release_handoff(fr.req_id)
+            except TransportError as e:
+                # the commit already landed; a torn unpin just means the
+                # source worker died and takes the kill path
+                self._worker_lost(src, f"release transport failed: {e}")
+            page_bytes = int(h.kv_page_bytes)
             # per-page accounting stays analytic (pad lanes excluded):
             # the quantized wire carries wire_frac of the dtype-true
             # page bytes, the rest is quant saving on top of dedup
@@ -829,7 +1312,11 @@ class FleetRouter:
             if fr.deadline and self._clock() >= fr.deadline:
                 src = self.replicas.get(fr.replica_id)
                 if src is not None:
-                    src.engine.release_handoff(rid)
+                    try:
+                        src.handle.release_handoff(rid)
+                    except TransportError as e:
+                        self._worker_lost(src, f"release transport "
+                                               f"failed: {e}")
                 fr.handoff = None
                 self.stats["migrate_aborts"] += 1
                 self._fleet_event("fleet/migrate_abort", req_id=rid,
@@ -912,24 +1399,63 @@ class FleetRouter:
             if rep.state != "healthy":
                 continue
             try:
-                done = rep.engine.step()
+                done = rep.handle.step()
+                self._engine_steps += 1
+                before = set(self.finished)
+                self._collect_finished(rep, done)
+                self._collect_terminated(rep)
+                if self._roles_enabled and rep.role == "prefill":
+                    self._collect_handoffs(rep)
+            except TransportError as e:
+                # torn wire ≠ engine fault: the PROCESS died (or its
+                # connection did) — take the worker-lost path, which
+                # books the fleet/worker_lost incident before killing
+                self._worker_lost(rep, f"step transport failed: {e}")
+                continue
             except Exception as e:
                 self.kill_replica(rep.replica_id,
                                   detail=f"step raised: {e}")
                 continue
-            before = set(self.finished)
-            self._collect_finished(rep, done)
-            self._collect_terminated(rep)
-            if self._roles_enabled and rep.role == "prefill":
-                self._collect_handoffs(rep)
             for rid in set(self.finished) - before:
                 done_now[rid] = self.finished[rid]
+        self._check_liveness()
         if self._roles_enabled:
             self._pump_migrations()
-        if self.steps % int(self.fleet.health_interval) == 0:
+        # the sweep waits until at least one replica has actually
+        # stepped — health_interval=1 (or a fleet killed down to zero
+        # replicas before its first step) must not fire a supervision
+        # verdict on engines that never ran
+        if self._engine_steps and \
+                self.steps % int(self.fleet.health_interval) == 0:
             self._supervise()
         self._ensure_target()
         return done_now
+
+    def _check_liveness(self):
+        """Heartbeat liveness for subprocess replicas: drain each
+        channel's async frames (heartbeats stamp ``last_heartbeat``
+        with the router's clock on receipt) and declare any replica
+        whose last heartbeat is older than ``heartbeat_deadline_s``
+        lost.  In-process handles report ``last_heartbeat=None`` and
+        are exempt — they cannot die without the router dying too."""
+        deadline = float(self.fleet.transport.heartbeat_deadline_s)
+        now = self._clock()
+        for rep in list(self.replicas.values()):
+            if rep.state != "healthy":
+                continue
+            try:
+                rep.handle.pump()
+            except TransportError as e:
+                self._worker_lost(rep, f"heartbeat wire died: {e}")
+                continue
+            last = rep.handle.last_heartbeat
+            if last is None or deadline <= 0:
+                continue
+            age = now - last
+            if age > deadline:
+                self._worker_lost(
+                    rep, f"missed heartbeats: last seen {age:.1f}s ago "
+                         f"(deadline {deadline:.1f}s)")
 
     def pop_terminated(self) -> Dict[Any, RequestResult]:
         """Hand back (and clear) every fleet-level typed terminal since
@@ -965,8 +1491,12 @@ class FleetRouter:
                     self.kill_replica(rep.replica_id, detail=str(e))
                     continue
             try:
-                leaks = rep.engine.leak_report()
-                storm = bool(rep.engine.health().get("recompile_storm"))
+                leaks = rep.handle.leak_report()
+                storm = bool(rep.handle.health().get("recompile_storm"))
+            except TransportError as e:
+                self._worker_lost(rep, f"health check transport "
+                                       f"failed: {e}")
+                continue
             except Exception as e:
                 self.kill_replica(rep.replica_id,
                                   detail=f"health check raised: {e}")
@@ -985,13 +1515,13 @@ class FleetRouter:
             return
         healthy = self._healthy()
         queue_depth = len(self.pending) + sum(
-            len(r.engine.queue) for r in healthy)
+            r.handle.queue_depth for r in healthy)
         shed_total = self.stats["shed"] + sum(
-            r.engine.stats["shed"] for r in healthy)
+            r.handle.shed_count for r in healthy)
         shed_delta = max(0, shed_total - self._last_shed_total)
         self._last_shed_total = shed_total
-        fracs = [r.engine.alloc.free_page_count /
-                 max(1, r.engine.alloc.num_pages - 1) for r in healthy]
+        fracs = [r.handle.free_pages /
+                 max(1, r.handle.num_pages - 1) for r in healthy]
         desired = self._autoscaler.decide(
             max(1, len(healthy)), queue_depth=queue_depth,
             shed_delta=shed_delta,
@@ -1007,8 +1537,7 @@ class FleetRouter:
             # retire the least-loaded healthy replica gracefully
             victim = min(
                 self._healthy(),
-                key=lambda r: (len(r.engine.queue) + r.engine.n_active,
-                               r.replica_id),
+                key=lambda r: (r.handle.load, r.replica_id),
                 default=None)
             if victim is not None:
                 self._fence(victim, "scale_down")
@@ -1023,17 +1552,17 @@ class FleetRouter:
         for role in ("prefill", "decode"):
             healthy = self._healthy(role)
             n_by[role] = max(1, len(healthy))
-            q_by[role] = sum(len(r.engine.queue) for r in healthy) + (
+            q_by[role] = sum(r.handle.queue_depth for r in healthy) + (
                 len(self.pending) if role == "prefill"
                 else len(self.migrations))
-            shed_total = sum(r.engine.stats["shed"] for r in healthy)
+            shed_total = sum(r.handle.shed_count for r in healthy)
             if role == "prefill":
                 shed_total += self.stats["shed"]    # admission sheds
             shed_by[role] = max(0,
                                 shed_total - self._last_shed_by[role])
             self._last_shed_by[role] = shed_total
-            fracs = [r.engine.alloc.free_page_count /
-                     max(1, r.engine.alloc.num_pages - 1)
+            fracs = [r.handle.free_pages /
+                     max(1, r.handle.num_pages - 1)
                      for r in healthy]
             frac_by[role] = min(fracs) if fracs else 1.0
         desired = self._autoscaler.decide(n_by, queue_by_pool=q_by,
@@ -1052,8 +1581,7 @@ class FleetRouter:
                                   queue_depth=q_by[role])
                 victim = min(
                     self._healthy(role),
-                    key=lambda r: (len(r.engine.queue) +
-                                   r.engine.n_active, r.replica_id),
+                    key=lambda r: (r.handle.load, r.replica_id),
                     default=None)
                 if victim is not None:
                     self._fence(victim, "scale_down")
@@ -1070,15 +1598,22 @@ class FleetRouter:
                     self._targets[role])
                 while sum(1 for r in self.replicas.values()
                           if r.role == role) < floor:
-                    dead = sorted(
+                    dead_all = sorted(
                         r for r in set(self._gens) - set(self.replicas)
                         if self._role_of.get(r) == role)
+                    dead = [r for r in dead_all if self._respawn_ready(r)]
+                    if dead_all and not dead:
+                        break       # every dead slot is backing off —
+                        #             don't mint NEW rids around them
                     self._spawn(replica_id=dead[0] if dead else None,
                                 respawn=bool(dead), role=role)
             return
         floor = max(int(self.fleet.min_replicas), self._target)
         while len(self.replicas) < floor:
-            dead = sorted(set(self._gens) - set(self.replicas))
+            dead_all = sorted(set(self._gens) - set(self.replicas))
+            dead = [r for r in dead_all if self._respawn_ready(r)]
+            if dead_all and not dead:
+                break               # respawn storm bounded by backoff
             self._spawn(replica_id=dead[0] if dead else None,
                         respawn=bool(dead))
 
@@ -1116,20 +1651,26 @@ class FleetRouter:
         exporter's ``GET /fleet``."""
         per_replica = {}
         queue_depth = len(self.pending)
+        now = self._clock()
+        subprocess_mode = self.fleet.transport.mode == "subprocess"
         for rep in self.replicas.values():
-            eng = rep.engine
-            per_replica[rep.replica_id] = {
+            h = rep.handle
+            entry = {
                 "state": rep.state,
                 "epoch": rep.epoch,
                 "role": rep.role,
-                "queue_depth": len(eng.queue),
-                "active_slots": eng.n_active,
-                "free_pages": eng.alloc.free_page_count,
-                "prefix_hit_rate": (
-                    eng.prefix_cache.snapshot()["hit_rate"]
-                    if eng.prefix_cache is not None else None),
+                "queue_depth": h.queue_depth,
+                "active_slots": h.n_active,
+                "free_pages": h.free_pages,
+                "prefix_hit_rate": h.prefix_hit_rate,
             }
-            queue_depth += len(eng.queue)
+            if subprocess_mode:
+                entry["transport"] = h.mode
+                last = h.last_heartbeat
+                entry["heartbeat_age_s"] = (
+                    round(now - last, 3) if last is not None else None)
+            per_replica[rep.replica_id] = entry
+            queue_depth += h.queue_depth
         snap = {
             "replicas": per_replica,
             "n_replicas": len(self.replicas),
@@ -1152,7 +1693,7 @@ class FleetRouter:
                 pools[role] = {
                     "n_healthy": len(healthy),
                     "target": self._targets[role],
-                    "queue_depth": sum(len(r.engine.queue)
+                    "queue_depth": sum(r.handle.queue_depth
                                        for r in healthy),
                 }
             snap["pools"] = pools
@@ -1168,6 +1709,15 @@ class FleetRouter:
                 tel.registry.gauge(gauge).set(snap[key])
             tel.registry.gauge("fleet/redispatches").set(
                 self.stats["redispatches"])
+            if subprocess_mode:
+                tel.registry.gauge("fleet/workers_lost").set(
+                    self.stats["workers_lost"])
+                ages = [e["heartbeat_age_s"]
+                        for e in per_replica.values()
+                        if e.get("heartbeat_age_s") is not None]
+                if ages:
+                    tel.registry.gauge("fleet/heartbeat_age_s").set(
+                        max(ages))
             if self._roles_enabled:
                 tel.registry.gauge("fleet/migrating").set(
                     snap["migrating"])
@@ -1187,8 +1737,14 @@ class FleetRouter:
         fleet-level trace-completeness audit, and the bookkeeping
         identity submitted == finished + terminated + unresolved."""
         leaks: Dict[str, Any] = {}
-        for rep in self.replicas.values():
-            for k, v in rep.engine.leak_report().items():
+        for rep in list(self.replicas.values()):
+            try:
+                report = rep.handle.leak_report()
+            except TransportError as e:
+                self._worker_lost(rep, f"leak audit transport "
+                                       f"failed: {e}")
+                continue
+            for k, v in report.items():
                 leaks[f"{rep.replica_id}:{k}"] = v
         live = [fr.req_id for fr in self.requests.values()
                 if fr.state in ("pending", "dispatched", "migrating")]
@@ -1201,3 +1757,16 @@ class FleetRouter:
                 "terminated": self.stats["terminated"],
                 "unresolved": self._unresolved()}
         return leaks
+
+    def close(self):
+        """Tear down every replica handle.  In-process: a no-op.
+        Subprocess: graceful shutdown of each healthy worker (SIGKILL
+        for anything already marked unhealthy) — tests and benches call
+        this so no worker processes outlive the router."""
+        for rep in list(self.replicas.values()):
+            if rep.handle is not None:
+                try:
+                    rep.handle.close(kill=(rep.state != "healthy"))
+                except Exception:
+                    pass
+        self.replicas.clear()

@@ -119,6 +119,55 @@ class PrefillHandoff:
     # decode here — not just the decode leg
     trace_ctx: Optional[dict] = None
 
+    def to_wire(self) -> dict:
+        """JSON-safe envelope for the cross-process fleet transport
+        (``inference/transport.py``), stamped with the wire version.
+        Every field is already plain primitives except ``rng_state``
+        (numpy bit-generator state — MT19937 carries an ndarray key)."""
+        from deepspeed_tpu.inference.transport import (WIRE_VERSION,
+                                                       pack_value)
+        return {
+            "v": list(WIRE_VERSION),
+            "req_id": pack_value(self.req_id),
+            "prompt": [int(t) for t in self.prompt],
+            "max_new_tokens": int(self.max_new_tokens),
+            "temperature": float(self.temperature),
+            "seed": int(self.seed),
+            "top_k": int(self.top_k),
+            "top_p": float(self.top_p),
+            "slo_class": str(self.slo_class),
+            "last_token": int(self.last_token),
+            "out": [int(t) for t in self.out],
+            "rng_state": pack_value(self.rng_state),
+            "pages": [int(p) for p in self.pages],
+            "trace_ctx": pack_value(self.trace_ctx),
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PrefillHandoff":
+        """Inverse of :meth:`to_wire`.  Rejects an unknown MAJOR wire
+        version with the typed ``WireVersionError`` before reading any
+        field — a decode replica must never guess at an envelope from a
+        newer incompatible router."""
+        from deepspeed_tpu.inference.transport import (check_wire_version,
+                                                       unpack_value)
+        check_wire_version(d.get("v"), "PrefillHandoff")
+        return cls(
+            req_id=unpack_value(d["req_id"]),
+            prompt=[int(t) for t in d["prompt"]],
+            max_new_tokens=int(d["max_new_tokens"]),
+            temperature=float(d["temperature"]),
+            seed=int(d["seed"]),
+            top_k=int(d["top_k"]),
+            top_p=float(d["top_p"]),
+            slo_class=str(d["slo_class"]),
+            last_token=int(d["last_token"]),
+            out=[int(t) for t in d["out"]],
+            rng_state=unpack_value(d["rng_state"]),
+            pages=[int(p) for p in d["pages"]],
+            trace_ctx=unpack_value(d.get("trace_ctx")),
+        )
+
 
 class ServingEngine:
     """``add_request`` → ``step`` until ``finished`` — or just

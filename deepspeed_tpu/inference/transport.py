@@ -1,0 +1,470 @@
+"""Cross-process fleet transport: framed JSON over a local socket pair.
+
+The fleet grew up in one process — ``FleetRouter`` holding N
+``ServingEngine`` objects — so "replica death" was an injected fault.
+This module is the real wire between a router and a worker process
+(``inference/fleet_worker.py``): length-prefixed JSON frames over an
+``AF_UNIX`` socketpair, a value codec that makes ndarrays / bytes /
+non-string-keyed maps JSON-safe, versioned envelopes for the KV-page
+migration payloads, and the router-side :class:`RpcChannel` that demuxes
+synchronous RPC responses from the worker's asynchronous heartbeats.
+
+Wire shape, all frames::
+
+    [4-byte big-endian length][utf-8 JSON object]
+
+Frame kinds: a request frame carries ``op`` (router → worker); the
+worker answers every op with exactly one ``kind: "resp"`` or ``kind:
+"err"`` frame, and interleaves unsolicited ``kind: "hb"`` heartbeat
+frames from its beat thread.  Responses are strictly ordered (one
+outstanding call at a time), so the channel needs no correlation ids.
+
+Versioning: every payload-bearing envelope (``PrefillHandoff.to_wire``,
+``QuantizedPayload.to_wire``, :func:`payload_to_wire`) carries ``"v":
+[major, minor]``.  An unknown MAJOR is rejected with the typed
+:class:`WireVersionError` (a router must never guess at a frame it
+cannot parse); a newer minor passes — minor bumps may only add fields.
+
+Everything here is stdlib + numpy; jax-adjacent imports (the quantized
+payload classes) are deferred into the payload helpers so a worker can
+import this module before jax finishes loading.
+"""
+
+import base64
+import json
+import socket
+import struct
+import time
+from collections import deque
+
+import numpy as np
+
+# The transport wire version, stamped into every payload envelope as
+# ``[major, minor]``.  Bump MINOR when adding fields (old decoders must
+# keep working); bump MAJOR for anything an old decoder would misread.
+WIRE_VERSION = (1, 0)
+
+_HEADER = struct.Struct(">I")
+# sanity bound on one frame (a full KV-page payload for the tiny test
+# engines is ~KBs; real payloads are bounded by the page-transfer
+# budget) — a corrupt length prefix must not trigger a giant allocation
+MAX_FRAME_BYTES = 1 << 30
+
+
+class TransportError(RuntimeError):
+    """The wire failed mid-conversation: torn connection, EOF inside a
+    frame, corrupt framing, or a worker-side error that has no typed
+    mapping.  The router treats this exactly like a replica death."""
+
+
+class WorkerError(RuntimeError):
+    """A worker-side op raised (engine exception, bad arguments).  The
+    wire itself is fine — deliberately NOT a :class:`TransportError`, so
+    the router can tell an engine fault (kill the replica, in-process
+    semantics) from a torn connection (worker lost)."""
+
+
+class WireVersionError(TransportError):
+    """Typed rejection of an envelope whose MAJOR version this decoder
+    does not speak (satellite: reject-with-typed-error, never guess)."""
+
+    def __init__(self, got, what="payload"):
+        self.got = got
+        self.what = what
+        super().__init__(
+            f"{what}: unknown wire version {got!r} "
+            f"(this decoder speaks major {WIRE_VERSION[0]})")
+
+
+def check_wire_version(v, what="payload"):
+    """Validate an envelope's ``v`` field: the major must match
+    ``WIRE_VERSION[0]``; any minor under that major is accepted."""
+    try:
+        major = int(v[0])
+        int(v[1])
+    except (TypeError, ValueError, IndexError, KeyError):
+        raise WireVersionError(v, what)
+    if major != WIRE_VERSION[0]:
+        raise WireVersionError(v, what)
+
+
+# ----------------------------------------------------------------------
+# value codec: JSON + ndarrays / bytes / non-string-keyed maps
+# ----------------------------------------------------------------------
+
+# reserved marker keys; a plain dict that happens to contain one is
+# escaped through the __map__ form so unpacking stays unambiguous
+_MARKERS = ("__nd__", "__b64__", "__map__", "__qleaf__", "__tup__")
+
+
+def _dtype_of(name):
+    """``np.dtype`` from its string name, reaching for ml_dtypes (a jax
+    dependency, always present here) for bfloat16-family names plain
+    numpy does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 with numpy
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def nd_to_wire(arr):
+    """One ndarray as a JSON-safe dict (base64 raw bytes + dtype name +
+    shape).  Accepts anything ``np.asarray`` takes — jax arrays device-
+    transfer here, which is exactly the wire boundary."""
+    arr = np.ascontiguousarray(np.asarray(arr))
+    return {"__nd__": base64.b64encode(arr.tobytes()).decode("ascii"),
+            "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def nd_from_wire(d):
+    raw = base64.b64decode(d["__nd__"])
+    return np.frombuffer(raw, dtype=_dtype_of(d["dtype"])).reshape(
+        d["shape"]).copy()
+
+
+def pack_value(obj):
+    """Recursively rewrite ``obj`` into a JSON-serializable structure:
+    ndarrays and numpy scalars, bytes, tuples (marked, so they unpack
+    back to tuples — req_ids must stay hashable across the wire), and
+    dicts with non-string keys all get stable encodings.  The inverse
+    is :func:`unpack_value`."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__b64__": base64.b64encode(bytes(obj)).decode("ascii")}
+    if isinstance(obj, np.ndarray):
+        return nd_to_wire(obj)
+    if isinstance(obj, tuple):
+        return {"__tup__": [pack_value(v) for v in obj]}
+    if isinstance(obj, list):
+        return [pack_value(v) for v in obj]
+    if isinstance(obj, dict):
+        if any(k in obj for k in _MARKERS):
+            return obj          # already packed — pack is idempotent
+        if all(isinstance(k, str) for k in obj):
+            return {k: pack_value(v) for k, v in obj.items()}
+        return {"__map__": [[pack_value(k), pack_value(v)]
+                            for k, v in obj.items()]}
+    raise TypeError(f"transport cannot encode {type(obj).__name__}")
+
+
+def unpack_value(obj):
+    """Inverse of :func:`pack_value`."""
+    if isinstance(obj, dict):
+        if "__b64__" in obj:
+            return base64.b64decode(obj["__b64__"])
+        if "__nd__" in obj:
+            return nd_from_wire(obj)
+        if "__tup__" in obj:
+            return tuple(unpack_value(v) for v in obj["__tup__"])
+        if "__map__" in obj:
+            return {_hashable(unpack_value(k)): unpack_value(v)
+                    for k, v in obj["__map__"]}
+        return {k: unpack_value(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [unpack_value(v) for v in obj]
+    return obj
+
+
+def _hashable(k):
+    return tuple(k) if isinstance(k, list) else k
+
+
+# ----------------------------------------------------------------------
+# framing
+# ----------------------------------------------------------------------
+
+
+def send_frame(sock, obj, lock=None):
+    """Serialize + length-prefix + sendall one frame.  ``lock`` guards
+    the socket when two threads write (the worker's main loop and its
+    heartbeat thread); any OS-level failure surfaces as
+    :class:`TransportError` — a torn wire, not a crash."""
+    data = json.dumps(pack_value(obj), separators=(",", ":")).encode()
+    buf = _HEADER.pack(len(data)) + data
+    try:
+        if lock is not None:
+            with lock:
+                sock.sendall(buf)
+        else:
+            sock.sendall(buf)
+    except (OSError, ValueError) as e:
+        raise TransportError(f"send failed: {e}")
+
+
+def recv_frame(stream):
+    """Read exactly one frame from a blocking file-like stream (the
+    worker side uses ``sock.makefile('rb')``).  EOF — clean or mid-frame
+    — is a :class:`TransportError`: the peer is gone."""
+    head = _read_exact(stream, _HEADER.size)
+    (n,) = _HEADER.unpack(head)
+    if n > MAX_FRAME_BYTES:
+        raise TransportError(f"frame length {n} exceeds cap")
+    return json.loads(_read_exact(stream, n).decode())
+
+
+def _read_exact(stream, n):
+    chunks = []
+    while n:
+        try:
+            chunk = stream.read(n)
+        except OSError as e:
+            raise TransportError(f"recv failed: {e}")
+        if not chunk:
+            raise TransportError("connection closed by peer")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+# ----------------------------------------------------------------------
+# migration payload envelopes (versioned)
+# ----------------------------------------------------------------------
+
+
+def payload_to_wire(payload):
+    """Wire envelope for a KV-page migration payload: either the raw
+    exported pytree or the source codec's :class:`QuantizedPayload`
+    (``comm/quantize.py``).  Quantized leaves stay int8 on the wire —
+    the whole point of the codec survives serialization."""
+    from deepspeed_tpu.comm.quantize import QuantizedPayload
+    if payload is None:
+        return None
+    if isinstance(payload, QuantizedPayload):
+        return {"v": list(WIRE_VERSION), "quant": True,
+                "block_size": int(payload.block_size),
+                "wire_bytes": int(payload.wire_bytes),
+                "raw_bytes": int(payload.raw_bytes),
+                "tree": _tree_to_wire(payload.leaves)}
+    return {"v": list(WIRE_VERSION), "quant": False,
+            "tree": _tree_to_wire(payload)}
+
+
+def payload_from_wire(d):
+    """Inverse of :func:`payload_to_wire`; validates the envelope
+    version before touching anything else."""
+    from deepspeed_tpu.comm.quantize import QuantizedPayload
+    if d is None:
+        return None
+    check_wire_version(d.get("v"), "QuantizedPayload"
+                       if d.get("quant") else "migration payload")
+    tree = _tree_from_wire(d["tree"])
+    if d.get("quant"):
+        return QuantizedPayload(leaves=tree,
+                                block_size=int(d["block_size"]),
+                                wire_bytes=int(d["wire_bytes"]),
+                                raw_bytes=int(d["raw_bytes"]))
+    return tree
+
+
+def _tree_to_wire(tree):
+    """Encode an exported-cache pytree (nested dict/list/tuple of
+    arrays, with :class:`QuantizedLeaf` at quantized positions)."""
+    from deepspeed_tpu.comm.quantize import QuantizedLeaf
+    if isinstance(tree, QuantizedLeaf):
+        return {"__qleaf__": {
+            "codes": nd_to_wire(tree.codes),
+            "scales": nd_to_wire(tree.scales),
+            "shape": list(tree.shape),
+            "dtype": str(np.dtype(tree.dtype)),
+            "numel": int(tree.numel)}}
+    if isinstance(tree, dict):
+        return {"__tree_dict__": {str(k): _tree_to_wire(v)
+                                  for k, v in tree.items()}}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        # a namedtuple pytree node (e.g. PagedKVCache): record the
+        # import path so the receiver rebuilds the SAME node type —
+        # import_pages tree_maps the payload against its own cache
+        # pytree, so plain lists would be a structure mismatch.  Both
+        # ends run this codebase by construction (the engine factory
+        # spec is itself a dotted import path), so import-by-name is
+        # the same trust domain the fleet already stands on.
+        cls = type(tree)
+        return {"__tree_ntup__":
+                f"{cls.__module__}:{cls.__qualname__}",
+                "fields": [_tree_to_wire(v) for v in tree]}
+    if isinstance(tree, tuple):
+        return {"__tree_tup__": [_tree_to_wire(v) for v in tree]}
+    if isinstance(tree, (list,)):
+        return {"__tree_list__": [_tree_to_wire(v) for v in tree]}
+    return nd_to_wire(tree)
+
+
+def _nd(x):
+    """ndarray from either wire form: the raw ``__nd__`` dict, or an
+    already-decoded array (a frame that passed through
+    :class:`RpcChannel`'s value decode on its way here)."""
+    return x if isinstance(x, np.ndarray) else nd_from_wire(x)
+
+
+def _tree_from_wire(node):
+    from deepspeed_tpu.comm.quantize import QuantizedLeaf
+    if isinstance(node, np.ndarray):
+        return node
+    if "__qleaf__" in node:
+        q = node["__qleaf__"]
+        return QuantizedLeaf(codes=_nd(q["codes"]),
+                             scales=_nd(q["scales"]),
+                             shape=tuple(q["shape"]),
+                             dtype=_dtype_of(q["dtype"]),
+                             numel=int(q["numel"]))
+    if "__tree_dict__" in node:
+        return {k: _tree_from_wire(v)
+                for k, v in node["__tree_dict__"].items()}
+    if "__tree_ntup__" in node:
+        import importlib
+        mod_name, _, qualname = node["__tree_ntup__"].partition(":")
+        cls = importlib.import_module(mod_name)
+        for part in qualname.split("."):
+            cls = getattr(cls, part)
+        return cls(*[_tree_from_wire(v) for v in node["fields"]])
+    if "__tree_tup__" in node:
+        return tuple(_tree_from_wire(v) for v in node["__tree_tup__"])
+    if "__tree_list__" in node:
+        return [_tree_from_wire(v) for v in node["__tree_list__"]]
+    return nd_from_wire(node)
+
+
+# ----------------------------------------------------------------------
+# router-side channel
+# ----------------------------------------------------------------------
+
+
+class RpcChannel:
+    """The router's end of one worker socket.
+
+    Single-threaded by design (the :class:`FleetRouter` owns it); the
+    worker interleaves asynchronous heartbeat frames between RPC
+    responses, so every read path funnels through the same buffered
+    parser: heartbeats update :attr:`last_heartbeat` / :attr:`hb_seq` /
+    :attr:`hb_epoch` the moment they are seen, everything else lands in
+    the response inbox.  :meth:`pump` drains whatever bytes have already
+    arrived without blocking — the router's liveness check calls it each
+    step, so a worker that stops beating is noticed even when no RPC is
+    in flight.
+
+    ``last_heartbeat`` is stamped with the ROUTER's clock at receipt
+    (injectable for tests); it starts at construction time, so a fresh
+    worker gets one full deadline to come up before liveness can indict
+    it.
+    """
+
+    def __init__(self, sock, clock=None):
+        self.sock = sock
+        self._clock = clock if clock is not None else time.monotonic
+        self._buf = bytearray()
+        self._inbox = deque()
+        self.last_heartbeat = self._clock()
+        self.hb_seq = -1
+        self.hb_epoch = None
+        self.closed = False
+
+    # -- byte plumbing ---------------------------------------------------
+    def _parse(self):
+        while True:
+            if len(self._buf) < _HEADER.size:
+                return
+            (n,) = _HEADER.unpack(bytes(self._buf[:_HEADER.size]))
+            if n > MAX_FRAME_BYTES:
+                raise TransportError(f"frame length {n} exceeds cap")
+            if len(self._buf) < _HEADER.size + n:
+                return
+            data = bytes(self._buf[_HEADER.size:_HEADER.size + n])
+            del self._buf[:_HEADER.size + n]
+            frame = unpack_value(json.loads(data.decode()))
+            if isinstance(frame, dict) and frame.get("kind") == "hb":
+                seq = int(frame.get("seq", 0))
+                # a monotonicity regression means a confused or replaced
+                # peer — ignore the beat rather than refresh liveness
+                if seq > self.hb_seq:
+                    self.hb_seq = seq
+                    self.hb_epoch = frame.get("epoch")
+                    self.last_heartbeat = self._clock()
+            else:
+                self._inbox.append(frame)
+
+    def _fill(self, timeout):
+        """Read whatever the socket has within ``timeout`` seconds
+        (0 = only what is already buffered) into the parse buffer."""
+        if self.closed:
+            raise TransportError("channel is closed")
+        try:
+            self.sock.settimeout(timeout)
+            chunk = self.sock.recv(1 << 16)
+        except (socket.timeout, BlockingIOError):
+            return False
+        except OSError as e:
+            raise TransportError(f"recv failed: {e}")
+        if not chunk:
+            raise TransportError("worker closed the connection")
+        self._buf.extend(chunk)
+        return True
+
+    def pump(self):
+        """Drain already-arrived frames without blocking (heartbeats
+        update liveness state; responses queue).  Raises
+        :class:`TransportError` when the worker side is gone."""
+        while self._fill(0.0):
+            pass
+        self._parse()
+
+    # -- calls -----------------------------------------------------------
+    def call(self, op, timeout=60.0, **kwargs):
+        """One synchronous RPC: send ``{op, **kwargs}``, block (up to
+        ``timeout`` wall seconds) for the matching response frame, and
+        return its payload dict.  Worker-side typed errors re-raise
+        here; anything structural raises :class:`TransportError`."""
+        self.pump()
+        if self._inbox:     # protocol break: a stale unclaimed response
+            raise TransportError(
+                f"unexpected frame before call {op!r}: "
+                f"{self._inbox.popleft()!r}")
+        frame = {"op": op}
+        frame.update(kwargs)
+        try:
+            self.sock.settimeout(timeout)
+            send_frame(self.sock, frame)
+        except TransportError:
+            raise
+        deadline = time.monotonic() + timeout
+        while not self._inbox:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TransportError(f"call {op!r} timed out "
+                                     f"after {timeout}s")
+            self._fill(remaining)
+            self._parse()
+        resp = self._inbox.popleft()
+        if not isinstance(resp, dict):
+            raise TransportError(f"malformed response to {op!r}")
+        if resp.get("kind") == "err":
+            self._raise_typed(op, resp)
+        return resp
+
+    @staticmethod
+    def _raise_typed(op, resp):
+        etype = resp.get("etype", "")
+        detail = resp.get("detail", "")
+        if etype == "RequestRejected":
+            from deepspeed_tpu.inference.robustness import RequestRejected
+            raise RequestRejected(resp.get("req_id"),
+                                  resp.get("reason", ""), detail)
+        if etype == "WireVersionError":
+            raise WireVersionError(resp.get("got"),
+                                   resp.get("what", op))
+        raise WorkerError(f"worker error in {op!r}: "
+                          f"{etype or 'Exception'}: {detail}")
+
+    def close(self):
+        self.closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -63,9 +63,12 @@ from deepspeed_tpu.utils.logging import logger
 INCIDENT_EVENTS = ("incident/open", "incident/written")
 
 # The closed set of trigger kinds — one per verdict source wired through
-# the planes (see module docstring).  Frozen for the same reason.
+# the planes (see module docstring; "worker_lost" is the cross-process
+# fleet's torn-wire / missed-heartbeat verdict).  Frozen for the same
+# reason.
 INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
-                     "replica_kill", "replica_fence", "slo_burn")
+                     "replica_kill", "replica_fence", "slo_burn",
+                     "worker_lost")
 
 # Default multi-window burn-rate policy: burning when >= 50% of
 # deadline-bearing requests missed over the last minute AND >= 10% over

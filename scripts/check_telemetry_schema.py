@@ -227,6 +227,7 @@ FLEET_EVENTS = (
     "fleet/scale_up", "fleet/scale_down",
     "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
     "fleet/migrate_abort", "fleet/local_prefill",
+    "fleet/worker_lost",
 )
 
 # FROZEN vocabulary of tune-kind event names — must stay byte-identical
@@ -313,7 +314,8 @@ ROOFLINE_METRICS = ("compute_frac", "bandwidth_frac")
 # leak_report(), fleet replica kill / fence, SLO burn-rate alert).
 INCIDENT_EVENTS = ("incident/open", "incident/written")
 INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
-                     "replica_kill", "replica_fence", "slo_burn")
+                     "replica_kill", "replica_fence", "slo_burn",
+                     "worker_lost")
 
 # FROZEN vocabularies of the time-attribution plane — each must stay
 # byte-identical to its twin in ``deepspeed_tpu.monitor.attribution``

@@ -11,6 +11,7 @@
 #   6. comm-quant smoke                              int8 codec roundtrip
 #   7. ds_trace_export.py --check                    Perfetto trace export
 #   8. overlap smoke                                 ZeRO-3 comm overlap
+#   9. fleet xproc smoke                             kill -9 a worker proc
 #
 # TELEMETRY_DIR (optional) is searched recursively for events*.jsonl
 # streams; INCIDENTS_DIR (optional) holds incident bundles; TUNE_DIR
@@ -258,6 +259,96 @@ print(f"overlap smoke: 3 overlapped steps vs serial — step-0 loss "
       f"bit-identical ({serial[0]:.6f}), trajectory within ulp "
       f"tolerance, {len(checker.OVERLAP_GAUGES)} overlap gauges + "
       f"exposed_comm_frac on a {len(events)}-event schema-valid stream")
+EOF
+
+# 9. cross-process fleet smoke: a 2-worker subprocess fleet must serve
+# the same tokens as the in-process fleet bit-for-bit, then survive a
+# real kill -9 of one worker mid-decode with zero lost requests — every
+# id reaches exactly one typed terminal, survivors stay bit-identical,
+# and the death is booked as a schema-valid fleet/worker_lost event plus
+# a worker_lost incident bundle the checker accepts
+run_gate "fleet xproc smoke" env JAX_PLATFORMS=cpu REPO="$REPO" "$PY" - <<'EOF'
+import importlib.util, json, os, signal, sys, tempfile
+repo = os.environ["REPO"]
+sys.path.insert(0, repo)
+from deepspeed_tpu.inference.fleet import FleetRouter
+from deepspeed_tpu.inference.fleet_worker import tiny_engine_factory
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+
+SPEC = {"factory":
+        "deepspeed_tpu.inference.fleet_worker:tiny_engine_factory",
+        "kwargs": {}}
+XPROC = {"mode": "subprocess", "heartbeat_interval_s": 0.2,
+         "heartbeat_deadline_s": 10.0}
+PROMPTS = {f"q{i}": [1 + i, 2 + i, 3 + i, 4 + i] for i in range(6)}
+
+def run(factory, fleet, kill_rid=None, telemetry=None):
+    router = FleetRouter(factory, fleet=fleet, telemetry=telemetry)
+    try:
+        for rid, p in sorted(PROMPTS.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        killed = False
+        for step in range(300):
+            if kill_rid and step == 3 and not killed:
+                os.kill(router.replicas[kill_rid].handle.proc.pid,
+                        signal.SIGKILL)
+                killed = True
+            router.step()
+            if not router._unresolved():
+                break
+        assert not router._unresolved(), "fleet did not converge"
+        return (dict(router.finished), router.pop_terminated(),
+                router.leak_report(), dict(router.stats))
+    finally:
+        router.close()
+
+base = {"replicas": 2, "health_interval": 4}
+ref, term, leaks, _ = run(tiny_engine_factory, dict(base))
+assert not term and leaks == {}, (term, leaks)
+
+out, term, leaks, _ = run(SPEC, dict(base, transport=dict(XPROC)))
+assert not term and leaks == {}, (term, leaks)
+assert out == ref, "subprocess fleet not bit-identical to in-process"
+
+tmp = tempfile.mkdtemp()
+tel = Telemetry().configure(TelemetryConfig(
+    {"enabled": True, "output_path": tmp, "job_name": "xproc_gate",
+     "incidents": {"enabled": True, "cooldown_s": 0.0}}), rank=0)
+try:
+    out, term, leaks, stats = run(SPEC, dict(base, transport=dict(XPROC)),
+                                  kill_rid="r0", telemetry=tel)
+finally:
+    tel.close()
+assert leaks == {}, leaks
+assert stats["workers_lost"] == 1, stats
+assert set(out) | set(term) == set(PROMPTS), (set(out), set(term))
+assert not (set(out) & set(term)), "a request reached two terminals"
+for rid, toks in out.items():
+    assert toks == ref[rid], f"{rid} diverged after kill -9"
+
+spec = importlib.util.spec_from_file_location(
+    "checker", os.path.join(repo, "scripts",
+                            "check_telemetry_schema.py"))
+checker = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(checker)
+stream = os.path.join(tmp, "xproc_gate", "events.jsonl")
+assert checker.validate_file(stream) == [], "event stream schema-invalid"
+events = [json.loads(l) for l in open(stream) if l.strip()]
+assert any(e.get("kind") == "fleet" and
+           e.get("name") == "fleet/worker_lost" for e in events)
+assert any(e.get("kind") == "incident" and
+           e.get("trigger") == "worker_lost" for e in events)
+bundles = os.path.join(tmp, "xproc_gate", "incidents")
+problems, n_bundles = checker.validate_incidents_path(bundles)
+assert problems == [], problems[:3]
+assert n_bundles >= 1, "no incident bundle written"
+print(f"fleet xproc smoke: {len(ref)} requests bit-identical across the "
+      f"process boundary; kill -9 mid-decode -> {len(out)} finished + "
+      f"{len(term)} re-terminated, zero lost, workers_lost="
+      f"{stats['workers_lost']}, respawns={stats['respawns']}, "
+      f"schema-valid worker_lost event + incident bundle")
 EOF
 
 if [ "$fail" -ne 0 ]; then

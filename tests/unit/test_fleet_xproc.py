@@ -1,0 +1,560 @@
+"""Cross-process fleet tests (inference/transport.py +
+inference/fleet_worker.py + the FleetRouter ReplicaHandle refactor):
+wire-envelope versioning and round-trips, supervision-sweep cadence,
+heartbeat liveness corners, and REAL worker processes surviving
+``kill -9`` with zero lost requests.
+
+Oracle discipline: a request's output depends only on (prompt, sampling
+params, seed) — never on which replica, process, or dispatch attempt
+served it — so a subprocess fleet over the deterministic
+``tiny_engine_factory`` spec must produce outputs bit-identical to an
+in-process fleet over the same factory, before AND after a worker is
+SIGKILLed mid-flight."""
+
+import importlib.util
+import json
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.quantize import CommQuantizer, QuantizedPayload
+from deepspeed_tpu.inference.fleet import (FleetConfig, FleetRouter,
+                                           FleetTransportConfig,
+                                           InProcessReplicaHandle,
+                                           SubprocessReplicaHandle)
+from deepspeed_tpu.inference.fleet_worker import (resolve_factory,
+                                                  tiny_engine_factory)
+from deepspeed_tpu.inference.serving import PrefillHandoff, ServingEngine
+from deepspeed_tpu.inference.transport import (TransportError,
+                                               WIRE_VERSION,
+                                               WireVersionError,
+                                               pack_value, unpack_value)
+from deepspeed_tpu.models.transformer import (CausalTransformerLM,
+                                              TransformerConfig)
+from deepspeed_tpu.monitor.attribution import TraceContext
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+
+SPEC = {"factory":
+        "deepspeed_tpu.inference.fleet_worker:tiny_engine_factory",
+        "kwargs": {}}
+XPROC = {"mode": "subprocess", "heartbeat_interval_s": 0.2,
+         "heartbeat_deadline_s": 10.0}
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    model = CausalTransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    return cfg, model, params
+
+
+def _factory(model, params, **overrides):
+    def build(replica_id, epoch):
+        kw = dict(max_batch=4, page_size=8, max_seq=128,
+                  dtype=jnp.float32, replica_epoch=epoch,
+                  serving={"prefix_cache": {"enabled": True}})
+        kw.update(overrides)
+        return ServingEngine(model, params, **kw)
+    return build
+
+
+def _load_checker():
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", "check_telemetry_schema.py")
+    spec = importlib.util.spec_from_file_location("check_telemetry_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------------
+# transport config
+# ----------------------------------------------------------------------
+def test_transport_config_validation():
+    cfg = FleetConfig({"transport": {"mode": "subprocess",
+                                     "heartbeat_deadline_s": 3.0}})
+    assert isinstance(cfg.transport, FleetTransportConfig)
+    assert cfg.transport.mode == "subprocess"
+    assert FleetConfig({}).transport.mode == "inprocess"
+    for bad in ({"mode": "carrier-pigeon"},
+                {"heartbeat_interval_s": -1.0},
+                {"heartbeat_interval_s": 5.0,
+                 "heartbeat_deadline_s": 1.0}):
+        with pytest.raises(ValueError):
+            FleetTransportConfig(bad)
+
+
+def test_subprocess_mode_rejects_live_callable(tiny):
+    cfg, model, params = tiny
+    with pytest.raises(TypeError):
+        FleetRouter(_factory(model, params),
+                    fleet={"replicas": 1, "transport": dict(XPROC)})
+
+
+def test_resolve_factory():
+    fn = resolve_factory(SPEC)
+    assert callable(fn)
+    fn2 = resolve_factory(SPEC["factory"])      # bare-string spec
+    assert callable(fn2)
+    with pytest.raises(ValueError):
+        resolve_factory("no_colon_here")
+
+
+# ----------------------------------------------------------------------
+# wire versioning (satellite: every envelope carries + checks "v")
+# ----------------------------------------------------------------------
+def _rng_states():
+    yield None
+    yield np.random.default_rng(7).bit_generator.state          # PCG64
+    yield np.random.RandomState(7).get_state(legacy=False)      # MT19937
+
+
+def _deep_eq(a, b):
+    """Structural equality that is ndarray-aware (``==`` on arrays is
+    elementwise)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (np.asarray(a).dtype == np.asarray(b).dtype and
+                np.array_equal(np.asarray(a), np.asarray(b)))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_deep_eq(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return (type(a) is type(b) and len(a) == len(b) and
+                all(_deep_eq(x, y) for x, y in zip(a, b)))
+    return a == b
+
+
+def _trace_ctxs():
+    yield None
+    yield TraceContext("rq", t_admit=1.0).to_wire()
+    yield TraceContext(("tup", 3), t_admit=2.5, t_prefill_start=2.6,
+                       t_first_token=3.0, t_handoff=3.1,
+                       prefill_active_ms=41.5, chunks=2,
+                       migrated=True).to_wire()
+
+
+def test_handoff_wire_roundtrip_all_field_combos():
+    """Property sweep: ``from_wire(to_wire(x)) == x`` across req_id
+    shapes, both numpy bit-generator state families, empty/non-empty
+    token + page lists, and the PR 16 TraceContext leg."""
+    req_ids = ["r1", 12345, ("fam", 7)]
+    outs = [[], [5, 9, 13]]
+    pages = [[], [0, 3, 7]]
+    n = 0
+    for rng_state in _rng_states():
+        for trace_ctx in _trace_ctxs():
+            for req_id in req_ids:
+                for out in outs:
+                    for pg in pages:
+                        h = PrefillHandoff(
+                            req_id=req_id, prompt=[1, 2, 3, 4],
+                            max_new_tokens=8, temperature=0.7, seed=11,
+                            top_k=0, top_p=1.0, slo_class="default",
+                            last_token=42, out=list(out),
+                            rng_state=rng_state, pages=list(pg),
+                            trace_ctx=trace_ctx)
+                        wire = h.to_wire()
+                        assert wire["v"] == list(WIRE_VERSION)
+                        # the envelope must survive JSON (the frame
+                        # codec is length-prefixed JSON text)
+                        wire = json.loads(json.dumps(wire))
+                        back = PrefillHandoff.from_wire(wire)
+                        assert back.req_id == req_id
+                        assert back.prompt == h.prompt
+                        assert back.out == list(out)
+                        assert back.pages == list(pg)
+                        assert back.trace_ctx == trace_ctx
+                        if rng_state is None:
+                            assert back.rng_state is None
+                        else:
+                            # MT19937 carries an ndarray key — the
+                            # ndarray-aware compare checks it exactly
+                            assert _deep_eq(back.rng_state, rng_state)
+                        n += 1
+    assert n == len(req_ids) * len(outs) * len(pages) * 3 * 3
+
+
+def test_handoff_wire_version_reject():
+    h = PrefillHandoff("r", [1], 4, 0.0, 0, 0, 1.0, "default", 9, [],
+                       None, [])
+    wire = h.to_wire()
+    wire["v"] = [WIRE_VERSION[0] + 1, 0]
+    with pytest.raises(WireVersionError) as ei:
+        PrefillHandoff.from_wire(wire)
+    assert ei.value.got == [WIRE_VERSION[0] + 1, 0]
+    assert "PrefillHandoff" in ei.value.what
+    # an unknown MINOR is compatible by contract
+    ok = h.to_wire()
+    ok["v"] = [WIRE_VERSION[0], WIRE_VERSION[1] + 7]
+    assert PrefillHandoff.from_wire(ok).req_id == "r"
+    # a missing stamp is a version error too, not a KeyError
+    none = h.to_wire()
+    del none["v"]
+    with pytest.raises(WireVersionError):
+        PrefillHandoff.from_wire(none)
+
+
+def test_quantized_payload_wire_roundtrip():
+    quant = CommQuantizer.from_config(
+        {"enabled": True, "block_size": 64, "min_tensor_bytes": 64})
+    rng = np.random.default_rng(0)
+    tree = {"k": rng.standard_normal((4, 8, 16)).astype(np.float32),
+            "v": rng.standard_normal((4, 8, 16)).astype(np.float32)}
+    payload = quant.encode_payload(tree, verb="kv_migrate")
+    assert isinstance(payload, QuantizedPayload)
+    wire = payload.to_wire()
+    assert wire["v"] == list(WIRE_VERSION) and wire["quant"]
+    back = QuantizedPayload.from_wire(wire)
+    dec = CommQuantizer.decode_payload(back)
+    ref = CommQuantizer.decode_payload(payload)
+    for key in tree:
+        np.testing.assert_array_equal(dec[key], ref[key])
+    # version reject, typed
+    wire["v"] = [99, 0]
+    with pytest.raises(WireVersionError):
+        QuantizedPayload.from_wire(wire)
+
+
+def test_pack_value_idempotent_and_maps():
+    vals = [{"a": np.arange(6, dtype=np.int32)},
+            {(1, 2): "pair-keyed", 3: "int-keyed"},
+            b"raw-bytes", ("tu", "ple")]
+    for v in vals:
+        once = pack_value(v)
+        twice = pack_value(once)        # frame-level re-pack must be safe
+        assert _deep_eq(unpack_value(json.loads(json.dumps(twice))),
+                        unpack_value(json.loads(json.dumps(once))))
+        assert _deep_eq(unpack_value(json.loads(json.dumps(once))), v)
+
+
+# ----------------------------------------------------------------------
+# supervision-sweep cadence (satellite: no sweep before any replica
+# has actually stepped)
+# ----------------------------------------------------------------------
+def _count_supervise(router):
+    calls = []
+    orig = router._supervise
+
+    def counting():
+        calls.append(router.steps)
+        orig()
+    router._supervise = counting
+    return calls
+
+
+def test_sweep_waits_for_first_engine_step(tiny):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2, "health_interval": 1})
+    calls = _count_supervise(fleet)
+    assert calls == [] and fleet.steps == 0     # step 0: no sweep ever
+    # kill everything before any replica stepped: step 1 has replicas==0
+    # engine-steps, so even health_interval=1 must NOT sweep
+    for rid in list(fleet.replicas):
+        fleet.kill_replica(rid, detail="cadence drill")
+    fleet.step()
+    assert fleet.steps == 1 and calls == []
+    # the respawned ring steps at step 2 -> the sweep fires from there
+    fleet.step()
+    assert calls == [2]
+    fleet.step()
+    assert calls == [2, 3]
+
+
+def test_sweep_cadence_on_interval(tiny):
+    cfg, model, params = tiny
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 1, "health_interval": 3})
+    calls = _count_supervise(fleet)
+    for _ in range(7):
+        fleet.step()
+    assert calls == [3, 6]
+
+
+# ----------------------------------------------------------------------
+# heartbeat liveness corners (driven through fake clocks + handle
+# attributes; real heartbeats are exercised by the subprocess tests)
+# ----------------------------------------------------------------------
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_delayed_heartbeat_is_not_a_death(tiny):
+    """A worker whose heartbeat is late but within the deadline must
+    not be declared lost (no false kill)."""
+    cfg, model, params = tiny
+    clock = _FakeClock()
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2,
+                               "transport": {"heartbeat_deadline_s": 2.0}},
+                        clock=clock)
+    rep = next(iter(fleet.replicas.values()))
+    rep.handle.last_heartbeat = clock() - 1.9       # delayed but alive
+    fleet._check_liveness()
+    assert rep.state == "healthy"
+    assert fleet.stats["workers_lost"] == 0
+    # past the deadline the same replica IS lost
+    rep.handle.last_heartbeat = clock() - 2.1
+    fleet._check_liveness()
+    assert rep.replica_id not in fleet.replicas
+    assert fleet.stats["workers_lost"] == 1
+
+
+def test_inprocess_handles_exempt_from_liveness(tiny):
+    cfg, model, params = tiny
+    clock = _FakeClock()
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2,
+                               "transport": {"heartbeat_interval_s": 0.1,
+                                             "heartbeat_deadline_s": 0.1}},
+                        clock=clock)
+    clock.t += 1e6          # eons pass with no heartbeats at all
+    fleet.step()
+    assert fleet.stats["workers_lost"] == 0
+    assert len(fleet._healthy()) == 2
+
+
+def test_heartbeat_ignored_during_drain(tiny):
+    """A stale heartbeat on a replica that is being drained (fenced)
+    must not double-kill it — liveness only judges healthy replicas."""
+    cfg, model, params = tiny
+    clock = _FakeClock()
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2,
+                               "transport": {"heartbeat_deadline_s": 1.0}},
+                        clock=clock)
+    for rep in fleet.replicas.values():
+        rep.handle.last_heartbeat = clock() - 50.0
+    res = fleet.drain()
+    assert fleet.stats["workers_lost"] == 0
+    assert res["health"]["traces"]["open"] == 0
+
+
+def test_respawn_storm_bounded_by_backoff(tiny):
+    """With ``respawn_backoff_s`` armed, a slot whose worker keeps dying
+    respawns at most once per backoff window instead of thrashing."""
+    cfg, model, params = tiny
+    clock = _FakeClock()
+    fleet = FleetRouter(_factory(model, params),
+                        fleet={"replicas": 2, "min_replicas": 1,
+                               "transport": {"respawn_backoff_s": 30.0,
+                                             "heartbeat_deadline_s": 5.0}},
+                        clock=clock)
+    victim = sorted(fleet.replicas)[0]
+    fleet._worker_lost(fleet.replicas[victim], "storm drill")
+    assert fleet.stats["workers_lost"] == 1
+    respawns_before = fleet.stats["respawns"]
+    for _ in range(5):                  # storm of steps inside backoff
+        fleet.step()
+        clock.t += 1.0
+    assert fleet.stats["respawns"] == respawns_before
+    assert victim not in fleet.replicas
+    clock.t += 30.0                     # backoff expires -> ONE respawn
+    fleet.step()
+    assert fleet.stats["respawns"] == respawns_before + 1
+    assert victim in fleet.replicas
+    assert fleet.replicas[victim].epoch.endswith("g1")
+
+
+# ----------------------------------------------------------------------
+# real worker processes (the tentpole acceptance)
+# ----------------------------------------------------------------------
+def _run_fleet(factory, fleet_cfg, prompts, kill_rid=None, kill_step=3,
+               telemetry=None):
+    """Run a fleet to completion; optionally SIGKILL one worker process
+    mid-flight.  Returns (finished, terminated, leaks, stats)."""
+    router = FleetRouter(factory, fleet=fleet_cfg, telemetry=telemetry)
+    try:
+        for rid, p in sorted(prompts.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        killed = False
+        for step in range(300):
+            if kill_rid is not None and step == kill_step and not killed:
+                handle = router.replicas[kill_rid].handle
+                os.kill(handle.proc.pid, signal.SIGKILL)
+                killed = True
+            router.step()
+            if not router._unresolved():
+                break
+        assert not router._unresolved(), "fleet did not converge"
+        return (dict(router.finished), router.pop_terminated(),
+                router.leak_report(), dict(router.stats))
+    finally:
+        router.close()
+
+
+def _prompts(cfg, n=6):
+    rng = np.random.default_rng(3)
+    return {f"q{i}": rng.integers(0, cfg.vocab_size, (10,)).tolist()
+            for i in range(n)}
+
+
+@pytest.mark.slow
+def test_xproc_bit_identity_and_kill9_mid_decode(tiny, tmp_path):
+    """The acceptance triple: (a) a subprocess fleet is bit-identical to
+    the in-process fleet over the same deterministic factory spec;
+    (b) ``kill -9`` of a worker mid-decode loses zero requests and the
+    survivors + re-served requests stay bit-identical; (c) the death is
+    booked as a schema-valid ``fleet/worker_lost`` event + ``worker_lost``
+    incident bundle."""
+    cfg, model, params = tiny
+    prompts = _prompts(cfg)
+    base = {"replicas": 2, "health_interval": 4}
+
+    ref, term, leaks, _ = _run_fleet(
+        tiny_engine_factory, dict(base), prompts)
+    assert not term and leaks == {}
+
+    out, term, leaks, _ = _run_fleet(
+        SPEC, dict(base, transport=dict(XPROC)), prompts)
+    assert not term and leaks == {}
+    assert out == ref       # bit-for-bit across the process boundary
+
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "xproc",
+         "incidents": {"enabled": True, "cooldown_s": 0.0}}), rank=0)
+    try:
+        out, term, leaks, stats = _run_fleet(
+            SPEC, dict(base, transport=dict(XPROC)), prompts,
+            kill_rid="r0", telemetry=tel)
+    finally:
+        tel.close()
+    assert leaks == {}
+    assert stats["workers_lost"] == 1 and stats["respawns"] >= 1
+    # zero loss: every id reaches exactly one terminal...
+    assert set(out) | set(term) == set(prompts)
+    assert not (set(out) & set(term))
+    # ...and everything that finished matches the no-kill run exactly
+    for rid, toks in out.items():
+        assert toks == ref[rid], f"{rid} diverged after kill -9"
+    # the death is observable: event + incident, both schema-valid
+    events_path = os.path.join(str(tmp_path), "xproc", "events.jsonl")
+    checker = _load_checker()
+    assert checker.validate_file(events_path) == []
+    with open(events_path) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    assert any(e["kind"] == "fleet" and e["name"] == "fleet/worker_lost"
+               for e in events)
+    incidents = [e for e in events if e["kind"] == "incident"
+                 and e.get("trigger") == "worker_lost"]
+    assert incidents
+
+
+@pytest.fixture(scope="module")
+def xproc_roles_results():
+    """One roles-fleet triple (clean in-process reference, kill -9 of
+    the prefill worker mid-migration, torn commit ack on the decode
+    worker), shared across the assertion tests below — worker processes
+    are expensive to boot, so boot them once."""
+    cfg = TransformerConfig.tiny(hidden_size=64, n_heads=4, n_kv_heads=2)
+    rng = np.random.default_rng(5)
+    fam = rng.integers(0, cfg.vocab_size, (24,)).tolist()
+    prompts = {f"m{i}": fam + rng.integers(
+        0, cfg.vocab_size, (4,)).tolist() for i in range(4)}
+    roles = {"roles": {"enabled": True, "prefill_replicas": 1,
+                       "decode_replicas": 1, "page_transfer_budget": 1}}
+    ref, term, leaks, _ = _run_fleet(tiny_engine_factory, dict(roles),
+                                     prompts)
+    assert not term and leaks == {}
+    out = {"prompts": prompts, "roles": roles, "ref": ref}
+
+    # (a) kill -9 the PREFILL worker while handoffs are pinned on it
+    router = FleetRouter(SPEC, fleet=dict(roles, transport=dict(XPROC)))
+    try:
+        for rid, p in sorted(prompts.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        killed = False
+        for _ in range(300):
+            router.step()
+            if not killed and router.migrations and \
+                    "p0" in router.replicas:
+                # handoffs are pinned on p0 RIGHT NOW -> kill -9 lands
+                # mid-migration, taking the pinned source copies
+                os.kill(router.replicas["p0"].handle.proc.pid,
+                        signal.SIGKILL)
+                killed = True
+            if not router._unresolved():
+                break
+        assert killed
+        out["mid_migration"] = (dict(router.finished),
+                                router.pop_terminated(),
+                                router.leak_report(),
+                                dict(router.stats))
+    finally:
+        router.close()
+
+    # (b) torn commit ack: SIGKILL the decode worker at the exact
+    # moment the router sends commit_import — the ack never arrives
+    router = FleetRouter(SPEC, fleet=dict(roles, transport=dict(XPROC)))
+    try:
+        torn = {"count": 0}
+        for rid, p in sorted(prompts.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        d0 = router.replicas["d0"].handle
+        orig_commit = d0.commit_import
+
+        def torn_commit(req_id):
+            if not torn["count"]:
+                torn["count"] += 1
+                os.kill(d0.proc.pid, signal.SIGKILL)
+                time.sleep(0.3)     # let the SIGKILL land first
+            return orig_commit(req_id)
+        d0.commit_import = torn_commit
+        for _ in range(300):
+            router.step()
+            if not router._unresolved():
+                break
+        out["torn_ack"] = (dict(router.finished),
+                           router.pop_terminated(),
+                           router.leak_report(), dict(router.stats),
+                           torn["count"])
+    finally:
+        router.close()
+    return out
+
+
+@pytest.mark.slow
+def test_xproc_kill9_mid_migration_zero_loss(xproc_roles_results):
+    res = xproc_roles_results
+    finished, term, leaks, stats = res["mid_migration"]
+    assert leaks == {}
+    assert stats["workers_lost"] >= 1
+    assert set(finished) | set(term) == set(res["prompts"])
+    assert not (set(finished) & set(term))
+    for rid, toks in finished.items():
+        assert toks == res["ref"][rid], \
+            f"{rid} diverged after mid-migration kill -9"
+
+
+@pytest.mark.slow
+def test_xproc_torn_commit_ack_rolls_back(xproc_roles_results):
+    """A connection torn between commit send and ack must roll the
+    transaction back exactly like an injected ``migrate_commit`` fault:
+    the fault is booked, the worker takes the lost path, and every
+    request still ends bit-identical."""
+    res = xproc_roles_results
+    finished, term, leaks, stats, torn_count = res["torn_ack"]
+    assert torn_count == 1
+    assert leaks == {}
+    assert stats["migrate_commit_faults"] >= 1
+    assert stats["workers_lost"] >= 1
+    assert set(finished) | set(term) == set(res["prompts"])
+    for rid, toks in finished.items():
+        assert toks == res["ref"][rid], \
+            f"{rid} diverged after torn commit ack"
