@@ -894,6 +894,56 @@ def _worker_fleet_xproc(spec):
     print(json.dumps(_fleet_xproc_bench(spec)))
 
 
+def _fleet_chaos_bench(spec=None):
+    """CPU-runnable chaos-recovery micro-bench: replays the gate-10
+    wire-fault scenarios (lost add_request ack, slow worker tripping the
+    circuit breaker, torn commit_import ack) over a real 2-worker
+    subprocess fleet via scripts/ds_chaos.py and reports per-scenario
+    recovery wall time plus the retry / breaker / dedup counters.  Every
+    scenario asserts the hard bar before returning: zero lost requests,
+    one typed terminal per request, empty leak report, survivors
+    bit-identical to a no-fault in-process reference, and checker-valid
+    telemetry — so a green number here is also a correctness proof."""
+    spec = spec or {}
+    import importlib.util
+    repo = os.path.dirname(os.path.abspath(__file__))
+    sp = importlib.util.spec_from_file_location(
+        "ds_chaos", os.path.join(repo, "scripts", "ds_chaos.py"))
+    chaos = importlib.util.module_from_spec(sp)
+    sp.loader.exec_module(chaos)
+
+    seed = int(spec.get("seed", 0))
+    names = list(spec.get("scenarios") or
+                 ("ack_loss", "slow_worker", "torn_commit"))
+    out = {"seed": seed, "scenarios": len(names), "lost_requests": 0}
+    totals = {"retries": 0, "rpc_timeouts": 0, "breaker_opens": 0,
+              "breaker_closes": 0, "dup_calls_dropped": 0,
+              "workers_lost": 0, "respawns": 0}
+    for name in names:
+        res = chaos.run_scenario(name, seed=seed)
+        st = res["stats"]
+        out[f"{name}_elapsed_s"] = round(res["elapsed_s"], 3)
+        out["lost_requests"] += (st["submitted"] - st["finished"] -
+                                 st["terminated"])
+        for k in totals:
+            totals[k] += st[k]
+        if name == "slow_worker":
+            opened = [e for e in res["events"]
+                      if e.get("name") == "fleet/breaker_open"]
+            closed = [e for e in res["events"]
+                      if e.get("name") == "fleet/breaker_close"]
+            if opened and closed:
+                out["breaker_open_to_close_s"] = round(
+                    closed[0]["ts"] - opened[0]["ts"], 3)
+    for k, v in totals.items():
+        out[f"{k}_total"] = v
+    return out
+
+
+def _worker_fleet_chaos(spec):
+    print(json.dumps(_fleet_chaos_bench(spec)))
+
+
 def _serving_attn_bench(spec=None):
     """CPU-runnable serving-attention micro-bench: the jnp gather path vs
     the fused ragged Pallas kernel (interpret mode) on ONE mixed
@@ -2777,6 +2827,26 @@ def _attach_fleet_xproc(out):
     return out
 
 
+def _attach_fleet_chaos(out):
+    """Attach the chaos-recovery micro-bench under the stable key
+    ``cpu_fleet_chaos`` (CPU-runnable: gate-10 wire-fault scenarios —
+    ack loss, slow worker breaker trip, torn commit — per-scenario
+    recovery wall time, retry/breaker/dedup counters, zero-loss +
+    bit-identity asserted inside each scenario).  Budget-gated; a
+    failure is recorded in notes, never fatal."""
+    if _remaining() < 120:
+        return out
+    res, err = _run_worker(
+        "fleet_chaos", {},
+        timeout=max(90, min(360, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_fleet_chaos"] = res
+    else:
+        out.setdefault("notes", {})["fleet_chaos"] = (err or "")[:200]
+    return out
+
+
 def _attach_incident(out):
     """Attach the incident-plane micro-bench under the stable key
     ``cpu_incident`` (CPU-runnable: ring-buffer record overhead, injected
@@ -2931,7 +3001,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))))
+            print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -3019,7 +3089,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))
+        print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -3094,7 +3164,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))))))))
+    print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))))))))
 
 
 if __name__ == "__main__":
@@ -3127,6 +3197,8 @@ if __name__ == "__main__":
             _worker_fleet_disagg(spec)
         elif which == "fleet_xproc":
             _worker_fleet_xproc(spec)
+        elif which == "fleet_chaos":
+            _worker_fleet_chaos(spec)
         elif which == "serving_attn":
             _worker_serving_attn(spec)
         elif which == "serving_slo":

@@ -82,10 +82,12 @@ from deepspeed_tpu.inference.robustness import (
     REJECT_BAD_REQUEST, REJECT_BAD_SAMPLING, REJECT_DRAINING,
     REJECT_DUPLICATE, REJECT_INFEASIBLE, REJECT_OVERSIZED, SHED_DEADLINE,
     SHED_DRAIN, RequestRejected, RequestResult, RequestTracer)
-from deepspeed_tpu.inference.transport import RpcChannel, TransportError
+from deepspeed_tpu.inference.transport import (RpcChannel, RpcTimeout,
+                                               TransportError,
+                                               WireFaultInjector)
 from deepspeed_tpu.monitor.telemetry import get_telemetry
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
-from deepspeed_tpu.runtime.resilience import FaultInjector
+from deepspeed_tpu.runtime.resilience import FaultInjector, RetryPolicy
 from deepspeed_tpu.utils.logging import logger
 
 # The frozen fleet/* event vocabulary.  scripts/check_telemetry_schema.py
@@ -99,10 +101,27 @@ FLEET_EVENTS = (
     "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
     "fleet/migrate_abort", "fleet/local_prefill",
     "fleet/worker_lost",
+    "fleet/retry", "fleet/breaker_open", "fleet/breaker_close",
+    "fleet/dup_call_dropped",
 )
 
-# the closed set of replica supervision states (docs/serving.md)
-REPLICA_STATES = ("healthy", "fenced", "dead")
+# The frozen fleet/* GAUGE vocabulary (registry snapshots in health(),
+# gauge events at breaker transitions).  Mirrored in the checker like
+# FLEET_EVENTS; gauge names are deliberately disjoint from event names.
+FLEET_GAUGES = (
+    "fleet/replicas", "fleet/healthy", "fleet/pending",
+    "fleet/queue_depth", "fleet/redispatches", "fleet/workers_lost",
+    "fleet/heartbeat_age_s", "fleet/migrating", "fleet/migrated_pages",
+    "fleet/dedup_skipped_pages", "fleet/prefill_queue_depth",
+    "fleet/decode_queue_depth",
+    "fleet/breaker_open_replicas", "fleet/breaker_opens",
+    "fleet/breaker_closes", "fleet/retries", "fleet/dup_calls_dropped",
+)
+
+# the closed set of replica supervision states (docs/serving.md);
+# "breaker_open" fences routing like "fenced" but keeps the PROCESS
+# alive — the circuit breaker's half-open probe decides its fate
+REPLICA_STATES = ("healthy", "fenced", "dead", "breaker_open")
 
 # the closed set of replica roles: a roleless fleet is all-"unified";
 # a disaggregated fleet (serving.fleet.roles.enabled) splits into a
@@ -166,6 +185,34 @@ class FleetRolesConfig(DeepSpeedConfigModel):
                     f"[min_{role}_replicas, max_{role}_replicas]")
 
 
+class RpcRetryConfig(DeepSpeedConfigModel):
+    """The ``serving.fleet.transport.retry`` block (docs/config-json.md):
+    exponential backoff + jitter applied by :class:`RpcChannel` to
+    IDEMPOTENT ops after an :class:`RpcTimeout`.  Mutating ops carry
+    idempotency keys the worker dedups, so a retry after a dropped ack
+    replays the recorded outcome instead of double-applying.  Non-
+    idempotent ops (``step``, the pops, ``drain``) never retry here —
+    their timeout feeds the router's circuit breaker instead."""
+
+    max_retries = 2                 # attempts AFTER the first (0 = off)
+    backoff_s = 0.05                # first-retry backoff
+    backoff_max_s = 2.0             # exponential cap
+    jitter = 0.25                   # backoff *= 1 + jitter·U[0,1)
+    seed = 0xD5                     # jitter rng seed (deterministic)
+
+    def _validate(self):
+        if int(self.max_retries) < 0:
+            raise ValueError(
+                "serving.fleet.transport.retry.max_retries must be >= 0")
+        for k in ("backoff_s", "backoff_max_s"):
+            if float(getattr(self, k)) < 0:
+                raise ValueError(
+                    f"serving.fleet.transport.retry.{k} must be >= 0")
+        if not (0.0 <= float(self.jitter) <= 1.0):
+            raise ValueError(
+                "serving.fleet.transport.retry.jitter must be in [0, 1]")
+
+
 class FleetTransportConfig(DeepSpeedConfigModel):
     """The ``serving.fleet.transport`` block (docs/config-json.md):
     where replicas live.  ``mode="inprocess"`` (the default) keeps the
@@ -182,19 +229,56 @@ class FleetTransportConfig(DeepSpeedConfigModel):
     heartbeat_interval_s = 1.0      # worker beat period
     heartbeat_deadline_s = 10.0     # missed-beat window before death
     respawn_backoff_s = 0.0         # wait before respawning a lost slot
-    call_timeout_s = 120.0          # per-RPC wall budget (engine build,
-    #                                 jit warm-up included)
+    call_timeout_s = 120.0          # per-RPC wall budget (steady state)
+    init_timeout_s = 120.0          # wall budget for the worker's init
+    #                                 RPC alone (engine build + jit
+    #                                 warm-up) — chaos scenarios shrink
+    #                                 call_timeout_s without breaking
+    #                                 worker boot
+    retry = {}                      # RpcRetryConfig (idempotent-op retry)
+    chaos = {}                      # WireFaultInjector spec + "seed" —
+    #                                 deterministic frame faults; empty
+    #                                 = no injection (zero overhead)
+    # per-replica circuit breaker: consecutive RPC timeouts trip it
+    # (closed → open → half-open probe → closed); a tripped breaker
+    # fences routing WITHOUT killing a possibly-just-slow worker
+    breaker_failures = 3            # consecutive timeouts to open
+    #                                 (0 = off: every timeout is a
+    #                                 worker-lost, pre-breaker behaviour)
+    breaker_open_s = 1.0            # cooldown before the half-open probe
+    breaker_open_max_s = 30.0       # cap for the doubling cooldown
+    breaker_flap_window_s = 30.0    # re-open this soon after a close ⇒
+    #                                 flapping link: cooldown doubles, so
+    #                                 a flap cannot probe/respawn-storm
+    breaker_probes = 3              # failed half-open probes before the
+    #                                 replica is finally declared lost
+    breaker_probe_timeout_s = 5.0   # wall budget for one half-open ping
 
     def _validate(self):
         if self.mode not in ("inprocess", "subprocess"):
             raise ValueError(
                 "serving.fleet.transport.mode must be 'inprocess' or "
                 f"'subprocess', got {self.mode!r}")
+        if not isinstance(self.retry, RpcRetryConfig):
+            self.retry = RpcRetryConfig(self.retry or {})
+        if self.chaos:
+            WireFaultInjector(dict(self.chaos))  # site names validated
         for k in ("heartbeat_interval_s", "heartbeat_deadline_s",
-                  "respawn_backoff_s", "call_timeout_s"):
+                  "respawn_backoff_s", "call_timeout_s",
+                  "init_timeout_s",
+                  "breaker_open_s", "breaker_open_max_s",
+                  "breaker_flap_window_s", "breaker_probe_timeout_s"):
             if float(getattr(self, k)) < 0:
                 raise ValueError(f"serving.fleet.transport.{k} must "
                                  "be >= 0")
+        for k in ("breaker_failures", "breaker_probes"):
+            if int(getattr(self, k)) < 0:
+                raise ValueError(f"serving.fleet.transport.{k} must "
+                                 "be >= 0")
+        if float(self.breaker_open_max_s) < float(self.breaker_open_s):
+            raise ValueError(
+                "serving.fleet.transport.breaker_open_max_s must be "
+                ">= breaker_open_s")
         if float(self.call_timeout_s) <= 0:
             raise ValueError(
                 "serving.fleet.transport.call_timeout_s must be > 0")
@@ -276,7 +360,9 @@ class InProcessReplicaHandle:
         self.engine = engine
 
     # -- engine surface --------------------------------------------------
-    def add_request(self, req_id, prompt, **kwargs):
+    def add_request(self, req_id, prompt, ikey=None, **kwargs):
+        # ikey is the wire transport's dedup token; in-process delivery
+        # is exactly-once by construction, so it is ignored here
         self.engine.add_request(req_id, prompt, **kwargs)
 
     def step(self):
@@ -309,12 +395,12 @@ class InProcessReplicaHandle:
         return payload, 1.0
 
     def import_request(self, handoff, payload=None, shared_pages=(),
-                       deadline_s=None):
+                       deadline_s=None, ikey=None):
         return self.engine.import_request(handoff, payload=payload,
                                           shared_pages=shared_pages,
                                           deadline_s=deadline_s)
 
-    def commit_import(self, req_id):
+    def commit_import(self, req_id, ikey=None):
         self.engine.commit_import(req_id)
 
     def cancel_import(self, req_id):
@@ -399,12 +485,22 @@ class SubprocessReplicaHandle:
     mode = "subprocess"
 
     def __init__(self, spec, replica_id, epoch, transport_cfg,
-                 telemetry=None, rank=0, clock=None):
+                 telemetry=None, rank=0, clock=None, wire=None,
+                 retry=None, on_retry=None, on_stale=None):
         self.replica_id = replica_id
         self.epoch = epoch
         self.engine = None      # no in-process engine behind this handle
         self._timeout = float(transport_cfg.call_timeout_s)
         self._load = {}
+        self._on_stale_cb = on_stale
+        self._breaker = None    # attached by the router at spawn
+        # cumulative-ack bookkeeping: ids delivered by the last step /
+        # pop response, confirmed back to the worker on the NEXT call of
+        # the same op so its result buffer can prune (a lost response is
+        # simply redelivered — nothing finished can vanish on the wire)
+        self._ack_done: List[Any] = []
+        self._ack_term: List[Any] = []
+        self._ack_hand: List[Any] = []
         parent, child = socket.socketpair()
         # the worker must be able to import this package even when the
         # router's cwd is not the source root — export the package
@@ -424,10 +520,19 @@ class SubprocessReplicaHandle:
                 pass_fds=(child.fileno(),), env=env)
         finally:
             child.close()
-        self.chan = RpcChannel(parent, clock=clock)
+        self.chan = RpcChannel(parent, clock=clock, wire=wire,
+                               retry=retry, peer=replica_id)
+        if on_retry is not None:
+            self.chan.on_retry = \
+                lambda op, a, d, el: on_retry(replica_id, op, a, d, el)
+        if on_stale is not None:
+            self.chan.on_stale = \
+                lambda op, kind: on_stale(replica_id, op, kind)
+        init_timeout = max(self._timeout,
+                           float(transport_cfg.init_timeout_s))
         try:
             init = self.chan.call(
-                "init", timeout=self._timeout, rid=replica_id,
+                "init", timeout=init_timeout, rid=replica_id,
                 epoch=epoch, spec=spec,
                 hb_interval_s=float(transport_cfg.heartbeat_interval_s),
                 telemetry=telemetry, rank=int(rank))
@@ -438,44 +543,60 @@ class SubprocessReplicaHandle:
         self.kv_page_bytes = int(init["kv_page_bytes"])
         self._load = dict(init.get("load") or {})
 
-    def _call(self, op, **kwargs):
-        resp = self.chan.call(op, timeout=self._timeout, **kwargs)
+    def _call(self, op, _idempotent=False, _ikey=None, **kwargs):
+        resp = self.chan.call(op, timeout=self._timeout,
+                              idempotent=_idempotent, ikey=_ikey,
+                              **kwargs)
+        if self._breaker is not None:
+            self._breaker.record_success()  # a reply = the wire works
+        if resp.get("dup") and self._on_stale_cb is not None:
+            # the worker replayed a cached mutation for a retried
+            # idempotency key — the first execution's ack was lost
+            self._on_stale_cb(self.replica_id, op, "ikey_replay")
         load = resp.get("load")
         if load:
             self._load = load
         return resp
 
     # -- engine surface --------------------------------------------------
-    def add_request(self, req_id, prompt, **kwargs):
-        self._call("add_request", req_id=req_id,
+    def add_request(self, req_id, prompt, ikey=None, **kwargs):
+        self._call("add_request", _idempotent=True, _ikey=ikey,
+                   req_id=req_id,
                    prompt=[int(t) for t in prompt], kwargs=kwargs)
 
     def step(self):
-        return {_key(rid): toks
-                for rid, toks in self._call("step")["done"]}
+        done = {_key(rid): toks for rid, toks in
+                self._call("step", ack=self._ack_done)["done"]}
+        self._ack_done = list(done)
+        return done
 
     def pop_terminated(self):
         out = {}
-        for rid, res in self._call("pop_terminated")["results"]:
+        for rid, res in self._call("pop_terminated",
+                                   ack=self._ack_term)["results"]:
             rid = _key(rid)
             out[rid] = RequestResult(
                 rid, res["status"], res["reason"],
                 tokens=list(res["tokens"]),
                 n_generated=int(res["n_generated"]),
                 detail=res.get("detail", ""))
+        self._ack_term = list(out)
         return out
 
     def pop_prefilled(self):
         from deepspeed_tpu.inference.serving import PrefillHandoff
-        return {_key(rid): PrefillHandoff.from_wire(wire)
-                for rid, wire in self._call("pop_prefilled")["handoffs"]}
+        out = {_key(rid): PrefillHandoff.from_wire(wire)
+               for rid, wire in self._call(
+                   "pop_prefilled", ack=self._ack_hand)["handoffs"]}
+        self._ack_hand = list(out)
+        return out
 
     def release_handoff(self, req_id):
-        return bool(self._call("release_handoff",
+        return bool(self._call("release_handoff", _idempotent=True,
                                req_id=req_id)["ok"])
 
     def resident_prefix(self, prompt):
-        return self._call("resident_prefix",
+        return self._call("resident_prefix", _idempotent=True,
                           prompt=[int(t) for t in prompt])["pages"]
 
     def export_payload(self, page_ids):
@@ -484,7 +605,7 @@ class SubprocessReplicaHandle:
         codec), ready to forward to the destination worker."""
         if not page_ids:
             return None, 1.0
-        resp = self._call("export_payload",
+        resp = self._call("export_payload", _idempotent=True,
                           pages=[int(p) for p in page_ids])
         payload = resp["payload"]
         if resp.get("quant") and payload is not None:
@@ -493,21 +614,28 @@ class SubprocessReplicaHandle:
         return payload, 1.0
 
     def import_request(self, handoff, payload=None, shared_pages=(),
-                       deadline_s=None):
+                       deadline_s=None, ikey=None):
         return bool(self._call(
-            "import_request", handoff=handoff.to_wire(), payload=payload,
+            "import_request", _idempotent=True, _ikey=ikey,
+            handoff=handoff.to_wire(), payload=payload,
             shared_pages=[int(p) for p in shared_pages],
             deadline_s=deadline_s)["ok"])
 
-    def commit_import(self, req_id):
+    def commit_import(self, req_id, ikey=None):
         """The explicit commit ack: raises :class:`TransportError` when
-        the connection tears before the worker acknowledges — the
+        the connection TEARS before the worker acknowledges — the
         uncommitted import died with the process, so the router rolls
-        back exactly like an injected ``migrate_commit`` fault."""
-        self._call("commit_import", req_id=req_id)
+        back exactly like an injected ``migrate_commit`` fault.  A mere
+        :class:`RpcTimeout` is different: the commit may have landed
+        with only the ack lost, so the call is idempotent-retryable
+        under ``ikey`` and the worker replays a committed outcome
+        instead of double-committing."""
+        self._call("commit_import", _idempotent=True, _ikey=ikey,
+                   req_id=req_id)
 
     def cancel_import(self, req_id):
-        return bool(self._call("cancel_import", req_id=req_id)["ok"])
+        return bool(self._call("cancel_import", _idempotent=True,
+                               req_id=req_id)["ok"])
 
     def drain(self):
         resp = self._call("drain")
@@ -518,10 +646,17 @@ class SubprocessReplicaHandle:
                 "health": resp["health"]}
 
     def leak_report(self):
-        return self._call("leak_report")["leaks"]
+        return self._call("leak_report", _idempotent=True)["leaks"]
 
     def health(self):
-        return self._call("health")["health"]
+        return self._call("health", _idempotent=True)["health"]
+
+    def ping(self, timeout=None):
+        """Liveness probe (the breaker's half-open check): one round
+        trip under its own wall budget, no engine work."""
+        self.chan.call("ping",
+                       timeout=self._timeout if timeout is None
+                       else float(timeout))
 
     def generate(self, prompts, max_new_tokens=8):
         """Warm-up helper for benches/tests (mirrors the engine API)."""
@@ -620,6 +755,99 @@ class _Replica:
     handle: Any = None          # ReplicaHandle (the router's only surface)
     state: str = "healthy"
     role: str = "unified"
+    breaker: Any = None         # CircuitBreaker (subprocess mode only)
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker over RPC timeouts: ``closed`` →
+    (``breaker_failures`` consecutive timeouts) → ``open`` →
+    (``breaker_open_s`` cooldown) → ``half_open`` probe → ``closed`` on
+    success, back to ``open`` with a doubled cooldown on failure, and
+    finally worker-lost after ``breaker_probes`` failed probes.
+
+    Distinct from heartbeat death on purpose: a slow or lossy link
+    produces timeouts while the worker is perfectly alive — fencing
+    routing (and letting the probe decide) preserves the worker's warm
+    prefix cache and avoids respawn churn.  Hysteresis: re-opening
+    within ``breaker_flap_window_s`` of a close doubles the cooldown
+    (capped), so a flapping link backs off instead of probe-storming."""
+
+    def __init__(self, tcfg, clock):
+        self.failures_limit = int(tcfg.breaker_failures)
+        self.base_cooldown = float(tcfg.breaker_open_s)
+        self.max_cooldown = float(tcfg.breaker_open_max_s)
+        self.flap_window = float(tcfg.breaker_flap_window_s)
+        self.max_probes = int(tcfg.breaker_probes)
+        self._clock = clock
+        self.state = "closed"
+        self.consecutive = 0        # timeout run length while closed
+        self.opens = 0
+        self.closes = 0
+        self.probe_failures = 0     # within the CURRENT open episode
+        self.cooldown_s = self.base_cooldown
+        self._open_until = 0.0
+        self._last_close = None
+
+    @property
+    def enabled(self):
+        return self.failures_limit > 0
+
+    def record_success(self):
+        if self.state == "closed":
+            self.consecutive = 0
+
+    def record_failure(self):
+        """Count one timeout; True when it should OPEN the breaker."""
+        if self.state != "closed" or not self.enabled:
+            return False
+        self.consecutive += 1
+        return self.consecutive >= self.failures_limit
+
+    def open(self):
+        """Trip.  Returns the cooldown armed before the half-open
+        probe (doubled when re-opening inside the flap window)."""
+        now = self._clock()
+        cooldown = self.base_cooldown
+        if self._last_close is not None and \
+                now - self._last_close < self.flap_window:
+            cooldown = min(max(self.cooldown_s, self.base_cooldown) * 2,
+                           self.max_cooldown)
+        self.cooldown_s = cooldown
+        self.state = "open"
+        self.opens += 1
+        self.probe_failures = 0
+        self._open_until = now + cooldown
+        return cooldown
+
+    def probe_due(self):
+        """True once the cooldown has elapsed (enters ``half_open``)."""
+        if self.state == "open" and self._clock() >= self._open_until:
+            self.state = "half_open"
+        return self.state == "half_open"
+
+    def probe_failed(self):
+        """Book one failed half-open probe, re-arm a doubled cooldown;
+        True when the probe budget is spent (escalate to worker-lost)."""
+        self.probe_failures += 1
+        self.state = "open"
+        self.cooldown_s = min(max(self.cooldown_s,
+                                  self.base_cooldown) * 2,
+                              self.max_cooldown)
+        self._open_until = self._clock() + self.cooldown_s
+        return self.probe_failures >= self.max_probes
+
+    def close(self):
+        self.state = "closed"
+        self.closes += 1
+        self.consecutive = 0
+        self.probe_failures = 0
+        self._last_close = self._clock()
+
+    def snapshot(self):
+        return {"state": self.state, "consecutive": self.consecutive,
+                "opens": self.opens, "closes": self.closes,
+                "probe_failures": self.probe_failures,
+                "cooldown_s": round(self.cooldown_s, 3)}
 
 
 class FleetRouter:
@@ -650,6 +878,19 @@ class FleetRouter:
         self._engine_steps = 0          # replica steps actually executed
         self.injector = injector if injector is not None \
             else FaultInjector.from_config(cfg.fault_injection)
+        # the chaos plane: ONE seeded frame-fault injector shared by
+        # every replica channel, so a whole campaign replays from
+        # (spec, seed) alone — counters are global across the fleet
+        self.wire_injector = WireFaultInjector.from_config(
+            dict(cfg.transport.chaos) if cfg.transport.chaos else None)
+        rcfg = cfg.transport.retry
+        self._retry_policy = RetryPolicy(
+            max_retries=int(rcfg.max_retries),
+            backoff_secs=float(rcfg.backoff_s),
+            backoff_max_secs=float(rcfg.backoff_max_s),
+            jitter=float(rcfg.jitter),
+            seed=int(rcfg.seed)) \
+            if int(rcfg.max_retries) > 0 else None
         self.replicas: Dict[str, _Replica] = {}
         self.requests: Dict[Any, _FleetRequest] = {}
         self.pending = deque()          # req_ids awaiting (re)dispatch
@@ -668,7 +909,10 @@ class FleetRouter:
                       "migrate_bytes_saved": 0,
                       "migrate_quant_bytes_saved": 0, "migrate_faults": 0,
                       "migrate_commit_faults": 0, "migrate_aborts": 0,
-                      "local_prefills": 0, "workers_lost": 0}
+                      "local_prefills": 0, "workers_lost": 0,
+                      "retries": 0, "rpc_timeouts": 0,
+                      "breaker_opens": 0, "breaker_closes": 0,
+                      "dup_calls_dropped": 0}
         self._gens: Dict[str, int] = {}     # replica_id -> spawn generation
         self._role_of: Dict[str, str] = {}  # replica_id -> role (sticky
         #                                     across respawns, so a dead
@@ -807,6 +1051,13 @@ class FleetRouter:
         handle = self._make_handle(rid, epoch)
         rep = _Replica(rid, epoch, handle.engine, handle=handle,
                        role=(role or "unified"))
+        if isinstance(handle, SubprocessReplicaHandle):
+            # fresh breaker per spawn: a respawned process starts with a
+            # clean slate (flap hysteresis lives across open/close
+            # cycles of ONE process, not across respawns)
+            rep.breaker = CircuitBreaker(self.fleet.transport,
+                                         self._clock)
+            handle._breaker = rep.breaker
         self.replicas[rid] = rep
         self._role_of[rid] = rep.role
         if self._route_tokens == 0:
@@ -835,7 +1086,9 @@ class FleetRouter:
             return SubprocessReplicaHandle(
                 self._factory, rid, epoch, tcfg,
                 telemetry=self._worker_telemetry,
-                rank=self._worker_seq, clock=self._clock)
+                rank=self._worker_seq, clock=self._clock,
+                wire=self.wire_injector, retry=self._retry_policy,
+                on_retry=self._on_retry, on_stale=self._on_stale)
         return InProcessReplicaHandle(self._factory(rid, epoch))
 
     def _healthy(self, role: Optional[str] = None) -> List[_Replica]:
@@ -941,6 +1194,125 @@ class FleetRouter:
         self._respawn_after.pop(rid, None)
         return True
 
+    # -- circuit breaker -------------------------------------------------
+    def _ikey(self, rep: _Replica, fr: _FleetRequest) -> str:
+        """Idempotency key for one mutation incarnation: stable across
+        the channel's retries of one dispatch (the worker dedups a
+        replay after a dropped ack), distinct across redispatches
+        (``fr.dispatches``) and respawns (``rep.epoch``) — a NEW
+        incarnation must really re-execute."""
+        return f"{rep.epoch}:{fr.req_id!r}:{fr.dispatches}"
+
+    def _on_retry(self, rid, op, attempt, delay_s, elapsed_s):
+        self.stats["retries"] += 1
+        self._fleet_event("fleet/retry", replica=rid, op=op,
+                          attempt=int(attempt),
+                          delay_s=round(float(delay_s), 4),
+                          elapsed_s=round(float(elapsed_s), 4))
+
+    def _on_stale(self, rid, op, kind):
+        """A duplicate call's effect was dropped somewhere: a late or
+        duplicated response discarded by call id (``stale_resp``) or a
+        worker-side idempotency replay (``ikey_replay``)."""
+        self.stats["dup_calls_dropped"] += 1
+        self._fleet_event("fleet/dup_call_dropped", replica=rid, op=op,
+                          kind=kind)
+
+    def _rpc_failed(self, rep: _Replica, what: str, e: Exception):
+        """An RPC to ``rep`` TIMED OUT (wire intact as far as anyone
+        knows — the worker may just be slow or the frames lost).  The
+        breaker counts consecutive timeouts and fences the replica
+        without killing the process; with the breaker off this
+        degrades to the pre-breaker behaviour: worker lost."""
+        self.stats["rpc_timeouts"] += 1
+        br = rep.breaker
+        if br is None or not br.enabled:
+            self._worker_lost(rep, f"{what}: {e}")
+            return
+        if br.record_failure():
+            self._breaker_open(rep, f"{what}: {e}")
+
+    def _breaker_open(self, rep: _Replica, detail: str):
+        """Trip the breaker: fence ``rep`` from routing and requeue its
+        requests (bookkeeping only — no RPC can block here) WITHOUT
+        killing the process.  Exactly one incident bundle; heartbeat
+        death is suspended while the breaker owns the verdict, so one
+        gray failure cannot be double-counted as two incidents."""
+        cooldown = rep.breaker.open()
+        rep.state = "breaker_open"
+        self.stats["breaker_opens"] += 1
+        moved = self._requeue_owned(rep)
+        logger.warning(
+            f"fleet: replica {rep.replica_id} ({rep.epoch}) breaker "
+            f"open: {detail}; redispatching {len(moved)} requests, "
+            f"half-open probe in {cooldown:.2f}s")
+        self._fleet_event("fleet/breaker_open", replica=rep.replica_id,
+                          epoch=rep.epoch, detail=detail,
+                          consecutive=rep.breaker.consecutive,
+                          cooldown_s=round(cooldown, 3),
+                          redispatched=len(moved))
+        self._incident("breaker_open", source=str(rep.replica_id),
+                       detail=f"{detail}; redispatched {len(moved)}")
+        self._breaker_gauges()
+
+    def _breaker_close(self, rep: _Replica):
+        rep.breaker.close()
+        rep.state = "healthy"
+        self.stats["breaker_closes"] += 1
+        self._fleet_event("fleet/breaker_close", replica=rep.replica_id,
+                          epoch=rep.epoch,
+                          probes=rep.breaker.probe_failures + 1)
+        self._breaker_gauges()
+
+    def _breaker_gauges(self):
+        tel = self._tel()
+        if tel is None:
+            return
+        n_open = sum(1 for r in self.replicas.values()
+                     if r.state == "breaker_open")
+        tel.gauge("fleet/breaker_open_replicas", float(n_open),
+                  step=self.steps)
+        tel.gauge("fleet/breaker_opens",
+                  float(self.stats["breaker_opens"]), step=self.steps)
+        tel.gauge("fleet/breaker_closes",
+                  float(self.stats["breaker_closes"]), step=self.steps)
+
+    def _probe_breakers(self):
+        """Drive every breaker-open replica: keep its channel pumped
+        (heartbeats and late replies still flow), and once the cooldown
+        elapses run the half-open probe — a ``ping`` under its own wall
+        budget.  Success rejoins the ring (the worker's stale work
+        self-resolves through the collect guards; its warm prefix cache
+        survives); a timed-out probe re-arms a doubled cooldown until
+        the probe budget is spent; a torn wire is a worker-lost."""
+        probe_timeout = float(self.fleet.transport.breaker_probe_timeout_s)
+        for rep in list(self.replicas.values()):
+            if rep.state != "breaker_open":
+                continue
+            try:
+                rep.handle.pump()
+            except TransportError as e:
+                self._worker_lost(rep, f"breaker-open wire died: {e}")
+                continue
+            if not rep.breaker.probe_due():
+                continue
+            try:
+                rep.handle.ping(timeout=probe_timeout)
+            except RpcTimeout as e:
+                if rep.breaker.probe_failed():
+                    self._worker_lost(
+                        rep, f"breaker half-open probes exhausted "
+                             f"({rep.breaker.probe_failures}): {e}")
+                continue
+            except TransportError as e:
+                self._worker_lost(rep, f"breaker probe wire died: {e}")
+                continue
+            except Exception as e:
+                self.kill_replica(rep.replica_id,
+                                  detail=f"breaker probe raised: {e}")
+                continue
+            self._breaker_close(rep)
+
     def _fence(self, rep: _Replica, why: str):
         """Graceful failover: stop routing to the replica, drain it (its
         finished work is delivered, its shed work redispatched), then
@@ -1034,9 +1406,13 @@ class FleetRouter:
             if prefill_only:
                 kwargs["prefill_only"] = True
             try:
-                rep.handle.add_request(fr.req_id, fr.prompt, **kwargs)
+                rep.handle.add_request(fr.req_id, fr.prompt,
+                                       ikey=self._ikey(rep, fr), **kwargs)
             except RequestRejected as e:
                 rejects.append(e)
+                continue
+            except RpcTimeout as e:
+                self._rpc_failed(rep, "add_request timed out", e)
                 continue
             except TransportError as e:
                 self._worker_lost(rep, f"add_request transport "
@@ -1190,6 +1566,9 @@ class FleetRouter:
             # a hot shared prefix migrates ONCE per decode replica
             try:
                 resident = h.resident_prefix(handoff.prompt)
+            except RpcTimeout as e:
+                self._rpc_failed(rep, "resident_prefix timed out", e)
+                continue        # try the next decode replica
             except TransportError as e:
                 self._worker_lost(rep, f"resident_prefix transport "
                                        f"failed: {e}")
@@ -1202,6 +1581,11 @@ class FleetRouter:
             # process boundary (the int8 saving is real wire bytes)
             try:
                 payload, wire_frac = src.handle.export_payload(to_send)
+            except RpcTimeout as e:
+                # the pinned copy is still there; back off via the
+                # breaker and retry the whole attempt next pump
+                self._rpc_failed(src, "export timed out", e)
+                return ("retry", 0)
             except TransportError as e:
                 # source wire died holding the pin — the pinned copy is
                 # gone; _worker_lost requeues this request for a
@@ -1212,7 +1596,14 @@ class FleetRouter:
             try:
                 imported = h.import_request(handoff, payload=payload,
                                             shared_pages=resident,
-                                            deadline_s=deadline_s)
+                                            deadline_s=deadline_s,
+                                            ikey=self._ikey(rep, fr))
+            except RpcTimeout as e:
+                # the import may or may not have staged; either way it
+                # is uncommitted and a later retry carries the same
+                # ikey, so the worker converges to ONE staged import
+                self._rpc_failed(rep, "import timed out", e)
+                continue
             except TransportError as e:
                 self._worker_lost(rep, f"import transport failed: {e}")
                 continue        # uncommitted import died with the worker
@@ -1234,7 +1625,22 @@ class FleetRouter:
                         1, int(self.fleet.roles.migrate_backoff_steps))
                     return ("commit_fault", 0)
             try:
-                h.commit_import(fr.req_id)
+                h.commit_import(fr.req_id, ikey=self._ikey(rep, fr))
+            except RpcTimeout as e:
+                # GRAY torn commit: the ack was lost but the commit may
+                # have LANDED.  Do not kill the destination — book a
+                # commit fault and back off; the retry re-runs the whole
+                # transaction and the ikey makes commit_import converge
+                # exactly-once (a landed commit replays its cached ok,
+                # an unstaged one re-imports from the pinned source).
+                self._rpc_failed(rep, "commit ack timed out", e)
+                self.stats["migrate_commit_faults"] += 1
+                self._fleet_event("fleet/migrate_fault", req_id=fr.req_id,
+                                  site="migrate_commit",
+                                  error=f"commit ack timed out: {e}")
+                fr.migrate_after = self.steps + max(
+                    1, int(self.fleet.roles.migrate_backoff_steps))
+                return ("commit_fault", 0)
             except TransportError as e:
                 # TORN COMMIT ACK: the destination died (or the wire
                 # tore) before acknowledging — the uncommitted import
@@ -1406,6 +1812,12 @@ class FleetRouter:
                 self._collect_terminated(rep)
                 if self._roles_enabled and rep.role == "prefill":
                     self._collect_handoffs(rep)
+            except RpcTimeout as e:
+                # slow-but-alive ≠ dead: the breaker counts consecutive
+                # timeouts and fences WITHOUT killing; its half-open
+                # probe decides whether the worker ever comes back
+                self._rpc_failed(rep, "step timed out", e)
+                continue
             except TransportError as e:
                 # torn wire ≠ engine fault: the PROCESS died (or its
                 # connection did) — take the worker-lost path, which
@@ -1437,7 +1849,13 @@ class FleetRouter:
         with the router's clock on receipt) and declare any replica
         whose last heartbeat is older than ``heartbeat_deadline_s``
         lost.  In-process handles report ``last_heartbeat=None`` and
-        are exempt — they cannot die without the router dying too."""
+        are exempt — they cannot die without the router dying too.
+
+        Breaker-open replicas are EXEMPT from heartbeat death (the
+        ``!= "healthy"`` skip): the breaker already owns the verdict
+        for that gray failure, and its half-open probe — driven by
+        ``_probe_breakers`` below — decides between rejoin and
+        worker-lost.  One gray failure, one incident."""
         deadline = float(self.fleet.transport.heartbeat_deadline_s)
         now = self._clock()
         for rep in list(self.replicas.values()):
@@ -1456,6 +1874,7 @@ class FleetRouter:
                 self._worker_lost(
                     rep, f"missed heartbeats: last seen {age:.1f}s ago "
                          f"(deadline {deadline:.1f}s)")
+        self._probe_breakers()
 
     def pop_terminated(self) -> Dict[Any, RequestResult]:
         """Hand back (and clear) every fleet-level typed terminal since
@@ -1493,6 +1912,9 @@ class FleetRouter:
             try:
                 leaks = rep.handle.leak_report()
                 storm = bool(rep.handle.health().get("recompile_storm"))
+            except RpcTimeout as e:
+                self._rpc_failed(rep, "health check timed out", e)
+                continue
             except TransportError as e:
                 self._worker_lost(rep, f"health check transport "
                                        f"failed: {e}")
@@ -1669,6 +2091,8 @@ class FleetRouter:
                 last = h.last_heartbeat
                 entry["heartbeat_age_s"] = (
                     round(now - last, 3) if last is not None else None)
+                if rep.breaker is not None:
+                    entry["breaker"] = rep.breaker.snapshot()
             per_replica[rep.replica_id] = entry
             queue_depth += h.queue_depth
         snap = {
@@ -1718,6 +2142,15 @@ class FleetRouter:
                 if ages:
                     tel.registry.gauge("fleet/heartbeat_age_s").set(
                         max(ages))
+                tel.registry.gauge("fleet/breaker_open_replicas").set(
+                    sum(1 for r in self.replicas.values()
+                        if r.state == "breaker_open"))
+                for gauge, key in (
+                        ("fleet/breaker_opens", "breaker_opens"),
+                        ("fleet/breaker_closes", "breaker_closes"),
+                        ("fleet/retries", "retries"),
+                        ("fleet/dup_calls_dropped", "dup_calls_dropped")):
+                    tel.registry.gauge(gauge).set(self.stats[key])
             if self._roles_enabled:
                 tel.registry.gauge("fleet/migrating").set(
                     snap["migrating"])

@@ -39,6 +39,18 @@ heartbeat deadline.  Worker telemetry rides the rank-stamped shard sink
 (``telemetry.distributed``): each worker writes ``events.rank{N}.jsonl``
 in the shared shard dir, so one merged stream keeps per-replica
 attribution.
+
+Exactly-once under gray failures: every request frame carries a call id
+(``cid``) echoed on the response; a duplicated delivery of the same cid
+resends the cached response without re-executing.  Mutating ops
+(``add_request`` / ``import_request`` / ``commit_import``) additionally
+carry an idempotency key (``ikey``, ``epoch:req_id`` at the router) —
+a RETRY under a fresh cid replays the cached outcome (response flagged
+``dup: true``) instead of double-admitting or double-committing.  And
+because a dropped response must not silently lose completed work, the
+lossy result ops (``step`` / ``pop_terminated`` / ``pop_prefilled``)
+are cumulative: results stay buffered until the router acks them on its
+next call (``ack`` list), so a timed-out response is redelivered whole.
 """
 
 import argparse
@@ -47,6 +59,7 @@ import socket
 import sys
 import threading
 import time
+from collections import OrderedDict
 
 from deepspeed_tpu.inference.transport import (TransportError,
                                                WIRE_VERSION,
@@ -105,6 +118,11 @@ def _result_to_wire(res):
 class FleetWorker:
     """Hosts one engine behind the socket; see the module docstring."""
 
+    # dedup cache bounds: cids are dense (a dup arrives right behind the
+    # original), ikeys live as long as a retry storm plausibly can
+    MAX_CID_CACHE = 32
+    MAX_IKEY_CACHE = 4096
+
     def __init__(self, sock):
         self.sock = sock
         self.stream = sock.makefile("rb")
@@ -113,6 +131,14 @@ class FleetWorker:
         self.rid = None
         self.epoch = None
         self._hb_stop = threading.Event()
+        self._resp_by_cid = OrderedDict()   # cid → sent response frame
+        self._done_ikeys = OrderedDict()    # (ikey, op) → response core
+        self.dup_calls = 0                  # replays served from caches
+        # cumulative result buffers, pruned by the router's acks — a
+        # dropped response cannot silently lose finished work
+        self._done_buf = {}                 # rid → generated tokens
+        self._term_buf = {}                 # rid → wire RequestResult
+        self._hand_buf = {}                 # rid → wire PrefillHandoff
 
     # -- liveness --------------------------------------------------------
     def _heartbeat_loop(self, interval_s):
@@ -163,20 +189,33 @@ class FleetWorker:
                                 frame["prompt"], **frame["kwargs"])
         return {}
 
+    @staticmethod
+    def _ack(frame, buf):
+        """Prune a cumulative result buffer by the router's ack list —
+        ids the router confirms it has consumed from a prior response."""
+        for rid in frame.get("ack") or []:
+            buf.pop(rid, None)
+
     def _op_step(self, frame):
-        done = self.engine.step()
-        return {"done": [[pack_value(rid), [int(t) for t in toks]]
-                         for rid, toks in done.items()]}
+        self._ack(frame, self._done_buf)
+        for rid, toks in self.engine.step().items():
+            self._done_buf[rid] = [int(t) for t in toks]
+        return {"done": [[pack_value(rid), list(toks)]
+                         for rid, toks in self._done_buf.items()]}
 
     def _op_pop_terminated(self, frame):
-        return {"results": [[pack_value(rid), _result_to_wire(res)]
-                            for rid, res in
-                            self.engine.pop_terminated().items()]}
+        self._ack(frame, self._term_buf)
+        for rid, res in self.engine.pop_terminated().items():
+            self._term_buf[rid] = _result_to_wire(res)
+        return {"results": [[pack_value(rid), dict(res)]
+                            for rid, res in self._term_buf.items()]}
 
     def _op_pop_prefilled(self, frame):
-        return {"handoffs": [[pack_value(rid), h.to_wire()]
-                             for rid, h in
-                             self.engine.pop_prefilled().items()]}
+        self._ack(frame, self._hand_buf)
+        for rid, h in self.engine.pop_prefilled().items():
+            self._hand_buf[rid] = h.to_wire()
+        return {"handoffs": [[pack_value(rid), dict(h)]
+                             for rid, h in self._hand_buf.items()]}
 
     def _op_release_handoff(self, frame):
         return {"ok": self.engine.release_handoff(
@@ -249,20 +288,51 @@ class FleetWorker:
             except TransportError:
                 return          # router closed the socket (or died)
             op = frame.get("op")
+            cid = frame.get("cid")
+            if cid is not None and cid in self._resp_by_cid:
+                # duplicated delivery of the same request frame: resend
+                # the cached response verbatim, execute nothing — the
+                # router discards the extra copy by cid
+                self.dup_calls += 1
+                try:
+                    send_frame(self.sock, self._resp_by_cid[cid],
+                               lock=self.wlock)
+                except TransportError:
+                    return
+                continue
             if op == "shutdown":
                 self._hb_stop.set()
-                send_frame(self.sock, {"kind": "resp"}, lock=self.wlock)
+                send_frame(self.sock, {"kind": "resp", "cid": cid},
+                           lock=self.wlock)
                 return
             handler = getattr(self, f"_op_{op}", None)
+            ikey = frame.get("ikey")
             try:
                 if handler is None:
                     raise ValueError(f"unknown op {op!r}")
-                resp = handler(frame)
+                if ikey is not None and (ikey, op) in self._done_ikeys:
+                    # retried mutation whose first execution succeeded
+                    # but whose ack was lost: replay the outcome, do
+                    # not double-admit / double-commit
+                    self.dup_calls += 1
+                    resp = dict(self._done_ikeys[(ikey, op)])
+                    resp["dup"] = True
+                else:
+                    resp = handler(frame)
+                    if ikey is not None:
+                        self._done_ikeys[(ikey, op)] = dict(resp)
+                        while len(self._done_ikeys) > self.MAX_IKEY_CACHE:
+                            self._done_ikeys.popitem(last=False)
                 resp["kind"] = "resp"
                 if self.engine is not None:
                     resp["load"] = self._load()
             except Exception as e:
                 resp = self._err_frame(op, e)
+            resp["cid"] = cid
+            if cid is not None:
+                self._resp_by_cid[cid] = resp
+                while len(self._resp_by_cid) > self.MAX_CID_CACHE:
+                    self._resp_by_cid.popitem(last=False)
             try:
                 send_frame(self.sock, resp, lock=self.wlock)
             except TransportError:

@@ -32,6 +32,7 @@ import this module before jax finishes loading.
 
 import base64
 import json
+import random
 import socket
 import struct
 import time
@@ -62,6 +63,16 @@ class WorkerError(RuntimeError):
     wire itself is fine — deliberately NOT a :class:`TransportError`, so
     the router can tell an engine fault (kill the replica, in-process
     semantics) from a torn connection (worker lost)."""
+
+
+class RpcTimeout(TransportError):
+    """One call's deadline expired with no matching response.  Subclass
+    of :class:`TransportError` so legacy catch sites still treat it as a
+    wire problem, but distinct so the router's circuit breaker can tell
+    "slow or lossy" (count, maybe retry, maybe open the breaker) from
+    "torn" (connection dead — worker lost, no retry can help).  The
+    reply may still arrive later; it is discarded by call id, never
+    misread as the next call's response."""
 
 
 class WireVersionError(TransportError):
@@ -207,7 +218,14 @@ def recv_frame(stream):
     (n,) = _HEADER.unpack(head)
     if n > MAX_FRAME_BYTES:
         raise TransportError(f"frame length {n} exceeds cap")
-    return json.loads(_read_exact(stream, n).decode())
+    body = _read_exact(stream, n)
+    try:
+        return json.loads(body.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        # a torn/overlapping frame desynchronized the stream — there is
+        # no way to resync a length-prefixed stream after a bad length,
+        # so surface it as a wire death, not a crash
+        raise TransportError(f"corrupt frame: {e}")
 
 
 def _read_exact(stream, n):
@@ -333,6 +351,116 @@ def _tree_from_wire(node):
 
 
 # ----------------------------------------------------------------------
+# deterministic wire-fault injection
+# ----------------------------------------------------------------------
+
+# Frame-layer fault sites, mirrored (same names, same order) inside
+# ``runtime/resilience.py``'s frozen FAULT_SITES tail — a tier-1 test
+# diffs the two, so chaos configs and docs share one vocabulary:
+#   wire_send   — one outbound request frame: drop / dup / reorder / tear
+#   wire_recv   — one inbound response frame: drop / dup / reorder
+#   wire_delay  — injected latency before an outbound frame
+#   rpc_timeout — force one call's deadline to expire without sending
+WIRE_FAULT_SITES = ("wire_send", "wire_recv", "wire_delay", "rpc_timeout")
+
+# actions a site plan can yield, in the order they are checked
+_WIRE_ACTIONS = ("tear", "drop", "dup", "reorder", "delay", "timeout")
+
+
+class WireFaultInjector:
+    """Deterministic, seeded, site-addressable frame-fault injector —
+    the ``FaultInjector`` idiom (``runtime/resilience.py``) pushed down
+    into the wire.  One injector is shared by every channel in a fleet,
+    so site counters are global across replicas and a whole chaos
+    scenario replays from ``(spec, seed)`` alone.
+
+    Spec: ``{site: cfg, ...}`` over :data:`WIRE_FAULT_SITES`.  Each cfg
+    may carry:
+
+    - ``drop_at`` / ``dup_at`` / ``reorder_at`` / ``tear_at`` /
+      ``delay_at`` / ``timeout_at`` — 0-based invocation indices (per
+      site, counted AFTER filters) at which that action fires;
+    - ``times`` + ``action`` — fire ``action`` on the first N matching
+      invocations (``{"times": 2, "action": "drop"}``);
+    - ``every`` + ``action`` — fire on every Nth matching invocation;
+    - ``rate`` + ``action`` — fire with probability ``rate`` from the
+      seeded rng (still replayable: same seed, same plan);
+    - ``delay_secs`` — sleep budget used when the action is ``delay``;
+    - ``ops`` — only frames for these ops consume an index here;
+    - ``replicas`` — only channels whose peer id matches consume an
+      index, making per-replica plans independent of how often the
+      *other* replicas talk (wall-clock-proof determinism).
+
+    Filtered-out invocations consume nothing, so indices stay stable no
+    matter how much unrelated traffic interleaves."""
+
+    def __init__(self, spec=None, seed=0):
+        spec = dict(spec or {})
+        self.seed = int(spec.pop("seed", seed))
+        for site in spec:
+            if site not in WIRE_FAULT_SITES:
+                raise ValueError(f"unknown wire fault site {site!r} "
+                                 f"(have {WIRE_FAULT_SITES})")
+        self.spec = {site: dict(cfg) for site, cfg in spec.items()}
+        self._rng = random.Random(self.seed)
+        self._counts = {site: 0 for site in WIRE_FAULT_SITES}
+        self._fired = {site: 0 for site in WIRE_FAULT_SITES}
+
+    @classmethod
+    def from_config(cls, spec, seed=0):
+        """``None``/empty spec → no injector (zero overhead path)."""
+        return cls(spec, seed=seed) if spec else None
+
+    def calls(self, site):
+        return self._counts[site]
+
+    def fired(self, site):
+        return self._fired[site]
+
+    def delay_secs(self, site):
+        cfg = self.spec.get(site) or {}
+        return float(cfg.get("delay_secs", 0.01))
+
+    def plan(self, site, op=None, peer=None):
+        """Consume one invocation at ``site`` and return the action to
+        take (one of ``tear|drop|dup|reorder|delay|timeout``) or
+        ``None``.  Filters (``ops``/``replicas``) are checked first and
+        do not consume an index."""
+        if site not in self._counts:
+            raise ValueError(f"unknown wire fault site {site!r}")
+        cfg = self.spec.get(site)
+        if not cfg:
+            return None
+        ops = cfg.get("ops")
+        if ops is not None and op not in ops:
+            return None
+        reps = cfg.get("replicas")
+        if reps is not None and peer not in reps:
+            return None
+        idx = self._counts[site]
+        self._counts[site] += 1
+        action = None
+        for act in _WIRE_ACTIONS:
+            at = cfg.get(f"{act}_at")
+            if at is not None and idx in at:
+                action = act
+                break
+        if action is None and "action" in cfg:
+            act = cfg["action"]
+            if act not in _WIRE_ACTIONS:
+                raise ValueError(f"unknown wire fault action {act!r}")
+            if "times" in cfg and idx < int(cfg["times"]):
+                action = act
+            elif "every" in cfg and (idx + 1) % int(cfg["every"]) == 0:
+                action = act
+            elif "rate" in cfg and self._rng.random() < float(cfg["rate"]):
+                action = act
+        if action is not None:
+            self._fired[site] += 1
+        return action
+
+
+# ----------------------------------------------------------------------
 # router-side channel
 # ----------------------------------------------------------------------
 
@@ -354,9 +482,22 @@ class RpcChannel:
     (injectable for tests); it starts at construction time, so a fresh
     worker gets one full deadline to come up before liveness can indict
     it.
+
+    Every request frame is stamped with a monotonically increasing call
+    id (``cid``) which the worker echoes on its response, so a reply
+    that arrives AFTER its call timed out is discarded by id instead of
+    being misread as the next call's response.  Calls flagged
+    ``idempotent`` retry on :class:`RpcTimeout` with exponential
+    backoff + jitter (``retry`` policy, injectable); mutating ops
+    additionally carry an idempotency key the worker dedups, so a retry
+    after a dropped ack cannot double-apply.  ``wire`` is an optional
+    :class:`WireFaultInjector` — the chaos plane's hook into every
+    frame this channel sends or receives (heartbeats excepted: their
+    timing is wall-clock noise and faulting them would break replay).
     """
 
-    def __init__(self, sock, clock=None):
+    def __init__(self, sock, clock=None, wire=None, retry=None,
+                 peer=None):
         self.sock = sock
         self._clock = clock if clock is not None else time.monotonic
         self._buf = bytearray()
@@ -365,6 +506,22 @@ class RpcChannel:
         self.hb_seq = -1
         self.hb_epoch = None
         self.closed = False
+        self.wire = wire            # WireFaultInjector (chaos) or None
+        self.retry = retry          # RetryPolicy-shaped object or None
+        self.peer = peer            # replica id, for per-replica chaos
+        self._call_seq = 0          # monotonically increasing call id
+        self._op_in_flight = None
+        self._recv_hold = None      # inbound frame held by a reorder
+        self._send_hold = None      # outbound frame held by a reorder
+        # a call timed out with its reply (or a partial frame) possibly
+        # still in flight; cleared when a matching reply next arrives.
+        # Length-prefixed framing self-heals the buffer, and cids keep
+        # the stale reply from being claimed by the next call.
+        self.desynced = False
+        self.stale_drops = 0        # late/duplicate replies discarded
+        self.retries = 0
+        self.on_retry = None        # callback(op, attempt, delay_s, elapsed_s)
+        self.on_stale = None        # callback(op, kind)
 
     # -- byte plumbing ---------------------------------------------------
     def _parse(self):
@@ -378,7 +535,10 @@ class RpcChannel:
                 return
             data = bytes(self._buf[_HEADER.size:_HEADER.size + n])
             del self._buf[:_HEADER.size + n]
-            frame = unpack_value(json.loads(data.decode()))
+            try:
+                frame = unpack_value(json.loads(data.decode()))
+            except (UnicodeDecodeError, ValueError) as e:
+                raise TransportError(f"corrupt frame: {e}")
             if isinstance(frame, dict) and frame.get("kind") == "hb":
                 seq = int(frame.get("seq", 0))
                 # a monotonicity regression means a confused or replaced
@@ -388,7 +548,31 @@ class RpcChannel:
                     self.hb_epoch = frame.get("epoch")
                     self.last_heartbeat = self._clock()
             else:
-                self._inbox.append(frame)
+                self._deliver(frame)
+
+    def _deliver(self, frame):
+        """Inbound fault point for non-heartbeat frames: the chaos
+        plane may drop, duplicate, or reorder one decoded frame before
+        it reaches the response inbox."""
+        if self.wire is not None:
+            act = self.wire.plan("wire_recv", op=self._op_in_flight,
+                                 peer=self.peer)
+            if act == "drop":
+                return
+            if act == "dup":
+                self._push(frame)
+                self._push(frame)
+                return
+            if act == "reorder":
+                self._recv_hold = frame   # delivered after the NEXT one
+                return
+        self._push(frame)
+
+    def _push(self, frame):
+        self._inbox.append(frame)
+        if self._recv_hold is not None:
+            held, self._recv_hold = self._recv_hold, None
+            self._inbox.append(held)
 
     def _fill(self, timeout):
         """Read whatever the socket has within ``timeout`` seconds
@@ -416,37 +600,143 @@ class RpcChannel:
         self._parse()
 
     # -- calls -----------------------------------------------------------
-    def call(self, op, timeout=60.0, **kwargs):
-        """One synchronous RPC: send ``{op, **kwargs}``, block (up to
-        ``timeout`` wall seconds) for the matching response frame, and
-        return its payload dict.  Worker-side typed errors re-raise
-        here; anything structural raises :class:`TransportError`."""
+    def call(self, op, timeout=60.0, idempotent=False, ikey=None,
+             **kwargs):
+        """One synchronous RPC: send ``{op, cid, **kwargs}``, block (up
+        to ``timeout`` wall seconds per attempt) for the response whose
+        call id matches, and return its payload dict.  Worker-side
+        typed errors re-raise here; a missed deadline raises
+        :class:`RpcTimeout`, and — for ``idempotent`` calls when a
+        retry policy is attached — is retried under a fresh call id
+        with exponential backoff + jitter.  ``ikey`` (idempotency key)
+        rides every attempt unchanged so the worker can dedup a true
+        re-execution after a dropped ack.  Non-idempotent ops never
+        retry here: the typed error surfaces to the router, which owns
+        that recovery decision (breaker, fence, or kill)."""
+        policy = self.retry if idempotent else None
+        max_retries = int(policy.max_retries) if policy is not None else 0
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(op, timeout, ikey, kwargs)
+            except RpcTimeout:
+                if attempt >= max_retries:
+                    raise
+                attempt += 1
+                delay = policy.delay(attempt)
+                self.retries += 1
+                if self.on_retry is not None:
+                    self.on_retry(op, attempt, delay,
+                                  time.monotonic() - start)
+                if delay > 0:
+                    policy.sleep_fn(delay)
+
+    def _call_once(self, op, timeout, ikey, kwargs):
         self.pump()
-        if self._inbox:     # protocol break: a stale unclaimed response
-            raise TransportError(
-                f"unexpected frame before call {op!r}: "
-                f"{self._inbox.popleft()!r}")
-        frame = {"op": op}
+        self._drop_stale(op)
+        cid = self._call_seq
+        self._call_seq += 1
+        if self.wire is not None and self.wire.plan(
+                "rpc_timeout", op=op, peer=self.peer) == "timeout":
+            # deadline forced without sending: the cheap, wall-clock-
+            # free way to exercise every timeout consumer (retry,
+            # breaker) deterministically
+            raise RpcTimeout(f"call {op!r} (cid {cid}): injected timeout")
+        frame = {"op": op, "cid": cid}
+        if ikey is not None:
+            frame["ikey"] = ikey
         frame.update(kwargs)
+        self._op_in_flight = op
         try:
-            self.sock.settimeout(timeout)
-            send_frame(self.sock, frame)
-        except TransportError:
-            raise
-        deadline = time.monotonic() + timeout
-        while not self._inbox:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TransportError(f"call {op!r} timed out "
-                                     f"after {timeout}s")
-            self._fill(remaining)
-            self._parse()
-        resp = self._inbox.popleft()
-        if not isinstance(resp, dict):
-            raise TransportError(f"malformed response to {op!r}")
+            self._send(frame, op, timeout)
+            deadline = time.monotonic() + timeout
+            while True:
+                resp = self._take(cid, op)
+                if resp is not None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # the reply (possibly a partial frame already in
+                    # ``_buf``) may still arrive late; mark the channel
+                    # desynchronized — the buffered parser self-heals
+                    # and ``_take``/``_drop_stale`` discard the stale
+                    # reply by cid instead of corrupting the next call
+                    self.desynced = True
+                    raise RpcTimeout(f"call {op!r} (cid {cid}) timed "
+                                     f"out after {timeout}s")
+                self._fill(remaining)
+                self._parse()
+        finally:
+            self._op_in_flight = None
         if resp.get("kind") == "err":
             self._raise_typed(op, resp)
         return resp
+
+    def _take(self, cid, op):
+        """Pop the response matching ``cid``; discard (and count) any
+        stale frame — a late reply to a call that already timed out, or
+        the extra copy of a duplicated delivery."""
+        while self._inbox:
+            resp = self._inbox.popleft()
+            if not isinstance(resp, dict):
+                raise TransportError(f"malformed response to {op!r}")
+            rcid = resp.get("cid")
+            if rcid is None or rcid == cid:
+                self.desynced = False   # resynchronized on a live reply
+                return resp
+            self.stale_drops += 1
+            if self.on_stale is not None:
+                self.on_stale(op, "stale_resp")
+        return None
+
+    def _drop_stale(self, op):
+        """Before a new call goes out, anything still in the inbox is a
+        late reply to a timed-out predecessor — discard it (counted),
+        where the pre-cid protocol had to declare the channel broken."""
+        while self._inbox:
+            self._inbox.popleft()
+            self.stale_drops += 1
+            if self.on_stale is not None:
+                self.on_stale(op, "stale_resp")
+
+    def _send(self, frame, op, timeout):
+        """Outbound fault point: the chaos plane may delay, drop,
+        duplicate, reorder, or tear this request frame."""
+        wire = self.wire
+        try:
+            self.sock.settimeout(timeout)
+        except OSError as e:
+            raise TransportError(f"send failed: {e}")
+        if wire is None:
+            send_frame(self.sock, frame)
+            return
+        if wire.plan("wire_delay", op=op, peer=self.peer) == "delay":
+            time.sleep(wire.delay_secs("wire_delay"))
+        act = wire.plan("wire_send", op=op, peer=self.peer)
+        if act == "drop":
+            return                       # frame never leaves the host
+        if act == "tear":
+            # half a frame on the wire: the worker's stream desyncs and
+            # dies with a typed corrupt-frame TransportError — a real
+            # tear is unrecoverable for a length-prefixed stream
+            data = json.dumps(pack_value(frame),
+                              separators=(",", ":")).encode()
+            buf = _HEADER.pack(len(data)) + data
+            try:
+                self.sock.sendall(buf[:max(1, len(buf) // 2)])
+            except (OSError, ValueError) as e:
+                raise TransportError(f"send failed: {e}")
+            return
+        if act == "reorder":
+            self._send_hold = frame      # goes out after the NEXT frame
+            return
+        send_frame(self.sock, frame)
+        if act == "dup":
+            send_frame(self.sock, frame)     # exact duplicate delivery
+        if self._send_hold is not None:
+            held, self._send_hold = self._send_hold, None
+            send_frame(self.sock, held)
 
     @staticmethod
     def _raise_typed(op, resp):

@@ -64,11 +64,12 @@ INCIDENT_EVENTS = ("incident/open", "incident/written")
 
 # The closed set of trigger kinds — one per verdict source wired through
 # the planes (see module docstring; "worker_lost" is the cross-process
-# fleet's torn-wire / missed-heartbeat verdict).  Frozen for the same
-# reason.
+# fleet's torn-wire / missed-heartbeat verdict, "breaker_open" the
+# gray-failure circuit-breaker trip that fences WITHOUT killing).
+# Frozen for the same reason.
 INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
                      "replica_kill", "replica_fence", "slo_burn",
-                     "worker_lost")
+                     "worker_lost", "breaker_open")
 
 # Default multi-window burn-rate policy: burning when >= 50% of
 # deadline-bearing requests missed over the last minute AND >= 10% over

@@ -182,10 +182,17 @@ _EXC_TABLE = {
 # cross-replica page transfer and the all-or-nothing commit — both
 # consulted BEFORE any routing-table or allocator mutation becomes
 # durable, so a faulted migration retries from a consistent state.
+# wire_send / wire_recv / wire_delay / rpc_timeout are the cross-process
+# transport's frame-level sites (inference/transport.py): outbound and
+# inbound frame faults (drop/duplicate/reorder/tear), injected frame
+# latency, and a forced RPC-deadline expiry — consulted by the seeded
+# WireFaultInjector, which shares this frozen vocabulary (a tier-1 test
+# diffs the two) but keeps frame-action semantics of its own.
 FAULT_SITES = ("ckpt_save", "ckpt_load", "fs", "dataloader_next",
                "serve_step", "serve_sample", "page_alloc",
                "replica_kill", "route_dispatch",
-               "page_migrate", "migrate_commit")
+               "page_migrate", "migrate_commit",
+               "wire_send", "wire_recv", "wire_delay", "rpc_timeout")
 
 
 class FaultInjector:

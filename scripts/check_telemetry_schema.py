@@ -228,6 +228,24 @@ FLEET_EVENTS = (
     "fleet/migrate_start", "fleet/migrate_commit", "fleet/migrate_fault",
     "fleet/migrate_abort", "fleet/local_prefill",
     "fleet/worker_lost",
+    "fleet/retry", "fleet/breaker_open", "fleet/breaker_close",
+    "fleet/dup_call_dropped",
+)
+
+# FROZEN vocabulary of the fleet gauge family — must stay byte-identical
+# to ``deepspeed_tpu.inference.fleet.FLEET_GAUGES`` (the tier-1 test
+# diffs the two).  Every gauge event under the ``fleet/`` prefix is
+# validated against this tuple; most of the family is registry-only
+# (scraped by the exporter) and only the breaker gauges are also
+# emitted as gauge EVENTS at transition time.
+FLEET_GAUGES = (
+    "fleet/replicas", "fleet/healthy", "fleet/pending",
+    "fleet/queue_depth", "fleet/redispatches", "fleet/workers_lost",
+    "fleet/heartbeat_age_s", "fleet/migrating", "fleet/migrated_pages",
+    "fleet/dedup_skipped_pages", "fleet/prefill_queue_depth",
+    "fleet/decode_queue_depth", "fleet/breaker_open_replicas",
+    "fleet/breaker_opens", "fleet/breaker_closes", "fleet/retries",
+    "fleet/dup_calls_dropped",
 )
 
 # FROZEN vocabulary of tune-kind event names — must stay byte-identical
@@ -315,7 +333,7 @@ ROOFLINE_METRICS = ("compute_frac", "bandwidth_frac")
 INCIDENT_EVENTS = ("incident/open", "incident/written")
 INCIDENT_TRIGGERS = ("stall", "storm", "straggler", "leak",
                      "replica_kill", "replica_fence", "slo_burn",
-                     "worker_lost")
+                     "worker_lost", "breaker_open")
 
 # FROZEN vocabularies of the time-attribution plane — each must stay
 # byte-identical to its twin in ``deepspeed_tpu.monitor.attribution``
@@ -407,6 +425,10 @@ def validate_event(event):
             event["name"] not in STEP_ATTR_GAUGES:
         problems.append(
             f"gauge: unknown step/attr gauge {event['name']!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str) and \
+            event["name"].startswith("fleet/") and \
+            event["name"] not in FLEET_GAUGES:
+        problems.append(f"gauge: unknown fleet gauge {event['name']!r}")
     if kind == "compile" and isinstance(event.get("name"), str):
         if event["name"] not in COMPILE_EVENTS:
             problems.append(

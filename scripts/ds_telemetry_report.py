@@ -180,6 +180,27 @@ def aggregate(events):
                 if site:
                     sites = rec.setdefault("sites", {})
                     sites[site] = sites.get(site, 0) + 1
+            # transport plane (fleet/retry, breaker transitions,
+            # dup_call_dropped): per-op retry counts + elapsed-at-retry
+            # samples for the timeout percentiles, breaker open/close
+            # per replica, and the dedup drop census by op+kind
+            elif ev["name"] == "fleet/retry":
+                op = str(attrs.get("op") or "?")
+                ops = rec.setdefault("ops", {})
+                ops[op] = ops.get(op, 0) + 1
+                if attrs.get("elapsed_s") is not None:
+                    rec.setdefault("elapsed_s", []).append(
+                        float(attrs["elapsed_s"]))
+            elif ev["name"] in ("fleet/breaker_open",
+                                "fleet/breaker_close"):
+                if replica:
+                    per = rec.setdefault("per_replica", {})
+                    per[str(replica)] = per.get(str(replica), 0) + 1
+            elif ev["name"] == "fleet/dup_call_dropped":
+                op = str(attrs.get("op") or "?")
+                kind_ = str(attrs.get("kind") or "?")
+                drops = rec.setdefault("drops", {})
+                drops[(op, kind_)] = drops.get((op, kind_), 0) + 1
         elif kind == "tune":
             # closed-loop autotuner stream (frozen tune/* vocabulary):
             # trial_start stamps the knob point, trial_result the
@@ -359,6 +380,7 @@ def summarize(agg):
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
             "fleet": fleet_rows,
+            "fleet_transport": _transport_summary(agg),
             "fleet_disagg": _disagg_summary(agg),
             "autotuning": _autotuning_summary(agg),
             "serving_attention": _serving_attention_summary(agg),
@@ -367,6 +389,43 @@ def summarize(agg):
             "request_latency": _request_latency_summary(agg),
             "stalls": [{k: v for k, v in s.items() if k != "kind"}
                        for s in agg["stalls"]]}
+
+
+def _transport_summary(agg):
+    """Fleet wire-layer digest from the frozen transport events
+    (``fleet/retry``, ``fleet/breaker_open|close``,
+    ``fleet/dup_call_dropped``): retry counts by op with the
+    elapsed-at-retry percentiles (a proxy for the call-timeout tail),
+    breaker transitions per replica, and the duplicate-call drop census
+    by op and kind (``stale_resp`` = late reply discarded by call id,
+    ``ikey_replay`` = worker-side idempotency dedup).  None when the
+    stream carries no transport events."""
+    fleets = agg.get("fleets") or {}
+    retry = fleets.get("fleet/retry") or {}
+    opens = fleets.get("fleet/breaker_open") or {}
+    closes = fleets.get("fleet/breaker_close") or {}
+    drops = fleets.get("fleet/dup_call_dropped") or {}
+    if not (retry or opens or closes or drops):
+        return None
+    elapsed = sorted(retry.get("elapsed_s") or [])
+    breakers = {}
+    for name, rec in (("opens", opens), ("closes", closes)):
+        for rid, n in (rec.get("per_replica") or {}).items():
+            breakers.setdefault(rid, {"opens": 0, "closes": 0})[name] = n
+    return {
+        "retries": retry.get("count", 0),
+        "retries_by_op": dict(sorted((retry.get("ops") or {}).items())),
+        "retry_elapsed_p50_s": (round(_pct(elapsed, 50), 4)
+                                if elapsed else None),
+        "retry_elapsed_p99_s": (round(_pct(elapsed, 99), 4)
+                                if elapsed else None),
+        "breaker_opens": opens.get("count", 0),
+        "breaker_closes": closes.get("count", 0),
+        "breakers": dict(sorted(breakers.items())),
+        "dup_calls_dropped": drops.get("count", 0),
+        "drops_by_op": {f"{op}:{kind}": n for (op, kind), n in
+                        sorted((drops.get("drops") or {}).items())},
+    }
 
 
 def _autotuning_summary(agg):
@@ -903,6 +962,26 @@ def print_tables(summary, out=sys.stdout):
                 parts.append(", ".join(f"{k}={v}"
                                        for k, v in r["reasons"].items()))
             w(f"{name:<24}{r['count']:>7}  {' | '.join(parts)}\n")
+        w("\n")
+    tp = summary.get("fleet_transport")
+    if tp:
+        w("== fleet transport ==\n")
+        retries = ", ".join(f"{k}={v}" for k, v in
+                            tp["retries_by_op"].items()) or "-"
+        w(f"retries: {tp['retries']}  by op: {retries}\n")
+        if tp["retry_elapsed_p50_s"] is not None:
+            w(f"elapsed at retry: p50 {tp['retry_elapsed_p50_s']}s  "
+              f"p99 {tp['retry_elapsed_p99_s']}s\n")
+        w(f"breaker: {tp['breaker_opens']} open, "
+          f"{tp['breaker_closes']} close\n")
+        if tp["breakers"]:
+            w(f"{'replica':<12}{'opens':>7}{'closes':>8}\n")
+            for rid, b in tp["breakers"].items():
+                w(f"{rid:<12}{b['opens']:>7}{b['closes']:>8}\n")
+        drops = ", ".join(f"{k}={v}" for k, v in
+                          tp["drops_by_op"].items()) or "-"
+        w(f"duplicate calls dropped: {tp['dup_calls_dropped']}  "
+          f"by op: {drops}\n")
         w("\n")
     tune = summary.get("autotuning")
     if tune:

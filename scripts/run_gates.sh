@@ -12,6 +12,7 @@
 #   7. ds_trace_export.py --check                    Perfetto trace export
 #   8. overlap smoke                                 ZeRO-3 comm overlap
 #   9. fleet xproc smoke                             kill -9 a worker proc
+#  10. chaos smoke                                   seeded wire faults
 #
 # TELEMETRY_DIR (optional) is searched recursively for events*.jsonl
 # streams; INCIDENTS_DIR (optional) holds incident bundles; TUNE_DIR
@@ -350,6 +351,16 @@ print(f"fleet xproc smoke: {len(ref)} requests bit-identical across the "
       f"{stats['workers_lost']}, respawns={stats['respawns']}, "
       f"schema-valid worker_lost event + incident bundle")
 EOF
+
+# 10. chaos smoke: deterministic wire-fault campaign over the 2-worker
+# subprocess fleet — lost add_request ack (channel retry + ikey dedup),
+# slow worker (circuit breaker opens, probes, closes; no respawn), and a
+# torn commit_import ack (gray migrate recovers exactly-once). Each
+# scenario asserts zero lost requests, one terminal per request, empty
+# leak report, bit-identical survivors vs an in-process reference, and
+# checker-valid telemetry.
+run_gate "chaos smoke" env JAX_PLATFORMS=cpu "$PY" \
+    "$REPO/scripts/ds_chaos.py" --scenarios ack_loss,slow_worker,torn_commit
 
 if [ "$fail" -ne 0 ]; then
     echo "GATES: FAIL"

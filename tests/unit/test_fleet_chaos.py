@@ -1,0 +1,639 @@
+"""Network chaos plane tests (inference/transport.py wire-fault
+injection + retry/cid protocol, inference/fleet_worker.py exactly-once
+dedup, inference/fleet.py circuit breaker):
+
+- ``WireFaultInjector`` plan semantics: exact indices, ``times`` /
+  ``every`` / ``rate`` triggers, ops/replica filters that consume no
+  index, seeded replayability.
+- Frame-parser robustness as a property: a frame stream split at EVERY
+  byte offset — and fully coalesced, and one byte at a time — parses to
+  the same frames, with interleaved heartbeats consumed inline.
+- The timeout-desync regression: a response arriving one byte at a time
+  ACROSS the call deadline leaves a partial frame buffered; the next
+  call must discard the late reply by call id and resynchronize.
+- Channel retry: idempotent calls retry on ``RpcTimeout`` under a fresh
+  cid with the SAME idempotency key; non-idempotent calls never do.
+- Worker dedup: a duplicated cid resends the cached response verbatim
+  (no re-execution); a replayed ikey returns the recorded outcome
+  flagged ``dup`` (exactly-once mutation semantics).
+- ``CircuitBreaker`` state machine on a fake clock: trip threshold,
+  half-open probe cycle, doubling cooldowns, flap hysteresis.
+- Vocabulary lockstep: the wire fault sites are the frozen tail of
+  ``runtime/resilience.py``'s FAULT_SITES.
+- slow: subprocess end-to-end exactly-once proof (dropped admission
+  ack) and the breaker/liveness composition — a tripped breaker fences
+  WITHOUT killing, exempt from heartbeat death, exactly ONE incident.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.fleet import CircuitBreaker, FleetRouter
+from deepspeed_tpu.inference.fleet_worker import (FleetWorker,
+                                                  tiny_engine_factory)
+from deepspeed_tpu.inference.transport import (RpcChannel, RpcTimeout,
+                                               TransportError,
+                                               WIRE_FAULT_SITES,
+                                               WireFaultInjector,
+                                               pack_value, send_frame)
+from deepspeed_tpu.monitor.telemetry import Telemetry
+from deepspeed_tpu.runtime.config import TelemetryConfig
+from deepspeed_tpu.runtime.resilience import RetryPolicy
+
+SPEC = {"factory":
+        "deepspeed_tpu.inference.fleet_worker:tiny_engine_factory",
+        "kwargs": {}}
+
+
+def _load_checker():
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "scripts", "check_telemetry_schema.py")
+    spec = importlib.util.spec_from_file_location("_chaos_checker", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------------
+# WireFaultInjector plan semantics
+# ----------------------------------------------------------------------
+def test_injector_fires_at_exact_indices():
+    inj = WireFaultInjector({"wire_send": {"drop_at": [1, 3]}})
+    acts = [inj.plan("wire_send", op="step") for _ in range(5)]
+    assert acts == [None, "drop", None, "drop", None]
+    assert inj.calls("wire_send") == 5
+    assert inj.fired("wire_send") == 2
+
+
+def test_injector_op_filter_consumes_no_index():
+    """Filtered-out invocations must not advance the site counter, so a
+    plan aimed at one op stays deterministic no matter how much
+    unrelated traffic interleaves."""
+    inj = WireFaultInjector({"wire_send": {"drop_at": [0],
+                                           "ops": ["add_request"]}})
+    for _ in range(10):                       # unrelated chatter
+        assert inj.plan("wire_send", op="step") is None
+    assert inj.calls("wire_send") == 0        # nothing consumed
+    assert inj.plan("wire_send", op="add_request") == "drop"
+
+
+def test_injector_replica_filter_is_independent():
+    inj = WireFaultInjector({"rpc_timeout": {"timeout_at": [0],
+                                             "replicas": ["r1"]}})
+    assert inj.plan("rpc_timeout", op="step", peer="r0") is None
+    assert inj.calls("rpc_timeout") == 0
+    assert inj.plan("rpc_timeout", op="step", peer="r1") == "timeout"
+
+
+def test_injector_times_and_every_triggers():
+    inj = WireFaultInjector({"rpc_timeout": {"action": "timeout",
+                                             "times": 2}})
+    acts = [inj.plan("rpc_timeout") for _ in range(4)]
+    assert acts == ["timeout", "timeout", None, None]
+    inj = WireFaultInjector({"wire_recv": {"action": "drop", "every": 3}})
+    acts = [inj.plan("wire_recv") for _ in range(7)]
+    assert acts == [None, None, "drop", None, None, "drop", None]
+
+
+def test_injector_rate_is_seed_deterministic():
+    spec = {"wire_send": {"action": "drop", "rate": 0.5}}
+    plans = []
+    for _ in range(2):
+        inj = WireFaultInjector(spec, seed=7)
+        plans.append([inj.plan("wire_send") for _ in range(40)])
+    assert plans[0] == plans[1]               # same seed, same campaign
+    assert "drop" in plans[0] and None in plans[0]
+    other = WireFaultInjector(spec, seed=8)
+    assert [other.plan("wire_send") for _ in range(40)] != plans[0]
+
+
+def test_injector_rejects_unknown_site_and_action():
+    with pytest.raises(ValueError):
+        WireFaultInjector({"not_a_site": {"drop_at": [0]}})
+    inj = WireFaultInjector({"wire_send": {"action": "explode",
+                                           "times": 1}})
+    with pytest.raises(ValueError):
+        inj.plan("wire_send")
+    with pytest.raises(ValueError):
+        WireFaultInjector({}).plan("not_a_site")
+
+
+def test_injector_from_config_empty_is_none():
+    assert WireFaultInjector.from_config(None) is None
+    assert WireFaultInjector.from_config({}) is None
+    assert WireFaultInjector.from_config(
+        {"wire_send": {"drop_at": [0]}}) is not None
+
+
+def test_injector_seed_rides_spec():
+    inj = WireFaultInjector({"seed": 42, "wire_send": {"drop_at": [0]}})
+    assert inj.seed == 42
+    assert "seed" not in inj.spec
+
+
+# ----------------------------------------------------------------------
+# frame parser as a property: every split of the byte stream parses the
+# same (satellite: property-style fragmentation test)
+# ----------------------------------------------------------------------
+def _frame_bytes(obj):
+    data = json.dumps(pack_value(obj), separators=(",", ":")).encode()
+    return struct.pack(">I", len(data)) + data
+
+
+def _parse_channel():
+    ch = RpcChannel(None, clock=lambda: 0.0)
+    return ch
+
+
+def _stream_and_expected():
+    frames = [{"kind": "resp", "cid": 0, "val": "a"},
+              {"kind": "hb", "seq": 0, "rid": "r0"},
+              {"kind": "resp", "cid": 1, "val": "bb"},
+              {"kind": "hb", "seq": 1, "rid": "r0"},
+              {"kind": "resp", "cid": 2, "val": "ccc"}]
+    stream = b"".join(_frame_bytes(f) for f in frames)
+    resps = [f for f in frames if f["kind"] == "resp"]
+    return stream, resps
+
+
+def test_frame_parser_every_byte_offset():
+    """Splitting the stream at ANY byte boundary — inside a length
+    prefix, inside a JSON body, between frames — must yield exactly the
+    same frames as one coalesced delivery."""
+    stream, resps = _stream_and_expected()
+    for cut in range(1, len(stream)):
+        ch = _parse_channel()
+        ch._buf.extend(stream[:cut])
+        ch._parse()
+        ch._buf.extend(stream[cut:])
+        ch._parse()
+        assert list(ch._inbox) == resps, f"diverged at offset {cut}"
+        assert ch.hb_seq == 1
+
+
+def test_frame_parser_one_byte_at_a_time_and_coalesced():
+    stream, resps = _stream_and_expected()
+    drip = _parse_channel()
+    for i in range(len(stream)):
+        drip._buf.extend(stream[i:i + 1])
+        drip._parse()
+    whole = _parse_channel()
+    whole._buf.extend(stream)
+    whole._parse()
+    assert list(drip._inbox) == list(whole._inbox) == resps
+
+
+def test_frame_parser_heartbeats_never_reach_inbox():
+    ch = _parse_channel()
+    clock = {"t": 100.0}
+    ch._clock = lambda: clock["t"]
+    ch._buf.extend(_frame_bytes({"kind": "hb", "seq": 5, "rid": "r0"}))
+    ch._parse()
+    assert not ch._inbox and ch.hb_seq == 5
+    assert ch.last_heartbeat == 100.0
+    clock["t"] = 200.0                 # a seq REGRESSION must not refresh
+    ch._buf.extend(_frame_bytes({"kind": "hb", "seq": 3, "rid": "r0"}))
+    ch._parse()
+    assert ch.hb_seq == 5 and ch.last_heartbeat == 100.0
+
+
+def test_frame_parser_rejects_oversized_length_prefix():
+    ch = _parse_channel()
+    ch._buf.extend(struct.pack(">I", (1 << 30) + 1))
+    with pytest.raises(TransportError):
+        ch._parse()
+
+
+# ----------------------------------------------------------------------
+# channel protocol over a real socketpair
+# ----------------------------------------------------------------------
+def _responder(sock, script):
+    """Read request frames off the worker end; ``script(frame)`` returns
+    the response dict to send (or None to stay silent)."""
+    stream = sock.makefile("rb")
+
+    def run():
+        from deepspeed_tpu.inference.transport import recv_frame
+        while True:
+            try:
+                frame = recv_frame(stream)
+            except TransportError:
+                return
+            resp = script(frame)
+            if resp is not None:
+                try:
+                    send_frame(sock, resp)
+                except TransportError:
+                    return
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_idempotent_retry_fresh_cid_same_ikey():
+    """Two injected timeouts then a live attempt: the op retries under
+    FRESH cids 0→1→2 while the idempotency key rides every attempt
+    unchanged, the backoff schedule is the policy's, and exactly ONE
+    frame ever reaches the worker."""
+    a, b = socket.socketpair()
+    seen, delays, retried = [], [], []
+    try:
+        ch = RpcChannel(
+            a,
+            wire=WireFaultInjector({"rpc_timeout": {"action": "timeout",
+                                                    "times": 2}}),
+            retry=RetryPolicy(max_retries=2, backoff_secs=0.01,
+                              backoff_max_secs=0.05, jitter=0.0,
+                              sleep_fn=delays.append))
+        ch.on_retry = lambda op, att, d, el: retried.append((op, att, d))
+        _responder(b, lambda f: (seen.append(f),
+                                 {"kind": "resp", "cid": f["cid"],
+                                  "ok": True})[1])
+        resp = ch.call("bump", timeout=5.0, idempotent=True, ikey="k0")
+        assert resp["ok"] is True
+        assert ch.retries == 2
+        assert [s["cid"] for s in seen] == [2]   # cids 0,1 never sent
+        assert seen[0]["ikey"] == "k0"
+        assert delays == [0.01, 0.02]            # base, then doubled
+        assert [(op, att) for op, att, _ in retried] == \
+            [("bump", 1), ("bump", 2)]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_non_idempotent_call_never_retries():
+    a, b = socket.socketpair()
+    try:
+        ch = RpcChannel(
+            a,
+            wire=WireFaultInjector({"rpc_timeout": {"action": "timeout",
+                                                    "times": 5}}),
+            retry=RetryPolicy(max_retries=3, backoff_secs=0.01,
+                              sleep_fn=lambda s: None))
+        with pytest.raises(RpcTimeout):
+            ch.call("step", timeout=5.0)
+        assert ch.retries == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_timeout_desync_resync_one_byte_response():
+    """THE regression: a reply trickling in one byte at a time crosses
+    the call deadline — the call times out with a partial frame
+    buffered.  The buffered parser must self-heal, the late reply must
+    be discarded BY CALL ID, and the next call must succeed."""
+    a, b = socket.socketpair()
+    stale = []
+    try:
+        ch = RpcChannel(a)
+        ch.on_stale = lambda op, kind: stale.append((op, kind))
+        late = _frame_bytes({"kind": "resp", "cid": 0, "val": "late"})
+
+        def drip_half():
+            time.sleep(0.05)
+            for i in range(len(late) // 2):   # one byte at a time...
+                b.sendall(late[i:i + 1])      # ...stopping mid-frame
+
+        t = threading.Thread(target=drip_half, daemon=True)
+        t.start()
+        with pytest.raises(RpcTimeout):
+            ch.call("x", timeout=0.4)
+        t.join()
+        assert ch.desynced
+        b.sendall(late[len(late) // 2:])      # the tail arrives late
+
+        def answer_second(f):
+            if f.get("cid") == 1:
+                return {"kind": "resp", "cid": 1, "val": "fresh"}
+            return None                       # ignore the stale request
+        _responder(b, answer_second)
+        resp = ch.call("y", timeout=5.0)
+        assert resp["val"] == "fresh"         # never the cid-0 reply
+        assert not ch.desynced
+        assert ch.stale_drops == 1
+        assert stale == [("y", "stale_resp")]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_dup_extra_copy_dropped_by_cid():
+    a, b = socket.socketpair()
+    try:
+        ch = RpcChannel(
+            a, wire=WireFaultInjector({"wire_recv": {"dup_at": [0]}}))
+        _responder(b, lambda f: {"kind": "resp", "cid": f["cid"]})
+        ch.call("p", timeout=5.0)             # delivered twice
+        ch.call("q", timeout=5.0)             # extra copy is stale now
+        assert ch.stale_drops == 1
+    finally:
+        a.close()
+        b.close()
+
+
+# ----------------------------------------------------------------------
+# worker-side exactly-once dedup (cid cache + ikey replay)
+# ----------------------------------------------------------------------
+def _worker_pair():
+    """A FleetWorker over a socketpair with one side-effecting test op
+    patched in (the real ops need an engine; the dedup layer does not)."""
+    a, b = socket.socketpair()
+    worker = FleetWorker(b)
+    calls = {"n": 0}
+
+    def _op_bump(frame):
+        calls["n"] += 1
+        return {"n": calls["n"]}
+    worker._op_bump = _op_bump
+    t = threading.Thread(target=worker.serve, daemon=True)
+    t.start()
+    return a, b, worker, calls
+
+
+def test_worker_duplicate_cid_resends_cached_response():
+    a, b, worker, calls = _worker_pair()
+    try:
+        ch = RpcChannel(a)
+        frame = {"op": "bump", "cid": 0}
+        send_frame(a, frame)
+        send_frame(a, frame)                  # exact duplicate delivery
+        deadline = time.monotonic() + 5.0
+        while len(ch._inbox) < 2 and time.monotonic() < deadline:
+            ch.pump()
+            time.sleep(0.005)
+        first, second = ch._inbox.popleft(), ch._inbox.popleft()
+        assert first == second                # resent verbatim
+        assert first["n"] == 1
+        assert calls["n"] == 1                # executed exactly once
+        assert worker.dup_calls == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_worker_ikey_replay_returns_recorded_outcome():
+    """A retry under a fresh cid but the same ikey must replay the
+    recorded outcome flagged ``dup`` — never re-execute the mutation."""
+    a, b, worker, calls = _worker_pair()
+    try:
+        ch = RpcChannel(a)
+        r1 = ch.call("bump", timeout=5.0, ikey="k1")
+        assert r1["n"] == 1 and "dup" not in r1
+        r2 = ch.call("bump", timeout=5.0, ikey="k1")   # fresh cid 1
+        assert r2["n"] == 1 and r2["dup"] is True
+        assert calls["n"] == 1
+        assert worker.dup_calls == 1
+        r3 = ch.call("bump", timeout=5.0, ikey="k2")   # new key executes
+        assert r3["n"] == 2 and "dup" not in r3
+        assert calls["n"] == 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_worker_cid_cache_is_bounded():
+    a, b, worker, calls = _worker_pair()
+    try:
+        ch = RpcChannel(a)
+        for _ in range(FleetWorker.MAX_CID_CACHE + 1):
+            ch.call("bump", timeout=5.0)
+        assert 0 not in worker._resp_by_cid   # oldest cid evicted
+        assert len(worker._resp_by_cid) == FleetWorker.MAX_CID_CACHE
+    finally:
+        a.close()
+        b.close()
+
+
+# ----------------------------------------------------------------------
+# CircuitBreaker state machine (fake clock)
+# ----------------------------------------------------------------------
+class _Tcfg:
+    breaker_failures = 3
+    breaker_open_s = 1.0
+    breaker_open_max_s = 8.0
+    breaker_flap_window_s = 30.0
+    breaker_probes = 2
+    breaker_probe_timeout_s = 5.0
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_consecutive_timeouts_only():
+    clock = _Clock()
+    br = CircuitBreaker(_Tcfg(), clock)
+    assert not br.record_failure() and not br.record_failure()
+    br.record_success()                       # run broken → start over
+    assert not br.record_failure() and not br.record_failure()
+    assert br.record_failure()                # third consecutive trips
+    assert br.open() == 1.0
+    assert br.state == "open" and br.opens == 1
+
+
+def test_breaker_halfopen_probe_cycle():
+    clock = _Clock()
+    br = CircuitBreaker(_Tcfg(), clock)
+    for _ in range(3):
+        br.record_failure()
+    br.open()
+    assert not br.probe_due()                 # cooldown still running
+    clock.t += 1.0
+    assert br.probe_due() and br.state == "half_open"
+    br.close()
+    assert br.state == "closed" and br.closes == 1
+    assert br.consecutive == 0
+
+
+def test_breaker_probe_failures_double_then_escalate():
+    clock = _Clock()
+    br = CircuitBreaker(_Tcfg(), clock)
+    for _ in range(3):
+        br.record_failure()
+    br.open()
+    clock.t += 1.0
+    assert br.probe_due()
+    assert not br.probe_failed()              # 1st failed probe: re-arm
+    assert br.state == "open" and br.cooldown_s == 2.0
+    clock.t += 2.0
+    assert br.probe_due()
+    assert br.probe_failed()                  # budget spent → escalate
+    assert br.probe_failures == 2
+
+
+def test_breaker_flap_window_doubles_cooldown_capped():
+    clock = _Clock()
+    br = CircuitBreaker(_Tcfg(), clock)
+    assert br.open() == 1.0                   # first open: base cooldown
+    br.close()
+    clock.t += 0.5                            # re-open INSIDE the window
+    assert br.open() == 2.0
+    br.close()
+    clock.t += 0.5
+    for _ in range(5):                        # keep flapping → cap
+        br.close()
+        clock.t += 0.5
+        br.open()
+    assert br.cooldown_s == 8.0               # breaker_open_max_s
+    br.close()
+    clock.t += 100.0                          # settle PAST the window
+    assert br.open() == 1.0                   # hysteresis resets
+
+
+def test_breaker_disabled_when_failures_zero():
+    cfg = _Tcfg()
+    cfg.breaker_failures = 0
+    br = CircuitBreaker(cfg, _Clock())
+    assert not br.enabled
+    assert not br.record_failure()            # never trips
+
+
+# ----------------------------------------------------------------------
+# vocabulary lockstep
+# ----------------------------------------------------------------------
+def test_wire_fault_sites_are_fault_sites_tail():
+    """Chaos configs, docs, and the resilience injector share ONE site
+    vocabulary: the wire sites are the frozen tail of FAULT_SITES, same
+    names, same order."""
+    from deepspeed_tpu.runtime.resilience import FAULT_SITES
+    assert FAULT_SITES[-len(WIRE_FAULT_SITES):] == WIRE_FAULT_SITES
+
+
+# ----------------------------------------------------------------------
+# subprocess end-to-end (slow): exactly-once + breaker/liveness
+# ----------------------------------------------------------------------
+def _prompts(n=3):
+    rng = np.random.default_rng(9)
+    return {f"c{i}": rng.integers(0, 256, (8,)).tolist()
+            for i in range(n)}
+
+
+def _drive(router, settle=None, wall_s=120.0):
+    deadline = time.monotonic() + wall_s
+    for _ in range(2000):
+        router.step()
+        if not router._unresolved() and (settle is None or
+                                         settle(router)):
+            break
+        assert time.monotonic() < deadline, "chaos run wall-clock bound"
+    assert not router._unresolved(), "fleet did not converge"
+    return (dict(router.finished), router.pop_terminated(),
+            router.leak_report(), dict(router.stats))
+
+
+def _reference(prompts):
+    router = FleetRouter(tiny_engine_factory,
+                         fleet={"replicas": 2, "health_interval": 1000})
+    try:
+        for rid, p in sorted(prompts.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        finished, term, leaks, _ = _drive(router)
+        assert not term and leaks == {}
+        return finished
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_e2e_dropped_admission_ack_is_exactly_once():
+    """The first ``add_request`` response is dropped on the floor: the
+    channel retries under the same ikey, the worker replays the recorded
+    admission instead of double-admitting, and every output stays
+    bit-identical to the no-fault reference — with zero kills."""
+    prompts = _prompts()
+    ref = _reference(prompts)
+    router = FleetRouter(SPEC, fleet={
+        "replicas": 2, "health_interval": 1000,
+        "transport": {
+            "mode": "subprocess", "heartbeat_interval_s": 0.2,
+            "heartbeat_deadline_s": 60.0, "call_timeout_s": 8.0,
+            "retry": {"max_retries": 2, "backoff_s": 0.02,
+                      "backoff_max_s": 0.1},
+            "chaos": {"seed": 0,
+                      "wire_recv": {"drop_at": [0],
+                                    "ops": ["add_request"]}}}})
+    try:
+        for rid, p in sorted(prompts.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        finished, term, leaks, stats = _drive(router)
+    finally:
+        router.close()
+    assert leaks == {} and not term
+    assert finished == ref                    # bit-identical through chaos
+    assert stats["retries"] >= 1
+    assert stats["dup_calls_dropped"] >= 1    # the ikey replay, observed
+    assert stats["workers_lost"] == 0 and stats["respawns"] == 0
+
+
+@pytest.mark.slow
+def test_e2e_breaker_fences_without_killing_one_incident(tmp_path):
+    """Breaker/liveness composition: consecutive step timeouts on one
+    replica trip its breaker (fenced, requests redispatched), the
+    half-open probe closes it, heartbeat death NEVER fires for the
+    fenced replica, and the whole episode books exactly ONE incident
+    bundle (trigger ``breaker_open``) — not a second ``worker_lost``."""
+    prompts = _prompts()
+    ref = _reference(prompts)
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": str(tmp_path),
+         "job_name": "chaos_breaker",
+         "incidents": {"enabled": True, "cooldown_s": 0.0}}), rank=0)
+    router = FleetRouter(SPEC, fleet={
+        "replicas": 2, "health_interval": 1000,
+        "transport": {
+            "mode": "subprocess", "heartbeat_interval_s": 0.2,
+            "heartbeat_deadline_s": 60.0, "call_timeout_s": 30.0,
+            "retry": {"max_retries": 0},
+            "breaker_failures": 2, "breaker_open_s": 0.2,
+            "breaker_probe_timeout_s": 5.0,
+            "chaos": {"seed": 0,
+                      "rpc_timeout": {"action": "timeout", "times": 2,
+                                      "ops": ["step"],
+                                      "replicas": ["r0"]}}}},
+        telemetry=tel)
+    try:
+        for rid, p in sorted(prompts.items()):
+            router.submit(rid, p, max_new_tokens=6, temperature=0.7,
+                          seed=11)
+        finished, term, leaks, stats = _drive(
+            router, settle=lambda r: r.stats["breaker_closes"] >= 1)
+        assert router.replicas["r0"].state == "healthy"   # probe healed
+    finally:
+        router.close()
+        tel.close()
+    assert leaks == {} and not term
+    assert finished == ref
+    assert stats["breaker_opens"] == 1 and stats["breaker_closes"] == 1
+    assert stats["workers_lost"] == 0 and stats["respawns"] == 0
+
+    events_path = os.path.join(str(tmp_path), "chaos_breaker",
+                               "events.jsonl")
+    checker = _load_checker()
+    assert checker.validate_file(events_path) == []
+    with open(events_path) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    opens = [e for e in events if e.get("kind") == "fleet"
+             and e.get("name") == "fleet/breaker_open"]
+    closes = [e for e in events if e.get("kind") == "fleet"
+              and e.get("name") == "fleet/breaker_close"]
+    assert len(opens) == 1 and len(closes) == 1
+    bundles = [e for e in events if e.get("kind") == "incident"
+               and e.get("name") == "incident/open"]
+    assert [b.get("trigger") for b in bundles] == ["breaker_open"]

@@ -509,12 +509,12 @@ def xproc_roles_results():
         d0 = router.replicas["d0"].handle
         orig_commit = d0.commit_import
 
-        def torn_commit(req_id):
+        def torn_commit(req_id, **kw):
             if not torn["count"]:
                 torn["count"] += 1
                 os.kill(d0.proc.pid, signal.SIGKILL)
                 time.sleep(0.3)     # let the SIGKILL land first
-            return orig_commit(req_id)
+            return orig_commit(req_id, **kw)
         d0.commit_import = torn_commit
         for _ in range(300):
             router.step()

@@ -57,6 +57,22 @@ def test_rejects_unknown_fleet_name(checker):
          "attrs": {"replica": "r1", "epoch": "r1g0"}, "step": 3})
 
 
+def test_fleet_gauges_in_lockstep(checker):
+    """The frozen fleet-gauge vocabulary must stay byte-identical between
+    the router side (inference/fleet.py) and the checker script."""
+    from deepspeed_tpu.inference.fleet import FLEET_GAUGES
+    assert checker.FLEET_GAUGES == FLEET_GAUGES
+
+
+def test_rejects_unknown_fleet_gauge(checker):
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "fleet/not_a_gauge",
+         "value": 1.0, "peak": 1.0, "step": 3})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "fleet/breaker_open_replicas",
+         "value": 1.0, "peak": 1.0, "step": 3})
+
+
 def test_comm_ops_in_lockstep(checker):
     """The frozen collective-name vocabulary must stay byte-identical
     between the engine side (comm/comm.py) and the checker script."""
