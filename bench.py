@@ -2567,6 +2567,178 @@ def _worker_overlap(spec):
     print(json.dumps(_overlap_bench(spec)))
 
 
+def _tiered_bench(spec):
+    """Tiered-memory-engine micro-bench (runtime/tiered_store.py): a
+    synthetic layer stack LARGER than a simulated HBM budget streams
+    through host + NVMe tiers behind the schedule-driven prefetch
+    engine.  Asserts the fp32 placement round-trips bit-identical, the
+    int8 placement stays inside the codec's absmax/127 block bound while
+    shrinking the NVMe tier ~4x, the HBM working set respects the budget
+    (evictions fired), the sealed directory fscks COMMITTED, the frozen
+    ``tier/*`` gauge stream schema-validates, and the bench's own rows
+    rehearse the ledger + ds_perf_diff gates."""
+    spec = spec or {}
+    import importlib.util
+    import subprocess as sp
+    import tempfile
+
+    import numpy as np
+
+    from deepspeed_tpu.monitor.telemetry import Telemetry
+    from deepspeed_tpu.runtime import resilience
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.runtime.tiered_store import (PlacementPolicy,
+                                                    PrefetchEngine,
+                                                    TieredStore)
+
+    layers = int(spec.get("layers", 16))
+    hidden = int(spec.get("hidden", 64))
+    passes = int(spec.get("passes", 3))
+    layer_bytes = hidden * hidden * 4
+    # the point of the exercise: the model does NOT fit the device
+    hbm_budget = 3 * layer_bytes
+    model_bytes = layers * layer_bytes
+    assert model_bytes > 4 * hbm_budget
+
+    rng = np.random.default_rng(0)
+    W = [(rng.standard_normal((hidden, hidden)) / np.sqrt(hidden))
+         .astype(np.float32) for _ in range(layers)]
+
+    tmp = tempfile.mkdtemp(prefix="tiered_bench_")
+    tel = Telemetry().configure(TelemetryConfig(
+        {"enabled": True, "output_path": tmp, "job_name": "tiered"}))
+    # patch the store's process-global telemetry hook onto this bench's
+    # sink so publish_gauges lands in our stream
+    import deepspeed_tpu.monitor.telemetry as _telmod
+    _saved = _telmod._telemetry
+    _telmod._telemetry = tel
+
+    def run_store(name, quantize):
+        store = TieredStore(
+            name=name, nvme_dir=tmp,
+            policy=PlacementPolicy(default_tier="nvme",
+                                   quantize=quantize),
+            hbm_budget_bytes=hbm_budget)
+        for i, w in enumerate(W):
+            # alternate host/NVMe so both beyond-HBM tiers carry load
+            store.put(f"L{i}", w, tier="host" if i % 2 else "nvme")
+        store.commit()
+        sched = [[f"L{i}"] for i in range(layers)]
+        eng = PrefetchEngine(store, sched, depth=1)
+        t0 = time.perf_counter()
+        for _ in range(passes):
+            for i in range(layers):
+                eng.access(i, device=True)
+        dur = time.perf_counter() - t0
+        return store, dur
+
+    fp32_store, fp32_s = run_store("bench_fp32", quantize=False)
+    int8_store, int8_s = run_store("bench_int8", quantize=True)
+
+    # fp32: tiers are bit-transparent
+    exact = sum(int(np.array_equal(fp32_store.fetch(f"L{i}"), W[i]))
+                for i in range(layers))
+    assert exact == layers, f"fp32 round trip lost bits: {exact}/{layers}"
+    # int8: error bounded by the codec's per-block scale (absmax/127)
+    int8_max_err, int8_bound = 0.0, 0.0
+    for i, w in enumerate(W):
+        got = int8_store.fetch(f"L{i}")
+        int8_max_err = max(int8_max_err,
+                           float(np.max(np.abs(got - w))))
+        int8_bound = max(int8_bound, float(np.max(np.abs(w))) / 127.0)
+    assert int8_max_err <= int8_bound, (int8_max_err, int8_bound)
+
+    fp32_stats = fp32_store.stats()
+    int8_stats = int8_store.stats()
+    quant_ratio = int8_stats["nvme_bytes"] / max(fp32_stats["nvme_bytes"],
+                                                 1)
+    assert quant_ratio < 0.5, f"int8 tier not smaller: {quant_ratio}"
+    assert fp32_stats["hbm_bytes"] <= hbm_budget, fp32_stats
+    assert fp32_stats["evictions"] > 0, "budget never forced an eviction"
+    assert fp32_stats["prefetch_hits"] > fp32_stats["prefetch_misses"], \
+        fp32_stats
+    committed = sum(
+        int(s.validate()[0] == resilience.COMMITTED)
+        for s in (fp32_store, int8_store))
+    assert committed == 2, "tier dirs did not fsck COMMITTED"
+
+    fp32_store.publish_gauges()
+    int8_store.publish_gauges()
+    tel.close()
+    _telmod._telemetry = _saved
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    scripts_dir = os.path.join(repo, "scripts")
+    sp_ = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(scripts_dir, "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(sp_)
+    sp_.loader.exec_module(checker)
+    stream = os.path.join(tmp, "tiered", "events.jsonl")
+    stream_problems = checker.validate_file(stream)
+    with open(stream) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    tier_gauges = sum(1 for ev in events if ev.get("kind") == "gauge"
+                      and str(ev.get("name", "")).startswith("tier/"))
+    assert tier_gauges >= len(checker.TIER_GAUGES), tier_gauges
+
+    # ledger + perf-diff rehearsal on a scratch ledger (two runs so the
+    # diff has a median to gate against)
+    check_ledger = os.path.join(tmp, "ledger.jsonl")
+    with open(check_ledger, "w") as f:
+        for run in ("run-a", "run-b"):
+            for metric, value in (("fp32_pass_s", fp32_s / passes),
+                                  ("int8_pass_s", int8_s / passes),
+                                  ("quant_ratio", quant_ratio)):
+                f.write(json.dumps(
+                    {"ts": time.time(), "run": run, "bench": "cpu_tiered",
+                     "metric": metric, "value": value}) + "\n")
+
+    def _rc(argv):
+        try:
+            return sp.run([sys.executable] + argv, capture_output=True,
+                          timeout=60).returncode
+        except Exception:
+            return -1
+
+    ledger_gate_rc = _rc([os.path.join(scripts_dir,
+                                       "check_telemetry_schema.py"),
+                          "--ledger", check_ledger])
+    perf_diff_rc = _rc([os.path.join(scripts_dir, "ds_perf_diff.py"),
+                        check_ledger, "--check"])
+    assert ledger_gate_rc == 0, f"--ledger gate rc={ledger_gate_rc}"
+    assert perf_diff_rc == 0, f"ds_perf_diff --check rc={perf_diff_rc}"
+
+    return {
+        "layers": layers,
+        "model_mib": round(model_bytes / 2**20, 3),
+        "hbm_budget_mib": round(hbm_budget / 2**20, 3),
+        "passes": passes,
+        "fp32_pass_s": round(fp32_s / passes, 4),
+        "int8_pass_s": round(int8_s / passes, 4),
+        "fp32_bit_identical_layers": exact,
+        "int8_max_err": round(int8_max_err, 6),
+        "int8_err_bound": round(int8_bound, 6),
+        "quant_ratio": round(quant_ratio, 4),
+        "prefetch_hit_rate": fp32_stats["prefetch_hit_rate"],
+        "evictions": fp32_stats["evictions"],
+        "manifests_committed": committed,
+        "tier_gauges_emitted": tier_gauges,
+        "events_ok": not stream_problems,
+        "ledger_gate_rc": ledger_gate_rc,
+        "perf_diff_rc": perf_diff_rc,
+        "note": "16-layer stack 4x over a simulated HBM budget streamed "
+                "via host+NVMe tiers with depth-1 prefetch: fp32 "
+                "bit-identical, int8 inside the absmax/127 block bound "
+                "at ~4x smaller NVMe tier, dirs sealed COMMITTED, "
+                "tier/* gauges schema-valid",
+    }
+
+
+def _worker_tiered(spec):
+    print(json.dumps(_tiered_bench(spec)))
+
+
 # ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
@@ -2904,6 +3076,26 @@ def _attach_overlap(out):
     return out
 
 
+def _attach_tiered(out):
+    """Attach the tiered-memory micro-bench under the stable key
+    ``cpu_tiered`` (CPU-runnable: layer stack 4x over a simulated HBM
+    budget streamed through host/NVMe tiers, fp32 bit-identical vs int8
+    error-bounded, manifest fsck, tier/* gauges schema-validated, ledger
+    + perf-diff rehearsal).  Budget-gated; a failure is recorded in
+    notes, never fatal."""
+    if _remaining() < 90:
+        return out
+    res, err = _run_worker(
+        "tiered", {},
+        timeout=max(60, min(300, int(_remaining()) - 10)),
+        cpu=True, reserve=20)
+    if res:
+        out["cpu_tiered"] = res
+    else:
+        out.setdefault("notes", {})["tiered"] = (err or "")[:200]
+    return out
+
+
 def _attach_autotune(out):
     """Attach the closed-loop autotuner micro-bench under the stable key
     ``cpu_autotune`` (CPU-runnable: end-to-end tune over a serving knob
@@ -3001,7 +3193,7 @@ def main():
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"backend unavailable: {errors}",
             }
-            print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))))))
+            print(json.dumps(_append_ledger(_attach_tiered(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))))))
             return
 
     on_tpu = probe["platform"] not in ("cpu",)
@@ -3089,7 +3281,7 @@ def main():
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": f"all train attempts failed: {errors}",
         }
-        print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out)))))))))))))))))))
+        print(json.dumps(_append_ledger(_attach_tiered(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(_promote_cached(out))))))))))))))))))))
         return
 
     tps = train["tokens_per_sec"]
@@ -3164,7 +3356,7 @@ def main():
         result = _promote_cached(result)
     else:
         _save_onchip(result)   # cpu_dispatch attaches after: cache stays on-chip-only
-    print(json.dumps(_append_ledger(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result))))))))))))))))))))
+    print(json.dumps(_append_ledger(_attach_tiered(_attach_overlap(_attach_autotune(_attach_step_attr(_attach_incident(_attach_fleet_chaos(_attach_fleet_xproc(_attach_fleet_disagg(_attach_fleet(_attach_compile_churn(_attach_comm_quant(_attach_comm_census(_attach_serving_sched(_attach_serving_slo(_attach_serving_attn(_attach_serving_prefix(_attach_serving(_attach_dispatch(result)))))))))))))))))))))
 
 
 if __name__ == "__main__":
@@ -3219,6 +3411,8 @@ if __name__ == "__main__":
             _worker_autotune(spec)
         elif which == "overlap":
             _worker_overlap(spec)
+        elif which == "tiered":
+            _worker_tiered(spec)
         else:
             raise SystemExit(f"unknown worker {which}")
     else:

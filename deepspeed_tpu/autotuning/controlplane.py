@@ -152,11 +152,28 @@ class ControlPlane:
         if isinstance(page, int) and isinstance(draft, int) and \
                 draft + 1 > page:
             return f"draft_exceeds_page (draft={draft}, page={page})"
+        mem = trial_cfg.get("memory") or {}
+        placement = mem.get("placement_policy")
+        if placement == "nvme" and not mem.get("nvme_dir"):
+            return ("nvme_placement_no_dir (memory.placement_policy="
+                    "'nvme' needs memory.nvme_dir)")
+        if placement == "host" and self.model_num_params:
+            # tiered host state is fp32 master + 2 Adam moments (16 B per
+            # param with grads); a budget it cannot fit needs the NVMe
+            # spill tier behind it
+            budget = int(mem.get("host_budget_bytes") or 0)
+            state_bytes = 16 * int(self.model_num_params)
+            if budget and state_bytes > budget and not mem.get("nvme_dir"):
+                return (f"host_budget (tiered state {state_bytes} > "
+                        f"host budget {budget}, no nvme spill dir)")
         if self.hbm_bytes and self.model_num_params:
             zero = trial_cfg.get("zero_optimization") or {}
             stage = int(zero.get("stage", 0))
             dp = max(1, int(trial_cfg.get("dp", 1)))
-            offload = bool(zero.get("offload_optimizer"))
+            # a host/nvme tier placement moves optimizer state off the
+            # chip exactly like offload_optimizer for the HBM model
+            offload = bool(zero.get("offload_optimizer")) or \
+                placement in ("host", "nvme")
             est = model_memory_per_chip(self.model_num_params, stage, dp,
                                         offload_optimizer=offload)
             observed = self._observed_peak_bytes()

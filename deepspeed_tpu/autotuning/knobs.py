@@ -68,6 +68,28 @@ def comm_quant_block_knob(pad_multiple: Optional[int] = None) -> Knob:
                 values or [256], domain="training")
 
 
+def memory_knobs(nvme_dir: Optional[str] = None) -> List[Knob]:
+    """Tiered-memory engine knobs (``runtime/tiered_store.py``): the
+    default placement tier and the pinned-host budget.  ``nvme`` only
+    enters the placement candidates when the caller declares an
+    ``nvme_dir`` — a placement the store cannot realise is pruned here
+    rather than burned as a trial (the control plane additionally
+    rejects nvme placements whose config carries no dir, and prices
+    host/nvme placements into the ZeRO memory model as offloaded
+    state)."""
+    tiers = ["host", "nvme"] if nvme_dir else ["host"]
+    knobs = [
+        Knob("mem_placement_policy", "memory/placement_policy", tiers,
+             domain="training"),
+        Knob("mem_host_budget_bytes", "memory/host_budget_bytes",
+             [0, 1 << 30, 4 << 30, 16 << 30], domain="training"),
+    ]
+    if nvme_dir:
+        knobs.append(Knob("mem_nvme_dir", "memory/nvme_dir", [nvme_dir],
+                          domain="training"))
+    return knobs
+
+
 def default_training_knobs() -> List[Knob]:
     return [
         Knob("gas", "gradient_accumulation_steps", [1, 2, 4, 8],

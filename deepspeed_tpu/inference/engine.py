@@ -123,12 +123,9 @@ class InferenceEngine:
         # per-layer H2D upload is THE bottleneck of streamed inference —
         # groupwise int8 + scales halves it vs bf16 (reference:
         # ZeRO-Inference composes with ZeroQuant weight quantization for
-        # exactly this reason)
-        if self._quantized and offload.get("device") == "nvme":
-            raise NotImplementedError(
-                "int8 weight streaming supports the cpu tier; the "
-                "NVMe swapper stores flat typed buffers and does not "
-                "carry the per-group scale sidecars yet")
+        # exactly this reason).  int8 composes with NVMe too: the tiered
+        # store keeps qv/qs/qz as separate manifest-listed files, so the
+        # per-group scale sidecars survive the disk round trip.
 
         def host_cast(x):
             x = np.asarray(x)
@@ -160,25 +157,29 @@ class InferenceEngine:
         host_layers = [
             {k: host_leaf(k, v[i]) for k, v in layers.items()}
             for i in range(c.n_layers)]
-        self._nvme_swapper = None
+        self._tiered = None
         if offload.get("device") == "nvme":
-            from deepspeed_tpu.runtime.zero.offload import \
-                PartitionedParamSwapper
-            import os
-            swap_dir = os.path.join(
-                str(offload.get("nvme_path") or "/tmp"),
-                "zero_inference_params")
-            self._nvme_swapper = PartitionedParamSwapper(
-                swap_dir, dtype=np_dtype,
-                buffer_count=int(offload.get("buffer_count", 5)))
+            from deepspeed_tpu.runtime.tiered_store import (PlacementPolicy,
+                                                            TieredStore)
+            # read-only placement over the tiered store: every layer leaf
+            # is one NVMe entry (int8 leaves are {qv,qs,qz} multi-file
+            # entries — the scale sidecars land in the manifest), and the
+            # store seals the directory with the checkpoint protocol's
+            # manifest + marker so ds_ckpt_fsck classifies a torn weight
+            # file before it serves garbage tokens
+            self._tiered = TieredStore(
+                name="zero_inference_params",
+                nvme_dir=str(offload.get("nvme_path") or "/tmp"),
+                policy=PlacementPolicy(default_tier="nvme", read_only=True),
+                aio_config=dict(offload.get("aio") or {}))
             self._layer_keys = [sorted(host_layers[0].keys())] * c.n_layers
             for i, hl in enumerate(host_layers):
                 for k, v in hl.items():
-                    self._nvme_swapper.swap_out(f"L{i}.{k}", v)
-            self._nvme_swapper.release()
+                    self._tiered.put(f"L{i}.{k}", v, tier="nvme")
+            self._tiered.commit()
             self._host_layers = None
             log_dist(f"ZeRO-Inference: {c.n_layers} layers on NVMe at "
-                     f"{swap_dir}", ranks=[0])
+                     f"{self._tiered.nvme_path}", ranks=[0])
         else:
             self._host_layers = host_layers
             log_dist(f"ZeRO-Inference: {c.n_layers} layers in host RAM",
@@ -197,28 +198,33 @@ class InferenceEngine:
         self._jit_embed = None
         self._jit_head = None
 
+    def _layer_entry_keys(self, i):
+        return [f"L{i}.{k}" for k in self._layer_keys[i]]
+
     def _issue_layer_reads(self, i):
         """Queue async NVMe reads for layer ``i`` (they run while the
         device crunches earlier layers)."""
-        if self._nvme_swapper is None or not (0 <= i < self._n_layers):
+        if self._tiered is None or not (0 <= i < self._n_layers):
             return
-        if i not in self._nvme_pending:
-            self._nvme_pending[i] = {
-                k: self._nvme_swapper.swap_in(f"L{i}.{k}", async_op=True)
-                for k in self._layer_keys[i]}
+        self._tiered.prefetch(self._layer_entry_keys(i))
 
     def _fetch_layer(self, i):
         """Host/NVMe → device.  Host path: device_put returns before the
         transfer completes, so it overlaps compute.  NVMe path: reads were
-        issued earlier by ``_issue_layer_reads`` and only synchronized
-        here, after the previous layer's compute was dispatched."""
+        issued earlier by ``_issue_layer_reads`` (a cold fetch is a demand
+        miss the ``tier/*`` gauges expose) and land here, after the
+        previous layer's compute was dispatched."""
         if self._host_layers is not None:
-            host = self._host_layers[i]
-        else:
-            self._issue_layer_reads(i)
-            self._nvme_swapper.synchronize_reads()
-            host = self._nvme_pending.pop(i)
-        return jax.device_put(host)
+            return jax.device_put(self._host_layers[i])
+        keys = self._layer_entry_keys(i)
+        self._issue_layer_reads(i)
+        host = self._tiered.fetch_group(keys)
+        dev = jax.device_put(host)
+        for k in keys:
+            # drop staging caches so host RAM holds at most the prefetch
+            # window, not the model — the NVMe files stay authoritative
+            self._tiered.evict(k)
+        return dev
 
     def _streaming_apply_with_cache(self, input_ids, caches):
         """Layer-streamed twin of ``CausalTransformerLM.apply_with_cache``
@@ -255,7 +261,6 @@ class InferenceEngine:
 
         x, positions = self._jit_embed(self.params, input_ids, start)
         new_caches = []
-        self._nvme_pending = {}
         nxt = self._fetch_layer(0)
         self._issue_layer_reads(1)
         for i in range(self._n_layers):
@@ -267,6 +272,8 @@ class InferenceEngine:
             if i + 1 < self._n_layers:
                 nxt = self._fetch_layer(i + 1)
                 self._issue_layer_reads(i + 2)
+        if self._tiered is not None:
+            self._tiered.publish_gauges()
         return self._jit_head(self.params, x), new_caches
 
     def _streaming_generate(self, input_ids, max_new_tokens):

@@ -85,6 +85,50 @@ class CommQuantizationConfig(DeepSpeedConfigModel):
                     f"(expected a subset of {QUANTIZABLE_VERBS})")
 
 
+class MemoryConfig(DeepSpeedConfigModel):
+    """``"memory"`` top-level block: the tiered-memory engine
+    (``runtime/tiered_store.py``, ZeRO-Infinity-style HBM ⇄ pinned host
+    ⇄ NVMe).  ``placement_policy`` picks the default tier for tensors
+    above ``persistence_threshold`` numel (smaller ones stay
+    device-resident); ``quantize_tiers`` stores float host/NVMe payloads
+    as the PR 15 blockwise-int8 codec with fp32 scale sidecars.  Budgets
+    are bytes; 0 / None disables the bound."""
+    placement_policy = "host"       # resident | host | nvme
+    nvme_dir = None                 # required when any placement is nvme
+    host_budget_bytes = 0           # spill host -> nvme past this
+    hbm_budget_bytes = 0            # evict staged device copies past this
+    persistence_threshold = 0       # numel <= threshold pins to hbm
+    quantize_tiers = False          # int8 payloads on host/nvme tiers
+    quant_block = 256               # codec block (elements per scale)
+    overrides = {}                  # name-prefix -> tier
+    aio = {}                        # AsyncIOHandle kwargs
+
+    def _validate(self):
+        tiers = ("resident", "hbm", "host", "nvme")
+        if self.placement_policy not in tiers:
+            raise ValueError(
+                f"memory.placement_policy must be one of {tiers}, got "
+                f"{self.placement_policy!r}")
+        # "resident" is the user-facing alias for the hbm tier
+        if self.placement_policy == "resident":
+            self.placement_policy = "hbm"
+        for k in ("host_budget_bytes", "hbm_budget_bytes",
+                  "persistence_threshold"):
+            if int(getattr(self, k) or 0) < 0:
+                raise ValueError(f"memory.{k} must be >= 0")
+        if int(self.quant_block) < 8:
+            raise ValueError("memory.quant_block must be >= 8")
+        if self.placement_policy == "nvme" and not self.nvme_dir:
+            raise ValueError(
+                "memory.placement_policy 'nvme' needs memory.nvme_dir")
+        for name, tier in dict(self.overrides or {}).items():
+            t = "hbm" if tier == "resident" else tier
+            if t not in ("hbm", "host", "nvme"):
+                raise ValueError(
+                    f"memory.overrides[{name!r}]: unknown tier {tier!r}")
+            self.overrides[name] = t
+
+
 class CommConfig(DeepSpeedConfigModel):
     """``"comm"`` top-level block (reference accepts ``comm_*`` sections;
     here it holds the wire-codec policy)."""
@@ -502,6 +546,7 @@ class DeepSpeedConfig:
         self.comms_config = CommsConfig(pd.get(C.COMMS_LOGGER, {}))
         self.comm_config = CommConfig(pd.get(C.COMM, {}))
         self.comm_quantization = self.comm_config.quantization
+        self.memory_config = MemoryConfig(pd.get("memory", {}))
         self.telemetry_config = TelemetryConfig(pd.get(C.TELEMETRY, {}))
         self.async_pipeline_config = AsyncPipelineConfig(
             pd.get(C.ASYNC_PIPELINE, {}))
@@ -553,6 +598,8 @@ class DeepSpeedConfig:
         # training engine ignores the block, create_serving_engine()
         # consumes it
         "serving",
+        # tiered-memory engine (runtime/tiered_store.py)
+        "memory",
         # reference top-level keys accepted for config portability but
         # intentionally inert here (amp -> XLA owns mixed precision, the
         # dtype/memory knobs have no TPU analogue); listed so ported

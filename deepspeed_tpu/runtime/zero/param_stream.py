@@ -168,6 +168,20 @@ class HostParamStore:
         mdir = self.moments_nvme_dir
         self.n_layers = len(layer_trees)
 
+        # every plane is catalogued in the tiered store (host tier = RAM,
+        # nvme tier = memmap), so the tier/* gauges price the footprint;
+        # allocation semantics are register_plane's (identical to the old
+        # module-level _alloc)
+        from deepspeed_tpu.runtime.tiered_store import (PlacementPolicy,
+                                                        TieredStore)
+        self.tiered = TieredStore(
+            name="param_stream",
+            policy=PlacementPolicy(default_tier="host"))
+
+        def _alloc(shape, dtype, d, name):
+            return self.tiered.register_plane(name, shape, dtype,
+                                              nvme_dir=d)
+
         host = jax.tree_util.tree_map(np.asarray, resident_tree)
         self.res_layout = FlatLayout(host)
         self.res_master = _alloc((self.res_layout.total,), np.float32,
@@ -499,28 +513,42 @@ class ParamStreamRunner:
             self._pinned[l] = device_put_global(self.store.mirror_tree(l),
                                                 self._layer_shardings[l])
 
-    def _ensure(self, l: int):
+    def _ensure(self, l: int, use: bool = False):
         """Working set for layer ``l`` (device).  Issues the async upload if
-        not already in flight — call early to prefetch, late to use."""
+        not already in flight — call early to prefetch, late to use.
+        ``use=True`` marks the on-critical-path access: the tiered store
+        books it as a prefetch hit (upload already in flight / resident)
+        or a demand miss (the H2D starts now, exposed)."""
         if l < 0 or l >= self.n_layers:
             return None
         if l < self.resident_layers:
+            if use:
+                self.store.tiered.note_prefetch(True)
             return self._pinned[l]
+        if use:
+            self.store.tiered.note_prefetch(l in self._dev)
         if l not in self._dev:
             host = self.store.mirror_tree(l)
             if self._tel.enabled:
                 self._tel.count("param_stream/h2d_calls")
                 self._tel.count("param_stream/h2d_bytes", _tree_bytes(host))
+            t0 = time.perf_counter()
             self._dev[l] = device_put_global(host, self._layer_shardings[l])
+            self.store.tiered.note_transfer(
+                "h2d", _tree_bytes(host), time.perf_counter() - t0)
         return self._dev[l]
 
     def _evict(self, keep: List[int]):
         """Drop streamed working sets not in ``keep`` (refcount drop; XLA
         frees the buffers once their last consumer retires)."""
         keep_s = set(keep)
+        dropped = 0
         for l in list(self._dev):
             if l not in keep_s:
                 del self._dev[l]
+                dropped += 1
+        if dropped:
+            self.store.tiered.note_eviction(dropped)
 
     # -- jitted programs ----------------------------------------------
     def _jit(self, name, fn, **kw):
@@ -727,7 +755,7 @@ class ParamStreamRunner:
             for l in range(L):
                 for k in range(1, bc):       # prefetch bc-1 ahead, under
                     self._ensure(l + k)      # compute (no-op once in flight)
-                params_l = self._ensure(l)
+                params_l = self._ensure(l, use=True)
                 stash[l] = x
                 lrng = (None if self.stacked else
                         (jax.random.fold_in(mrng, l)
@@ -745,7 +773,7 @@ class ParamStreamRunner:
             for l in range(L - 1, -1, -1):
                 for k in range(1, bc):       # prefetch under compute
                     self._ensure(l - k)
-                params_l = self._ensure(l)
+                params_l = self._ensure(l, use=True)
                 lrng = (None if self.stacked else
                         (jax.random.fold_in(mrng, l)
                          if mrng is not None else None))
@@ -784,6 +812,9 @@ class ParamStreamRunner:
                 clip_coef = clip / (grad_norm + 1e-6)
             self._apply_boundary(lr, clip_coef, gas,
                                  pipelined=self.boundary_pipelined)
+        if self._tel.enabled:
+            # tier/* occupancy + hit-rate + bandwidth for this step
+            self.store.tiered.publish_gauges()
         return mean_loss, grad_norm, overflow
 
     def _apply_boundary(self, lr: float, clip_coef: Optional[float],

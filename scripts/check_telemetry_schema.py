@@ -297,6 +297,27 @@ OVERLAP_GAUGES = (
     "comm/overlap/prefetch_depth",
 )
 
+# FROZEN vocabulary of the tiered-memory-engine gauges — must stay
+# byte-identical to ``deepspeed_tpu.runtime.tiered_store.TIER_GAUGES``
+# (the tier-1 test diffs the two).  Occupancy per tier, prefetch
+# hit/miss counters, eviction/writeback counts, achieved bandwidth per
+# transfer path, and int8-tier savings; every gauge event under the
+# ``tier/`` prefix is validated against this tuple.
+TIER_GAUGES = (
+    "tier/hbm_bytes",
+    "tier/host_bytes",
+    "tier/nvme_bytes",
+    "tier/prefetch_hits",
+    "tier/prefetch_misses",
+    "tier/evictions",
+    "tier/writebacks",
+    "tier/h2d_gbps",
+    "tier/d2h_gbps",
+    "tier/nvme_read_gbps",
+    "tier/nvme_write_gbps",
+    "tier/quant_bytes_saved",
+)
+
 # FROZEN vocabulary of the cluster aggregation gauges — must stay
 # byte-identical to ``deepspeed_tpu.monitor.aggregate.CLUSTER_GAUGES``
 # (the tier-1 test diffs the two).
@@ -420,6 +441,10 @@ def validate_event(event):
             not event["name"].startswith("comm/overlap/") and \
             event["name"] not in QUANT_GAUGES:
         problems.append(f"gauge: unknown comm gauge {event['name']!r}")
+    if kind == "gauge" and isinstance(event.get("name"), str) and \
+            event["name"].startswith("tier/") and \
+            event["name"] not in TIER_GAUGES:
+        problems.append(f"gauge: unknown tier gauge {event['name']!r}")
     if kind == "gauge" and isinstance(event.get("name"), str) and \
             event["name"].startswith("step/attr/") and \
             event["name"] not in STEP_ATTR_GAUGES:

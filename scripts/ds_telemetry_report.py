@@ -376,6 +376,7 @@ def summarize(agg):
             "profiling": _profiling_summary(agg),
             "attribution": _attribution_summary(agg),
             "overlap": _overlap_summary(agg),
+            "tiered": _tiered_summary(agg),
             "cluster": _cluster_summary(agg),
             "input_feed": _input_feed_summary(agg),
             "serving": serve_rows,
@@ -581,6 +582,23 @@ def _overlap_summary(agg):
     frac = agg["gauges"].get("step/attr/exposed_comm_frac")
     return {"gauges": rows,
             "exposed_comm_frac": frac["last"] if frac else None}
+
+
+def _tiered_summary(agg):
+    """Tiered-memory-engine digest (runtime/tiered_store.py): the frozen
+    ``tier/*`` gauges — occupancy per tier, prefetch hit rate, transfer
+    bandwidths, eviction/writeback counts, int8-tier savings.  None when
+    the run never touched a tiered store."""
+    rows = {name.split("/", 1)[1]: {"last": g["last"], "peak": g["peak"]}
+            for name, g in sorted(agg["gauges"].items())
+            if name.startswith("tier/")}
+    if not rows:
+        return None
+    hits = (rows.get("prefetch_hits") or {}).get("last") or 0
+    misses = (rows.get("prefetch_misses") or {}).get("last") or 0
+    return {"gauges": rows,
+            "prefetch_hit_rate": (round(hits / (hits + misses), 4)
+                                  if hits + misses else None)}
 
 
 def _cluster_summary(agg):
@@ -929,6 +947,19 @@ def print_tables(summary, out=sys.stdout):
         if ov["exposed_comm_frac"] is not None:
             w(f"exposed comm fraction (step/attr): "
               f"{ov['exposed_comm_frac']}\n")
+        w("\n")
+    tiered = summary.get("tiered")
+    if tiered:
+        w("== tiered memory ==\n")
+        w(f"{'gauge':<20}{'last':>14}{'peak':>14}\n")
+        for name, r in tiered["gauges"].items():
+            last, peak = r["last"], r["peak"]
+            if name.endswith("_bytes") or name == "quant_bytes_saved":
+                last, peak = _fmt_bytes(last), _fmt_bytes(peak)
+            w(f"{name:<20}{last:>14}{peak:>14}\n")
+        if tiered["prefetch_hit_rate"] is not None:
+            w(f"prefetch hit rate: "
+              f"{tiered['prefetch_hit_rate'] * 100:.1f}%\n")
         w("\n")
     feed = summary.get("input_feed")
     if feed:

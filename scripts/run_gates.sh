@@ -362,6 +362,68 @@ EOF
 run_gate "chaos smoke" env JAX_PLATFORMS=cpu "$PY" \
     "$REPO/scripts/ds_chaos.py" --scenarios ack_loss,slow_worker,torn_commit
 
+# 11. tiered-store smoke: a memory config block must build a TieredStore
+# whose quantized NVMe entries carry their scale sidecars, whose sealed
+# directory fscks COMMITTED (and flags a torn payload file as partial),
+# and whose frozen tier/* gauges ride a schema-valid stream
+run_gate "tiered smoke" env JAX_PLATFORMS=cpu REPO="$REPO" "$PY" - <<'EOF'
+import importlib.util, json, os, sys, tempfile
+repo = os.environ["REPO"]
+sys.path.insert(0, repo)
+import numpy as np
+from deepspeed_tpu.monitor import telemetry as telmod
+from deepspeed_tpu.runtime import resilience
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, TelemetryConfig
+from deepspeed_tpu.runtime.tiered_store import TieredStore
+
+tmp = tempfile.mkdtemp(prefix="tiered_gate_")
+cfg = DeepSpeedConfig({
+    "train_batch_size": 1,
+    "memory": {"placement_policy": "nvme", "nvme_dir": tmp,
+               "quantize_tiers": True, "quant_block": 64},
+})
+tel = telmod.get_telemetry().configure(TelemetryConfig(
+    {"enabled": True, "output_path": tmp, "job_name": "tier_gate"}),
+    rank=0)
+store = TieredStore.from_config(cfg.memory_config, name="gate")
+rng = np.random.default_rng(0)
+W = {f"L{i}": rng.standard_normal(256).astype(np.float32)
+     for i in range(4)}
+for k, v in W.items():
+    store.put(k, v)
+store.commit()
+status, manifest = store.validate()
+assert status == resilience.COMMITTED, status
+listed = [f["path"] for f in manifest["files"]]
+assert any(p.endswith(".scales.bin") for p in listed), listed
+for k, v in W.items():
+    got = store.fetch(k)
+    bound = float(np.max(np.abs(v))) / 127.0
+    assert float(np.max(np.abs(got - v))) <= bound
+store.publish_gauges()
+tel.close()
+# torn payload file -> the fsck verdict flips to partial
+victim = os.path.join(store.nvme_path,
+                      next(p for p in listed if p.endswith(".q.bin")))
+with open(victim, "r+b") as f:
+    f.truncate(8)
+assert store.validate()[0] == resilience.PARTIAL
+spec = importlib.util.spec_from_file_location(
+    "checker", os.path.join(repo, "scripts",
+                            "check_telemetry_schema.py"))
+checker = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(checker)
+stream = os.path.join(tmp, "tier_gate", "events.jsonl")
+assert checker.validate_file(stream) == [], "event stream schema-invalid"
+events = [json.loads(l) for l in open(stream) if l.strip()]
+names = {e["name"] for e in events if e.get("kind") == "gauge"
+         and str(e.get("name", "")).startswith("tier/")}
+assert "tier/nvme_bytes" in names and "tier/quant_bytes_saved" in names
+print(f"tiered smoke: memory config -> {len(W)} int8 NVMe entries with "
+      f"manifest-listed scale sidecars, fsck COMMITTED -> torn file "
+      f"flagged partial, {len(names)} tier/* gauges schema-valid")
+EOF
+
 if [ "$fail" -ne 0 ]; then
     echo "GATES: FAIL"
     exit 1

@@ -261,6 +261,52 @@ def test_zero_mem_model_pruning(tmp_path):
     assert summary["best"]["knobs"] == {"stage": 3}
 
 
+def test_memory_placement_pruning(tmp_path):
+    """Tiered-memory placements the store cannot realise are pruned
+    before a trial burns: nvme placement with no nvme_dir, and a host
+    placement whose 16 B/param state overflows host_budget_bytes with
+    no NVMe spill tier behind it."""
+    from deepspeed_tpu.autotuning.knobs import memory_knobs
+    space = KnobSpace(memory_knobs(nvme_dir=None))
+    cp = ControlPlane(base_config={"dp": 1},
+                      knob_space=space, objective=Objective(),
+                      results_dir=str(tmp_path),
+                      model_num_params=1_000_000_000)
+    # 16 GB of tiered host state into a 1 GiB host budget, no nvme_dir
+    assert "host_budget" in cp.prune_reason(
+        {"memory": {"placement_policy": "host",
+                    "host_budget_bytes": 1 << 30}})
+    assert "nvme_placement_no_dir" in cp.prune_reason(
+        {"memory": {"placement_policy": "nvme"}})
+    # an nvme spill dir makes both feasible
+    assert cp.prune_reason(
+        {"memory": {"placement_policy": "host",
+                    "host_budget_bytes": 1 << 30,
+                    "nvme_dir": str(tmp_path)}}) is None
+    assert cp.prune_reason(
+        {"memory": {"placement_policy": "nvme",
+                    "nvme_dir": str(tmp_path)}}) is None
+    # unbudgeted host placement is fine (advisory budget)
+    assert cp.prune_reason(
+        {"memory": {"placement_policy": "host"}}) is None
+
+
+def test_memory_knobs_gate_nvme_on_dir(tmp_path):
+    from deepspeed_tpu.autotuning.knobs import memory_knobs
+    names = {k.name: k for k in memory_knobs()}
+    assert names["mem_placement_policy"].values == ["host"]
+    assert "mem_nvme_dir" not in names
+    names = {k.name: k for k in memory_knobs(nvme_dir=str(tmp_path))}
+    assert names["mem_placement_policy"].values == ["host", "nvme"]
+    assert names["mem_nvme_dir"].values == [str(tmp_path)]
+    frag = KnobSpace(list(names.values())).fragment_for(
+        {"mem_placement_policy": "nvme",
+         "mem_host_budget_bytes": 0,
+         "mem_nvme_dir": str(tmp_path)})
+    assert frag["memory"]["placement_policy"] == "nvme"
+    assert frag["memory"]["nvme_dir"] == str(tmp_path)
+
+
 def test_max_trials_caps_grid(tmp_path):
     space = KnobSpace([Knob("x", "x", [1, 2, 3, 4])])
     cp = ControlPlane(base_config={}, knob_space=space,

@@ -98,6 +98,45 @@ def test_offload_configs():
     assert cfg.zero_config.offload_param_device == "nvme"
 
 
+def test_memory_config_block(tmp_path):
+    """The ``memory`` block builds the tiered-store placement policy:
+    'resident' aliases to hbm, nvme placement requires a directory, and
+    override tiers are validated (with the same alias)."""
+    import pytest
+
+    cfg = DeepSpeedConfig({"train_batch_size": 1,
+                           "memory": {"placement_policy": "resident",
+                                      "overrides": {"L0.": "resident"}}},
+                          world_size=1)
+    assert cfg.memory_config.placement_policy == "hbm"
+    assert cfg.memory_config.overrides == {"L0.": "hbm"}
+    cfg = DeepSpeedConfig({"train_batch_size": 1,
+                           "memory": {"placement_policy": "nvme",
+                                      "nvme_dir": str(tmp_path),
+                                      "quantize_tiers": True}},
+                          world_size=1)
+    assert cfg.memory_config.nvme_dir == str(tmp_path)
+    assert cfg.memory_config.quantize_tiers
+    with pytest.raises(ValueError, match="needs memory.nvme_dir"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "memory": {"placement_policy": "nvme"}},
+                        world_size=1)
+    with pytest.raises(ValueError, match="quant_block"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "memory": {"quant_block": 4}}, world_size=1)
+    with pytest.raises(ValueError, match="unknown tier"):
+        DeepSpeedConfig({"train_batch_size": 1,
+                         "memory": {"overrides": {"x": "tape"}}},
+                        world_size=1)
+    # defaults: advisory host tier, no budgets, fp32 payloads
+    cfg = DeepSpeedConfig({"train_batch_size": 1}, world_size=1)
+    mc = cfg.memory_config
+    assert mc.placement_policy == "host" and not mc.quantize_tiers
+    from deepspeed_tpu.runtime.tiered_store import PlacementPolicy
+    pol = PlacementPolicy.from_config(mc)
+    assert pol.default_tier == "host" and not pol.quantize
+
+
 def test_mesh_section():
     cfg = DeepSpeedConfig({
         "train_batch_size": 8,

@@ -304,3 +304,55 @@ def test_report_cli_aggregates_smoke(tmp_path):
     rep.print_tables(summary, out=buf)
     assert "engine/step" in buf.getvalue()
     assert "all_reduce" in buf.getvalue()
+
+
+def test_report_tiered_memory_table(tmp_path):
+    """tier/* gauges from a TieredStore land in the report's tiered
+    summary (--json key ``tiered``) and its '== tiered memory ==' table,
+    and every emitted event is schema-valid."""
+    from deepspeed_tpu.monitor.telemetry import get_telemetry
+    # the store publishes through the process-global telemetry
+    tel = get_telemetry().configure(
+        TelemetryConfig({"enabled": True, "output_path": str(tmp_path),
+                         "job_name": "tier"}), rank=0)
+    from deepspeed_tpu.runtime.tiered_store import (PlacementPolicy,
+                                                    TieredStore)
+    store = TieredStore(name="t", nvme_dir=str(tmp_path / "nv"),
+                        policy=PlacementPolicy(default_tier="nvme",
+                                               quantize=True))
+    store.put("w", np.random.default_rng(0).standard_normal(
+        512).astype(np.float32))
+    store.prefetch("w")
+    store.fetch("w")
+    store.publish_gauges()
+    tel.close()
+
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "ds_telemetry_report",
+        os.path.join(repo, "scripts", "ds_telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    files = rep.discover_files(str(tmp_path / "tier"))
+    summary = rep.summarize(rep.aggregate(rep.load_events(files)))
+    tiered = summary["tiered"]
+    assert tiered["gauges"]["nvme_bytes"]["last"] > 0
+    assert tiered["prefetch_hit_rate"] == 1.0
+    import io
+    buf = io.StringIO()
+    rep.print_tables(summary, out=buf)
+    assert "== tiered memory ==" in buf.getvalue()
+    assert "nvme_bytes" in buf.getvalue()
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(repo, "scripts", "check_telemetry_schema.py"))
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    problems = []
+    for f in files:
+        with open(f) as fh:
+            problems += list(checker.validate_stream(fh))
+    assert not problems, problems

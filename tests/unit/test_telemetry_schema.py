@@ -109,6 +109,26 @@ def test_overlap_gauge_validation(checker):
          "value": 1.0, "peak": 1.0})
 
 
+def test_tier_gauges_in_lockstep(checker):
+    """The frozen tier/* gauge vocabulary must stay byte-identical
+    between the tiered-memory engine (runtime/tiered_store.py) and the
+    checker."""
+    from deepspeed_tpu.runtime.tiered_store import TIER_GAUGES
+    assert checker.TIER_GAUGES == TIER_GAUGES
+
+
+def test_tier_gauge_validation(checker):
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "tier/nvme_bytes",
+         "value": 4096.0, "peak": 4096.0})
+    assert not checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "tier/prefetch_hits",
+         "value": 7.0, "peak": 7.0})
+    assert checker.validate_event(
+        {"ts": 1.0, "kind": "gauge", "name": "tier/vibes",
+         "value": 1.0, "peak": 1.0})
+
+
 def test_cluster_gauges_in_lockstep(checker):
     """The frozen cluster/* gauge vocabulary must stay byte-identical
     between the aggregator (monitor/aggregate.py) and the checker."""
